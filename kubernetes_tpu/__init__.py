@@ -1,0 +1,26 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+A brand-new framework with the capabilities of Kubernetes (reference: a
+~v1.7/1.8-era tree), re-designed TPU-first.  The organizing idea: instead of
+the reference's per-pod ``scheduleOne`` loop
+(``plugin/pkg/scheduler/scheduler.go:253``), the scheduler drains the pending
+queue, tensorizes cluster state into dense pods x nodes x resources arrays,
+and evaluates filter feasibility masks, scoring, and batched assignment as
+JAX kernels sharded over the node axis of a TPU mesh — while a faithful CPU
+oracle guards binding-for-binding correctness.
+
+Layer map (mirrors SURVEY.md section 1):
+
+- ``api``        — types, Quantity arithmetic, label selectors (L1)
+- ``store``      — revisioned in-memory KV with CAS + watch streams (L0/L2)
+- ``client``     — reflector / informer / workqueue machinery (L5)
+- ``scheduler``  — CPU oracle scheduler + batched TPU backend (L6')
+- ``models``     — tensorized cluster-state snapshots (the NodeInfo analogue)
+- ``ops``        — JAX/Pallas kernels: filters, scores, assignment
+- ``parallel``   — device mesh / sharding utilities
+- ``controllers``— reconciling control loops (L6)
+- ``kubelet``    — hollow node agent for scale testing (L7 analogue)
+- ``utils``      — workqueue-adjacent helpers, metrics, tracing
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,121 @@
+"""Typed client layer over the store.
+
+The capability of the reference's generated clientsets
+(``staging/src/k8s.io/client-go/kubernetes``): typed create/get/list/
+update/delete/watch per kind, plus the two special verbs the control plane
+runs on:
+
+- ``PodClient.bind`` — the Binding subresource
+  (``pkg/registry/core/pod/storage/storage.go:128 BindingREST``): the ONLY
+  way a placement is committed; a CAS update that sets ``spec.nodeName``
+  and fails if the pod is already bound to a different node.
+- ``update_status`` — status subresource semantics (spec untouched).
+
+In-process today (function calls instead of HTTPS+protobuf), but the
+interface is transport-shaped: everything passes through serialization, so
+a wire transport can be slotted under ``Clientset`` without touching
+callers.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Optional, Type
+
+from ..api import types as api
+from ..store.store import Store, Watch
+
+
+class TypedClient:
+    def __init__(self, store: Store, kind: str, cls: Type):
+        self._store = store
+        self.kind = kind
+        self._cls = cls
+
+    def create(self, obj):
+        return self._cls.from_dict(self._store.create(self.kind, obj.to_dict()))
+
+    def get(self, name: str, namespace: str = "default"):
+        return self._cls.from_dict(self._store.get(self.kind, namespace, name))
+
+    def list(self, namespace: Optional[str] = None):
+        dicts, rev = self._store.list(self.kind, namespace)
+        return [self._cls.from_dict(d) for d in dicts], rev
+
+    def update(self, obj):
+        return self._cls.from_dict(self._store.update(self.kind, obj.to_dict()))
+
+    def guaranteed_update(self, name: str, mutate: Callable, namespace: str = "default"):
+        """mutate receives a typed object, returns the new typed object."""
+
+        def _mutate_dict(d: dict) -> dict:
+            return mutate(self._cls.from_dict(d)).to_dict()
+
+        return self._cls.from_dict(
+            self._store.guaranteed_update(self.kind, namespace, name, _mutate_dict)
+        )
+
+    def update_status(self, obj):
+        """Write only .status (+ heartbeat metadata), preserving concurrent
+        spec/label changes, like the /status subresource."""
+        status = obj.to_dict().get("status")
+
+        def _mutate(cur):
+            d = cur.to_dict()
+            d["status"] = copy.deepcopy(status)
+            return self._cls.from_dict(d)
+
+        return self.guaranteed_update(obj.meta.name, _mutate, obj.meta.namespace)
+
+    def delete(self, name: str, namespace: str = "default"):
+        return self._cls.from_dict(self._store.delete(self.kind, namespace, name))
+
+    def watch(self, from_revision: Optional[int] = None) -> Watch:
+        return self._store.watch(self.kind, from_revision)
+
+
+class PodClient(TypedClient):
+    def __init__(self, store: Store):
+        super().__init__(store, "Pod", api.Pod)
+
+    def bind(self, binding: api.Binding) -> api.Pod:
+        """Commit a placement (BindingREST.Create → assignPod →
+        setPodHostAndAnnotations, ``storage.go:141,157,191``)."""
+
+        def _assign(pod: api.Pod) -> api.Pod:
+            if pod.spec.node_name and pod.spec.node_name != binding.node_name:
+                raise BindConflictError(
+                    f"pod {pod.meta.key} already bound to {pod.spec.node_name}"
+                )
+            pod.spec.node_name = binding.node_name
+            return pod
+
+        return self.guaranteed_update(binding.pod_name, _assign, binding.pod_namespace)
+
+
+class BindConflictError(Exception):
+    pass
+
+
+class Clientset:
+    """One handle per kind (``clientset.Interface`` analogue)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self.pods = PodClient(store)
+        self.nodes = TypedClient(store, "Node", api.Node)
+        self.services = TypedClient(store, "Service", api.Service)
+        self.replicasets = TypedClient(store, "ReplicaSet", api.ReplicaSet)
+        self.deployments = TypedClient(store, "Deployment", api.Deployment)
+        self.events = TypedClient(store, "Event", api.Event)
+        self._by_kind = {
+            "Pod": self.pods,
+            "Node": self.nodes,
+            "Service": self.services,
+            "ReplicaSet": self.replicasets,
+            "Deployment": self.deployments,
+            "Event": self.events,
+        }
+
+    def client_for(self, kind: str) -> TypedClient:
+        return self._by_kind[kind]

@@ -1,0 +1,199 @@
+"""Shared informer: LIST+WATCH → local indexed cache → handler fan-out.
+
+The reference's list-watch-cache stack
+(``client-go/tools/cache``: ``reflector.go:239 ListAndWatch``,
+``shared_informer.go:182 Run`` + ``processorListener :537``) collapsed into
+one component: list to seed the cache at a revision, watch from that
+revision, apply deltas to an indexed local store, and fan events out to any
+number of handlers (SURVEY.md P4).
+
+Two drive modes:
+
+- ``start()`` — background thread, production-shaped;
+- ``pump()`` — synchronously drain pending watch events on the caller's
+  thread.  Deterministic tests and single-threaded control loops use this;
+  it is the informer analogue of running the event loop manually.
+
+Objects handed to handlers are shared and MUST NOT be mutated.  With
+``mutation_detector=True`` the informer snapshots each object and panics on
+divergence — the reference's ``KUBE_CACHE_MUTATION_DETECTOR``
+(``tools/cache/mutation_detector.go``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..store.store import ADDED, DELETED, MODIFIED, ExpiredRevisionError, WatchEvent
+from .clientset import TypedClient
+
+
+class Handler:
+    def __init__(
+        self,
+        on_add: Optional[Callable] = None,
+        on_update: Optional[Callable] = None,
+        on_delete: Optional[Callable] = None,
+    ):
+        self.on_add = on_add or (lambda obj: None)
+        self.on_update = on_update or (lambda old, new: None)
+        self.on_delete = on_delete or (lambda obj: None)
+
+
+class SharedInformer:
+    def __init__(self, client: TypedClient, mutation_detector: bool = False):
+        self._client = client
+        self.kind = client.kind
+        self._handlers: list[Handler] = []
+        self._cache: dict[str, object] = {}  # key -> typed object
+        self._mu = threading.RLock()
+        self._synced = threading.Event()
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._mutation_detector = mutation_detector
+        self._snapshots: dict[str, dict] = {}
+        self.last_revision = 0
+
+    # -- registration ------------------------------------------------------
+    def add_handler(self, handler: Handler) -> None:
+        with self._mu:
+            self._handlers.append(handler)
+            if self._synced.is_set():
+                for obj in list(self._cache.values()):
+                    handler.on_add(obj)
+
+    # -- cache reads (the Lister/Indexer surface) --------------------------
+    def get(self, key: str):
+        with self._mu:
+            return self._cache.get(key)
+
+    def list(self) -> list:
+        with self._mu:
+            return list(self._cache.values())
+
+    def keys(self) -> list[str]:
+        with self._mu:
+            return list(self._cache.keys())
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def _seed(self) -> None:
+        objs, rev = self._client.list()
+        with self._mu:
+            self._cache = {o.meta.key: o for o in objs}
+            if self._mutation_detector:
+                self._snapshots = {o.meta.key: o.to_dict() for o in objs}
+            self.last_revision = rev
+            self._watch = self._client.watch(from_revision=rev)
+            handlers = list(self._handlers)
+            objs_now = list(self._cache.values())
+        for h in handlers:
+            for o in objs_now:
+                h.on_add(o)
+        self._synced.set()
+
+    def start(self) -> None:
+        """Seed synchronously, then consume the watch on a daemon thread."""
+        self._seed()
+        self._thread = threading.Thread(target=self._run_loop, daemon=True)
+        self._thread.start()
+
+    def start_manual(self) -> None:
+        """Seed synchronously; caller drives with pump()."""
+        self._seed()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run_loop(self) -> None:
+        while not self._stopped.is_set():
+            ev = self._watch.get(timeout=0.2)
+            if ev is None:
+                continue
+            self._apply(ev)
+
+    def pump(self, max_events: Optional[int] = None) -> int:
+        """Synchronously apply all (or up to max_events) pending events."""
+        if self._watch is None:
+            self._seed()
+        n = 0
+        while max_events is None or n < max_events:
+            ev = self._watch.get(timeout=0)
+            if ev is None:
+                break
+            self._apply(ev)
+            n += 1
+        return n
+
+    # -- delta application -------------------------------------------------
+    def _apply(self, ev: WatchEvent) -> None:
+        obj = self._client._cls.from_dict(ev.object)
+        with self._mu:
+            old = self._cache.get(ev.key)
+            if self._mutation_detector and old is not None:
+                snap = self._snapshots.get(ev.key)
+                if snap is not None and old.to_dict() != snap:
+                    raise CacheMutationError(
+                        f"{self.kind} {ev.key} was mutated in the informer cache"
+                    )
+            if ev.type == DELETED:
+                self._cache.pop(ev.key, None)
+                self._snapshots.pop(ev.key, None)
+            else:
+                self._cache[ev.key] = obj
+                if self._mutation_detector:
+                    self._snapshots[ev.key] = obj.to_dict()
+            self.last_revision = max(self.last_revision, ev.revision)
+            handlers = list(self._handlers)
+        for h in handlers:
+            if ev.type == ADDED:
+                h.on_add(obj)
+            elif ev.type == MODIFIED:
+                h.on_update(old, obj)
+            elif ev.type == DELETED:
+                h.on_delete(old if old is not None else obj)
+
+
+class CacheMutationError(RuntimeError):
+    pass
+
+
+class InformerFactory:
+    """SharedInformerFactory analogue: one informer per kind per factory."""
+
+    def __init__(self, clientset, mutation_detector: bool = False):
+        self._clientset = clientset
+        self._informers: dict[str, SharedInformer] = {}
+        self._mutation_detector = mutation_detector
+
+    def informer(self, kind: str) -> SharedInformer:
+        if kind not in self._informers:
+            self._informers[kind] = SharedInformer(
+                self._clientset.client_for(kind), mutation_detector=self._mutation_detector
+            )
+        return self._informers[kind]
+
+    def start_all(self) -> None:
+        for inf in self._informers.values():
+            if not inf.has_synced():
+                inf.start()
+
+    def start_all_manual(self) -> None:
+        for inf in self._informers.values():
+            if not inf.has_synced():
+                inf.start_manual()
+
+    def pump_all(self) -> int:
+        return sum(inf.pump() for inf in self._informers.values())
+
+    def stop_all(self) -> None:
+        for inf in self._informers.values():
+            inf.stop()

@@ -1,0 +1,148 @@
+"""Rate-limited, deduplicating work queues.
+
+Capability of ``client-go/util/workqueue``: items (keys) are deduped while
+queued, in-flight items that are re-added are re-queued on done(), and
+failures get per-item exponential backoff (``default_rate_limiters.go``).
+This is the spine of every controller (SURVEY.md P3).
+
+The delay machinery is virtual-time-friendly: pass a ``clock`` callable for
+deterministic tests (the reference injects ``util/clock`` the same way).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, Hashable, Optional
+
+
+class ExponentialBackoff:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: dict[Hashable, int] = {}
+        self._mu = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._mu:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base_delay * (2**n), self.max_delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._mu:
+            self._failures.pop(item, None)
+
+    def retries(self, item: Hashable) -> int:
+        with self._mu:
+            return self._failures.get(item, 0)
+
+
+class WorkQueue:
+    """Dedup queue with the add/get/done discipline of ``workqueue.Type``."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._cond = threading.Condition()
+        self._queue: list[Hashable] = []
+        self._dirty: set[Hashable] = set()
+        self._processing: set[Hashable] = set()
+        self._shutdown = False
+        self._clock = clock
+        # delayed adds: heap of (ready_time, seq, item)
+        self._delayed: list[tuple[float, int, Hashable]] = []
+        self._seq = 0
+        self.rate_limiter = ExponentialBackoff()
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # re-queued by done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Hashable) -> None:
+        self.rate_limiter.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self.rate_limiter.retries(item)
+
+    def _drain_delayed_locked(self) -> Optional[float]:
+        """Move ready delayed items into the queue; return wait time to the
+        next delayed item, if any."""
+        now = self._clock()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+        if self._delayed:
+            return max(0.0, self._delayed[0][0] - now)
+        return None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        """Blocking pop; returns None on shutdown or timeout."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                next_delay = self._drain_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._processing.add(item)
+                    self._dirty.discard(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait = next_delay
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def try_get(self) -> Optional[Hashable]:
+        return self.get(timeout=0)
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._cond:
+            self._drain_delayed_locked()
+            return len(self._queue)
+
+    def pending_delayed(self) -> int:
+        with self._cond:
+            return len(self._delayed)
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def is_shutdown(self) -> bool:
+        with self._cond:
+            return self._shutdown

@@ -1,0 +1,14 @@
+"""Revisioned store + watch streams (SURVEY.md L0/L2)."""
+
+from .store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    ExpiredRevisionError,
+    NotFoundError,
+    Store,
+    Watch,
+    WatchEvent,
+)

@@ -1,0 +1,253 @@
+"""Revisioned object store with CAS updates and watch streams.
+
+The capability of the reference's L0+L2 (etcd3 +
+``apiserver/pkg/storage/etcd3/store.go`` + the watch cache
+``storage/cacher.go``) collapsed into one in-process component:
+
+- a single monotonically increasing **revision** counter (etcd
+  ``mod_revision`` analogue) stamped onto every write;
+- **GuaranteedUpdate**: optimistic-concurrency read-modify-write that
+  retries the caller's mutation function on revision conflict
+  (``storage/etcd3/store.go:257``);
+- **watch streams from a revision**: every watcher gets the exact ordered
+  event sequence after its start revision, served from an in-memory event
+  log (the watch-cache sliding window, ``storage/watch_cache.go``) — one
+  writer fans out to any number of watchers (SURVEY.md P4).
+
+Deliberate design point: the store holds **serialized dicts**, never live
+objects, and deep-copies on every get/list/event — informer objects are
+immutable by construction, which is what the reference enforces with its
+cache mutation detector (``client-go/tools/cache/mutation_detector.go``).
+
+The scheduler treats everything device-resident as a disposable cache of
+this store, rebuildable from snapshot + watch replay (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from ..api.meta import new_uid
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class ConflictError(Exception):
+    """CAS failure: the object's resourceVersion changed under the writer."""
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    key: str  # namespace/name
+    revision: int
+    object: dict  # serialized object (deep-copied per consumer)
+
+
+@dataclass
+class _Item:
+    data: dict
+    revision: int
+
+
+class Watch:
+    """One watch stream.  Iterate, or ``stop()`` to end.  Events are
+    delivered in revision order with no gaps from ``start_revision``."""
+
+    def __init__(self, store: "Store", q: "queue.Queue[Optional[WatchEvent]]"):
+        self._store = store
+        self._queue = q
+        self._stopped = threading.Event()
+
+    def stop(self) -> None:
+        if not self._stopped.is_set():
+            self._stopped.set()
+            self._store._remove_watch(self._queue)
+            self._queue.put(None)
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class Store:
+    """In-process strongly-ordered object store (etcd3 + watch-cache analogue)."""
+
+    def __init__(self, event_log_window: int = 100_000):
+        self._mu = threading.RLock()
+        self._rev = 0
+        # kind -> {key -> _Item}
+        self._objects: dict[str, dict[str, _Item]] = {}
+        # ordered event log (the watch-cache window)
+        self._log: list[WatchEvent] = []
+        self._log_window = event_log_window
+        self._watchers: list[tuple[Optional[str], "queue.Queue[Optional[WatchEvent]]"]] = []
+
+    # -- revision ----------------------------------------------------------
+    @property
+    def revision(self) -> int:
+        with self._mu:
+            return self._rev
+
+    def _next_rev(self) -> int:
+        self._rev += 1
+        return self._rev
+
+    # -- writes ------------------------------------------------------------
+    def create(self, kind: str, obj: dict) -> dict:
+        with self._mu:
+            meta = obj.setdefault("metadata", {})
+            key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+            bucket = self._objects.setdefault(kind, {})
+            if key in bucket:
+                raise AlreadyExistsError(f"{kind} {key} already exists")
+            rev = self._next_rev()
+            data = copy.deepcopy(obj)
+            m = data["metadata"]
+            m.setdefault("namespace", "default")
+            if not m.get("uid"):
+                m["uid"] = new_uid()
+            m["resourceVersion"] = rev
+            m["creationRevision"] = rev
+            bucket[key] = _Item(data=data, revision=rev)
+            self._emit(WatchEvent(ADDED, kind, key, rev, copy.deepcopy(data)))
+            return copy.deepcopy(data)
+
+    def update(self, kind: str, obj: dict, expect_rev: Optional[int] = None) -> dict:
+        """CAS write.  ``expect_rev`` defaults to obj.metadata.resourceVersion;
+        pass 0/None there to force-write (last-write-wins)."""
+        with self._mu:
+            meta = obj.get("metadata") or {}
+            key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+            bucket = self._objects.setdefault(kind, {})
+            item = bucket.get(key)
+            if item is None:
+                raise NotFoundError(f"{kind} {key}")
+            if expect_rev is None:
+                expect_rev = int(meta.get("resourceVersion", 0)) or None
+            if expect_rev is not None and item.revision != expect_rev:
+                raise ConflictError(
+                    f"{kind} {key}: expected rev {expect_rev}, have {item.revision}"
+                )
+            rev = self._next_rev()
+            data = copy.deepcopy(obj)
+            m = data["metadata"]
+            m["uid"] = item.data["metadata"]["uid"]
+            m["resourceVersion"] = rev
+            m["creationRevision"] = item.data["metadata"].get("creationRevision", 0)
+            bucket[key] = _Item(data=data, revision=rev)
+            self._emit(WatchEvent(MODIFIED, kind, key, rev, copy.deepcopy(data)))
+            return copy.deepcopy(data)
+
+    def guaranteed_update(
+        self, kind: str, namespace: str, name: str, mutate: Callable[[dict], dict]
+    ) -> dict:
+        """Read-modify-write retry loop (``etcd3/store.go:257``).  ``mutate``
+        receives a deep copy and returns the new object (or raises)."""
+        while True:
+            cur = self.get(kind, namespace, name)
+            new = mutate(copy.deepcopy(cur))
+            try:
+                return self.update(kind, new, expect_rev=int(cur["metadata"]["resourceVersion"]))
+            except ConflictError:
+                continue
+
+    def delete(self, kind: str, namespace: str, name: str, expect_rev: Optional[int] = None) -> dict:
+        with self._mu:
+            key = f"{namespace}/{name}"
+            bucket = self._objects.setdefault(kind, {})
+            item = bucket.get(key)
+            if item is None:
+                raise NotFoundError(f"{kind} {key}")
+            if expect_rev is not None and item.revision != expect_rev:
+                raise ConflictError(f"{kind} {key}")
+            rev = self._next_rev()
+            del bucket[key]
+            final = copy.deepcopy(item.data)
+            final["metadata"]["deletionRevision"] = rev
+            self._emit(WatchEvent(DELETED, kind, key, rev, final))
+            return final
+
+    # -- reads -------------------------------------------------------------
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        with self._mu:
+            item = self._objects.get(kind, {}).get(f"{namespace}/{name}")
+            if item is None:
+                raise NotFoundError(f"{kind} {namespace}/{name}")
+            return copy.deepcopy(item.data)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> tuple[list[dict], int]:
+        """Returns (objects, list_revision) — the revision to start a watch
+        from, exactly the reflector's LIST-then-WATCH contract
+        (``tools/cache/reflector.go:239``)."""
+        with self._mu:
+            out = []
+            for key, item in self._objects.get(kind, {}).items():
+                if namespace is None or key.split("/", 1)[0] == namespace:
+                    out.append(copy.deepcopy(item.data))
+            out.sort(key=lambda d: (d["metadata"]["namespace"], d["metadata"]["name"]))
+            return out, self._rev
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None) -> Watch:
+        """Watch events for ``kind`` (None = all kinds) strictly after
+        ``from_revision`` (None = now).  Raises if the revision has fallen
+        out of the event-log window ("too old resource version" — the
+        reflector then relists)."""
+        with self._mu:
+            q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+            if from_revision is not None and from_revision < self._rev:
+                oldest = self._log[0].revision if self._log else self._rev + 1
+                if from_revision + 1 < oldest:
+                    raise ExpiredRevisionError(
+                        f"revision {from_revision} too old (oldest {oldest})"
+                    )
+                for ev in self._log:
+                    if ev.revision > from_revision and (kind is None or ev.kind == kind):
+                        q.put(
+                            WatchEvent(
+                                ev.type, ev.kind, ev.key, ev.revision, copy.deepcopy(ev.object)
+                            )
+                        )
+            self._watchers.append((kind, q))
+            return Watch(self, q)
+
+    def _remove_watch(self, q) -> None:
+        with self._mu:
+            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+
+    def _emit(self, ev: WatchEvent) -> None:
+        self._log.append(ev)
+        if len(self._log) > self._log_window:
+            del self._log[: len(self._log) - self._log_window]
+        for kind, q in self._watchers:
+            if kind is None or kind == ev.kind:
+                q.put(WatchEvent(ev.type, ev.kind, ev.key, ev.revision, copy.deepcopy(ev.object)))
+
+
+class ExpiredRevisionError(Exception):
+    """Watch window compacted past the requested revision; caller must relist."""

@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device platform so multi-chip sharding
+(mesh tests) executes without TPU hardware; this must be set before jax
+initializes.  Bench runs (bench.py) use the real TPU instead.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

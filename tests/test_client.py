@@ -1,0 +1,147 @@
+import pytest
+
+from kubernetes_tpu.api import Binding, Node, ObjectMeta, Pod, PodSpec
+from kubernetes_tpu.client import (
+    BindConflictError,
+    CacheMutationError,
+    Clientset,
+    Handler,
+    SharedInformer,
+    WorkQueue,
+)
+from kubernetes_tpu.store import Store
+
+
+@pytest.fixture
+def cs():
+    return Clientset(Store())
+
+
+def test_typed_crud(cs):
+    pod = Pod(meta=ObjectMeta(name="p1"))
+    created = cs.pods.create(pod)
+    assert created.meta.uid and created.meta.resource_version == 1
+    got = cs.pods.get("p1")
+    assert got.meta.name == "p1"
+    pods, rev = cs.pods.list()
+    assert len(pods) == 1 and rev == 1
+    cs.pods.delete("p1")
+    assert cs.pods.list()[0] == []
+
+
+def test_bind_commits_node_name(cs):
+    cs.pods.create(Pod(meta=ObjectMeta(name="p1")))
+    cs.pods.bind(Binding(pod_name="p1", node_name="n1"))
+    assert cs.pods.get("p1").spec.node_name == "n1"
+
+
+def test_bind_conflict(cs):
+    cs.pods.create(Pod(meta=ObjectMeta(name="p1")))
+    cs.pods.bind(Binding(pod_name="p1", node_name="n1"))
+    with pytest.raises(BindConflictError):
+        cs.pods.bind(Binding(pod_name="p1", node_name="n2"))
+    # re-binding to the same node is idempotent
+    cs.pods.bind(Binding(pod_name="p1", node_name="n1"))
+
+
+def test_update_status_preserves_spec(cs):
+    cs.pods.create(Pod(meta=ObjectMeta(name="p1")))
+    # concurrent spec write happens first
+    cs.pods.bind(Binding(pod_name="p1", node_name="n1"))
+    stale = Pod(meta=ObjectMeta(name="p1"))
+    stale.status.phase = "Running"
+    cs.pods.update_status(stale)
+    got = cs.pods.get("p1")
+    assert got.spec.node_name == "n1"
+    assert got.status.phase == "Running"
+
+
+def test_informer_seed_and_pump(cs):
+    cs.pods.create(Pod(meta=ObjectMeta(name="p1")))
+    inf = SharedInformer(cs.pods)
+    adds, updates, deletes = [], [], []
+    inf.add_handler(
+        Handler(
+            on_add=lambda o: adds.append(o.meta.name),
+            on_update=lambda old, new: updates.append(new.meta.name),
+            on_delete=lambda o: deletes.append(o.meta.name),
+        )
+    )
+    inf.start_manual()
+    assert inf.has_synced()
+    assert adds == ["p1"]
+
+    cs.pods.create(Pod(meta=ObjectMeta(name="p2")))
+    cs.pods.bind(Binding(pod_name="p1", node_name="n1"))
+    cs.pods.delete("p2")
+    inf.pump()
+    assert adds == ["p1", "p2"]
+    assert updates == ["p1"]
+    assert deletes == ["p2"]
+    assert inf.get("default/p1").spec.node_name == "n1"
+    assert inf.get("default/p2") is None
+
+
+def test_informer_threaded(cs):
+    import time
+
+    inf = SharedInformer(cs.pods)
+    inf.start()
+    cs.pods.create(Pod(meta=ObjectMeta(name="p1")))
+    deadline = time.time() + 2
+    while inf.get("default/p1") is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert inf.get("default/p1") is not None
+    inf.stop()
+
+
+def test_mutation_detector(cs):
+    cs.pods.create(Pod(meta=ObjectMeta(name="p1")))
+    inf = SharedInformer(cs.pods, mutation_detector=True)
+    inf.start_manual()
+    inf.get("default/p1").spec.node_name = "EVIL"
+    cs.pods.create(Pod(meta=ObjectMeta(name="p2")))
+    cs.pods.bind(Binding(pod_name="p1", node_name="n1"))
+    with pytest.raises(CacheMutationError):
+        inf.pump()
+
+
+def test_workqueue_dedup():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+    assert q.get(timeout=0) == "a"
+    assert q.get(timeout=0) == "b"
+    assert q.get(timeout=0) is None
+
+
+def test_workqueue_readd_while_processing():
+    q = WorkQueue()
+    q.add("a")
+    item = q.get(timeout=0)
+    q.add("a")  # while processing → deferred
+    assert q.get(timeout=0) is None
+    q.done(item)
+    assert q.get(timeout=0) == "a"
+
+
+def test_workqueue_rate_limited_backoff():
+    t = {"now": 0.0}
+    q = WorkQueue(clock=lambda: t["now"])
+    q.add_rate_limited("a")
+    assert q.get(timeout=0) is None  # base delay not elapsed
+    t["now"] += 0.01
+    assert q.get(timeout=0) == "a"
+    q.done("a")
+    q.add_rate_limited("a")  # second failure → 2x base delay
+    t["now"] += 0.006
+    assert q.get(timeout=0) is None
+    t["now"] += 0.01
+    assert q.get(timeout=0) == "a"
+    q.done("a")
+    q.forget("a")
+    q.add_rate_limited("a")
+    t["now"] += 0.006
+    assert q.get(timeout=0) == "a"

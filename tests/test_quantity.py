@@ -1,0 +1,69 @@
+from fractions import Fraction
+
+import pytest
+
+from kubernetes_tpu.api.quantity import Quantity
+
+
+def test_parse_plain():
+    assert Quantity("100").value() == 100
+    assert Quantity("0").value() == 0
+    assert Quantity(42).value() == 42
+
+
+def test_parse_milli():
+    assert Quantity("100m").milli_value() == 100
+    assert Quantity("1500m").value() == 2  # rounds up
+    assert Quantity("1500m").milli_value() == 1500
+    assert Quantity("2").milli_value() == 2000
+
+
+def test_parse_binary_suffixes():
+    assert Quantity("1Ki").value() == 1024
+    assert Quantity("128Mi").value() == 128 * 2**20
+    assert Quantity("2Gi").value() == 2 * 2**30
+    assert Quantity("0.5Gi").value() == 2**29
+
+
+def test_parse_decimal_suffixes():
+    assert Quantity("1k").value() == 1000
+    assert Quantity("100M").value() == 100_000_000
+    assert Quantity("1G").value() == 10**9
+
+
+def test_parse_scientific():
+    assert Quantity("1e3").value() == 1000
+    assert Quantity("2.5e2").value() == 250
+    assert Quantity("1E6").value() == 10**6
+
+
+def test_parse_fractional_decimal():
+    assert Quantity("0.1").fraction == Fraction(1, 10)
+    assert Quantity("0.1").milli_value() == 100
+    # value() rounds up like reference Quantity.Value()
+    assert Quantity("0.1").value() == 1
+
+
+def test_negative():
+    assert Quantity("-100m").milli_value() == -100
+
+
+def test_invalid():
+    for bad in ["", "abc", "1.2.3", "100mm", "1Kii"]:
+        with pytest.raises(ValueError):
+            Quantity(bad)
+
+
+def test_arithmetic_and_compare():
+    a = Quantity("500m")
+    b = Quantity("1500m")
+    assert (a + b) == Quantity("2")
+    assert (b - a) == Quantity("1")
+    assert a < b
+    assert Quantity("1Ki") == Quantity(1024)
+    assert Quantity("1Gi") > Quantity("1G")
+
+
+def test_roundtrip_str():
+    for s in ["100m", "2Gi", "1500m", "3"]:
+        assert Quantity(str(Quantity(s))) == Quantity(s)

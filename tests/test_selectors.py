@@ -1,0 +1,80 @@
+from kubernetes_tpu.api.selectors import (
+    DOES_NOT_EXIST,
+    EXISTS,
+    GT,
+    IN,
+    LT,
+    NOT_IN,
+    LabelSelector,
+    NodeSelector,
+    NodeSelectorTerm,
+    Requirement,
+)
+
+
+def test_requirement_in():
+    r = Requirement("env", IN, ["prod", "staging"])
+    assert r.matches({"env": "prod"})
+    assert not r.matches({"env": "dev"})
+    assert not r.matches({})
+
+
+def test_requirement_not_in_missing_key_matches():
+    r = Requirement("env", NOT_IN, ["prod"])
+    assert r.matches({})
+    assert r.matches({"env": "dev"})
+    assert not r.matches({"env": "prod"})
+
+
+def test_requirement_exists():
+    assert Requirement("gpu", EXISTS).matches({"gpu": "yes"})
+    assert not Requirement("gpu", EXISTS).matches({})
+    assert Requirement("gpu", DOES_NOT_EXIST).matches({})
+
+
+def test_requirement_gt_lt():
+    assert Requirement("cores", GT, ["4"]).matches({"cores": "8"})
+    assert not Requirement("cores", GT, ["4"]).matches({"cores": "2"})
+    assert Requirement("cores", LT, ["4"]).matches({"cores": "2"})
+    assert not Requirement("cores", GT, ["4"]).matches({"cores": "abc"})
+
+
+def test_label_selector_combined():
+    s = LabelSelector(
+        match_labels={"app": "web"},
+        match_expressions=[Requirement("tier", IN, ["frontend"])],
+    )
+    assert s.matches({"app": "web", "tier": "frontend"})
+    assert not s.matches({"app": "web", "tier": "backend"})
+    assert not s.matches({"tier": "frontend"})
+
+
+def test_empty_selector_matches_all():
+    assert LabelSelector().matches({"anything": "x"})
+    assert LabelSelector().matches({})
+
+
+def test_node_selector_or_of_terms():
+    ns = NodeSelector(
+        terms=[
+            NodeSelectorTerm([Requirement("zone", IN, ["us-a"])]),
+            NodeSelectorTerm([Requirement("zone", IN, ["us-b"])]),
+        ]
+    )
+    assert ns.matches({"zone": "us-a"})
+    assert ns.matches({"zone": "us-b"})
+    assert not ns.matches({"zone": "us-c"})
+
+
+def test_empty_term_matches_nothing():
+    assert not NodeSelectorTerm([]).matches({"a": "b"})
+
+
+def test_selector_roundtrip():
+    s = LabelSelector(
+        match_labels={"a": "b"},
+        match_expressions=[Requirement("k", NOT_IN, ["v1", "v2"])],
+    )
+    s2 = LabelSelector.from_dict(s.to_dict())
+    assert s2.matches({"a": "b", "k": "v3"})
+    assert not s2.matches({"a": "b", "k": "v1"})

@@ -1,0 +1,144 @@
+import pytest
+
+from kubernetes_tpu.api import Node, ObjectMeta, Pod
+from kubernetes_tpu.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    ExpiredRevisionError,
+    NotFoundError,
+    Store,
+)
+
+
+def make_pod_dict(name, ns="default"):
+    return Pod(meta=ObjectMeta(name=name, namespace=ns)).to_dict()
+
+
+def test_create_assigns_uid_and_revision():
+    s = Store()
+    obj = s.create("Pod", make_pod_dict("p1"))
+    assert obj["metadata"]["uid"]
+    assert obj["metadata"]["resourceVersion"] == 1
+    obj2 = s.create("Pod", make_pod_dict("p2"))
+    assert obj2["metadata"]["resourceVersion"] == 2
+
+
+def test_create_duplicate_fails():
+    s = Store()
+    s.create("Pod", make_pod_dict("p1"))
+    with pytest.raises(AlreadyExistsError):
+        s.create("Pod", make_pod_dict("p1"))
+
+
+def test_get_is_deep_copy():
+    s = Store()
+    s.create("Pod", make_pod_dict("p1"))
+    a = s.get("Pod", "default", "p1")
+    a["spec"]["nodeName"] = "mutated"
+    b = s.get("Pod", "default", "p1")
+    assert b["spec"]["nodeName"] == ""
+
+
+def test_cas_update_conflict():
+    s = Store()
+    obj = s.create("Pod", make_pod_dict("p1"))
+    obj["spec"]["nodeName"] = "n1"
+    s.update("Pod", obj)  # ok at rev 1
+    obj["spec"]["nodeName"] = "n2"
+    with pytest.raises(ConflictError):
+        s.update("Pod", obj)  # still claims rev 1
+
+
+def test_guaranteed_update_retries(monkeypatch):
+    s = Store()
+    s.create("Pod", make_pod_dict("p1"))
+
+    calls = {"n": 0}
+    real_update = s.update
+
+    def flaky_update(kind, obj, expect_rev=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # simulate a concurrent writer landing between read and write
+            raise ConflictError("simulated")
+        return real_update(kind, obj, expect_rev=None)
+
+    monkeypatch.setattr(s, "update", flaky_update)
+
+    def mutate(d):
+        d["spec"]["nodeName"] = "n1"
+        return d
+
+    out = s.guaranteed_update("Pod", "default", "p1", mutate)
+    assert out["spec"]["nodeName"] == "n1"
+    assert calls["n"] == 2
+
+
+def test_delete_and_not_found():
+    s = Store()
+    s.create("Pod", make_pod_dict("p1"))
+    s.delete("Pod", "default", "p1")
+    with pytest.raises(NotFoundError):
+        s.get("Pod", "default", "p1")
+    with pytest.raises(NotFoundError):
+        s.delete("Pod", "default", "p1")
+
+
+def test_list_returns_revision_for_watch():
+    s = Store()
+    s.create("Pod", make_pod_dict("p1"))
+    objs, rev = s.list("Pod")
+    assert len(objs) == 1 and rev == 1
+    s.create("Pod", make_pod_dict("p2"))
+    objs, rev = s.list("Pod")
+    assert len(objs) == 2 and rev == 2
+
+
+def test_watch_from_revision_replays_backlog():
+    s = Store()
+    s.create("Pod", make_pod_dict("p1"))
+    _, rev = s.list("Pod")
+    w = s.watch("Pod", from_revision=rev)
+    s.create("Pod", make_pod_dict("p2"))
+    obj = s.get("Pod", "default", "p2")
+    obj["spec"]["nodeName"] = "n1"
+    s.update("Pod", obj)
+    s.delete("Pod", "default", "p1")
+    evs = [w.get(timeout=1) for _ in range(3)]
+    assert [e.type for e in evs] == [ADDED, MODIFIED, DELETED]
+    assert evs[0].key == "default/p2"
+    assert evs[2].key == "default/p1"
+    w.stop()
+
+
+def test_watch_kind_filtering():
+    s = Store()
+    w = s.watch("Node", from_revision=0)
+    s.create("Pod", make_pod_dict("p1"))
+    s.create("Node", Node(meta=ObjectMeta(name="n1", namespace="")).to_dict())
+    ev = w.get(timeout=1)
+    assert ev.kind == "Node"
+    assert w.get(timeout=0.05) is None
+    w.stop()
+
+
+def test_watch_events_in_revision_order_no_gaps():
+    s = Store()
+    w = s.watch("Pod", from_revision=0)
+    for i in range(10):
+        s.create("Pod", make_pod_dict(f"p{i}"))
+    revs = [w.get(timeout=1).revision for _ in range(10)]
+    assert revs == sorted(revs)
+    assert len(set(revs)) == 10
+    w.stop()
+
+
+def test_expired_revision():
+    s = Store(event_log_window=2)
+    for i in range(5):
+        s.create("Pod", make_pod_dict(f"p{i}"))
+    with pytest.raises(ExpiredRevisionError):
+        s.watch("Pod", from_revision=1)
