@@ -26,16 +26,24 @@ from ..api import types as api
 from ..store.store import Store, Watch
 
 
+# Kinds whose objects live outside any namespace (reference: node is
+# cluster-scoped; its store key is the bare name).
+CLUSTER_SCOPED_KINDS = {"Node"}
+
+
 class TypedClient:
     def __init__(self, store: Store, kind: str, cls: Type):
         self._store = store
         self.kind = kind
         self._cls = cls
+        self.default_namespace = "" if kind in CLUSTER_SCOPED_KINDS else "default"
 
     def create(self, obj):
         return self._cls.from_dict(self._store.create(self.kind, obj.to_dict()))
 
-    def get(self, name: str, namespace: str = "default"):
+    def get(self, name: str, namespace: Optional[str] = None):
+        if namespace is None:
+            namespace = self.default_namespace
         return self._cls.from_dict(self._store.get(self.kind, namespace, name))
 
     def list(self, namespace: Optional[str] = None):
@@ -45,8 +53,10 @@ class TypedClient:
     def update(self, obj):
         return self._cls.from_dict(self._store.update(self.kind, obj.to_dict()))
 
-    def guaranteed_update(self, name: str, mutate: Callable, namespace: str = "default"):
+    def guaranteed_update(self, name: str, mutate: Callable, namespace: Optional[str] = None):
         """mutate receives a typed object, returns the new typed object."""
+        if namespace is None:
+            namespace = self.default_namespace
 
         def _mutate_dict(d: dict) -> dict:
             return mutate(self._cls.from_dict(d)).to_dict()
@@ -67,7 +77,9 @@ class TypedClient:
 
         return self.guaranteed_update(obj.meta.name, _mutate, obj.meta.namespace)
 
-    def delete(self, name: str, namespace: str = "default"):
+    def delete(self, name: str, namespace: Optional[str] = None):
+        if namespace is None:
+            namespace = self.default_namespace
         return self._cls.from_dict(self._store.delete(self.kind, namespace, name))
 
     def watch(self, from_revision: Optional[int] = None) -> Watch:

@@ -138,6 +138,12 @@ class WorkQueue:
         with self._cond:
             return len(self._delayed)
 
+    def delayed_keys(self) -> set:
+        """Items currently waiting in the delay heap (not yet ready)."""
+        with self._cond:
+            self._drain_delayed_locked()
+            return {item for _, _, item in self._delayed}
+
     def shut_down(self) -> None:
         with self._cond:
             self._shutdown = True

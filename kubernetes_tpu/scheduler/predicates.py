@@ -1,0 +1,397 @@
+"""Oracle filter predicates — the feasibility spec.
+
+Capability of the reference's default predicate set
+(``plugin/pkg/scheduler/algorithm/predicates/predicates.go``; registration
+``algorithmprovider/defaults/defaults.go:118-186``).  This module is the
+sequential CPU *oracle*: the behavioral specification that the TPU
+feasibility-mask kernels (``kubernetes_tpu/ops/filters.py``) must reproduce
+bit-for-bit on the canonical fixed-point units.
+
+Each predicate: ``fn(pod, meta, node_info, ctx) -> (ok, reasons)`` where
+``meta`` is per-pod precomputation shared across all nodes (reference
+``predicates/metadata.go``) and ``ctx`` exposes cluster-wide lookups (all
+pods, node-by-name) like the reference's ``PodAffinityChecker`` listers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api import types as api
+from ..api.selectors import matches_simple_selector
+from .nodeinfo import NodeInfo
+from .units import CPU_MILLI, GPU_COUNT, MEM_MIB, STORAGE_MIB, ResourceVec, pod_request_vec
+
+# Failure reasons (predicate name -> human string), mirroring the
+# reference's typed PredicateFailureReasons.
+INSUFFICIENT_CPU = "Insufficient cpu"
+INSUFFICIENT_MEMORY = "Insufficient memory"
+INSUFFICIENT_STORAGE = "Insufficient ephemeral-storage"
+INSUFFICIENT_GPU = "Insufficient nvidia.com/gpu"
+INSUFFICIENT_PODS = "Too many pods"
+NODE_NOT_MATCH_HOST = "node(s) didn't match the requested hostname"
+PORT_CONFLICT = "node(s) didn't have free ports"
+SELECTOR_MISMATCH = "node(s) didn't match node selector"
+TAINT_NOT_TOLERATED = "node(s) had taints that the pod didn't tolerate"
+MEMORY_PRESSURE = "node(s) had memory pressure"
+DISK_PRESSURE = "node(s) had disk pressure"
+DISK_CONFLICT = "node(s) had no available disk"
+MAX_VOLUME_COUNT = "node(s) exceed max volume count"
+AFFINITY_NOT_MATCH = "node(s) didn't satisfy inter-pod (anti)affinity"
+NODE_UNSCHEDULABLE = "node(s) were unschedulable"
+
+
+@dataclass
+class MatchingAntiAffinityTerm:
+    """An existing pod's required anti-affinity term that selects the pod
+    being scheduled (the symmetry set, reference
+    ``getMatchingAntiAffinityTerms`` ``predicates.go:1065,1120``)."""
+
+    term: api.PodAffinityTerm
+    owner_node_labels: dict[str, str]
+
+
+@dataclass
+class PredicateMetadata:
+    """Per-pod precomputation shared across all nodes
+    (``predicates/metadata.go``).  Cheap host-side work done once per pod;
+    the batch tensorizer computes the same things as [P, ...] arrays."""
+
+    pod_request: ResourceVec = field(default_factory=ResourceVec)
+    is_best_effort: bool = False
+    host_ports: list[tuple[str, int]] = field(default_factory=list)
+    matching_anti_affinity_terms: list[MatchingAntiAffinityTerm] = field(default_factory=list)
+
+
+class PredicateContext:
+    """Cluster-wide lookups for cross-node predicates (affinity).
+
+    The pod lists are memoized: one Schedule() call evaluates N nodes
+    against the same snapshot, and rebuilding a 150k-pod list per node
+    would dominate the filter phase (the reference avoids this with
+    predicate metadata, ``predicates/metadata.go``)."""
+
+    def __init__(self, node_info_map: dict[str, NodeInfo]):
+        self.node_info_map = node_info_map
+        self._all_pods: Optional[list[tuple[api.Pod, NodeInfo]]] = None
+        self._all_pods_with_affinity: Optional[list[tuple[api.Pod, NodeInfo]]] = None
+
+    def all_pods_with_affinity(self) -> list[tuple[api.Pod, NodeInfo]]:
+        if self._all_pods_with_affinity is None:
+            self._all_pods_with_affinity = [
+                (p, info)
+                for info in self.node_info_map.values()
+                for p in info.pods_with_affinity
+            ]
+        return self._all_pods_with_affinity
+
+    def all_pods(self) -> list[tuple[api.Pod, NodeInfo]]:
+        if self._all_pods is None:
+            self._all_pods = [
+                (p, info) for info in self.node_info_map.values() for p in info.pods
+            ]
+        return self._all_pods
+
+    def node_labels(self, node_name: str) -> dict[str, str]:
+        info = self.node_info_map.get(node_name)
+        if info is None or info.node is None:
+            return {}
+        return info.node.meta.labels
+
+
+def compute_metadata(pod: api.Pod, ctx: PredicateContext) -> PredicateMetadata:
+    meta = PredicateMetadata(
+        pod_request=pod_request_vec(pod),
+        is_best_effort=pod.qos_class() == api.BEST_EFFORT,
+        host_ports=pod.host_ports(),
+    )
+    # Symmetry set: every existing pod whose required anti-affinity selects
+    # this pod forbids co-location within its term's topology domain.
+    for existing, info in ctx.all_pods_with_affinity():
+        aff = existing.spec.affinity
+        if aff is None or not aff.pod_anti_affinity_required:
+            continue
+        node_labels = info.node.meta.labels if info.node else {}
+        for term in aff.pod_anti_affinity_required:
+            if _pod_matches_term(pod, existing, term):
+                meta.matching_anti_affinity_terms.append(
+                    MatchingAntiAffinityTerm(term=term, owner_node_labels=node_labels)
+                )
+    return meta
+
+
+def _pod_matches_term(candidate: api.Pod, term_owner: api.Pod, term: api.PodAffinityTerm) -> bool:
+    """Does ``candidate`` fall in the term's namespace+selector scope?
+    (reference ``priorityutil.PodMatchesTermsNamespaceAndSelector``)"""
+    namespaces = term.namespaces or [term_owner.meta.namespace]
+    if candidate.meta.namespace not in namespaces:
+        return False
+    if term.selector is None:
+        return False
+    return term.selector.matches(candidate.meta.labels)
+
+
+def _same_topology(labels_a: dict[str, str], labels_b: dict[str, str], key: str) -> bool:
+    """reference ``priorityutil.NodesHaveSameTopologyKey``: both nodes carry
+    the key and the values are equal."""
+    if not key:
+        return False
+    return key in labels_a and key in labels_b and labels_a[key] == labels_b[key]
+
+
+# ---------------------------------------------------------------------------
+# GeneralPredicates (predicates.go:900): resources + host + ports + selector
+# ---------------------------------------------------------------------------
+
+
+def pod_fits_resources(pod, meta: PredicateMetadata, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``PodFitsResources`` (:556): requested + pod <= allocatable
+    per resource, plus the pod-count dimension."""
+    reasons = []
+    if len(info.pods) + 1 > info.allocatable_pods:
+        reasons.append(INSUFFICIENT_PODS)
+    req = meta.pod_request
+    checks = (
+        (CPU_MILLI, INSUFFICIENT_CPU),
+        (MEM_MIB, INSUFFICIENT_MEMORY),
+        (STORAGE_MIB, INSUFFICIENT_STORAGE),
+        (GPU_COUNT, INSUFFICIENT_GPU),
+    )
+    for slot, reason in checks:
+        if req[slot] > 0 and info.requested[slot] + req[slot] > info.allocatable[slot]:
+            reasons.append(reason)
+    return (not reasons), reasons
+
+
+def pod_fits_host(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``PodFitsHost`` (:698)."""
+    if not pod.spec.node_name:
+        return True, []
+    ok = info.node is not None and pod.spec.node_name == info.node.meta.name
+    return ok, ([] if ok else [NODE_NOT_MATCH_HOST])
+
+
+def pod_fits_host_ports(pod, meta: PredicateMetadata, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``PodFitsHostPorts`` (:859)."""
+    for port in meta.host_ports:
+        if port in info.used_ports:
+            return False, [PORT_CONFLICT]
+    return True, []
+
+
+def pod_matches_node_selector(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``PodMatchNodeSelector`` (:686) =
+    ``podMatchesNodeLabels``: spec.nodeSelector AND required node affinity."""
+    if info.node is None:
+        return False, [SELECTOR_MISMATCH]
+    labels = info.node.meta.labels
+    if pod.spec.node_selector and not matches_simple_selector(pod.spec.node_selector, labels):
+        return False, [SELECTOR_MISMATCH]
+    aff = pod.spec.affinity
+    if aff is not None and aff.node_affinity_required is not None:
+        # nil terms list matches nothing is handled by NodeSelector.matches
+        if not aff.node_affinity_required.matches(labels):
+            return False, [SELECTOR_MISMATCH]
+    return True, []
+
+
+def general_predicates(pod, meta, info, ctx) -> tuple[bool, list[str]]:
+    reasons: list[str] = []
+    for fn in (pod_fits_resources, pod_fits_host, pod_fits_host_ports, pod_matches_node_selector):
+        ok, r = fn(pod, meta, info, ctx)
+        reasons.extend(r)
+    return (not reasons), reasons
+
+
+# ---------------------------------------------------------------------------
+# Taints / node conditions
+# ---------------------------------------------------------------------------
+
+
+def pod_tolerates_node_taints(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``PodToleratesNodeTaints`` (:1241): only NoSchedule and
+    NoExecute taints matter; every such taint must be tolerated."""
+    if info.node is None:
+        return True, []
+    for taint in info.node.spec.taints:
+        if taint.effect not in (api.NO_SCHEDULE, api.NO_EXECUTE):
+            continue
+        if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return False, [TAINT_NOT_TOLERATED]
+    return True, []
+
+
+def check_node_memory_pressure(pod, meta: PredicateMetadata, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``CheckNodeMemoryPressurePredicate`` (:1274): only
+    BestEffort pods are blocked by memory pressure."""
+    if not meta.is_best_effort:
+        return True, []
+    if info.memory_pressure:
+        return False, [MEMORY_PRESSURE]
+    return True, []
+
+
+def check_node_disk_pressure(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``CheckNodeDiskPressurePredicate`` (:1296): blocks all pods."""
+    if info.disk_pressure:
+        return False, [DISK_PRESSURE]
+    return True, []
+
+
+def check_node_schedulable(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """spec.unschedulable gate (reference enforces this in the node lister
+    filter, ``factory.go``'s scheduled-node predicate; kept explicit here)."""
+    if info.node is not None and info.node.spec.unschedulable:
+        return False, [NODE_UNSCHEDULABLE]
+    return True, []
+
+
+# ---------------------------------------------------------------------------
+# Volumes
+# ---------------------------------------------------------------------------
+
+# Disk kinds that allow co-location when every reference is read-only
+# (reference NoDiskConflict: GCE PD and ISCSI allow all-read-only sharing;
+# EBS and RBD never share — predicates.go:121-183).
+_READONLY_SHARED_KINDS = {"gce-pd", "iscsi"}
+
+VOLUME_COUNT_LIMITS = {
+    "aws-ebs": 39,  # DefaultMaxEBSVolumes
+    "gce-pd": 16,  # DefaultMaxGCEPDVolumes
+    "azure-disk": 16,
+}
+
+
+def no_disk_conflict(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    for vol in pod.spec.volumes:
+        if not vol.disk_id:
+            continue
+        for existing in info.pods:
+            for evol in existing.spec.volumes:
+                if evol.disk_id != vol.disk_id or evol.disk_kind != vol.disk_kind:
+                    continue
+                if vol.disk_kind in _READONLY_SHARED_KINDS and vol.read_only and evol.read_only:
+                    continue
+                return False, [DISK_CONFLICT]
+    return True, []
+
+
+def max_volume_count(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """reference ``MaxPDVolumeCountChecker`` (:215): per attachable-disk
+    kind, distinct volumes already on the node plus the pod's new ones must
+    not exceed the kind's limit."""
+    for kind, limit in VOLUME_COUNT_LIMITS.items():
+        pod_vols = {v.disk_id for v in pod.spec.volumes if v.disk_kind == kind and v.disk_id}
+        if not pod_vols:
+            continue
+        node_vols = set()
+        for existing in info.pods:
+            for evol in existing.spec.volumes:
+                if evol.disk_kind == kind and evol.disk_id:
+                    node_vols.add(evol.disk_id)
+        if len(node_vols | pod_vols) > limit:
+            return False, [MAX_VOLUME_COUNT]
+    return True, []
+
+
+# ---------------------------------------------------------------------------
+# Inter-pod affinity / anti-affinity (the reference's hot spot,
+# predicates.go:982 MatchInterPodAffinity)
+# ---------------------------------------------------------------------------
+
+
+def match_inter_pod_affinity(pod, meta: PredicateMetadata, info: NodeInfo, ctx: PredicateContext) -> tuple[bool, list[str]]:
+    if info.node is None:
+        return False, [AFFINITY_NOT_MATCH]
+    node_labels = info.node.meta.labels
+
+    # 1. Symmetry: existing pods' required anti-affinity must not be broken
+    #    (satisfiesExistingPodsAntiAffinity, predicates.go:1146).
+    for mt in meta.matching_anti_affinity_terms:
+        if not mt.term.topology_key:
+            return False, [AFFINITY_NOT_MATCH]
+        if _same_topology(node_labels, mt.owner_node_labels, mt.term.topology_key):
+            return False, [AFFINITY_NOT_MATCH]
+
+    aff = pod.spec.affinity
+    if aff is None or (not aff.pod_affinity_required and not aff.pod_anti_affinity_required):
+        return True, []
+
+    all_pods = None  # lazily fetched
+
+    # 2. The pod's own required affinity terms
+    #    (satisfiesPodsAffinityAntiAffinity, predicates.go:1181).
+    for term in aff.pod_affinity_required:
+        if not term.topology_key:
+            return False, [AFFINITY_NOT_MATCH]
+        if all_pods is None:
+            all_pods = ctx.all_pods()
+        term_matches = False
+        matching_pod_exists = False
+        for existing, existing_info in all_pods:
+            if not _pod_matches_term(existing, pod, term):
+                continue
+            matching_pod_exists = True
+            existing_labels = existing_info.node.meta.labels if existing_info.node else {}
+            if _same_topology(node_labels, existing_labels, term.topology_key):
+                term_matches = True
+                break
+        if not term_matches:
+            # First-pod rule (predicates.go:1196-1216): if no pod anywhere
+            # matches the term but the pod matches its own term, disregard.
+            if matching_pod_exists:
+                return False, [AFFINITY_NOT_MATCH]
+            if not _pod_matches_term(pod, pod, term):
+                return False, [AFFINITY_NOT_MATCH]
+
+    # 3. The pod's own required anti-affinity terms.
+    for term in aff.pod_anti_affinity_required:
+        if not term.topology_key:
+            return False, [AFFINITY_NOT_MATCH]
+        if all_pods is None:
+            all_pods = ctx.all_pods()
+        for existing, existing_info in all_pods:
+            if not _pod_matches_term(existing, pod, term):
+                continue
+            existing_labels = existing_info.node.meta.labels if existing_info.node else {}
+            if _same_topology(node_labels, existing_labels, term.topology_key):
+                return False, [AFFINITY_NOT_MATCH]
+
+    return True, []
+
+
+# ---------------------------------------------------------------------------
+# Registry — the default predicate set, in a fixed evaluation order
+# (order affects only failure reasons, not feasibility).
+# ---------------------------------------------------------------------------
+
+PredicateFn = Callable[[api.Pod, PredicateMetadata, NodeInfo, PredicateContext], tuple[bool, list[str]]]
+
+DEFAULT_PREDICATES: dict[str, PredicateFn] = {
+    "CheckNodeSchedulable": check_node_schedulable,
+    "NoDiskConflict": no_disk_conflict,
+    "MaxVolumeCount": max_volume_count,
+    "GeneralPredicates": general_predicates,
+    "PodToleratesNodeTaints": pod_tolerates_node_taints,
+    "CheckNodeMemoryPressure": check_node_memory_pressure,
+    "CheckNodeDiskPressure": check_node_disk_pressure,
+    "MatchInterPodAffinity": match_inter_pod_affinity,
+}
+
+
+def pod_fits_on_node(
+    pod: api.Pod,
+    meta: PredicateMetadata,
+    info: NodeInfo,
+    ctx: PredicateContext,
+    predicates: Optional[dict[str, PredicateFn]] = None,
+) -> tuple[bool, list[str]]:
+    """Run every predicate (``podFitsOnNode``, ``core/generic_scheduler.go:234``)
+    — all of them, collecting every failure reason, like the reference."""
+    reasons: list[str] = []
+    for fn in (predicates or DEFAULT_PREDICATES).values():
+        ok, r = fn(pod, meta, info, ctx)
+        if not ok:
+            reasons.extend(r)
+    return (not reasons), reasons

@@ -1,0 +1,127 @@
+"""Pending-pod FIFO queue + per-pod scheduling backoff.
+
+Capability of the reference's ``podQueue *cache.FIFO``
+(``factory/factory.go:75,140``; blocking pop ``getNextPod :782``) and
+``util/backoff_utils.go:86 PodBackoff`` (1s initial, 60s max, exponential).
+
+Extra over the reference (the batch seam): ``drain(max_n)`` pops every
+currently-pending pod at once — the TPU backend schedules the whole drained
+batch in one device program instead of one ``pop()`` per iteration.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..api import types as api
+from ..client.workqueue import WorkQueue
+
+
+class PodBackoff:
+    def __init__(
+        self,
+        initial: float = 1.0,
+        max_duration: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.initial = initial
+        self.max_duration = max_duration
+        self._clock = clock
+        self._entries: dict[str, tuple[float, float]] = {}  # key -> (backoff, last_update)
+        self._mu = threading.Lock()
+
+    def get_backoff(self, pod_key: str) -> float:
+        """Returns the duration to wait; doubles for next time
+        (reference ``getBackoff``)."""
+        with self._mu:
+            backoff, _ = self._entries.get(pod_key, (self.initial, 0.0))
+            next_backoff = min(backoff * 2, self.max_duration)
+            self._entries[pod_key] = (next_backoff, self._clock())
+            return backoff
+
+    def forget(self, pod_key: str) -> None:
+        with self._mu:
+            self._entries.pop(pod_key, None)
+
+    def gc(self, max_age: float = 600.0) -> None:
+        with self._mu:
+            now = self._clock()
+            for k in [k for k, (_, t) in self._entries.items() if now - t > max_age]:
+                del self._entries[k]
+
+
+class SchedulingQueue:
+    """FIFO of pending pods, deduped by key, with delayed re-adds.
+
+    A thin pod-object layer over :class:`~kubernetes_tpu.client.workqueue.
+    WorkQueue` (one blocking/dedup/delay implementation in the codebase):
+    the workqueue carries keys, this class carries the pod objects.  A key
+    whose pod was removed may linger in the workqueue; pops skip such
+    phantoms, and ``__len__`` counts live pods only."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._wq = WorkQueue(clock=clock)
+        self._mu = threading.Lock()
+        self._pods: dict[str, api.Pod] = {}
+        self._clock = clock
+
+    def add(self, pod: api.Pod) -> None:
+        with self._mu:
+            self._pods[pod.meta.key] = pod
+        self._wq.add(pod.meta.key)
+
+    def add_after(self, pod: api.Pod, delay: float) -> None:
+        with self._mu:
+            self._pods[pod.meta.key] = pod
+        self._wq.add_after(pod.meta.key, delay)
+
+    def update(self, pod: api.Pod) -> None:
+        with self._mu:
+            if pod.meta.key in self._pods:
+                self._pods[pod.meta.key] = pod
+
+    def remove(self, pod_key: str) -> None:
+        with self._mu:
+            self._pods.pop(pod_key, None)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[api.Pod]:
+        """Blocking FIFO pop (``getNextPod``)."""
+        deadline = None if timeout is None else self._clock() + timeout
+        while True:
+            remaining = None if deadline is None else max(0.0, deadline - self._clock())
+            key = self._wq.get(timeout=remaining)
+            if key is None:
+                return None
+            self._wq.done(key)
+            with self._mu:
+                pod = self._pods.pop(key, None)
+            if pod is not None:
+                return pod
+            # phantom (removed while queued): keep draining
+
+    def drain(self, max_n: Optional[int] = None) -> list[api.Pod]:
+        """Pop every currently-ready pod in FIFO order — the batch seam."""
+        out: list[api.Pod] = []
+        while max_n is None or len(out) < max_n:
+            pod = self.pop(timeout=0.0)
+            if pod is None:
+                break
+            out.append(pod)
+        return out
+
+    def __len__(self) -> int:
+        with self._mu:
+            live = set(self._pods)
+        # live pods that are ready (not still in the delay heap)
+        delayed = self._wq.delayed_keys()
+        return len([k for k in live if k not in delayed])
+
+    def pending_delayed(self) -> int:
+        delayed = self._wq.delayed_keys()
+        with self._mu:
+            return len([k for k in delayed if k in self._pods])
+
+    def close(self) -> None:
+        self._wq.shut_down()

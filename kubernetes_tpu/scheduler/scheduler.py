@@ -1,0 +1,268 @@
+"""The scheduler daemon: watch wiring, the scheduleOne loop, and the batch seam.
+
+Capability of ``plugin/pkg/scheduler/scheduler.go`` +
+``factory/factory.go:120 NewConfigFactory``:
+
+- informers feed the scheduler cache (bound/assumed pods, nodes) and the
+  pending queue (unscheduled pods) — factory.go:140,188-199,391-520;
+- ``schedule_one`` (scheduler.go:253): pop → snapshot → schedule → assume →
+  bind, with failure → backoff re-enqueue (MakeDefaultErrorFunc,
+  factory.go:718) and assumed-pod TTL expiry self-healing;
+- Scheduled / FailedScheduling events (scheduler.go:174,248) and the three
+  latency SLIs (metrics/metrics.go).
+
+The TPU path: ``schedule_pending_batch`` drains the whole queue and hands
+the batch to a pluggable ``backend`` (``kubernetes_tpu/ops/backend.py``),
+generalizing the reference's 1-deep assume/bind pipeline (SURVEY.md P9) to
+batch depth.  The oracle path stays available both as the correctness
+reference and as the fallback when a batch member's bind CAS fails.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..api import types as api
+from ..client.clientset import BindConflictError, Clientset
+from ..client.informer import Handler, InformerFactory
+from ..store.store import NotFoundError
+from ..utils.metrics import SchedulerMetrics
+from ..utils.trace import Trace
+from .generic_scheduler import FitError, GenericScheduler
+from .nodeinfo import NodeInfo, SchedulerCache
+from .priorities import PriorityContext
+from .queue import PodBackoff, SchedulingQueue
+
+logger = logging.getLogger("kubernetes_tpu.scheduler")
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+
+def _is_scheduler_pod(pod: api.Pod, name: str) -> bool:
+    return pod.spec.scheduler_name == name and pod.status.phase in (api.PENDING, api.RUNNING)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        clientset: Clientset,
+        algorithm: Optional[GenericScheduler] = None,
+        backend=None,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        assume_ttl: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        emit_events: bool = True,
+    ):
+        self.clientset = clientset
+        self.algorithm = algorithm or GenericScheduler()
+        self.backend = backend  # TPU batch backend (ops/backend.py) or None
+        self.scheduler_name = scheduler_name
+        self.cache = SchedulerCache(ttl=assume_ttl, clock=clock)
+        self.queue = SchedulingQueue(clock=clock)
+        self.backoff = PodBackoff(clock=clock)
+        self.metrics = SchedulerMetrics()
+        self.emit_events = emit_events
+        self._clock = clock
+        self._snapshot: dict[str, NodeInfo] = {}
+        self._event_seq = 0
+
+        self.informers = InformerFactory(clientset)
+        self._wire_informers()
+
+    # -- informer wiring (factory.go:140-520) ------------------------------
+    def _wire_informers(self) -> None:
+        pods = self.informers.informer("Pod")
+        pods.add_handler(
+            Handler(
+                on_add=self._on_pod_add,
+                on_update=self._on_pod_update,
+                on_delete=self._on_pod_delete,
+            )
+        )
+        nodes = self.informers.informer("Node")
+        nodes.add_handler(
+            Handler(
+                on_add=lambda n: self.cache.add_node(n),
+                on_update=lambda old, new: self.cache.update_node(new),
+                on_delete=lambda n: self.cache.remove_node(n.meta.name),
+            )
+        )
+        # services/replicasets: cache-only informers for spreading priorities
+        self.informers.informer("Service")
+        self.informers.informer("ReplicaSet")
+
+    def _on_pod_add(self, pod: api.Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.add_pod(pod)
+        elif _is_scheduler_pod(pod, self.scheduler_name):
+            self.queue.add(pod)
+
+    def _on_pod_update(self, old: api.Pod, new: api.Pod) -> None:
+        if new.spec.node_name:
+            if old is not None and old.spec.node_name:
+                self.cache.update_pod(old, new)
+            else:
+                self.queue.remove(new.meta.key)
+                self.cache.add_pod(new)
+        else:
+            if _is_scheduler_pod(new, self.scheduler_name):
+                self.queue.update(new)
+            else:
+                # pod became terminal (Failed/Succeeded) or changed scheduler
+                # while pending: drop it from the queue
+                self.queue.remove(new.meta.key)
+
+    def _on_pod_delete(self, pod: api.Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.remove_pod(pod)
+        else:
+            self.queue.remove(pod.meta.key)
+
+    def start(self, manual: bool = True) -> None:
+        """Seed informers.  manual=True (tests, bench) → caller pumps;
+        manual=False → informer threads run the watch loops."""
+        if manual:
+            self.informers.start_all_manual()
+        else:
+            self.informers.start_all()
+
+    def pump(self) -> int:
+        return self.informers.pump_all()
+
+    # -- snapshot ----------------------------------------------------------
+    def snapshot(self) -> dict[str, NodeInfo]:
+        """Generation-checked CoW refresh (cache.go:79)."""
+        self.cache.snapshot_into(self._snapshot)
+        return self._snapshot
+
+    def priority_context(self, snapshot: dict[str, NodeInfo]) -> PriorityContext:
+        services = self.informers.informer("Service").list()
+        replicasets = self.informers.informer("ReplicaSet").list()
+        return PriorityContext(snapshot, services=services, replicasets=replicasets)
+
+    # -- events / SLIs -----------------------------------------------------
+    def _event(self, pod: api.Pod, etype: str, reason: str, message: str) -> None:
+        if not self.emit_events:
+            return
+        self._event_seq += 1
+        try:
+            self.clientset.events.create(
+                api.Event(
+                    meta=api.ObjectMeta(
+                        name=f"{pod.meta.name}.{self._event_seq}", namespace=pod.meta.namespace
+                    ),
+                    involved_kind="Pod",
+                    involved_key=pod.meta.key,
+                    reason=reason,
+                    message=message,
+                    type=etype,
+                )
+            )
+        except Exception:  # events are best-effort (reference: rate-limited drops)
+            logger.debug("event emit failed", exc_info=True)
+
+    # -- bind + failure handling ------------------------------------------
+    def _bind(self, pod: api.Pod, node_name: str) -> bool:
+        start = self._clock()
+        try:
+            self.clientset.pods.bind(
+                api.Binding(
+                    pod_namespace=pod.meta.namespace, pod_name=pod.meta.name, node_name=node_name
+                )
+            )
+        except (BindConflictError, NotFoundError) as e:
+            logger.warning("bind failed for %s: %s", pod.meta.key, e)
+            self.cache.forget_pod(pod)
+            self._event(pod, "Warning", "FailedBinding", str(e))
+            return False
+        self.metrics.binding_latency.observe((self._clock() - start) * 1e6)
+        self.cache.finish_binding(pod.meta.key)
+        self._event(pod, "Normal", "Scheduled", f"Successfully assigned {pod.meta.key} to {node_name}")
+        return True
+
+    def handle_schedule_failure(self, pod: api.Pod, err: Exception) -> None:
+        """MakeDefaultErrorFunc (factory.go:718): re-enqueue with backoff."""
+        self.metrics.schedule_failures.inc()
+        self._event(pod, "Warning", "FailedScheduling", str(err))
+        delay = self.backoff.get_backoff(pod.meta.key)
+        self.queue.add_after(pod, delay)
+
+    # -- the per-pod oracle loop (scheduler.go:253) ------------------------
+    def schedule_one(self, timeout: Optional[float] = 0.0, async_bind: bool = False) -> bool:
+        pod = self.queue.pop(timeout=timeout)
+        if pod is None:
+            return False
+        start = self._clock()
+        trace = Trace(f"Scheduling {pod.meta.key}", clock=self._clock)
+        self.metrics.schedule_attempts.inc()
+        snapshot = self.snapshot()
+        trace.step("snapshot")
+        try:
+            algo_start = self._clock()
+            result = self.algorithm.schedule(pod, snapshot, self.priority_context(snapshot))
+            self.metrics.scheduling_algorithm_latency.observe((self._clock() - algo_start) * 1e6)
+        except FitError as e:
+            self.handle_schedule_failure(pod, e)
+            return True
+        trace.step("schedule")
+        self.cache.assume_pod(pod, result.node_name)
+        self.backoff.forget(pod.meta.key)
+        if async_bind:
+            threading.Thread(target=self._bind, args=(pod, result.node_name), daemon=True).start()
+        else:
+            self._bind(pod, result.node_name)
+        trace.step("bind")
+        self.metrics.e2e_scheduling_latency.observe((self._clock() - start) * 1e6)
+        trace.log_if_long(0.1)
+        return True
+
+    def run_pending(self, max_pods: Optional[int] = None, pump_every: int = 100) -> int:
+        """Drive schedule_one until the queue drains (test/bench harness)."""
+        n = 0
+        while (max_pods is None or n < max_pods) and len(self.queue) > 0:
+            if not self.schedule_one(timeout=0.0):
+                break
+            n += 1
+            if n % pump_every == 0:
+                self.pump()
+        self.pump()
+        return n
+
+    # -- the batch TPU path ------------------------------------------------
+    def schedule_pending_batch(self, max_batch: Optional[int] = None) -> tuple[int, int]:
+        """Drain the queue, schedule the whole batch on the backend, then
+        assume+bind each result in pod order.  Returns (bound, failed)."""
+        if self.backend is None:
+            raise RuntimeError("no batch backend configured")
+        pods = self.queue.drain(max_batch)
+        if not pods:
+            return (0, 0)
+        self.metrics.batch_size.observe(len(pods))
+        start = self._clock()
+        snapshot = self.snapshot()
+        pctx = self.priority_context(snapshot)
+        algo_start = self._clock()
+        assignments = self.backend.schedule_batch(pods, snapshot, pctx)
+        self.metrics.batch_device_latency.observe((self._clock() - algo_start) * 1e6)
+        bound = failed = 0
+        for pod, node_name in zip(pods, assignments):
+            self.metrics.schedule_attempts.inc()
+            if node_name is None:
+                self.handle_schedule_failure(pod, FitError(pod, {}))
+                failed += 1
+                continue
+            self.cache.assume_pod(pod, node_name)
+            self.backoff.forget(pod.meta.key)
+            if self._bind(pod, node_name):
+                bound += 1
+            else:
+                failed += 1
+            self.metrics.e2e_scheduling_latency.observe((self._clock() - start) * 1e6)
+        return (bound, failed)
+
+    # -- housekeeping ------------------------------------------------------
+    def cleanup(self) -> list[str]:
+        return self.cache.cleanup_expired()

@@ -1,0 +1,144 @@
+"""Canonical fixed-point units — the scheduler's numeric spec.
+
+The reference computes scheduling math on arbitrary-precision Quantities
+(int64 milli-values) on the CPU.  A TPU kernel computes in int32/float32
+lanes.  To make "identical bindings" a *testable bit-exact property* instead
+of an approximation, this framework defines ONE canonical fixed-point
+representation used by BOTH the CPU oracle and the TPU kernels:
+
+- cpu               → integer millicores          (``Quantity.milli_value``)
+- memory            → integer MiB, rounded up
+- ephemeral-storage → integer MiB, rounded up
+- nvidia.com/gpu    → integer count
+- pods              → integer count
+
+All scores are integers 0..10 per priority function (the reference's
+``MaxPriority``, ``plugin/pkg/scheduler/api/types.go``), combined by integer
+weighted sum; fractional intermediates use 10-bit fixed point (x*1024//y).
+Every operation fits comfortably in int32 — exactly what the TPU VPU
+computes natively — so oracle scores and kernel scores are equal by
+construction, not by tolerance.
+
+Rounding deviates from the reference only at sub-MiB granularity (the
+reference divides raw bytes); that is this framework's documented spec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..api import types as api
+from ..api.quantity import Quantity
+
+# Resource-vector slot layout, shared by the oracle (NodeInfo) and the
+# tensorizer (models/snapshot).  Order matters: it is the R axis of every
+# [N, R] / [P, R] array on device.
+CPU_MILLI = 0
+MEM_MIB = 1
+STORAGE_MIB = 2
+GPU_COUNT = 3
+NUM_RESOURCES = 4
+
+RESOURCE_SLOTS = {
+    api.CPU: CPU_MILLI,
+    api.MEMORY: MEM_MIB,
+    api.EPHEMERAL_STORAGE: STORAGE_MIB,
+    api.GPU: GPU_COUNT,
+}
+
+MAX_PRIORITY = 10  # reference schedulerapi.MaxPriority
+FIXED_POINT_ONE = 1024  # 10-bit fixed-point scale for fractions
+
+# Priorities score against *non-zero* requests: containers with no request
+# count as 100 millicores / 200 MiB (reference
+# ``algorithm/priorities/util/non_zero.go:29-43`` DefaultMilliCpuRequest /
+# DefaultMemoryRequest = 200MB; canonicalized here to MiB).
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEM_MIB_REQUEST = 200
+
+MIB = 2**20
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def quantity_to_slot_units(slot: int, q: Quantity) -> int:
+    """Canonicalize one Quantity into its slot's integer unit."""
+    if slot == CPU_MILLI:
+        return q.milli_value()
+    if slot in (MEM_MIB, STORAGE_MIB):
+        f = q.fraction
+        return _ceil_div(f.numerator, f.denominator * MIB)
+    return q.value()
+
+
+@dataclass
+class ResourceVec:
+    """Fixed-size integer resource vector (one row of the [*, R] tensors)."""
+
+    units: list[int]
+
+    def __init__(self, units: "list[int] | None" = None):
+        self.units = list(units) if units is not None else [0] * NUM_RESOURCES
+
+    @classmethod
+    def from_resource_list(cls, rl: dict[str, Quantity]) -> "ResourceVec":
+        v = cls()
+        for name, q in rl.items():
+            slot = RESOURCE_SLOTS.get(name)
+            if slot is not None:
+                v.units[slot] += quantity_to_slot_units(slot, q)
+        return v
+
+    def add(self, other: "ResourceVec") -> None:
+        for i in range(NUM_RESOURCES):
+            self.units[i] += other.units[i]
+
+    def sub(self, other: "ResourceVec") -> None:
+        for i in range(NUM_RESOURCES):
+            self.units[i] -= other.units[i]
+
+    def copy(self) -> "ResourceVec":
+        return ResourceVec(self.units)
+
+    def __getitem__(self, slot: int) -> int:
+        return self.units[slot]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ResourceVec) and self.units == other.units
+
+    def __repr__(self) -> str:
+        return f"ResourceVec(cpu_m={self.units[0]}, mem_mib={self.units[1]}, storage_mib={self.units[2]}, gpu={self.units[3]})"
+
+
+def pod_request_vec(pod: api.Pod) -> ResourceVec:
+    """Raw summed container requests in canonical units (predicate side;
+    reference ``predicates.GetResourceRequest``)."""
+    v = ResourceVec()
+    for c in pod.spec.containers:
+        v.add(ResourceVec.from_resource_list(c.resources.requests))
+    return v
+
+
+def pod_nonzero_request_vec(pod: api.Pod) -> ResourceVec:
+    """Summed container requests with per-container cpu/mem defaults for
+    empty requests (priority side; reference ``priorities/util/non_zero.go``)."""
+    v = ResourceVec()
+    for c in pod.spec.containers:
+        cv = ResourceVec.from_resource_list(c.resources.requests)
+        if cv.units[CPU_MILLI] == 0:
+            cv.units[CPU_MILLI] = DEFAULT_MILLI_CPU_REQUEST
+        if cv.units[MEM_MIB] == 0:
+            cv.units[MEM_MIB] = DEFAULT_MEM_MIB_REQUEST
+        v.add(cv)
+    return v
+
+
+def node_allocatable_vec(node: api.Node) -> ResourceVec:
+    return ResourceVec.from_resource_list(node.status.allocatable)
+
+
+def node_allocatable_pods(node: api.Node) -> int:
+    q = node.status.allocatable.get(api.PODS)
+    return q.value() if q is not None else 110

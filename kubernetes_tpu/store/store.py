@@ -33,6 +33,12 @@ from typing import Callable, Iterator, Optional
 
 from ..api.meta import new_uid
 
+def object_key(namespace: str, name: str) -> str:
+    """Canonical store/informer key — MUST match ``ObjectMeta.key``:
+    cluster-scoped objects (empty namespace) use the bare name."""
+    return f"{namespace}/{name}" if namespace else name
+
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
@@ -121,7 +127,7 @@ class Store:
     def create(self, kind: str, obj: dict) -> dict:
         with self._mu:
             meta = obj.setdefault("metadata", {})
-            key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+            key = object_key(meta.get("namespace", "default"), meta.get("name", ""))
             bucket = self._objects.setdefault(kind, {})
             if key in bucket:
                 raise AlreadyExistsError(f"{kind} {key} already exists")
@@ -142,7 +148,7 @@ class Store:
         pass 0/None there to force-write (last-write-wins)."""
         with self._mu:
             meta = obj.get("metadata") or {}
-            key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+            key = object_key(meta.get("namespace", "default"), meta.get("name", ""))
             bucket = self._objects.setdefault(kind, {})
             item = bucket.get(key)
             if item is None:
@@ -178,7 +184,7 @@ class Store:
 
     def delete(self, kind: str, namespace: str, name: str, expect_rev: Optional[int] = None) -> dict:
         with self._mu:
-            key = f"{namespace}/{name}"
+            key = object_key(namespace, name)
             bucket = self._objects.setdefault(kind, {})
             item = bucket.get(key)
             if item is None:
@@ -195,7 +201,7 @@ class Store:
     # -- reads -------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> dict:
         with self._mu:
-            item = self._objects.get(kind, {}).get(f"{namespace}/{name}")
+            item = self._objects.get(kind, {}).get(object_key(namespace, name))
             if item is None:
                 raise NotFoundError(f"{kind} {namespace}/{name}")
             return copy.deepcopy(item.data)
@@ -207,7 +213,8 @@ class Store:
         with self._mu:
             out = []
             for key, item in self._objects.get(kind, {}).items():
-                if namespace is None or key.split("/", 1)[0] == namespace:
+                ns = item.data["metadata"].get("namespace", "")
+                if namespace is None or ns == namespace:
                     out.append(copy.deepcopy(item.data))
             out.sort(key=lambda d: (d["metadata"]["namespace"], d["metadata"]["name"]))
             return out, self._rev

@@ -1,0 +1,4 @@
+"""Cross-cutting helpers: metrics, tracing (SURVEY.md §5)."""
+
+from .metrics import Counter, Gauge, Histogram, Registry, SchedulerMetrics
+from .trace import Trace

@@ -1,0 +1,148 @@
+"""Prometheus-style metrics primitives.
+
+Capability of the vendored Prometheus client as the reference uses it:
+counters and histograms with labels, a process-global registry, and a text
+exposition dump.  The scheduler's three SLIs
+(``plugin/pkg/scheduler/metrics/metrics.go:26-50``) are predefined below;
+the e2e SLO checks read exactly these (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Optional
+
+# reference metrics.go buckets: 1ms .. ~1000s exponential (in microseconds)
+_DEFAULT_BUCKETS = [1e3 * (2**i) for i in range(20)]
+
+
+class Histogram:
+    def __init__(self, name: str, help: str = "", buckets: Optional[list[float]] = None):
+        self.name = name
+        self.help = help
+        self.buckets = sorted(buckets or _DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._mu = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._mu:
+            i = bisect.bisect_left(self.buckets, value)
+            self._counts[i] += 1
+            self._sum += value
+            self._total += 1
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket boundaries (upper bound)."""
+        with self._mu:
+            if self._total == 0:
+                return 0.0
+            target = q * self._total
+            acc = 0
+            for i, c in enumerate(self._counts):
+                acc += c
+                if acc >= target:
+                    return self.buckets[i] if i < len(self.buckets) else float("inf")
+            return float("inf")
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        acc = 0
+        for b, c in zip(self.buckets, self._counts):
+            acc += c
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._total}')
+        lines.append(f"{self.name}_sum {self._sum}")
+        lines.append(f"{self.name}_count {self._total}")
+        return "\n".join(lines)
+
+
+class Counter:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._mu = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._mu:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} counter\n"
+            f"{self.name} {self._value}"
+        )
+
+
+class Gauge:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value}"
+        )
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._mu = threading.Lock()
+
+    def register(self, metric):
+        with self._mu:
+            self._metrics[metric.name] = metric
+        return metric
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def expose(self) -> str:
+        with self._mu:
+            return "\n".join(m.expose() for m in self._metrics.values()) + "\n"
+
+
+class SchedulerMetrics:
+    """The reference's three scheduling SLIs, in microseconds
+    (``metrics/metrics.go:26-50``), plus batch-backend extras."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.e2e_scheduling_latency = r.register(
+            Histogram("scheduler_e2e_scheduling_latency_microseconds")
+        )
+        self.scheduling_algorithm_latency = r.register(
+            Histogram("scheduler_scheduling_algorithm_latency_microseconds")
+        )
+        self.binding_latency = r.register(
+            Histogram("scheduler_binding_latency_microseconds")
+        )
+        self.schedule_attempts = r.register(Counter("scheduler_schedule_attempts_total"))
+        self.schedule_failures = r.register(Counter("scheduler_schedule_failures_total"))
+        # batch-backend extras
+        self.batch_size = r.register(Histogram("scheduler_batch_size", buckets=[2**i for i in range(20)]))
+        self.batch_device_latency = r.register(
+            Histogram("scheduler_batch_device_latency_microseconds")
+        )

@@ -1,0 +1,38 @@
+"""Poor-man's spans: step-timestamped traces logged only when slow.
+
+Capability of the reference's ``utiltrace.Trace``
+(``apiserver/pkg/util/trace/trace.go``): the scheduler wraps every Schedule
+call with a 100ms threshold (``generic_scheduler.go:89-90``)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+
+class Trace:
+    def __init__(self, name: str, clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self._clock = clock
+        self._start = clock()
+        self._steps: list[tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self._steps.append((self._clock(), msg))
+
+    def total(self) -> float:
+        return self._clock() - self._start
+
+    def log_if_long(self, threshold: float) -> None:
+        total = self.total()
+        if total < threshold:
+            return
+        lines = [f'Trace "{self.name}" (total {total * 1e3:.1f}ms):']
+        prev = self._start
+        for t, msg in self._steps:
+            lines.append(f"  +{(t - prev) * 1e3:.1f}ms {msg}")
+            prev = t
+        logger.info("\n".join(lines))

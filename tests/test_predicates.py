@@ -1,0 +1,355 @@
+"""Table-driven predicate tests — the behavioral spec, modeled on the
+reference's ``algorithm/predicates/predicates_test.go``."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    Affinity,
+    LabelSelector,
+    NodeCondition,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    Volume,
+)
+from kubernetes_tpu.api.selectors import NodeSelector, NodeSelectorTerm, Requirement
+from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+from kubernetes_tpu.scheduler.predicates import (
+    PredicateContext,
+    compute_metadata,
+    pod_fits_on_node,
+)
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def build(nodes_with_pods):
+    """[(node, [pods])] -> node_info_map"""
+    m = {}
+    for node, pods in nodes_with_pods:
+        info = NodeInfo(node)
+        for p in pods:
+            p.spec.node_name = node.meta.name
+            info.add_pod(p)
+        m[node.meta.name] = info
+    return m
+
+
+def fits(pod, node_name, node_info_map):
+    ctx = PredicateContext(node_info_map)
+    meta = compute_metadata(pod, ctx)
+    ok, reasons = pod_fits_on_node(pod, meta, node_info_map[node_name], ctx)
+    return ok, reasons
+
+
+# -- resources --------------------------------------------------------------
+
+
+def test_fits_resources_ok():
+    m = build([(make_node("n1", cpu="2", memory="4Gi"), [])])
+    ok, _ = fits(make_pod("p", cpu="1", memory="2Gi"), "n1", m)
+    assert ok
+
+
+def test_insufficient_cpu():
+    m = build([(make_node("n1", cpu="2"), [make_pod("e", cpu="1500m")])])
+    ok, reasons = fits(make_pod("p", cpu="1"), "n1", m)
+    assert not ok and "Insufficient cpu" in reasons
+
+
+def test_insufficient_memory():
+    m = build([(make_node("n1", memory="1Gi"), [])])
+    ok, reasons = fits(make_pod("p", memory="2Gi"), "n1", m)
+    assert not ok and "Insufficient memory" in reasons
+
+
+def test_zero_request_always_fits_resources():
+    m = build([(make_node("n1", cpu="1", memory="1Gi"), [make_pod("e", cpu="1", memory="1Gi")])])
+    ok, _ = fits(make_pod("p"), "n1", m)
+    assert ok  # no requests -> fits (only pod count limits)
+
+
+def test_pod_count_limit():
+    node = make_node("n1", pods=2)
+    m = build([(node, [make_pod("e1"), make_pod("e2")])])
+    ok, reasons = fits(make_pod("p"), "n1", m)
+    assert not ok and "Too many pods" in reasons
+
+
+def test_gpu_extended_resource():
+    m = build([(make_node("n1", gpu=2), [make_pod("e", gpu=2)])])
+    ok, reasons = fits(make_pod("p", gpu=1), "n1", m)
+    assert not ok and "Insufficient nvidia.com/gpu" in reasons
+
+
+def test_exact_fit_boundary():
+    # requested + pod == allocatable must fit (reference: > fails, == fits)
+    m = build([(make_node("n1", cpu="2"), [make_pod("e", cpu="1")])])
+    ok, _ = fits(make_pod("p", cpu="1"), "n1", m)
+    assert ok
+
+
+# -- host / ports / selector -----------------------------------------------
+
+
+def test_pod_fits_host():
+    m = build([(make_node("n1"), []), (make_node("n2"), [])])
+    pod = make_pod("p")
+    pod.spec.node_name = "n2"
+    ok, _ = fits(pod, "n2", m)
+    assert ok
+    ok, reasons = fits(pod, "n1", m)
+    assert not ok and "node(s) didn't match the requested hostname" in reasons
+
+
+def test_host_port_conflict():
+    m = build([(make_node("n1"), [make_pod("e", host_ports=[8080])])])
+    ok, reasons = fits(make_pod("p", host_ports=[8080]), "n1", m)
+    assert not ok and "node(s) didn't have free ports" in reasons
+    ok, _ = fits(make_pod("q", host_ports=[8081]), "n1", m)
+    assert ok
+
+
+def test_node_selector():
+    m = build([(make_node("n1", labels={"disk": "ssd"}), [])])
+    ok, _ = fits(make_pod("p", node_selector={"disk": "ssd"}), "n1", m)
+    assert ok
+    ok, reasons = fits(make_pod("q", node_selector={"disk": "hdd"}), "n1", m)
+    assert not ok and "node(s) didn't match node selector" in reasons
+
+
+def test_required_node_affinity():
+    m = build([(make_node("n1", labels={"zone": "a"}), [])])
+    aff = Affinity(
+        node_affinity_required=NodeSelector(
+            terms=[NodeSelectorTerm([Requirement("zone", "In", ["b", "c"])])]
+        )
+    )
+    ok, reasons = fits(make_pod("p", affinity=aff), "n1", m)
+    assert not ok and "node(s) didn't match node selector" in reasons
+
+
+# -- taints / conditions ----------------------------------------------------
+
+
+def test_taint_not_tolerated():
+    node = make_node("n1", taints=[Taint(key="k", value="v", effect="NoSchedule")])
+    m = build([(node, [])])
+    ok, reasons = fits(make_pod("p"), "n1", m)
+    assert not ok and "node(s) had taints that the pod didn't tolerate" in reasons
+
+
+def test_taint_tolerated():
+    node = make_node("n1", taints=[Taint(key="k", value="v", effect="NoSchedule")])
+    m = build([(node, [])])
+    pod = make_pod("p", tolerations=[Toleration(key="k", operator="Equal", value="v")])
+    ok, _ = fits(pod, "n1", m)
+    assert ok
+
+
+def test_prefer_no_schedule_taint_ignored_by_predicate():
+    node = make_node("n1", taints=[Taint(key="k", value="v", effect="PreferNoSchedule")])
+    m = build([(node, [])])
+    ok, _ = fits(make_pod("p"), "n1", m)
+    assert ok
+
+
+def test_exists_toleration_tolerates_all_values():
+    node = make_node("n1", taints=[Taint(key="k", value="anything", effect="NoSchedule")])
+    m = build([(node, [])])
+    pod = make_pod("p", tolerations=[Toleration(key="k", operator="Exists")])
+    ok, _ = fits(pod, "n1", m)
+    assert ok
+
+
+def test_memory_pressure_blocks_besteffort_only():
+    node = make_node(
+        "n1",
+        conditions=[
+            NodeCondition(type="Ready", status="True"),
+            NodeCondition(type="MemoryPressure", status="True"),
+        ],
+    )
+    m = build([(node, [])])
+    ok, reasons = fits(make_pod("be"), "n1", m)  # no requests -> BestEffort
+    assert not ok and "node(s) had memory pressure" in reasons
+    ok, _ = fits(make_pod("burstable", cpu="100m"), "n1", m)
+    assert ok
+
+
+def test_disk_pressure_blocks_all():
+    node = make_node(
+        "n1",
+        conditions=[
+            NodeCondition(type="Ready", status="True"),
+            NodeCondition(type="DiskPressure", status="True"),
+        ],
+    )
+    m = build([(node, [])])
+    ok, reasons = fits(make_pod("p", cpu="100m"), "n1", m)
+    assert not ok and "node(s) had disk pressure" in reasons
+
+
+def test_unschedulable_node():
+    m = build([(make_node("n1", unschedulable=True), [])])
+    ok, reasons = fits(make_pod("p"), "n1", m)
+    assert not ok and "node(s) were unschedulable" in reasons
+
+
+# -- volumes ----------------------------------------------------------------
+
+
+def test_disk_conflict_ebs():
+    existing = make_pod("e", volumes=[Volume(name="v", disk_id="vol-1", disk_kind="aws-ebs")])
+    m = build([(make_node("n1"), [existing])])
+    pod = make_pod("p", volumes=[Volume(name="v", disk_id="vol-1", disk_kind="aws-ebs")])
+    ok, reasons = fits(pod, "n1", m)
+    assert not ok and "node(s) had no available disk" in reasons
+
+
+def test_gce_pd_readonly_sharing():
+    existing = make_pod(
+        "e", volumes=[Volume(name="v", disk_id="pd-1", disk_kind="gce-pd", read_only=True)]
+    )
+    m = build([(make_node("n1"), [existing])])
+    ro = make_pod("p", volumes=[Volume(name="v", disk_id="pd-1", disk_kind="gce-pd", read_only=True)])
+    ok, _ = fits(ro, "n1", m)
+    assert ok
+    rw = make_pod("q", volumes=[Volume(name="v", disk_id="pd-1", disk_kind="gce-pd")])
+    ok, _ = fits(rw, "n1", m)
+    assert not ok
+
+
+def test_max_volume_count():
+    existing = [
+        make_pod(
+            f"e{i}",
+            volumes=[Volume(name="v", disk_id=f"pd-{i}", disk_kind="gce-pd", read_only=True)],
+        )
+        for i in range(16)
+    ]
+    m = build([(make_node("n1", pods=200), existing)])
+    pod = make_pod("p", volumes=[Volume(name="v", disk_id="pd-new", disk_kind="gce-pd")])
+    ok, reasons = fits(pod, "n1", m)
+    assert not ok and "node(s) exceed max volume count" in reasons
+    # an already-present volume doesn't count twice (read-only sharing, so
+    # NoDiskConflict stays quiet and only the count rule is exercised)
+    pod2 = make_pod(
+        "q", volumes=[Volume(name="v", disk_id="pd-3", disk_kind="gce-pd", read_only=True)]
+    )
+    ok, _ = fits(pod2, "n1", m)
+    assert ok
+
+
+# -- inter-pod affinity -----------------------------------------------------
+
+
+def _zone_nodes():
+    na = make_node("na", labels={"zone": "a", "kubernetes.io/hostname": "na"})
+    nb = make_node("nb", labels={"zone": "b", "kubernetes.io/hostname": "nb"})
+    return na, nb
+
+
+def test_required_pod_affinity_matches_topology():
+    na, nb = _zone_nodes()
+    backend = make_pod("backend", labels={"app": "db"})
+    m = build([(na, [backend]), (nb, [])])
+    aff = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "db"}), topology_key="zone"
+            )
+        ]
+    )
+    pod = make_pod("web", affinity=aff)
+    ok, _ = fits(pod, "na", m)
+    assert ok
+    ok, reasons = fits(pod, "nb", m)
+    assert not ok and "node(s) didn't satisfy inter-pod (anti)affinity" in reasons
+
+
+def test_first_pod_self_match_rule():
+    na, nb = _zone_nodes()
+    m = build([(na, []), (nb, [])])
+    aff = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "db"}), topology_key="zone"
+            )
+        ]
+    )
+    # pod matches its own affinity term and no other pod matches anywhere
+    pod = make_pod("db-0", labels={"app": "db"}, affinity=aff)
+    ok, _ = fits(pod, "na", m)
+    assert ok
+    # pod does NOT match its own term -> blocked
+    pod2 = make_pod("web", labels={"app": "web"}, affinity=aff)
+    ok, _ = fits(pod2, "na", m)
+    assert not ok
+
+
+def test_required_anti_affinity():
+    na, nb = _zone_nodes()
+    existing = make_pod("db-0", labels={"app": "db"})
+    m = build([(na, [existing]), (nb, [])])
+    aff = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "db"}), topology_key="zone"
+            )
+        ]
+    )
+    pod = make_pod("db-1", labels={"app": "db"}, affinity=aff)
+    ok, _ = fits(pod, "na", m)
+    assert not ok
+    ok, _ = fits(pod, "nb", m)
+    assert ok
+
+
+def test_anti_affinity_symmetry():
+    # existing pod has anti-affinity against app=web; incoming web pod must
+    # not land in its topology even though the incoming pod has no affinity.
+    na, nb = _zone_nodes()
+    aff = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "web"}), topology_key="zone"
+            )
+        ]
+    )
+    existing = make_pod("lonely", labels={"app": "db"}, affinity=aff)
+    m = build([(na, [existing]), (nb, [])])
+    pod = make_pod("web-0", labels={"app": "web"})
+    ok, reasons = fits(pod, "na", m)
+    assert not ok and "node(s) didn't satisfy inter-pod (anti)affinity" in reasons
+    ok, _ = fits(pod, "nb", m)
+    assert ok
+
+
+def test_affinity_namespace_scoping():
+    na, nb = _zone_nodes()
+    existing = make_pod("db-0", labels={"app": "db"}, namespace="other")
+    m = build([(na, [existing]), (nb, [])])
+    aff = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "db"}), topology_key="zone"
+            )
+        ]
+    )
+    # term defaults to the pod's own namespace (default) -> no match
+    pod = make_pod("web", affinity=aff)
+    ok, _ = fits(pod, "na", m)
+    assert not ok
+    # explicit namespaces
+    aff2 = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "db"}),
+                topology_key="zone",
+                namespaces=["other"],
+            )
+        ]
+    )
+    pod2 = make_pod("web2", affinity=aff2)
+    ok, _ = fits(pod2, "na", m)
+    assert ok

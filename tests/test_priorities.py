@@ -1,0 +1,284 @@
+"""Priority scoring tests with exact expected integers (the fixed-point
+spec), modeled on the reference's ``algorithm/priorities/*_test.go``."""
+
+from kubernetes_tpu.api import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+    PodAffinityTerm,
+    ReplicaSet,
+    Service,
+    Taint,
+    Toleration,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.api.selectors import NodeSelectorTerm, Requirement
+from kubernetes_tpu.api.types import PreferredSchedulingTerm
+from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+from kubernetes_tpu.scheduler.priorities import (
+    BalancedResourceAllocation,
+    InterPodAffinityPriority,
+    LeastRequestedPriority,
+    MostRequestedPriority,
+    NodeAffinityPriority,
+    NodePreferAvoidPodsPriority,
+    PriorityContext,
+    SelectorSpreadPriority,
+    TaintTolerationPriority,
+)
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def build(nodes_with_pods):
+    m = {}
+    for node, pods in nodes_with_pods:
+        info = NodeInfo(node)
+        for p in pods:
+            p.spec.node_name = node.meta.name
+            info.add_pod(p)
+        m[node.meta.name] = info
+    return m
+
+
+def infos(m, names):
+    return [m[n] for n in names]
+
+
+def test_least_requested_exact():
+    # node: 4000 milli cpu, 8192 MiB. existing: 2000m, 4096Mi. pod: 1000m, 2048Mi.
+    m = build([(make_node("n1", cpu="4", memory="8Gi"), [make_pod("e", cpu="2", memory="4Gi")])])
+    pod = make_pod("p", cpu="1", memory="2Gi")
+    scores = LeastRequestedPriority().compute_all(pod, infos(m, ["n1"]), PriorityContext(m))
+    # cpu: (4000-3000)*10//4000 = 2 ; mem: (8192-6144)*10//8192 = 2 ; (2+2)//2 = 2
+    assert scores == [2]
+
+
+def test_least_requested_nonzero_defaults():
+    # empty requests count as 100m/200MiB for priorities
+    m = build([(make_node("n1", cpu="1", memory="1000Mi"), [])])
+    pod = make_pod("p")  # no requests
+    scores = LeastRequestedPriority().compute_all(pod, infos(m, ["n1"]), PriorityContext(m))
+    # cpu: (1000-100)*10//1000 = 9 ; mem: (1000-200)*10//1000 = 8 ; (9+8)//2 = 8
+    assert scores == [8]
+
+
+def test_most_requested_exact():
+    m = build([(make_node("n1", cpu="4", memory="8Gi"), [make_pod("e", cpu="2", memory="4Gi")])])
+    pod = make_pod("p", cpu="1", memory="2Gi")
+    scores = MostRequestedPriority().compute_all(pod, infos(m, ["n1"]), PriorityContext(m))
+    # cpu: 3000*10//4000 = 7 ; mem: 6144*10//8192 = 7 ; 7
+    assert scores == [7]
+
+
+def test_most_requested_overcommit_scores_zero():
+    m = build([(make_node("n1", cpu="1", memory="1Gi"), [make_pod("e", cpu="900m")])])
+    pod = make_pod("p", cpu="200m", memory="512Mi")
+    scores = MostRequestedPriority().compute_all(pod, infos(m, ["n1"]), PriorityContext(m))
+    # cpu requested 1100 > 1000 -> 0 ; mem: (512+200)*10//1024 = 6 ; (0+6)//2=3
+    assert scores == [3]
+
+
+def test_balanced_resource_allocation_exact():
+    m = build([(make_node("n1", cpu="4", memory="8Gi"), [])])
+    # cpu frac 2000/4000=0.5, mem frac 4096/8192=0.5 -> perfectly balanced -> 10
+    pod = make_pod("p", cpu="2", memory="4Gi")
+    scores = BalancedResourceAllocation().compute_all(pod, infos(m, ["n1"]), PriorityContext(m))
+    assert scores == [10]
+    # cpu frac 1.0 -> score 0 (>= 1 rule)
+    pod2 = make_pod("q", cpu="4", memory="1Gi")
+    scores = BalancedResourceAllocation().compute_all(pod2, infos(m, ["n1"]), PriorityContext(m))
+    assert scores == [0]
+
+
+def test_balanced_fixed_point_skew():
+    m = build([(make_node("n1", cpu="4", memory="8Gi"), [])])
+    # cpu 1000/4000 -> 256/1024 ; mem 4096/8192 -> 512/1024 ; diff 256
+    # score = (10*1024 - 256*10)//1024 = (10240-2560)//1024 = 7
+    pod = make_pod("p", cpu="1", memory="4Gi")
+    scores = BalancedResourceAllocation().compute_all(pod, infos(m, ["n1"]), PriorityContext(m))
+    assert scores == [7]
+
+
+def test_selector_spread_no_zones():
+    rs = ReplicaSet(
+        meta=ObjectMeta(name="rs1"),
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+    )
+    pods_n1 = [make_pod("e1", labels={"app": "web"}), make_pod("e2", labels={"app": "web"})]
+    pods_n2 = [make_pod("e3", labels={"app": "web"})]
+    m = build([(make_node("n1"), pods_n1), (make_node("n2"), pods_n2), (make_node("n3"), [])])
+    ctx = PriorityContext(m, replicasets=[rs])
+    pod = make_pod("p", labels={"app": "web"})
+    scores = SelectorSpreadPriority().compute_all(pod, infos(m, ["n1", "n2", "n3"]), ctx)
+    # counts 2,1,0 ; max 2 -> scores (2-2)*10//2=0, (2-1)*10//2=5, 10
+    assert scores == [0, 5, 10]
+
+
+def test_selector_spread_zone_weighting():
+    labels_a = {"failure-domain.beta.kubernetes.io/zone": "a"}
+    labels_b = {"failure-domain.beta.kubernetes.io/zone": "b"}
+    svc = Service(meta=ObjectMeta(name="s"), selector={"app": "web"})
+    m = build(
+        [
+            (make_node("n1", labels=labels_a), [make_pod("e1", labels={"app": "web"})]),
+            (make_node("n2", labels=labels_a), []),
+            (make_node("n3", labels=labels_b), []),
+        ]
+    )
+    ctx = PriorityContext(m, services=[svc])
+    pod = make_pod("p", labels={"app": "web"})
+    scores = SelectorSpreadPriority().compute_all(pod, infos(m, ["n1", "n2", "n3"]), ctx)
+    # node counts: 1,0,0 (maxN=1); zone counts: a=1, b=0 (maxZ=1)
+    # n1: node_fp=0, zone_fp=0 -> 0
+    # n2: node_fp=10240, zone_fp=0 -> (10240+0)//3=3413 -> 3
+    # n3: node_fp=10240, zone_fp=10240 -> 10240 -> 10
+    assert scores == [0, 3, 10]
+
+
+def test_selector_spread_no_selectors_all_ten():
+    m = build([(make_node("n1"), [make_pod("e1")]), (make_node("n2"), [])])
+    ctx = PriorityContext(m)
+    scores = SelectorSpreadPriority().compute_all(make_pod("p"), infos(m, ["n1", "n2"]), ctx)
+    assert scores == [10, 10]
+
+
+def test_node_affinity_priority_normalized():
+    term = NodeSelectorTerm([Requirement("zone", "In", ["a"])])
+    aff = Affinity(
+        node_affinity_preferred=[
+            PreferredSchedulingTerm(weight=4, preference=term),
+            PreferredSchedulingTerm(
+                weight=2, preference=NodeSelectorTerm([Requirement("disk", "In", ["ssd"])])
+            ),
+        ]
+    )
+    m = build(
+        [
+            (make_node("n1", labels={"zone": "a", "disk": "ssd"}), []),
+            (make_node("n2", labels={"zone": "a"}), []),
+            (make_node("n3", labels={}), []),
+        ]
+    )
+    pod = make_pod("p", affinity=aff)
+    scores = NodeAffinityPriority().compute_all(pod, infos(m, ["n1", "n2", "n3"]), PriorityContext(m))
+    # counts 6,4,0 ; max 6 -> 10, 10*4//6=6, 0
+    assert scores == [10, 6, 0]
+
+
+def test_taint_toleration_priority():
+    t1 = Taint(key="k1", value="v", effect="PreferNoSchedule")
+    t2 = Taint(key="k2", value="v", effect="PreferNoSchedule")
+    hard = Taint(key="k3", value="v", effect="NoSchedule")
+    m = build(
+        [
+            (make_node("n1", taints=[t1, t2]), []),
+            (make_node("n2", taints=[t1]), []),
+            (make_node("n3", taints=[hard]), []),  # NoSchedule ignored by priority
+        ]
+    )
+    pod = make_pod("p", tolerations=[Toleration(key="k1", operator="Exists")])
+    scores = TaintTolerationPriority().compute_all(pod, infos(m, ["n1", "n2", "n3"]), PriorityContext(m))
+    # intolerable counts: n1=1 (k2), n2=0, n3=0 ; max=1 -> 0, 10, 10
+    assert scores == [0, 10, 10]
+
+
+def test_taint_toleration_all_clean():
+    m = build([(make_node("n1"), []), (make_node("n2"), [])])
+    scores = TaintTolerationPriority().compute_all(make_pod("p"), infos(m, ["n1", "n2"]), PriorityContext(m))
+    assert scores == [10, 10]
+
+
+def test_node_prefer_avoid_pods():
+    ref = OwnerReference(kind="ReplicaSet", name="rs", uid="uid-rs-1", controller=True)
+    m = build(
+        [
+            (
+                make_node(
+                    "n1",
+                    annotations={"scheduler.alpha.kubernetes.io/preferAvoidPods": "uid-rs-1"},
+                ),
+                [],
+            ),
+            (make_node("n2"), []),
+        ]
+    )
+    pod = make_pod("p", owner_refs=[ref])
+    scores = NodePreferAvoidPodsPriority().compute_all(pod, infos(m, ["n1", "n2"]), PriorityContext(m))
+    assert scores == [0, 10]
+    # pods without RC/RS controller get max everywhere
+    scores = NodePreferAvoidPodsPriority().compute_all(make_pod("q"), infos(m, ["n1", "n2"]), PriorityContext(m))
+    assert scores == [10, 10]
+
+
+def test_interpod_affinity_preferred():
+    labels_a = {"zone": "a"}
+    labels_b = {"zone": "b"}
+    existing = make_pod("db", labels={"app": "db"})
+    m = build(
+        [
+            (make_node("n1", labels=labels_a), [existing]),
+            (make_node("n2", labels=labels_a), []),
+            (make_node("n3", labels=labels_b), []),
+        ]
+    )
+    aff = Affinity(
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=5,
+                term=PodAffinityTerm(
+                    selector=LabelSelector.from_match_labels({"app": "db"}), topology_key="zone"
+                ),
+            )
+        ]
+    )
+    pod = make_pod("web", affinity=aff)
+    scores = InterPodAffinityPriority().compute_all(pod, infos(m, ["n1", "n2", "n3"]), PriorityContext(m))
+    # zone a gets +5 -> counts 5,5,0 -> min 0 max 5 -> 10,10,0
+    assert scores == [10, 10, 0]
+
+
+def test_interpod_anti_affinity_preferred_negative():
+    existing = make_pod("db", labels={"app": "db"})
+    m = build(
+        [
+            (make_node("n1", labels={"zone": "a"}), [existing]),
+            (make_node("n2", labels={"zone": "b"}), []),
+        ]
+    )
+    aff = Affinity(
+        pod_anti_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=3,
+                term=PodAffinityTerm(
+                    selector=LabelSelector.from_match_labels({"app": "db"}), topology_key="zone"
+                ),
+            )
+        ]
+    )
+    pod = make_pod("web", affinity=aff)
+    scores = InterPodAffinityPriority().compute_all(pod, infos(m, ["n1", "n2"]), PriorityContext(m))
+    # counts: n1=-3, n2=0 -> min -3 max 0 -> 10*(c-min)//range: n1=0, n2=10
+    assert scores == [0, 10]
+
+
+def test_interpod_affinity_symmetry_hard_weight():
+    # existing pod REQUIRES affinity to app=web; incoming web pod gets pulled
+    # toward its topology with hard_pod_affinity_weight.
+    aff_existing = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "web"}), topology_key="zone"
+            )
+        ]
+    )
+    existing = make_pod("db", labels={"app": "db"}, affinity=aff_existing)
+    m = build(
+        [
+            (make_node("n1", labels={"zone": "a"}), [existing]),
+            (make_node("n2", labels={"zone": "b"}), []),
+        ]
+    )
+    pod = make_pod("web-1", labels={"app": "web"})
+    scores = InterPodAffinityPriority().compute_all(pod, infos(m, ["n1", "n2"]), PriorityContext(m))
+    assert scores == [10, 0]
