@@ -1,0 +1,153 @@
+"""Benchmark: batched TPU scheduling throughput vs the reference scheduler.
+
+The harness mirrors ``test/integration/scheduler_perf`` (SURVEY.md §4.4):
+fake nodes + a flood of pending pods through the REAL scheduling path
+(store → informers → cache snapshot → backend → bind writes), measuring
+pods-scheduled/sec.  The reference's expected throughput on this harness is
+100 pods/s (warn threshold, ``scheduler_perf/scheduler_test.go:35``; hard
+floor 30) — ``vs_baseline`` is measured-value / 100.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Presets:
+  smoke  —   200 nodes ×   1k pods (fast sanity)
+  basic  —   500 nodes ×   2k pods (BASELINE.json configs[0], default)
+  dense  —  1000 nodes ×  10k pods
+  north  —  5000 nodes × 150k pods (the north-star scale)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+
+PRESETS = {
+    "smoke": (200, 1_000),
+    "basic": (500, 2_000),
+    "dense": (1_000, 10_000),
+    "north": (5_000, 150_000),
+}
+
+
+def build_cluster(clientset, n_nodes: int, rng: random.Random):
+    from kubernetes_tpu.testutil import make_node
+
+    for i in range(n_nodes):
+        clientset.nodes.create(
+            make_node(
+                f"node-{i:05d}",
+                cpu=rng.choice(["8", "16", "32"]),
+                memory=rng.choice(["16Gi", "32Gi", "64Gi"]),
+                pods=110,
+                labels={
+                    "kubernetes.io/hostname": f"node-{i:05d}",
+                    "failure-domain.beta.kubernetes.io/zone": f"zone-{i % 3}",
+                },
+            )
+        )
+
+
+def make_pods(n_pods: int, rng: random.Random):
+    from kubernetes_tpu.testutil import make_pod
+
+    # RC-of-pods style flood (scheduler_perf creates pods via RCs): a few
+    # homogeneous templates, like real workloads
+    templates = [
+        dict(cpu="100m", memory="128Mi", labels={"app": "web"}),
+        dict(cpu="250m", memory="256Mi", labels={"app": "api"}),
+        dict(cpu="500m", memory="512Mi", labels={"app": "db"}),
+        dict(cpu="1", memory="1Gi", labels={"app": "batch"}),
+    ]
+    return [make_pod(f"pod-{i:06d}", **templates[i % len(templates)]) for i in range(n_pods)]
+
+
+def run_once(n_nodes: int, n_pods: int, use_backend: bool, seed: int = 0) -> dict:
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.ops import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+    from kubernetes_tpu.store import Store
+
+    rng = random.Random(seed)
+    cs = Clientset(Store(event_log_window=max(200_000, 2 * (n_nodes + n_pods))))
+    build_cluster(cs, n_nodes, rng)
+    for pod in make_pods(n_pods, rng):
+        cs.pods.create(pod)
+
+    algo = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo) if use_backend else None
+    sched = Scheduler(cs, algorithm=algo, backend=backend, emit_events=False)
+    sched.start()
+
+    start = time.perf_counter()
+    if use_backend:
+        bound, failed = sched.schedule_pending_batch()
+    else:
+        bound = sched.run_pending()
+        failed = 0
+    elapsed = time.perf_counter() - start
+    return {
+        "bound": bound,
+        "failed": failed,
+        "elapsed_s": elapsed,
+        "pods_per_sec": bound / elapsed if elapsed > 0 else 0.0,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", choices=PRESETS, default="basic")
+    parser.add_argument("--nodes", type=int, default=None)
+    parser.add_argument("--pods", type=int, default=None)
+    parser.add_argument("--oracle", action="store_true", help="bench the CPU oracle path instead")
+    parser.add_argument(
+        "--compare", action="store_true", help="also run the oracle and report speedup to stderr"
+    )
+    args = parser.parse_args()
+    n_nodes, n_pods = PRESETS[args.preset]
+    if args.nodes:
+        n_nodes = args.nodes
+    if args.pods:
+        n_pods = args.pods
+
+    # warm-up at the same shapes: triggers all XLA compilation so the timed
+    # run measures steady-state throughput (first TPU compile is ~20-40s)
+    if not args.oracle:
+        run_once(n_nodes, n_pods, use_backend=True, seed=1)
+
+    result = run_once(n_nodes, n_pods, use_backend=not args.oracle, seed=0)
+    if result["bound"] == 0:
+        print(json.dumps({"metric": "pods-scheduled/sec", "value": 0, "unit": "pods/s", "vs_baseline": 0}))
+        sys.exit(1)
+
+    if args.compare:
+        oracle = run_once(n_nodes, min(n_pods, 2_000), use_backend=False, seed=0)
+        print(
+            f"# oracle: {oracle['pods_per_sec']:.1f} pods/s on {min(n_pods, 2000)} pods; "
+            f"backend speedup {result['pods_per_sec'] / max(oracle['pods_per_sec'], 1e-9):.1f}x",
+            file=sys.stderr,
+        )
+
+    print(
+        f"# {args.preset}: {result['bound']} bound / {result['failed']} failed "
+        f"in {result['elapsed_s']:.2f}s on {n_nodes} nodes",
+        file=sys.stderr,
+    )
+    # baseline: the reference harness's expected throughput (100 pods/s)
+    print(
+        json.dumps(
+            {
+                "metric": "pods-scheduled/sec",
+                "value": round(result["pods_per_sec"], 1),
+                "unit": "pods/s",
+                "vs_baseline": round(result["pods_per_sec"] / 100.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

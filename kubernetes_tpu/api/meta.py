@@ -71,6 +71,7 @@ class ObjectMeta:
             "namespace": self.namespace,
             "uid": self.uid,
             "resourceVersion": self.resource_version,
+            "creationRevision": self.creation_revision,
             "generation": self.generation,
         }
         if self.labels:
@@ -90,6 +91,7 @@ class ObjectMeta:
             namespace=d.get("namespace", "default"),
             uid=d.get("uid", ""),
             resource_version=int(d.get("resourceVersion", 0)),
+            creation_revision=int(d.get("creationRevision", 0)),
             labels=dict(d.get("labels") or {}),
             annotations=dict(d.get("annotations") or {}),
             owner_references=[

@@ -1,0 +1,3 @@
+"""Tensorized cluster-state models (the NodeInfo → device-array bridge)."""
+
+from .snapshot import BatchStatic, InitialState, Tensorizer, kernel_eligible, pod_signature_key

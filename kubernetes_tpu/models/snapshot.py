@@ -1,0 +1,476 @@
+"""Tensorization: cluster snapshot + pod batch → dense device arrays.
+
+This is the bridge between the object world (``SchedulerCache`` /
+``NodeInfo``, SURVEY.md §2.4) and the TPU kernels (``kubernetes_tpu/ops``).
+
+Design (TPU-first, not a port):
+
+- **Node axis**: nodes sorted by name form the canonical axis shared with
+  the oracle; padded to a lane/shard-friendly multiple with an ``exists``
+  mask so shapes stay static under churn (SURVEY.md §7.4 hard part 2).
+
+- **Pod equivalence signatures**: pods created from the same template
+  (labels, namespace, requests, selectors, tolerations, affinity, ports,
+  owner) are *identical* to every predicate and priority.  The batch is
+  deduped into G signatures, and every per-pod×node static quantity
+  (selector/taint/pressure masks, preferred-node-affinity raw counts,
+  image scores, …) becomes a [G, N] array — the tensor-native
+  generalization of the reference's equivalence cache
+  (``core/equivalence_cache.go``), and the reason 150k pods don't need
+  150k×5k precomputed bytes.
+
+- **Strings die on the host**: selectors, labels, taints, topology keys are
+  evaluated once here; the device sees only int32/bool arrays.
+
+The produced ``BatchStatic`` (numpy, host) feeds ``ops.batch_kernel``;
+``initial_state`` extracts the dynamic scan state from the NodeInfo map.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..api import types as api
+from ..scheduler.nodeinfo import NodeInfo
+from ..scheduler.predicates import _pod_matches_term
+from ..scheduler.priorities import (
+    PREFER_AVOID_PODS_ANNOTATION,
+    PriorityContext,
+    SelectorSpreadPriority,
+    _zone_key,
+)
+from ..scheduler.units import (
+    CPU_MILLI,
+    MEM_MIB,
+    NUM_RESOURCES,
+    pod_nonzero_request_vec,
+    pod_request_vec,
+)
+
+_MIN_IMG_MIB = 23
+_MAX_IMG_MIB = 1000
+
+
+def pod_signature_key(pod: api.Pod) -> str:
+    """Canonical scheduling-equivalence key (the ecache hash analogue:
+    reference ``equivalence_cache.go:98 getEquivalenceHash`` uses the
+    controller ref; this key is exact over everything predicates and
+    priorities read, so it is strictly safer)."""
+    ref = pod.meta.controller_ref()
+    parts = {
+        "ns": pod.meta.namespace,
+        "labels": sorted(pod.meta.labels.items()),
+        "nodeSelector": sorted(pod.spec.node_selector.items()),
+        "nodeName": pod.spec.node_name,
+        "affinity": pod.spec.affinity.to_dict() if pod.spec.affinity else None,
+        "tolerations": [t.to_dict() for t in pod.spec.tolerations],
+        "volumes": [v.to_dict() for v in pod.spec.volumes],
+        "owner": (ref.kind, ref.uid) if ref else None,
+        "containers": [
+            (
+                c.image,
+                sorted((k, str(v)) for k, v in c.resources.requests.items()),
+                sorted((p.protocol, p.host_port) for p in c.ports if p.host_port > 0),
+            )
+            for c in pod.spec.containers
+        ],
+    }
+    return json.dumps(parts, sort_keys=True, default=str)
+
+
+def kernel_eligible(pod: api.Pod) -> bool:
+    """Phase-A kernel scope: everything except inter-pod (anti)affinity and
+    volume-bearing pods (those route to the oracle segment path; widened in
+    later phases)."""
+    if pod.spec.volumes:
+        return False
+    a = pod.spec.affinity
+    if a is not None and (
+        a.pod_affinity_required
+        or a.pod_affinity_preferred
+        or a.pod_anti_affinity_required
+        or a.pod_anti_affinity_preferred
+    ):
+        return False
+    return True
+
+
+@dataclass
+class BatchStatic:
+    """Host-computed static arrays for one kernel segment (numpy)."""
+
+    # node axis
+    node_names: list[str]  # length N_real (pre-padding)
+    n_pad: int  # padded N
+    node_exists: np.ndarray  # [N] bool
+    node_alloc: np.ndarray  # [N, R] int32
+    node_alloc_pods: np.ndarray  # [N] int32
+    node_zone: np.ndarray  # [N] int32, -1 = no zone
+    num_zones: int
+
+    # signatures
+    group_of_pod: np.ndarray  # [P] int32
+    pod_names: list[str]
+    # per-signature static masks / scores [G, N]
+    static_ok: np.ndarray  # bool
+    node_aff_raw: np.ndarray  # int32 (preferred node affinity weights)
+    taint_intol_raw: np.ndarray  # int32 (PreferNoSchedule intolerable count)
+    static_score: np.ndarray  # int32 (weight-scaled absolute priorities)
+    # per-signature resources
+    g_request: np.ndarray  # [G, R] int32
+    g_nonzero: np.ndarray  # [G, 2] int32
+    # ports
+    g_ports: np.ndarray  # [G, Pv] bool
+    port_vocab: list[tuple[str, int]]
+    # spreading
+    g_has_spread: np.ndarray  # [G] bool (has matching selectors)
+    spread_inc: np.ndarray  # [G, G] int32: landing of sig h bumps counts of sig g
+    # inter-pod affinity contributions from EXISTING pods (phase-A pods carry
+    # no affinity terms themselves, so these are fully static):
+    interpod_raw: np.ndarray  # [G, N] int32 (scoring symmetry, may be negative)
+    # scoring mode flags
+    weights: dict = field(default_factory=dict)
+
+
+@dataclass
+class InitialState:
+    """Dynamic scan state extracted from the NodeInfo map (numpy)."""
+
+    requested: np.ndarray  # [N, R] int32
+    nonzero_requested: np.ndarray  # [N, 2] int32
+    pod_count: np.ndarray  # [N] int32
+    ports_used: np.ndarray  # [N, Pv] bool
+    spread_counts: np.ndarray  # [G, N] int32
+    round_robin: int
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return max(n, 1)
+    return max(((n + multiple - 1) // multiple) * multiple, multiple)
+
+
+class Tensorizer:
+    def __init__(self, pad_multiple: int = 128, max_groups: int = 512):
+        self.pad_multiple = pad_multiple
+        self.max_groups = max_groups
+
+    # -- static ------------------------------------------------------------
+    def build_static(
+        self,
+        pods: list[api.Pod],
+        node_info_map: dict[str, NodeInfo],
+        pctx: PriorityContext,
+        least_requested_weight: int = 0,
+        most_requested_weight: int = 0,
+        balanced_weight: int = 1,
+        spread_weight: int = 1,
+        node_affinity_weight: int = 1,
+        taint_weight: int = 1,
+        prefer_avoid_weight: int = 10000,
+        image_weight: int = 0,
+        interpod_weight: int = 1,
+    ) -> Optional[BatchStatic]:
+        node_names = sorted(n for n, i in node_info_map.items() if i.node is not None)
+        n_real = len(node_names)
+        if n_real == 0 or not pods:
+            return None
+        n_pad = _pad_to(n_real, self.pad_multiple)
+        infos = [node_info_map[n] for n in node_names]
+
+        # signatures
+        sig_to_gid: dict[str, int] = {}
+        group_of_pod = np.empty(len(pods), dtype=np.int32)
+        reps: list[api.Pod] = []  # representative pod per group
+        for i, pod in enumerate(pods):
+            key = pod_signature_key(pod)
+            gid = sig_to_gid.get(key)
+            if gid is None:
+                gid = len(reps)
+                if gid >= self.max_groups:
+                    return None  # caller falls back to oracle for this segment
+                sig_to_gid[key] = gid
+                reps.append(pod)
+            group_of_pod[i] = gid
+        G = len(reps)
+
+        # node-side basics
+        node_exists = np.zeros(n_pad, dtype=bool)
+        node_exists[:n_real] = True
+        node_alloc = np.zeros((n_pad, NUM_RESOURCES), dtype=np.int32)
+        node_alloc_pods = np.zeros(n_pad, dtype=np.int32)
+        zone_vocab: dict[str, int] = {}
+        node_zone = np.full(n_pad, -1, dtype=np.int32)
+        for j, info in enumerate(infos):
+            node_alloc[j] = info.allocatable.units
+            node_alloc_pods[j] = info.allocatable_pods
+            zk = _zone_key(info.node)
+            if zk:
+                if zk not in zone_vocab:
+                    zone_vocab[zk] = len(zone_vocab)
+                node_zone[j] = zone_vocab[zk]
+        num_zones = max(len(zone_vocab), 1)
+
+        # port vocab over the batch
+        port_vocab: dict[tuple[str, int], int] = {}
+        for rep in reps:
+            for port in rep.host_ports():
+                if port not in port_vocab:
+                    port_vocab[port] = len(port_vocab)
+        pv = max(len(port_vocab), 1)
+        g_ports = np.zeros((G, pv), dtype=bool)
+        for g, rep in enumerate(reps):
+            for port in rep.host_ports():
+                g_ports[g, port_vocab[port]] = True
+
+        # per-signature resources
+        g_request = np.zeros((G, NUM_RESOURCES), dtype=np.int32)
+        g_nonzero = np.zeros((G, 2), dtype=np.int32)
+        for g, rep in enumerate(reps):
+            g_request[g] = pod_request_vec(rep).units
+            nz = pod_nonzero_request_vec(rep)
+            g_nonzero[g, 0] = nz[CPU_MILLI]
+            g_nonzero[g, 1] = nz[MEM_MIB]
+
+        # static per-(signature, node) masks & raw scores
+        static_ok = np.zeros((G, n_pad), dtype=bool)
+        node_aff_raw = np.zeros((G, n_pad), dtype=np.int32)
+        taint_intol_raw = np.zeros((G, n_pad), dtype=np.int32)
+        static_score = np.zeros((G, n_pad), dtype=np.int32)
+        for g, rep in enumerate(reps):
+            is_best_effort = rep.qos_class() == api.BEST_EFFORT
+            ref = rep.meta.controller_ref()
+            images = {c.image for c in rep.spec.containers if c.image}
+            for j, info in enumerate(infos):
+                node = info.node
+                labels = node.meta.labels
+                ok = not node.spec.unschedulable
+                # host match
+                if ok and rep.spec.node_name:
+                    ok = rep.spec.node_name == node.meta.name
+                # selector + required node affinity
+                if ok and rep.spec.node_selector:
+                    ok = all(labels.get(k) == v for k, v in rep.spec.node_selector.items())
+                if ok and rep.spec.affinity is not None and rep.spec.affinity.node_affinity_required is not None:
+                    ok = rep.spec.affinity.node_affinity_required.matches(labels)
+                # taints (NoSchedule/NoExecute)
+                if ok:
+                    for taint in node.spec.taints:
+                        if taint.effect not in (api.NO_SCHEDULE, api.NO_EXECUTE):
+                            continue
+                        if not any(t.tolerates(taint) for t in rep.spec.tolerations):
+                            ok = False
+                            break
+                # pressure conditions
+                if ok and is_best_effort and info.memory_pressure:
+                    ok = False
+                if ok and info.disk_pressure:
+                    ok = False
+                static_ok[g, j] = ok
+
+                # preferred node affinity raw weight
+                if rep.spec.affinity is not None:
+                    cnt = 0
+                    for pt in rep.spec.affinity.node_affinity_preferred:
+                        if pt.weight > 0 and pt.preference.matches(labels):
+                            cnt += pt.weight
+                    node_aff_raw[g, j] = cnt
+                # intolerable PreferNoSchedule taints
+                cnt = 0
+                for taint in node.spec.taints:
+                    if taint.effect != api.PREFER_NO_SCHEDULE:
+                        continue
+                    if not any(t.tolerates(taint) for t in rep.spec.tolerations):
+                        cnt += 1
+                taint_intol_raw[g, j] = cnt
+
+                # absolute (non-normalized) priorities folded into one array
+                score = 0
+                if prefer_avoid_weight:
+                    avoided = False
+                    if ref is not None and ref.kind in ("ReplicaSet", "ReplicationController"):
+                        ann = node.meta.annotations.get(PREFER_AVOID_PODS_ANNOTATION, "")
+                        avoided = ref.uid in [u.strip() for u in ann.split(",") if u.strip()]
+                    score += prefer_avoid_weight * (0 if avoided else 10)
+                if image_weight:
+                    total_mib = 0
+                    for img in node.status.images:
+                        if any(nm in images for nm in img.get("names", [])):
+                            total_mib += int(img.get("sizeBytes", 0)) // (2**20)
+                    if total_mib < _MIN_IMG_MIB:
+                        iscore = 0
+                    elif total_mib > _MAX_IMG_MIB:
+                        iscore = 10
+                    else:
+                        iscore = ((total_mib - _MIN_IMG_MIB) * 10) // (_MAX_IMG_MIB - _MIN_IMG_MIB)
+                    score += image_weight * iscore
+                static_score[g, j] = score
+
+        # inter-pod affinity interactions with EXISTING pods.  Phase-A batch
+        # pods have no (anti)affinity terms of their own, but existing pods'
+        # terms still act on them (the symmetry rules):
+        #  - required anti-affinity of an existing pod matching the incoming
+        #    pod FORBIDS its topology domain (predicates.go:1146) -> static_ok;
+        #  - required/preferred affinity (+ preferred anti) of existing pods
+        #    matching the incoming pod contribute interpod priority weight
+        #    (interpod_affinity.go:160-186) -> interpod_raw.
+        interpod_raw = np.zeros((G, n_pad), dtype=np.int32)
+        existing_with_affinity = [
+            (q, qinfo)
+            for qinfo in node_info_map.values()
+            for q in qinfo.pods_with_affinity
+        ]
+        if existing_with_affinity:
+            # (topology key, value) -> weight accumulations per signature
+            for g, rep in enumerate(reps):
+                topo_weights: dict[tuple[str, str], int] = {}
+                forbidden: list[tuple[str, str]] = []  # (key, value) domains
+
+                def _add(node: Optional[api.Node], key: str, weight: int) -> None:
+                    if node is None or not key:
+                        return
+                    value = node.meta.labels.get(key)
+                    if value is None:
+                        return
+                    topo_weights[(key, value)] = topo_weights.get((key, value), 0) + weight
+
+                for q, qinfo in existing_with_affinity:
+                    qaff = q.spec.affinity
+                    qnode = qinfo.node
+                    for term in qaff.pod_anti_affinity_required:
+                        if _pod_matches_term(rep, q, term):
+                            if qnode is not None and term.topology_key:
+                                value = qnode.meta.labels.get(term.topology_key)
+                                if value is not None:
+                                    forbidden.append((term.topology_key, value))
+                            else:
+                                forbidden.append(("", ""))  # malformed term: always blocks
+                    if pctx.hard_pod_affinity_weight > 0:
+                        for term in qaff.pod_affinity_required:
+                            if _pod_matches_term(rep, q, term):
+                                _add(qnode, term.topology_key, pctx.hard_pod_affinity_weight)
+                    for wt in qaff.pod_affinity_preferred:
+                        if _pod_matches_term(rep, q, wt.term):
+                            _add(qnode, wt.term.topology_key, wt.weight)
+                    for wt in qaff.pod_anti_affinity_preferred:
+                        if _pod_matches_term(rep, q, wt.term):
+                            _add(qnode, wt.term.topology_key, -wt.weight)
+
+                if topo_weights or forbidden:
+                    for j, info in enumerate(infos):
+                        labels = info.node.meta.labels
+                        total = 0
+                        for (key, value), w in topo_weights.items():
+                            if labels.get(key) == value:
+                                total += w
+                        interpod_raw[g, j] = total
+                        for key, value in forbidden:
+                            if not key or labels.get(key) == value:
+                                static_ok[g, j] = False
+                                break
+
+        # spreading: selectors per signature; inc matrix between signatures
+        ssp = SelectorSpreadPriority()
+        g_selectors = [ssp._selectors_for_pod(rep, pctx) for rep in reps]
+        g_has_spread = np.array([len(s) > 0 for s in g_selectors], dtype=bool)
+        spread_inc = np.zeros((G, G), dtype=np.int32)
+        for g in range(G):
+            if not g_has_spread[g]:
+                continue
+            for h in range(G):
+                if reps[h].meta.namespace != reps[g].meta.namespace:
+                    continue
+                if ssp._matches_any(g_selectors[g], reps[h]):
+                    spread_inc[g, h] = 1
+
+        return BatchStatic(
+            node_names=node_names,
+            n_pad=n_pad,
+            node_exists=node_exists,
+            node_alloc=node_alloc,
+            node_alloc_pods=node_alloc_pods,
+            node_zone=node_zone,
+            num_zones=num_zones,
+            group_of_pod=group_of_pod,
+            pod_names=[p.meta.key for p in pods],
+            static_ok=static_ok,
+            node_aff_raw=node_aff_raw,
+            taint_intol_raw=taint_intol_raw,
+            static_score=static_score,
+            g_request=g_request,
+            g_nonzero=g_nonzero,
+            g_ports=g_ports,
+            port_vocab=list(port_vocab),
+            g_has_spread=g_has_spread,
+            spread_inc=spread_inc,
+            interpod_raw=interpod_raw,
+            weights={
+                "least": least_requested_weight,
+                "most": most_requested_weight,
+                "balanced": balanced_weight,
+                "spread": spread_weight,
+                "node_affinity": node_affinity_weight,
+                "taint": taint_weight,
+                "interpod": interpod_weight,
+            },
+        )
+
+    # -- dynamic state -----------------------------------------------------
+    def initial_state(
+        self,
+        static: BatchStatic,
+        node_info_map: dict[str, NodeInfo],
+        pctx: PriorityContext,
+        pods: list[api.Pod],
+        round_robin: int = 0,
+    ) -> InitialState:
+        n_pad = static.n_pad
+        G = static.static_ok.shape[0]
+        requested = np.zeros((n_pad, NUM_RESOURCES), dtype=np.int32)
+        nonzero = np.zeros((n_pad, 2), dtype=np.int32)
+        pod_count = np.zeros(n_pad, dtype=np.int32)
+        ports_used = np.zeros((n_pad, max(len(static.port_vocab), 1)), dtype=bool)
+        port_idx = {p: i for i, p in enumerate(static.port_vocab)}
+        spread_counts = np.zeros((G, n_pad), dtype=np.int32)
+
+        ssp = SelectorSpreadPriority()
+        # representative pod per group for selector extraction
+        reps: dict[int, api.Pod] = {}
+        for i, gid in enumerate(static.group_of_pod):
+            reps.setdefault(int(gid), pods[i])
+        g_selectors = {g: ssp._selectors_for_pod(rep, pctx) for g, rep in reps.items()}
+
+        for j, name in enumerate(static.node_names):
+            info = node_info_map[name]
+            requested[j] = info.requested.units
+            nonzero[j, 0] = info.nonzero_requested[CPU_MILLI]
+            nonzero[j, 1] = info.nonzero_requested[MEM_MIB]
+            pod_count[j] = len(info.pods)
+            for port in info.used_ports:
+                if port in port_idx:
+                    ports_used[j, port_idx[port]] = True
+            # existing matching-pod counts per spread group (zone sums are
+            # recomputed in-step from these, over the feasible mask)
+            for g, sels in g_selectors.items():
+                if not sels:
+                    continue
+                rep = reps[g]
+                cnt = 0
+                for q in info.pods:
+                    if q.meta.namespace == rep.meta.namespace and ssp._matches_any(sels, q):
+                        cnt += 1
+                if cnt:
+                    spread_counts[g, j] = cnt
+
+        return InitialState(
+            requested=requested,
+            nonzero_requested=nonzero,
+            pod_count=pod_count,
+            ports_used=ports_used,
+            spread_counts=spread_counts,
+            round_robin=round_robin,
+        )

@@ -1,0 +1,4 @@
+"""TPU kernels: feasibility masks, scoring, batched assignment."""
+
+from .backend import TPUBatchBackend
+from .batch_kernel import ScanState, StaticArrays, schedule_batch_arrays

@@ -1,0 +1,178 @@
+"""The TPU batch scheduling backend.
+
+Plugs into ``Scheduler.schedule_pending_batch`` (the seam the reference
+exposes as the HTTP extender, ``core/extender.go`` — here it is in-process
+and batch-shaped).  Guarantees **binding parity with the oracle**: the
+drained FIFO batch is split into maximal runs of kernel-eligible pods;
+eligible runs execute on device via the scan kernel, ineligible pods run
+through the oracle *in order* against the same evolving state, so the
+sequence of (pod → node) decisions is exactly what a pure-oracle run
+produces.
+
+Fallback ladder (every rung preserves parity):
+1. unsupported predicate/priority/extender config → all-oracle;
+2. segment exceeds the signature budget (max_groups) → that segment oracle;
+3. kernel-ineligible pod (volumes / own affinity terms, phase A) → that pod
+   oracle, between device segments.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api import types as api
+from ..scheduler.generic_scheduler import FitError, GenericScheduler
+from ..scheduler.nodeinfo import NodeInfo
+from ..scheduler.predicates import DEFAULT_PREDICATES
+from ..scheduler.priorities import (
+    BalancedResourceAllocation,
+    EqualPriority,
+    ImageLocalityPriority,
+    InterPodAffinityPriority,
+    LeastRequestedPriority,
+    MostRequestedPriority,
+    NodeAffinityPriority,
+    NodePreferAvoidPodsPriority,
+    PriorityContext,
+    SelectorSpreadPriority,
+    TaintTolerationPriority,
+)
+from ..models.snapshot import Tensorizer, kernel_eligible
+from .batch_kernel import schedule_batch_arrays
+
+logger = logging.getLogger("kubernetes_tpu.backend")
+
+_PRIORITY_WEIGHT_KEY = {
+    LeastRequestedPriority: "least",
+    MostRequestedPriority: "most",
+    BalancedResourceAllocation: "balanced",
+    SelectorSpreadPriority: "spread",
+    NodeAffinityPriority: "node_affinity",
+    TaintTolerationPriority: "taint",
+    InterPodAffinityPriority: "interpod",
+    NodePreferAvoidPodsPriority: "prefer_avoid",
+    ImageLocalityPriority: "image",
+}
+
+
+class TPUBatchBackend:
+    def __init__(self, algorithm: Optional[GenericScheduler] = None, tensorizer: Optional[Tensorizer] = None):
+        self.algorithm = algorithm or GenericScheduler()
+        self.tensorizer = tensorizer or Tensorizer()
+        self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0}
+
+    # -- config support check ---------------------------------------------
+    def _kernel_weights(self) -> Optional[dict]:
+        """Map the oracle's priority config onto kernel weights; None if any
+        configured plugin has no kernel implementation."""
+        weights = {
+            "least": 0,
+            "most": 0,
+            "balanced": 0,
+            "spread": 0,
+            "node_affinity": 0,
+            "taint": 0,
+            "interpod": 0,
+            "prefer_avoid": 0,
+            "image": 0,
+        }
+        for prio, weight in self.algorithm.priorities:
+            if isinstance(prio, EqualPriority):
+                continue  # constant shift; never changes argmax or ties
+            key = _PRIORITY_WEIGHT_KEY.get(type(prio))
+            if key is None:
+                return None
+            weights[key] += weight
+        return weights
+
+    def _config_supported(self) -> Optional[dict]:
+        if self.algorithm.extenders:
+            return None
+        if set(self.algorithm.predicates.keys()) != set(DEFAULT_PREDICATES.keys()):
+            return None
+        return self._kernel_weights()
+
+    # -- the batch entry point ---------------------------------------------
+    def schedule_batch(
+        self,
+        pods: list[api.Pod],
+        node_info_map: dict[str, NodeInfo],
+        pctx: PriorityContext,
+    ) -> list[Optional[str]]:
+        weights = self._config_supported()
+        # working state: clones so neither the scheduler's CoW snapshot nor
+        # the cache sees our speculative assumptions
+        work_map = {name: info.clone() for name, info in node_info_map.items()}
+        work_pctx = PriorityContext(
+            work_map,
+            services=pctx.services,
+            replicasets=pctx.replicasets,
+            hard_pod_affinity_weight=pctx.hard_pod_affinity_weight,
+        )
+
+        assignments: list[Optional[str]] = [None] * len(pods)
+
+        def apply(pod: api.Pod, node_name: Optional[str], i: int) -> None:
+            assignments[i] = node_name
+            if node_name is not None:
+                info = work_map.get(node_name)
+                if info is not None:
+                    info.add_pod(pod)
+
+        def run_oracle(pod: api.Pod, i: int) -> None:
+            try:
+                res = self.algorithm.schedule(pod, work_map, work_pctx)
+                apply(pod, res.node_name, i)
+            except FitError:
+                apply(pod, None, i)
+            self.stats["oracle_pods"] += 1
+
+        def run_kernel_segment(segment: list[tuple[int, api.Pod]]) -> None:
+            seg_pods = [p for _, p in segment]
+            static = self.tensorizer.build_static(
+                seg_pods,
+                work_map,
+                work_pctx,
+                least_requested_weight=weights["least"],
+                most_requested_weight=weights["most"],
+                balanced_weight=weights["balanced"],
+                spread_weight=weights["spread"],
+                node_affinity_weight=weights["node_affinity"],
+                taint_weight=weights["taint"],
+                prefer_avoid_weight=weights["prefer_avoid"],
+                image_weight=weights["image"],
+                interpod_weight=weights["interpod"],
+            )
+            if static is None:
+                for i, pod in segment:
+                    run_oracle(pod, i)
+                return
+            init = self.tensorizer.initial_state(
+                static, work_map, work_pctx, seg_pods, round_robin=self.algorithm._round_robin
+            )
+            chosen, final_rr = schedule_batch_arrays(static, init)
+            self.algorithm._round_robin = final_rr
+            for (i, pod), idx in zip(segment, chosen):
+                node_name = static.node_names[int(idx)] if int(idx) >= 0 else None
+                apply(pod, node_name, i)
+            self.stats["kernel_pods"] += len(segment)
+            self.stats["segments"] += 1
+
+        if weights is None:
+            for i, pod in enumerate(pods):
+                run_oracle(pod, i)
+            return assignments
+
+        segment: list[tuple[int, api.Pod]] = []
+        for i, pod in enumerate(pods):
+            if kernel_eligible(pod):
+                segment.append((i, pod))
+                continue
+            if segment:
+                run_kernel_segment(segment)
+                segment = []
+            run_oracle(pod, i)
+        if segment:
+            run_kernel_segment(segment)
+        return assignments

@@ -1,0 +1,261 @@
+"""The batched scheduling kernel: a `lax.scan` over the pod batch where
+every step is fully vectorized over the node axis.
+
+This replaces the reference's per-pod ``scheduleOne`` loop
+(``scheduler.go:253``) + 16-goroutine node parallel-for
+(``generic_scheduler.go:204``, SURVEY.md P1): the node axis becomes the
+TPU's vector axis (and the sharded mesh axis for multi-chip), and the
+sequential-greedy cache feedback the oracle gets from ``assume`` becomes
+the scan carry.  Bit-parity with the oracle holds because every operation
+is int32 fixed-point (see ``scheduler/units.py``) and the selection rule
+(feasibility mask → integer weighted score → argmax with round-robin
+tie-break in node-axis order, counter bumped only when ≥2 nodes are
+feasible — the reference's ``selectHost``/``lastNodeIndex`` semantics) is
+identical on both paths.
+
+Memory shape: dynamic state is O(N·R + G·N); per-pod static data is
+O(G·N) via equivalence signatures — nothing is ever [P, N].
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.snapshot import BatchStatic, InitialState
+from ..scheduler.units import FIXED_POINT_ONE, MAX_PRIORITY
+
+INT32_MIN = jnp.int32(-(2**31))
+INT32_MAX = jnp.int32(2**31 - 1)
+
+WEIGHT_KEYS = ("least", "most", "balanced", "spread", "node_affinity", "taint", "interpod")
+
+
+class ScanState(NamedTuple):
+    requested: jnp.ndarray  # [N, R] int32
+    nonzero_requested: jnp.ndarray  # [N, 2] int32
+    pod_count: jnp.ndarray  # [N] int32
+    ports_used: jnp.ndarray  # [N, Pv] bool
+    spread_counts: jnp.ndarray  # [G, N] int32
+    round_robin: jnp.ndarray  # [] int32
+
+
+class StaticArrays(NamedTuple):
+    """Device-resident static arrays (a pytree of arrays only — scalars that
+    change compilation live in the cached-runner key instead)."""
+
+    node_exists: jnp.ndarray  # [N] bool
+    node_alloc: jnp.ndarray  # [N, R] int32
+    node_alloc_pods: jnp.ndarray  # [N] int32
+    node_zone: jnp.ndarray  # [N] int32
+    static_ok: jnp.ndarray  # [G, N] bool
+    node_aff_raw: jnp.ndarray  # [G, N] int32
+    taint_intol_raw: jnp.ndarray  # [G, N] int32
+    static_score: jnp.ndarray  # [G, N] int32
+    interpod_raw: jnp.ndarray  # [G, N] int32
+    g_request: jnp.ndarray  # [G, R] int32
+    g_nonzero: jnp.ndarray  # [G, 2] int32
+    g_ports: jnp.ndarray  # [G, Pv] bool
+    g_has_spread: jnp.ndarray  # [G] bool
+    spread_inc: jnp.ndarray  # [G, G] int32
+
+
+def to_device(static: BatchStatic) -> StaticArrays:
+    return StaticArrays(
+        node_exists=jnp.asarray(static.node_exists),
+        node_alloc=jnp.asarray(static.node_alloc),
+        node_alloc_pods=jnp.asarray(static.node_alloc_pods),
+        node_zone=jnp.asarray(static.node_zone),
+        static_ok=jnp.asarray(static.static_ok),
+        node_aff_raw=jnp.asarray(static.node_aff_raw),
+        taint_intol_raw=jnp.asarray(static.taint_intol_raw),
+        static_score=jnp.asarray(static.static_score),
+        interpod_raw=jnp.asarray(static.interpod_raw),
+        g_request=jnp.asarray(static.g_request),
+        g_nonzero=jnp.asarray(static.g_nonzero),
+        g_ports=jnp.asarray(static.g_ports),
+        g_has_spread=jnp.asarray(static.g_has_spread),
+        spread_inc=jnp.asarray(static.spread_inc),
+    )
+
+
+def state_to_device(init: InitialState) -> ScanState:
+    return ScanState(
+        requested=jnp.asarray(init.requested),
+        nonzero_requested=jnp.asarray(init.nonzero_requested),
+        pod_count=jnp.asarray(init.pod_count),
+        ports_used=jnp.asarray(init.ports_used),
+        spread_counts=jnp.asarray(init.spread_counts),
+        round_robin=jnp.asarray(init.round_robin, dtype=jnp.int32),
+    )
+
+
+# -- fixed-point scoring pieces (must mirror scheduler/priorities.py) -------
+
+
+def _usage_score(requested, capacity, most: bool):
+    """least/most-requested per-resource score with the reference's guards
+    (capacity==0 -> 0, requested > capacity -> 0)."""
+    safe_cap = jnp.maximum(capacity, 1)
+    if most:
+        raw = (requested * MAX_PRIORITY) // safe_cap
+    else:
+        raw = ((capacity - requested) * MAX_PRIORITY) // safe_cap
+    return jnp.where((capacity == 0) | (requested > capacity), 0, raw)
+
+
+def _balanced_score(cpu_req, cpu_cap, mem_req, mem_cap):
+    f_cpu = (cpu_req * FIXED_POINT_ONE) // jnp.maximum(cpu_cap, 1)
+    f_mem = (mem_req * FIXED_POINT_ONE) // jnp.maximum(mem_cap, 1)
+    diff = jnp.abs(f_cpu - f_mem)
+    score = (MAX_PRIORITY * FIXED_POINT_ONE - diff * MAX_PRIORITY) // FIXED_POINT_ONE
+    bad = (cpu_cap == 0) | (mem_cap == 0) | (cpu_req >= cpu_cap) | (mem_req >= mem_cap)
+    return jnp.where(bad, 0, score)
+
+
+def _normalized_max(raw, feasible, reverse: bool):
+    """NormalizeReduce: 10*raw//max over feasible (0 if max==0); reversed
+    variant returns 10 when max==0."""
+    max_c = jnp.max(jnp.where(feasible, raw, 0))
+    if reverse:
+        return jnp.where(
+            max_c > 0, (MAX_PRIORITY * (max_c - raw)) // jnp.maximum(max_c, 1), MAX_PRIORITY
+        )
+    return jnp.where(max_c > 0, (MAX_PRIORITY * raw) // jnp.maximum(max_c, 1), 0)
+
+
+def make_step(dev: StaticArrays, num_zones: int, w: dict):
+    """Builds the scan step: (state, group_id) -> (state', chosen_node)."""
+
+    def step(state: ScanState, gid):
+        g_req = dev.g_request[gid]  # [R]
+        g_nz = dev.g_nonzero[gid]  # [2]
+        g_ports = dev.g_ports[gid]  # [Pv]
+
+        # -- feasibility (filters) ------------------------------------
+        fit = jnp.all(
+            jnp.where(g_req > 0, state.requested + g_req <= dev.node_alloc, True), axis=1
+        )
+        pods_ok = state.pod_count + 1 <= dev.node_alloc_pods
+        ports_ok = ~jnp.any(state.ports_used & g_ports, axis=1)
+        feasible = dev.static_ok[gid] & fit & pods_ok & ports_ok & dev.node_exists
+        n_feasible = jnp.sum(feasible.astype(jnp.int32))
+
+        # -- scores (priorities) --------------------------------------
+        cpu_req = state.nonzero_requested[:, 0] + g_nz[0]
+        mem_req = state.nonzero_requested[:, 1] + g_nz[1]
+        cpu_cap = dev.node_alloc[:, 0]
+        mem_cap = dev.node_alloc[:, 1]
+        total = dev.static_score[gid]
+        if w["least"]:
+            s = (_usage_score(cpu_req, cpu_cap, False) + _usage_score(mem_req, mem_cap, False)) // 2
+            total = total + w["least"] * s
+        if w["most"]:
+            s = (_usage_score(cpu_req, cpu_cap, True) + _usage_score(mem_req, mem_cap, True)) // 2
+            total = total + w["most"] * s
+        if w["balanced"]:
+            total = total + w["balanced"] * _balanced_score(cpu_req, cpu_cap, mem_req, mem_cap)
+        if w["spread"]:
+            cnt = state.spread_counts[gid]  # [N]
+            max_n = jnp.max(jnp.where(feasible, cnt, 0))
+            node_fp = jnp.where(
+                max_n > 0,
+                ((max_n - cnt) * (MAX_PRIORITY * FIXED_POINT_ONE)) // jnp.maximum(max_n, 1),
+                MAX_PRIORITY * FIXED_POINT_ONE,
+            )
+            # zone blend: counts aggregated over feasible nodes per zone
+            has_zone = dev.node_zone >= 0
+            zone_idx = jnp.where(has_zone, dev.node_zone, 0)
+            zsum = (
+                jnp.zeros(num_zones, dtype=jnp.int32)
+                .at[zone_idx]
+                .add(jnp.where(feasible & has_zone, cnt, 0))
+            )
+            max_z = jnp.max(zsum)
+            zcnt = zsum[zone_idx]
+            zone_fp = jnp.where(
+                max_z > 0,
+                ((max_z - zcnt) * (MAX_PRIORITY * FIXED_POINT_ONE)) // jnp.maximum(max_z, 1),
+                MAX_PRIORITY * FIXED_POINT_ONE,
+            )
+            have_zones = dev.g_has_spread[gid] & jnp.any(feasible & has_zone)
+            total_fp = jnp.where(have_zones & has_zone, (node_fp + 2 * zone_fp) // 3, node_fp)
+            total = total + w["spread"] * (total_fp // FIXED_POINT_ONE)
+        if w["node_affinity"]:
+            total = total + w["node_affinity"] * _normalized_max(
+                dev.node_aff_raw[gid], feasible, reverse=False
+            )
+        if w["taint"]:
+            total = total + w["taint"] * _normalized_max(
+                dev.taint_intol_raw[gid], feasible, reverse=True
+            )
+        if w["interpod"]:
+            raw = dev.interpod_raw[gid]
+            max_c = jnp.maximum(0, jnp.max(jnp.where(feasible, raw, INT32_MIN)))
+            min_c = jnp.minimum(0, jnp.min(jnp.where(feasible, raw, INT32_MAX)))
+            rng = max_c - min_c
+            s = jnp.where(rng > 0, (MAX_PRIORITY * (raw - min_c)) // jnp.maximum(rng, 1), 0)
+            total = total + w["interpod"] * s
+
+        # -- selection (selectHost) -----------------------------------
+        masked = jnp.where(feasible, total, INT32_MIN)
+        max_score = jnp.max(masked)
+        ties = feasible & (total == max_score)
+        t_count = jnp.sum(ties.astype(jnp.int32))
+        idx = state.round_robin % jnp.maximum(t_count, 1)
+        cum = jnp.cumsum(ties.astype(jnp.int32))
+        pick_among_ties = jnp.argmax(ties & (cum == idx + 1))
+        only = jnp.argmax(feasible)
+        chosen = jnp.where(
+            n_feasible == 0,
+            jnp.int32(-1),
+            jnp.where(n_feasible == 1, only, pick_among_ties).astype(jnp.int32),
+        )
+        # reference: selectHost (and its counter) runs only when >=2 feasible
+        rr = state.round_robin + (n_feasible >= 2).astype(jnp.int32)
+
+        # -- commit (assume) ------------------------------------------
+        landed = chosen >= 0
+        safe = jnp.maximum(chosen, 0)
+        onehot = (jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe) & landed
+        oh_i = onehot.astype(jnp.int32)
+        new_state = ScanState(
+            requested=state.requested + oh_i[:, None] * g_req[None, :],
+            nonzero_requested=state.nonzero_requested + oh_i[:, None] * g_nz[None, :],
+            pod_count=state.pod_count + oh_i,
+            ports_used=state.ports_used | (onehot[:, None] & g_ports[None, :]),
+            spread_counts=state.spread_counts
+            + dev.spread_inc[:, gid][:, None] * oh_i[None, :],
+            round_robin=rr,
+        )
+        return new_state, chosen
+
+    return step
+
+
+@lru_cache(maxsize=64)
+def _runner(num_zones: int, weights: tuple):
+    w = dict(zip(WEIGHT_KEYS, weights))
+
+    @jax.jit
+    def run(dev: StaticArrays, group_ids, state: ScanState):
+        step = make_step(dev, num_zones, w)
+        return jax.lax.scan(step, state, group_ids)
+
+    return run
+
+
+def schedule_batch_arrays(static: BatchStatic, init: InitialState) -> tuple[np.ndarray, int]:
+    """Run the kernel; returns (chosen node index per pod [-1 = unschedulable],
+    final round-robin counter)."""
+    dev = to_device(static)
+    state = state_to_device(init)
+    group_ids = jnp.asarray(static.group_of_pod)
+    weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
+    run = _runner(int(static.num_zones), weights)
+    final_state, chosen = run(dev, group_ids, state)
+    return np.asarray(chosen), int(final_state.round_robin)

@@ -1,0 +1,3 @@
+"""Device mesh / sharding utilities (the ICI-collective layer)."""
+
+from .mesh import NODE_AXIS, make_mesh, schedule_batch_sharded, shard_state, shard_static

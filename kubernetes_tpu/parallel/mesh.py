@@ -1,0 +1,102 @@
+"""Device mesh + sharding for the scheduling kernel.
+
+The reference's node-axis parallel-for (16 workers,
+``core/generic_scheduler.go:204``, SURVEY.md P1) is THE data-parallel axis
+of a cluster scheduler.  Here it becomes a real mesh axis: every [N]-shaped
+dynamic-state array and the N column of the [G, N] signature arrays shard
+over ``nodes``; XLA GSPMD inserts the collectives (max/sum reductions for
+score normalization → all-reduce over ICI, the cumsum tie-break → prefix
+exchange) exactly where the scan step needs them.
+
+Scale-out model: one scheduler process drives a mesh of chips; 5k nodes /
+8 chips = 640 node rows per chip, each step's work is elementwise on the
+shard plus O(log chips) collectives.  Host↔device traffic stays at the
+batch boundary (group ids in, chosen indices out) — the DCN/REST analogue
+of SURVEY.md §5.8.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.snapshot import BatchStatic, InitialState
+from ..ops.batch_kernel import (
+    StaticArrays,
+    ScanState,
+    WEIGHT_KEYS,
+    _runner,
+    state_to_device,
+    to_device,
+)
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the node axis (the framework's parallel axis)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (NODE_AXIS,))
+
+
+def shard_static(dev: StaticArrays, mesh: Mesh) -> StaticArrays:
+    """Place static arrays: node-axis sharded, signature axis replicated."""
+    n = NamedSharding(mesh, P(NODE_AXIS))
+    n_r = NamedSharding(mesh, P(NODE_AXIS, None))
+    g_n = NamedSharding(mesh, P(None, NODE_AXIS))
+    repl = NamedSharding(mesh, P())
+    return StaticArrays(
+        node_exists=jax.device_put(dev.node_exists, n),
+        node_alloc=jax.device_put(dev.node_alloc, n_r),
+        node_alloc_pods=jax.device_put(dev.node_alloc_pods, n),
+        node_zone=jax.device_put(dev.node_zone, n),
+        static_ok=jax.device_put(dev.static_ok, g_n),
+        node_aff_raw=jax.device_put(dev.node_aff_raw, g_n),
+        taint_intol_raw=jax.device_put(dev.taint_intol_raw, g_n),
+        static_score=jax.device_put(dev.static_score, g_n),
+        interpod_raw=jax.device_put(dev.interpod_raw, g_n),
+        g_request=jax.device_put(dev.g_request, repl),
+        g_nonzero=jax.device_put(dev.g_nonzero, repl),
+        g_ports=jax.device_put(dev.g_ports, repl),
+        g_has_spread=jax.device_put(dev.g_has_spread, repl),
+        spread_inc=jax.device_put(dev.spread_inc, repl),
+    )
+
+
+def shard_state(state: ScanState, mesh: Mesh) -> ScanState:
+    n = NamedSharding(mesh, P(NODE_AXIS))
+    n_r = NamedSharding(mesh, P(NODE_AXIS, None))
+    g_n = NamedSharding(mesh, P(None, NODE_AXIS))
+    repl = NamedSharding(mesh, P())
+    return ScanState(
+        requested=jax.device_put(state.requested, n_r),
+        nonzero_requested=jax.device_put(state.nonzero_requested, n_r),
+        pod_count=jax.device_put(state.pod_count, n),
+        ports_used=jax.device_put(state.ports_used, n_r),
+        spread_counts=jax.device_put(state.spread_counts, g_n),
+        round_robin=jax.device_put(state.round_robin, repl),
+    )
+
+
+def schedule_batch_sharded(
+    static: BatchStatic, init: InitialState, mesh: Mesh
+) -> tuple[np.ndarray, int]:
+    """Run the scan kernel with the node axis sharded over ``mesh``.
+
+    The padded node count must divide evenly by the mesh size (the
+    tensorizer's ``pad_multiple`` should be a multiple of it)."""
+    import jax.numpy as jnp
+
+    dev = shard_static(to_device(static), mesh)
+    state = shard_state(state_to_device(init), mesh)
+    group_ids = jnp.asarray(static.group_of_pod)
+    weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
+    run = _runner(int(static.num_zones), weights)
+    final_state, chosen = run(dev, group_ids, state)
+    return np.asarray(chosen), int(final_state.round_robin)

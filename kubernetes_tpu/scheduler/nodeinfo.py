@@ -94,10 +94,10 @@ class NodeInfo:
         ]
         self.requested.sub(pod_request_vec(pod))
         self.nonzero_requested.sub(pod_nonzero_request_vec(pod))
-        # rebuild ports: multiple pods may share... no — host ports are
-        # exclusive per node, so removal just drops this pod's ports.
-        for port in pod.host_ports():
-            self.used_ports.discard(port)
+        # Rebuild ports from the remaining pods: pods force-bound via
+        # spec.nodeName bypass predicates, so two residents CAN hold the
+        # same host port — a plain discard would free it too early.
+        self.used_ports = {p for q in self.pods for p in q.host_ports()}
         self.generation += 1
         return True
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..api import types as api
+from ..api.selectors import matches_simple_selector
 from .nodeinfo import NodeInfo
 from .units import (
     CPU_MILLI,
@@ -175,7 +176,7 @@ class SelectorSpreadPriority:
         sels = []
         for svc in ctx.services:
             if svc.meta.namespace == pod.meta.namespace and svc.selector:
-                if all(pod.meta.labels.get(k) == v for k, v in svc.selector.items()):
+                if matches_simple_selector(svc.selector, pod.meta.labels):
                     sels.append(("simple", svc.selector))
         for rs in ctx.replicasets:
             if rs.meta.namespace == pod.meta.namespace and not rs.selector.is_empty():
@@ -186,7 +187,7 @@ class SelectorSpreadPriority:
     def _matches_any(self, sels, q: api.Pod) -> bool:
         for kind, sel in sels:
             if kind == "simple":
-                if all(q.meta.labels.get(k) == v for k, v in sel.items()):
+                if matches_simple_selector(sel, q.meta.labels):
                     return True
             else:
                 if sel.matches(q.meta.labels):

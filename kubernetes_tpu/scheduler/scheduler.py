@@ -184,11 +184,20 @@ class Scheduler:
         return True
 
     def handle_schedule_failure(self, pod: api.Pod, err: Exception) -> None:
-        """MakeDefaultErrorFunc (factory.go:718): re-enqueue with backoff."""
+        """MakeDefaultErrorFunc (factory.go:718): re-enqueue with backoff.
+
+        Re-enqueues the *latest* version from the informer cache, not the
+        popped object — a spec patch that landed while the pod was in
+        flight (e.g. adding the missing toleration) must not be lost."""
         self.metrics.schedule_failures.inc()
         self._event(pod, "Warning", "FailedScheduling", str(err))
+        latest = self.informers.informer("Pod").get(pod.meta.key)
+        if latest is None:
+            return  # deleted while we were scheduling it
+        if latest.spec.node_name or not _is_scheduler_pod(latest, self.scheduler_name):
+            return  # bound by someone else, or became terminal
         delay = self.backoff.get_backoff(pod.meta.key)
-        self.queue.add_after(pod, delay)
+        self.queue.add_after(latest, delay)
 
     # -- the per-pod oracle loop (scheduler.go:253) ------------------------
     def schedule_one(self, timeout: Optional[float] = 0.0, async_bind: bool = False) -> bool:

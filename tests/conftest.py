@@ -2,12 +2,20 @@
 
 Tests run on CPU with a virtual 8-device platform so multi-chip sharding
 (mesh tests) executes without TPU hardware; this must be set before jax
-initializes.  Bench runs (bench.py) use the real TPU instead.
+initializes, and must OVERRIDE the ambient platform (the environment may
+point JAX_PLATFORMS at a live TPU tunnel).  Bench runs (bench.py) use the
+real TPU instead.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment may pre-bake jax_platforms (e.g. "axon,cpu" for a TPU
+# tunnel) at a higher precedence than the env var — force it via config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,50 @@
+"""Multi-chip sharding: the kernel must produce identical bindings when the
+node axis is sharded over an 8-device mesh (virtual CPU devices; see
+conftest.py)."""
+
+import random
+
+import jax
+import pytest
+
+from kubernetes_tpu.models import Tensorizer
+from kubernetes_tpu.ops.batch_kernel import schedule_batch_arrays
+from kubernetes_tpu.parallel import make_mesh, schedule_batch_sharded
+from kubernetes_tpu.scheduler import PriorityContext
+
+from tests.test_parity import build_cluster, make_batch
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def _build(seed, n_nodes, n_pods):
+    rng = random.Random(seed)
+    m = build_cluster(rng, n_nodes, zones=3)
+    pctx = PriorityContext(m)
+    pods = make_batch(rng, n_pods)
+    tz = Tensorizer(pad_multiple=8 * 16)  # divisible by mesh size
+    static = tz.build_static(pods, m, pctx, balanced_weight=1, spread_weight=1)
+    init = tz.initial_state(static, m, pctx, pods)
+    return static, init
+
+
+def test_sharded_matches_single_device(mesh):
+    static, init = _build(21, 40, 200)
+    chosen_single, rr_single = schedule_batch_arrays(static, init)
+    chosen_sharded, rr_sharded = schedule_batch_sharded(static, init, mesh)
+    assert (chosen_single == chosen_sharded).all()
+    assert rr_single == rr_sharded
+
+
+def test_sharded_various_mesh_sizes():
+    static, init = _build(22, 24, 100)
+    want, rr_want = schedule_batch_arrays(static, init)
+    for n_dev in (2, 4):
+        mesh = make_mesh(n_dev)
+        got, rr = schedule_batch_sharded(static, init, mesh)
+        assert (want == got).all(), f"mismatch at mesh size {n_dev}"
+        assert rr == rr_want

@@ -1,0 +1,280 @@
+"""Oracle ↔ TPU-kernel binding parity.
+
+The framework's core claim (BASELINE.json): the batched device path
+produces *identical bindings* to the sequential oracle.  These tests run
+both paths over randomized clusters and assert assignment-for-assignment
+equality, including the round-robin tie counter.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+    PodAffinityTerm,
+    ReplicaSet,
+    Service,
+    Taint,
+    Toleration,
+    Volume,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.models import Tensorizer
+from kubernetes_tpu.ops import TPUBatchBackend
+from kubernetes_tpu.scheduler import (
+    FitError,
+    GenericScheduler,
+    PriorityContext,
+    cluster_autoscaler_priorities,
+)
+from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+from kubernetes_tpu.testutil import make_node, make_pod
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def oracle_batch(pods, node_info_map, pctx, algorithm):
+    """Reference behavior: pure sequential oracle with cache feedback."""
+    work = {n: i.clone() for n, i in node_info_map.items()}
+    wctx = PriorityContext(
+        work, services=pctx.services, replicasets=pctx.replicasets,
+        hard_pod_affinity_weight=pctx.hard_pod_affinity_weight,
+    )
+    out = []
+    for pod in pods:
+        try:
+            res = algorithm.schedule(pod, work, wctx)
+            out.append(res.node_name)
+            work[res.node_name].add_pod(pod)
+        except FitError:
+            out.append(None)
+    return out
+
+
+def build_cluster(rng, n_nodes, zones=3, tainted_frac=0.1, existing_per_node=2):
+    node_info_map = {}
+    for i in range(n_nodes):
+        labels = {"kubernetes.io/hostname": f"node-{i:04d}"}
+        if zones:
+            labels[ZONE] = f"zone-{i % zones}"
+        if rng.random() < 0.3:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        taints = []
+        if rng.random() < tainted_frac:
+            taints.append(Taint(key="dedicated", value="special", effect="NoSchedule"))
+        node = make_node(
+            f"node-{i:04d}",
+            cpu=rng.choice(["4", "8", "16"]),
+            memory=rng.choice(["8Gi", "16Gi", "32Gi"]),
+            pods=rng.choice([50, 110]),
+            labels=labels,
+            taints=taints,
+        )
+        info = NodeInfo(node)
+        for j in range(rng.randrange(existing_per_node + 1)):
+            p = make_pod(
+                f"existing-{i}-{j}",
+                cpu=rng.choice(["100m", "500m", "1"]),
+                memory=rng.choice(["128Mi", "512Mi", "1Gi"]),
+                labels={"app": rng.choice(["web", "db", "cache"])},
+                node_name=node.meta.name,
+            )
+            info.add_pod(p)
+        node_info_map[node.meta.name] = info
+    return node_info_map
+
+
+def make_batch(rng, n_pods, templates=None):
+    templates = templates or [
+        dict(cpu="100m", memory="128Mi", labels={"app": "web"}),
+        dict(cpu="500m", memory="512Mi", labels={"app": "db"}),
+        dict(cpu="1", memory="1Gi", labels={"app": "cache"}),
+        dict(cpu="250m", memory="256Mi", labels={"app": "web"},
+             node_selector={"disk": "ssd"}),
+        dict(cpu="200m", memory="128Mi", labels={"app": "batch"},
+             tolerations=[Toleration(key="dedicated", operator="Exists")]),
+    ]
+    pods = []
+    for i in range(n_pods):
+        t = dict(rng.choice(templates))
+        pods.append(make_pod(f"pend-{i:05d}", **t))
+    return pods
+
+
+def assert_parity(pods, node_info_map, pctx, priorities=None, check_kernel_used=True):
+    algo_a = GenericScheduler(priorities=priorities)
+    algo_b = GenericScheduler(priorities=priorities)
+    want = oracle_batch(pods, node_info_map, pctx, algo_a)
+    backend = TPUBatchBackend(algorithm=algo_b)
+    got = backend.schedule_batch(pods, node_info_map, pctx)
+    mismatches = [
+        (p.meta.name, w, g) for p, w, g in zip(pods, want, got) if w != g
+    ]
+    assert not mismatches, f"{len(mismatches)} binding mismatches; first 10: {mismatches[:10]}"
+    assert algo_a._round_robin == algo_b._round_robin, "tie-break counter diverged"
+    if check_kernel_used:
+        assert backend.stats["kernel_pods"] > 0, "kernel path was never exercised"
+    return backend
+
+
+def test_parity_basic_resources():
+    rng = random.Random(1)
+    m = build_cluster(rng, 24, zones=0, tainted_frac=0, existing_per_node=2)
+    pods = make_batch(rng, 120, templates=[
+        dict(cpu="100m", memory="128Mi"),
+        dict(cpu="2", memory="4Gi"),
+        dict(cpu="500m", memory="1Gi"),
+    ])
+    assert_parity(pods, m, PriorityContext(m))
+
+
+def test_parity_zones_spread_services():
+    rng = random.Random(2)
+    m = build_cluster(rng, 30, zones=3)
+    svcs = [Service(meta=ObjectMeta(name=a), selector={"app": a}) for a in ("web", "db", "cache")]
+    pctx = PriorityContext(m, services=svcs)
+    pods = make_batch(rng, 150)
+    assert_parity(pods, m, pctx)
+
+
+def test_parity_replicaset_owners_and_spread():
+    rng = random.Random(3)
+    m = build_cluster(rng, 20, zones=2)
+    rs = ReplicaSet(
+        meta=ObjectMeta(name="rs-web"),
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+    )
+    pctx = PriorityContext(m, replicasets=[rs])
+    ref = OwnerReference(kind="ReplicaSet", name="rs-web", uid="uid-rs-web", controller=True)
+    pods = [
+        make_pod(f"w-{i}", cpu="200m", memory="256Mi", labels={"app": "web"}, owner_refs=[ref])
+        for i in range(80)
+    ]
+    assert_parity(pods, m, pctx)
+
+
+def test_parity_most_requested_binpack():
+    rng = random.Random(4)
+    m = build_cluster(rng, 16, zones=0)
+    pods = make_batch(rng, 100)
+    assert_parity(pods, m, PriorityContext(m), priorities=cluster_autoscaler_priorities())
+
+
+def test_parity_taints_and_node_affinity():
+    rng = random.Random(5)
+    m = build_cluster(rng, 25, zones=3, tainted_frac=0.3)
+    # add PreferNoSchedule taints to some nodes (exercises TaintToleration prio)
+    for i, (name, info) in enumerate(sorted(m.items())):
+        if i % 4 == 0:
+            info.node.spec.taints.append(Taint(key="soft", value="x", effect="PreferNoSchedule"))
+            info.set_node(info.node)
+    pods = make_batch(rng, 120)
+    assert_parity(pods, m, PriorityContext(m))
+
+
+def test_parity_host_ports():
+    rng = random.Random(6)
+    m = build_cluster(rng, 10, zones=0, existing_per_node=0)
+    pods = [make_pod(f"p-{i}", cpu="100m", host_ports=[8080]) for i in range(15)]
+    backend = assert_parity(pods, m, PriorityContext(m))
+    # only 10 nodes -> 10 pods land, 5 unschedulable on both paths
+
+
+def test_parity_unschedulable_overflow():
+    rng = random.Random(7)
+    m = build_cluster(rng, 6, zones=0, existing_per_node=0)
+    pods = make_batch(rng, 120, templates=[dict(cpu="2", memory="4Gi")])
+    backend = assert_parity(pods, m, PriorityContext(m))
+
+
+def test_parity_mixed_eligible_ineligible_segments():
+    rng = random.Random(8)
+    m = build_cluster(rng, 15, zones=2)
+    aff = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "solo"}),
+                topology_key="kubernetes.io/hostname",
+            )
+        ]
+    )
+    pods = []
+    for i in range(90):
+        if i % 10 == 5:
+            pods.append(make_pod(f"solo-{i}", cpu="100m", labels={"app": "solo"}, affinity=aff))
+        elif i % 17 == 3:
+            pods.append(
+                make_pod(
+                    f"vol-{i}", cpu="100m",
+                    volumes=[Volume(name="v", disk_id=f"pd-{i % 4}", disk_kind="gce-pd")],
+                )
+            )
+        else:
+            pods.append(make_pod(f"plain-{i}", cpu="200m", memory="256Mi", labels={"app": "web"}))
+    backend = assert_parity(pods, m, PriorityContext(m))
+    assert backend.stats["oracle_pods"] > 0
+    assert backend.stats["segments"] >= 2
+
+
+def test_parity_existing_affinity_pods_affect_eligible_batch():
+    # existing pods carry required anti-affinity + preferred affinity; the
+    # (affinity-less) batch pods must respect the symmetry rules on both paths
+    rng = random.Random(9)
+    m = build_cluster(rng, 12, zones=3, existing_per_node=0)
+    names = sorted(m.keys())
+    anti = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(selector=LabelSelector.from_match_labels({"app": "web"}), topology_key=ZONE)
+        ]
+    )
+    pref = Affinity(
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=7,
+                term=PodAffinityTerm(selector=LabelSelector.from_match_labels({"app": "web"}), topology_key=ZONE),
+            )
+        ]
+    )
+    lonely = make_pod("lonely", cpu="100m", labels={"app": "db"}, affinity=anti, node_name=names[0])
+    m[names[0]].add_pod(lonely)
+    friendly = make_pod("friendly", cpu="100m", labels={"app": "cache"}, affinity=pref, node_name=names[1])
+    m[names[1]].add_pod(friendly)
+    pods = [make_pod(f"web-{i}", cpu="100m", labels={"app": "web"}) for i in range(24)]
+    backend = assert_parity(pods, m, PriorityContext(m))
+    assert backend.stats["kernel_pods"] == 24  # affinity-less pods stay eligible
+
+
+def test_parity_large_randomized():
+    rng = random.Random(10)
+    m = build_cluster(rng, 60, zones=4, tainted_frac=0.15, existing_per_node=3)
+    svcs = [Service(meta=ObjectMeta(name=a), selector={"app": a}) for a in ("web", "db")]
+    pctx = PriorityContext(m, services=svcs)
+    pods = make_batch(rng, 400)
+    assert_parity(pods, m, pctx)
+
+
+def test_backend_in_scheduler_end_to_end():
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.store import Store
+
+    cs = Clientset(Store())
+    for i in range(8):
+        cs.nodes.create(make_node(f"n{i}", cpu="8", memory="16Gi"))
+    for i in range(40):
+        cs.pods.create(make_pod(f"p{i}", cpu="500m", memory="512Mi"))
+    algo = GenericScheduler()
+    sched = Scheduler(cs, algorithm=algo, backend=TPUBatchBackend(algorithm=algo))
+    sched.start()
+    bound, failed = sched.schedule_pending_batch()
+    assert (bound, failed) == (40, 0)
+    pods, _ = cs.pods.list()
+    assert all(p.spec.node_name for p in pods)
+    # batch respects capacity exactly like the per-pod path would
+    from collections import Counter
+    counts = Counter(p.spec.node_name for p in pods)
+    assert max(counts.values()) <= 110

@@ -219,3 +219,47 @@ def test_metrics_recorded(cluster):
     assert sched.metrics.binding_latency.count == 1
     text = sched.metrics.registry.expose()
     assert "scheduler_e2e_scheduling_latency_microseconds" in text
+
+
+def test_remove_pod_keeps_shared_host_port():
+    # two pods force-bound (bypassing predicates) share a host port; removing
+    # one must not free the port while the other still holds it
+    cache = SchedulerCache()
+    cache.add_node(make_node("n1"))
+    a = make_pod("a", host_ports=[8080], node_name="n1")
+    b = make_pod("b", host_ports=[8080], node_name="n1")
+    cache.add_pod(a)
+    cache.add_pod(b)
+    cache.remove_pod(a)
+    snap = {}
+    cache.snapshot_into(snap)
+    assert ("TCP", 8080) in snap["n1"].used_ports
+    cache.remove_pod(b)
+    snap = {}
+    cache.snapshot_into(snap)
+    assert snap["n1"].used_ports == set()
+
+
+def test_failed_pod_requeued_with_latest_spec(cluster):
+    from kubernetes_tpu.api import Taint, Toleration
+
+    cluster.nodes.create(
+        make_node("n1", taints=[Taint(key="k", value="v", effect="NoSchedule")])
+    )
+    cluster.pods.create(make_pod("p", cpu="100m"))
+    clock = FakeClock()
+    sched = Scheduler(cluster, clock=clock)
+    sched.start()
+    # patch the pod (add the toleration) while it is in flight: simulate by
+    # patching between pump and the scheduling attempt
+    def patch(pod):
+        pod.spec.tolerations = [Toleration(key="k", operator="Equal", value="v")]
+        return pod
+
+    sched.pump()
+    cluster.pods.guaranteed_update("p", patch)
+    sched.run_pending()  # attempt sees stale spec -> fails -> requeues LATEST
+    sched.pump()
+    clock.now += 2.0
+    sched.run_pending()
+    assert cluster.pods.get("p").spec.node_name == "n1"
