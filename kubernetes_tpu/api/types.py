@@ -487,12 +487,14 @@ class NodeCondition:
     type: str = ""
     status: str = "False"  # "True" | "False" | "Unknown"
     heartbeat_revision: int = 0
+    heartbeat_time: float = 0.0  # injected-clock seconds (kubelet heartbeat)
 
     def to_dict(self) -> dict:
         return {
             "type": self.type,
             "status": self.status,
             "heartbeatRevision": self.heartbeat_revision,
+            "heartbeatTime": self.heartbeat_time,
         }
 
     @classmethod
@@ -501,6 +503,7 @@ class NodeCondition:
             type=d.get("type", ""),
             status=d.get("status", "False"),
             heartbeat_revision=int(d.get("heartbeatRevision", 0)),
+            heartbeat_time=float(d.get("heartbeatTime", 0.0)),
         )
 
 

@@ -90,19 +90,32 @@ class PodClient(TypedClient):
     def __init__(self, store: Store):
         super().__init__(store, "Pod", api.Pod)
 
-    def bind(self, binding: api.Binding) -> api.Pod:
+    def bind(self, binding: api.Binding) -> None:
         """Commit a placement (BindingREST.Create → assignPod →
-        setPodHostAndAnnotations, ``storage.go:141,157,191``)."""
+        setPodHostAndAnnotations, ``storage.go:141,157,191``).
 
-        def _assign(pod: api.Pod) -> api.Pod:
-            if pod.spec.node_name and pod.spec.node_name != binding.node_name:
+        Operates at the wire-dict level — no typed round-trip.  This is the
+        scheduler's hottest write (one per scheduled pod; the batch path
+        issues hundreds of thousands), so it must stay O(small-dict-copy)."""
+
+        def _assign(d: dict) -> dict:
+            cur = (d.get("spec") or {}).get("nodeName", "")
+            if cur and cur != binding.node_name:
                 raise BindConflictError(
-                    f"pod {pod.meta.key} already bound to {pod.spec.node_name}"
+                    f"pod {binding.pod_namespace}/{binding.pod_name} already bound to {cur}"
                 )
-            pod.spec.node_name = binding.node_name
-            return pod
+            d.setdefault("spec", {})["nodeName"] = binding.node_name
+            return d
 
-        return self.guaranteed_update(binding.pod_name, _assign, binding.pod_namespace)
+        self._store.guaranteed_update(
+            "Pod", binding.pod_namespace, binding.pod_name, _assign
+        )
+
+    def bind_many(self, bindings: list[api.Binding]) -> list[Optional[str]]:
+        """Batch placement commit (one store txn); per-item error or None."""
+        return self._store.bind_many(
+            [(b.pod_namespace, b.pod_name, b.node_name) for b in bindings]
+        )
 
 
 class BindConflictError(Exception):

@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ..api import types as api
 from ..store.store import ADDED, DELETED, MODIFIED, ExpiredRevisionError, WatchEvent
 from .clientset import TypedClient
 
@@ -197,3 +198,30 @@ class InformerFactory:
     def stop_all(self) -> None:
         for inf in self._informers.values():
             inf.stop()
+
+
+class PodNodeIndex:
+    """By-node pod index over a shared informer (fieldSelector analogue)."""
+
+    def __init__(self, informer: "SharedInformer"):
+        self._by_node: dict[str, dict[str, "api.Pod"]] = {}
+        informer.add_handler(
+            Handler(on_add=self._upsert, on_update=lambda old, new: self._move(old, new),
+                    on_delete=self._drop)
+        )
+
+    def _upsert(self, pod: "api.Pod") -> None:
+        if pod.spec.node_name:
+            self._by_node.setdefault(pod.spec.node_name, {})[pod.meta.key] = pod
+
+    def _move(self, old: Optional["api.Pod"], new: "api.Pod") -> None:
+        if old is not None and old.spec.node_name and old.spec.node_name != new.spec.node_name:
+            self._by_node.get(old.spec.node_name, {}).pop(old.meta.key, None)
+        self._upsert(new)
+
+    def _drop(self, pod: "api.Pod") -> None:
+        if pod.spec.node_name:
+            self._by_node.get(pod.spec.node_name, {}).pop(pod.meta.key, None)
+
+    def pods_on(self, node_name: str) -> list:
+        return list(self._by_node.get(node_name, {}).values())

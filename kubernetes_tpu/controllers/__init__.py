@@ -1,0 +1,8 @@
+"""Reconciling control loops (SURVEY.md L6)."""
+
+from .base import Controller
+from .deployment import DeploymentController, template_hash
+from .garbagecollector import GarbageCollector
+from .manager import ControllerManager
+from .node_lifecycle import NodeLifecycleController, RateLimiter
+from .replicaset import Expectations, ReplicaSetController

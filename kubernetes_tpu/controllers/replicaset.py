@@ -1,0 +1,189 @@
+"""ReplicaSet controller: keep N replicas of a pod template alive.
+
+Capability of ``pkg/controller/replicaset`` (861 LoC; the expectations and
+adoption patterns from ``controller_utils.go`` / ``controller_ref_manager.go``):
+
+- reconciles |owned pods| to ``spec.replicas`` by creating/deleting pods;
+- **adoption**: selector-matching pods with no controller owner are
+  claimed by stamping an ownerReference;
+- **expectations**: in-flight creates/deletes are remembered so a sync
+  storm doesn't double-create before the informer catches up;
+- deletion preference: unbound (pending) pods die first, mirroring the
+  reference's pod-deletion cost ranking.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from ..api import types as api
+from ..api.meta import ObjectMeta, OwnerReference
+from ..store.store import AlreadyExistsError, NotFoundError
+from .base import Controller
+
+_suffix = itertools.count(1)
+
+
+class Expectations:
+    """Per-RS counters of in-flight creates/deletes (controller_utils.go)."""
+
+    def __init__(self):
+        self._exp: dict[str, tuple[int, int]] = {}
+
+    def expect(self, key: str, creates: int, deletes: int) -> None:
+        self._exp[key] = (creates, deletes)
+
+    def observe_create(self, key: str) -> None:
+        c, d = self._exp.get(key, (0, 0))
+        if c > 0:
+            self._exp[key] = (c - 1, d)
+
+    def observe_delete(self, key: str) -> None:
+        c, d = self._exp.get(key, (0, 0))
+        if d > 0:
+            self._exp[key] = (c, d - 1)
+
+    def satisfied(self, key: str) -> bool:
+        c, d = self._exp.get(key, (0, 0))
+        return c <= 0 and d <= 0
+
+    def forget(self, key: str) -> None:
+        self._exp.pop(key, None)
+
+
+class ReplicaSetController(Controller):
+    name = "replicaset"
+
+    def __init__(self, clientset, informers=None, burst_replicas: int = 500, **kw):
+        super().__init__(clientset, informers, **kw)
+        self.expectations = Expectations()
+        self.burst_replicas = burst_replicas
+        self.watch("ReplicaSet")
+        from ..client.informer import Handler
+
+        self.informers.informer("Pod").add_handler(
+            Handler(
+                on_add=lambda pod: self._pod_event(pod, "add"),
+                on_update=lambda old, new: self._pod_event(new, "update"),
+                on_delete=lambda pod: self._pod_event(pod, "delete"),
+            )
+        )
+
+    def _pod_event(self, pod: api.Pod, event: str) -> None:
+        key = self._rs_key_for_pod(pod)
+        if key is None:
+            return
+        # expectations observe only the event kinds they count
+        if event == "add":
+            self.expectations.observe_create(key)
+        elif event == "delete":
+            self.expectations.observe_delete(key)
+        self.queue.add(key)
+
+    def _rs_key_for_pod(self, pod: api.Pod) -> Optional[str]:
+        ref = pod.meta.controller_ref()
+        if ref is not None:
+            if ref.kind != "ReplicaSet":
+                return None
+            return f"{pod.meta.namespace}/{ref.name}"
+        # orphan: wake every RS in the namespace whose selector matches
+        for rs in self.informer("ReplicaSet").list():
+            if rs.meta.namespace == pod.meta.namespace and rs.selector.matches(pod.meta.labels):
+                return rs.meta.key
+        return None
+
+    # -- reconcile ---------------------------------------------------------
+    def _owned_and_orphans(self, rs: api.ReplicaSet):
+        owned, orphans = [], []
+        for pod in self.informer("Pod").list():
+            if pod.meta.namespace != rs.meta.namespace:
+                continue
+            if pod.status.phase in (api.SUCCEEDED, api.FAILED):
+                continue
+            ref = pod.meta.controller_ref()
+            if ref is not None:
+                if ref.kind == "ReplicaSet" and ref.uid == rs.meta.uid:
+                    owned.append(pod)
+            elif not rs.selector.is_empty() and rs.selector.matches(pod.meta.labels):
+                orphans.append(pod)
+        return owned, orphans
+
+    def sync(self, key: str) -> None:
+        namespace, name = key.split("/", 1)
+        try:
+            rs = self.clientset.replicasets.get(name, namespace)
+        except NotFoundError:
+            self.expectations.forget(key)
+            return
+        if not self.expectations.satisfied(key):
+            return  # wait for the informer to observe in-flight changes
+
+        owned, orphans = self._owned_and_orphans(rs)
+        # adoption (controller_ref_manager.go): claim matching orphans
+        for pod in orphans:
+            try:
+                self.clientset.pods.guaranteed_update(
+                    pod.meta.name,
+                    lambda p: self._stamp_owner(p, rs),
+                    pod.meta.namespace,
+                )
+                owned.append(pod)
+            except NotFoundError:
+                continue
+
+        diff = len(owned) - rs.replicas
+        if diff < 0:
+            n = min(-diff, self.burst_replicas)
+            self.expectations.expect(key, n, 0)
+            for _ in range(n):
+                self._create_pod(rs)
+        elif diff > 0:
+            n = min(diff, self.burst_replicas)
+            # prefer deleting pods that aren't running yet (unbound first)
+            victims = sorted(owned, key=lambda p: (bool(p.spec.node_name), p.meta.name))[:n]
+            self.expectations.expect(key, 0, n)
+            for pod in victims:
+                try:
+                    self.clientset.pods.delete(pod.meta.name, pod.meta.namespace)
+                except NotFoundError:
+                    self.expectations.observe_delete(key)
+
+        # status
+        ready = sum(1 for p in owned if p.status.phase == api.RUNNING)
+        if (
+            rs.status_replicas != len(owned)
+            or rs.status_ready_replicas != ready
+            or rs.status_observed_generation != rs.meta.generation
+        ):
+            def _status(cur: api.ReplicaSet) -> api.ReplicaSet:
+                cur.status_replicas = len(owned)
+                cur.status_ready_replicas = ready
+                cur.status_observed_generation = cur.meta.generation
+                return cur
+
+            self.clientset.replicasets.guaranteed_update(name, _status, namespace)
+
+    def _stamp_owner(self, pod: api.Pod, rs: api.ReplicaSet) -> api.Pod:
+        if pod.meta.controller_ref() is None:
+            pod.meta.owner_references.append(
+                OwnerReference(kind="ReplicaSet", name=rs.meta.name, uid=rs.meta.uid, controller=True)
+            )
+        return pod
+
+    def _create_pod(self, rs: api.ReplicaSet) -> None:
+        pod = api.Pod(
+            meta=ObjectMeta(
+                name=f"{rs.meta.name}-{next(_suffix):06d}",
+                namespace=rs.meta.namespace,
+                labels=dict(rs.template.labels),
+                owner_references=[
+                    OwnerReference(kind="ReplicaSet", name=rs.meta.name, uid=rs.meta.uid, controller=True)
+                ],
+            ),
+            spec=api.PodSpec.from_dict(rs.template.spec.to_dict()),
+        )
+        try:
+            self.clientset.pods.create(pod)
+        except AlreadyExistsError:
+            self.expectations.observe_create(rs.meta.key)

@@ -1,0 +1,4 @@
+"""Node agent layer (hollow kubelet fleet for scale testing; SURVEY.md L7/§4.5)."""
+
+from ..client.informer import PodNodeIndex
+from .hollow import HollowFleet, HollowKubelet

@@ -1,0 +1,180 @@
+"""Hollow kubelet: the node agent with a fake runtime.
+
+Capability of the reference's kubemark HollowKubelet
+(``pkg/kubemark/hollow_kubelet.go:48`` — real kubelet wiring over a fake
+Docker client; SURVEY.md §4.5): register the node, heartbeat its Ready
+condition, watch for pods bound to it, "start" them after a configurable
+latency, and report pod/node status back — everything the control plane
+observes from a node, with no containers underneath.  A fleet of these is
+how 5k-node control-plane behavior is tested on one machine.
+
+Scale shape: the fleet shares ONE pod informer with a by-node index (the
+apiserver-side fieldSelector ``spec.nodeName=X`` the real kubelet uses),
+so a tick is O(own pods), not O(cluster pods).
+
+Tick-driven with an injected clock (the kubelet's syncLoop ticks,
+``kubelet.go:1709``, collapsed into an explicit ``tick()``)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..api import types as api
+from ..api.meta import ObjectMeta
+from ..client.clientset import Clientset
+from ..client.informer import PodNodeIndex, SharedInformer
+from ..store.store import AlreadyExistsError, ConflictError, NotFoundError
+
+
+class HollowKubelet:
+    def __init__(
+        self,
+        clientset: Clientset,
+        node_name: str,
+        pod_index: Optional[PodNodeIndex] = None,
+        cpu: str = "8",
+        memory: str = "16Gi",
+        pods: int = 110,
+        labels: Optional[dict] = None,
+        pod_start_latency: float = 0.5,
+        heartbeat_interval: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.clientset = clientset
+        self.node_name = node_name
+        self.pod_index = pod_index
+        self.cpu = cpu
+        self.memory = memory
+        self.pods = pods
+        self.labels = labels or {}
+        self.pod_start_latency = pod_start_latency
+        self.heartbeat_interval = heartbeat_interval
+        self._clock = clock
+        self._last_heartbeat = -1e18
+        self._starting: dict[str, float] = {}  # pod key -> bind-seen time
+
+    # -- registration (kubelet_node_status.go registerWithApiserver) -------
+    def register(self) -> None:
+        labels = dict(self.labels)
+        labels.setdefault(api.HOSTNAME_LABEL, self.node_name)
+        node = api.Node(
+            meta=ObjectMeta(name=self.node_name, namespace="", labels=labels),
+            status=api.NodeStatus(
+                capacity={
+                    api.CPU: api.Quantity(self.cpu),
+                    api.MEMORY: api.Quantity(self.memory),
+                    api.PODS: api.Quantity(self.pods),
+                },
+                allocatable={
+                    api.CPU: api.Quantity(self.cpu),
+                    api.MEMORY: api.Quantity(self.memory),
+                    api.PODS: api.Quantity(self.pods),
+                },
+                conditions=[
+                    api.NodeCondition(
+                        type=api.NODE_READY, status="True", heartbeat_time=self._clock()
+                    )
+                ],
+            ),
+        )
+        try:
+            self.clientset.nodes.create(node)
+        except AlreadyExistsError:
+            self._heartbeat(force=True)
+
+    def _my_pods(self) -> list[api.Pod]:
+        if self.pod_index is not None:
+            return self.pod_index.pods_on(self.node_name)
+        return [
+            p for p in self.clientset.pods.list()[0] if p.spec.node_name == self.node_name
+        ]
+
+    # -- the sync tick -----------------------------------------------------
+    def tick(self) -> dict:
+        """One syncLoop iteration: heartbeat if due, admit newly-bound pods,
+        transition starting pods to Running after the start latency."""
+        now = self._clock()
+        out = {"started": 0, "observed": 0}
+        self._heartbeat()
+
+        mine = self._my_pods()
+        live = {p.meta.key for p in mine}
+        for pod in mine:
+            if pod.status.phase != api.PENDING:
+                continue
+            key = pod.meta.key
+            if key not in self._starting:
+                self._starting[key] = now
+                out["observed"] += 1
+            elif now - self._starting[key] >= self.pod_start_latency:
+                if self._set_running(pod, now):
+                    out["started"] += 1
+                del self._starting[key]
+        self._starting = {k: t for k, t in self._starting.items() if k in live}
+        return out
+
+    def _set_running(self, pod: api.Pod, now: float) -> bool:
+        pod.status.phase = api.RUNNING
+        pod.status.host_ip = self.node_name
+        try:
+            self.clientset.pods.update_status(pod)
+            return True
+        except (NotFoundError, ConflictError):
+            return False
+
+    def _heartbeat(self, force: bool = False) -> None:
+        now = self._clock()
+        if not force and now - self._last_heartbeat < self.heartbeat_interval:
+            return
+        self._last_heartbeat = now
+
+        def _mutate(cur: api.Node) -> api.Node:
+            c = cur.status.condition(api.NODE_READY)
+            if c is None:
+                c = api.NodeCondition(type=api.NODE_READY)
+                cur.status.conditions.append(c)
+            c.status = "True"
+            c.heartbeat_time = now
+            c.heartbeat_revision = cur.meta.resource_version
+            return cur
+
+        try:
+            self.clientset.nodes.guaranteed_update(self.node_name, _mutate, "")
+        except NotFoundError:
+            self.register()
+
+
+class HollowFleet:
+    """N hollow kubelets against one control plane (start-kubemark.sh),
+    sharing one pod informer + by-node index."""
+
+    def __init__(
+        self,
+        clientset: Clientset,
+        n: int,
+        clock: Callable[[], float] = time.monotonic,
+        **kubelet_kw,
+    ):
+        self.informer = SharedInformer(clientset.pods)
+        self.index = PodNodeIndex(self.informer)
+        self.kubelets = [
+            HollowKubelet(
+                clientset, f"hollow-{i:05d}", pod_index=self.index, clock=clock, **kubelet_kw
+            )
+            for i in range(n)
+        ]
+
+    def register_all(self) -> None:
+        for k in self.kubelets:
+            k.register()
+        self.informer.start_manual()
+
+    def tick_all(self) -> dict:
+        self.informer.pump()
+        total = {"started": 0, "observed": 0}
+        for k in self.kubelets:
+            r = k.tick()
+            total["started"] += r["started"]
+            total["observed"] += r["observed"]
+        return total

@@ -249,6 +249,10 @@ class Tensorizer:
                 node = info.node
                 labels = node.meta.labels
                 ok = not node.spec.unschedulable
+                # Ready-condition gate (CheckNodeCondition)
+                if ok:
+                    ready = node.status.condition(api.NODE_READY)
+                    ok = ready is None or ready.status == "True"
                 # host match
                 if ok and rep.spec.node_name:
                     ok = rep.spec.node_name == node.meta.name

@@ -40,6 +40,7 @@ DISK_CONFLICT = "node(s) had no available disk"
 MAX_VOLUME_COUNT = "node(s) exceed max volume count"
 AFFINITY_NOT_MATCH = "node(s) didn't satisfy inter-pod (anti)affinity"
 NODE_UNSCHEDULABLE = "node(s) were unschedulable"
+NODE_NOT_READY = "node(s) were not ready"
 
 
 @dataclass
@@ -247,6 +248,19 @@ def check_node_schedulable(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[s
     return True, []
 
 
+def check_node_condition(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
+    """Ready-condition gate: the reference's scheduler node lister excludes
+    nodes whose Ready condition is not True (``factory.go``
+    getNodeConditionPredicate) — without it, pods land on dead nodes and
+    ping-pong through eviction."""
+    if info.node is None:
+        return False, [NODE_NOT_READY]
+    ready = info.node.status.condition(api.NODE_READY)
+    if ready is not None and ready.status != "True":
+        return False, [NODE_NOT_READY]
+    return True, []
+
+
 # ---------------------------------------------------------------------------
 # Volumes
 # ---------------------------------------------------------------------------
@@ -370,6 +384,7 @@ PredicateFn = Callable[[api.Pod, PredicateMetadata, NodeInfo, PredicateContext],
 
 DEFAULT_PREDICATES: dict[str, PredicateFn] = {
     "CheckNodeSchedulable": check_node_schedulable,
+    "CheckNodeCondition": check_node_condition,
     "NoDiskConflict": no_disk_conflict,
     "MaxVolumeCount": max_volume_count,
     "GeneralPredicates": general_predicates,

@@ -256,7 +256,12 @@ class Scheduler:
         algo_start = self._clock()
         assignments = self.backend.schedule_batch(pods, snapshot, pctx)
         self.metrics.batch_device_latency.observe((self._clock() - algo_start) * 1e6)
+
+        # assume everything first, then commit all bindings in one store txn
+        # (the batch generalization of the reference's async-bind pipeline,
+        # SURVEY.md P9), then roll back the individual CAS losers.
         bound = failed = 0
+        to_bind: list[tuple[api.Pod, api.Binding]] = []
         for pod, node_name in zip(pods, assignments):
             self.metrics.schedule_attempts.inc()
             if node_name is None:
@@ -265,11 +270,34 @@ class Scheduler:
                 continue
             self.cache.assume_pod(pod, node_name)
             self.backoff.forget(pod.meta.key)
-            if self._bind(pod, node_name):
+            to_bind.append(
+                (
+                    pod,
+                    api.Binding(
+                        pod_namespace=pod.meta.namespace,
+                        pod_name=pod.meta.name,
+                        node_name=node_name,
+                    ),
+                )
+            )
+        bind_start = self._clock()
+        errors = self.clientset.pods.bind_many([b for _, b in to_bind])
+        self.metrics.binding_latency.observe((self._clock() - bind_start) * 1e6)
+        now = self._clock()
+        for (pod, binding), err in zip(to_bind, errors):
+            if err is None:
+                self.cache.finish_binding(pod.meta.key)
+                self._event(
+                    pod, "Normal", "Scheduled",
+                    f"Successfully assigned {pod.meta.key} to {binding.node_name}",
+                )
                 bound += 1
             else:
+                logger.warning("bind failed for %s: %s", pod.meta.key, err)
+                self.cache.forget_pod(pod)
+                self._event(pod, "Warning", "FailedBinding", err)
                 failed += 1
-            self.metrics.e2e_scheduling_latency.observe((self._clock() - start) * 1e6)
+            self.metrics.e2e_scheduling_latency.observe((now - start) * 1e6)
         return (bound, failed)
 
     # -- housekeeping ------------------------------------------------------

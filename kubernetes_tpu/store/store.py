@@ -143,9 +143,13 @@ class Store:
             self._emit(WatchEvent(ADDED, kind, key, rev, copy.deepcopy(data)))
             return copy.deepcopy(data)
 
-    def update(self, kind: str, obj: dict, expect_rev: Optional[int] = None) -> dict:
+    def update(
+        self, kind: str, obj: dict, expect_rev: Optional[int] = None, _trusted: bool = False
+    ) -> dict:
         """CAS write.  ``expect_rev`` defaults to obj.metadata.resourceVersion;
-        pass 0/None there to force-write (last-write-wins)."""
+        pass 0/None there to force-write (last-write-wins).  ``_trusted``
+        marks ``obj`` as privately owned (guaranteed_update's copy), skipping
+        one defensive deep copy on the hot write path."""
         with self._mu:
             meta = obj.get("metadata") or {}
             key = object_key(meta.get("namespace", "default"), meta.get("name", ""))
@@ -160,14 +164,55 @@ class Store:
                     f"{kind} {key}: expected rev {expect_rev}, have {item.revision}"
                 )
             rev = self._next_rev()
-            data = copy.deepcopy(obj)
+            data = obj if _trusted else copy.deepcopy(obj)
             m = data["metadata"]
             m["uid"] = item.data["metadata"]["uid"]
             m["resourceVersion"] = rev
             m["creationRevision"] = item.data["metadata"].get("creationRevision", 0)
             bucket[key] = _Item(data=data, revision=rev)
-            self._emit(WatchEvent(MODIFIED, kind, key, rev, copy.deepcopy(data)))
-            return copy.deepcopy(data)
+            ev_copy = copy.deepcopy(data)
+            self._emit(WatchEvent(MODIFIED, kind, key, rev, ev_copy))
+            # the event copy doubles as the caller's return value: both are
+            # read-only by contract, and the stored dict never escapes
+            return ev_copy
+
+    def bind_many(self, items: list[tuple[str, str, str]]) -> list[Optional[str]]:
+        """Batch placement commit: for each (namespace, name, node_name),
+        CAS-set ``spec.nodeName`` under ONE lock acquisition — the etcd-txn
+        analogue of issuing one BindingREST call per pod, shaped for the TPU
+        batch path where hundreds of thousands of bindings land at once.
+
+        Returns one entry per item: None on success, else an error string
+        ("not found" / "conflict: <node>").  Per-pod watch events are still
+        emitted (informers depend on them); their objects share the stored
+        containers/status structures and own fresh spec/metadata dicts —
+        the only fields this path ever mutates in place."""
+        results: list[Optional[str]] = []
+        with self._mu:
+            bucket = self._objects.setdefault("Pod", {})
+            for namespace, name, node_name in items:
+                key = object_key(namespace, name)
+                item = bucket.get(key)
+                if item is None:
+                    results.append("not found")
+                    continue
+                spec = item.data.setdefault("spec", {})
+                cur = spec.get("nodeName", "")
+                if cur and cur != node_name:
+                    results.append(f"conflict: already bound to {cur}")
+                    continue
+                rev = self._next_rev()
+                spec["nodeName"] = node_name
+                item.data["metadata"]["resourceVersion"] = rev
+                item.revision = rev
+                ev_obj = {
+                    **item.data,
+                    "spec": dict(spec),
+                    "metadata": dict(item.data["metadata"]),
+                }
+                self._emit(WatchEvent(MODIFIED, "Pod", key, rev, ev_obj))
+                results.append(None)
+        return results
 
     def guaranteed_update(
         self, kind: str, namespace: str, name: str, mutate: Callable[[dict], dict]
@@ -175,10 +220,11 @@ class Store:
         """Read-modify-write retry loop (``etcd3/store.go:257``).  ``mutate``
         receives a deep copy and returns the new object (or raises)."""
         while True:
-            cur = self.get(kind, namespace, name)
-            new = mutate(copy.deepcopy(cur))
+            cur = self.get(kind, namespace, name)  # private deep copy already
+            rev = int(cur["metadata"]["resourceVersion"])
+            new = mutate(cur)
             try:
-                return self.update(kind, new, expect_rev=int(cur["metadata"]["resourceVersion"]))
+                return self.update(kind, new, expect_rev=rev, _trusted=True)
             except ConflictError:
                 continue
 
@@ -235,11 +281,7 @@ class Store:
                     )
                 for ev in self._log:
                     if ev.revision > from_revision and (kind is None or ev.kind == kind):
-                        q.put(
-                            WatchEvent(
-                                ev.type, ev.kind, ev.key, ev.revision, copy.deepcopy(ev.object)
-                            )
-                        )
+                        q.put(ev)  # shared-immutable (see _emit)
             self._watchers.append((kind, q))
             return Watch(self, q)
 
@@ -248,12 +290,16 @@ class Store:
             self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
 
     def _emit(self, ev: WatchEvent) -> None:
+        # WatchEvent.object is SHARED-IMMUTABLE: one private copy is made at
+        # emit time and handed to the log and every watcher.  Consumers must
+        # not mutate it (the informer parses it into fresh typed objects;
+        # the mutation detector catches violations in tests).
         self._log.append(ev)
         if len(self._log) > self._log_window:
             del self._log[: len(self._log) - self._log_window]
         for kind, q in self._watchers:
             if kind is None or kind == ev.kind:
-                q.put(WatchEvent(ev.type, ev.kind, ev.key, ev.revision, copy.deepcopy(ev.object)))
+                q.put(ev)
 
 
 class ExpiredRevisionError(Exception):

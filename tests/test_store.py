@@ -59,7 +59,7 @@ def test_guaranteed_update_retries(monkeypatch):
     calls = {"n": 0}
     real_update = s.update
 
-    def flaky_update(kind, obj, expect_rev=None):
+    def flaky_update(kind, obj, expect_rev=None, _trusted=False):
         calls["n"] += 1
         if calls["n"] == 1:
             # simulate a concurrent writer landing between read and write
