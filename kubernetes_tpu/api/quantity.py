@@ -159,7 +159,14 @@ def _coerce(v) -> Fraction:
     raise TypeError(f"cannot compare Quantity with {type(v)!r}")
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=4096)
 def _parse(s: str) -> Fraction:
+    """Memoized: clusters reuse a handful of quantity strings ("100m",
+    "128Mi", …) across hundreds of thousands of objects, and Fractions are
+    immutable so sharing is safe."""
     s = s.strip()
     m = _QUANTITY_RE.match(s)
     if not m:

@@ -1,0 +1,283 @@
+"""HTTP API server: the store served over REST with watch streaming.
+
+Capability of the reference's generic API server + kube-apiserver
+(SURVEY.md L3/L4): resource routes installed per kind
+(``apiserver/pkg/endpoints/installer.go``), per-verb handlers
+(``handlers/rest.go:150 GetResource``, ``:276 ListResource`` incl. the
+watch upgrade, ``:388 createHandler``), the Binding subresource
+(``pkg/registry/core/pod/storage/storage.go:128``), and a filter chain
+(``server/config.go:469``) reduced to its behavioral essentials:
+panic recovery → request logging → authentication (optional static bearer
+tokens) → dispatch.
+
+Wire form: JSON.  Watches are chunked JSON-lines streams exactly like the
+reference's ``?watch=true`` (one ``{"type": ..., "object": ...}`` per
+line), resumable via ``resourceVersion``.
+
+Routes:
+  GET    /healthz  /metrics  /version
+  GET    /api/v1/{resource}[?namespace=&watch=true&resourceVersion=N]
+  POST   /api/v1/{resource}
+  GET    /api/v1/namespaces/{ns}/{resource}/{name}
+  PUT    /api/v1/namespaces/{ns}/{resource}/{name}[?cas=true]
+  DELETE /api/v1/namespaces/{ns}/{resource}/{name}
+  POST   /api/v1/namespaces/{ns}/pods/{name}/binding
+  POST   /api/v1/bindings:batch          (the TPU batch-bind txn)
+Cluster-scoped objects use ns "-" in paths.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..store.store import (
+    AlreadyExistsError,
+    ConflictError,
+    ExpiredRevisionError,
+    NotFoundError,
+    Store,
+)
+from ..utils.metrics import Counter, Histogram, Registry
+
+logger = logging.getLogger("kubernetes_tpu.apiserver")
+
+# resource path segment -> kind
+RESOURCES = {
+    "pods": "Pod",
+    "nodes": "Node",
+    "services": "Service",
+    "replicasets": "ReplicaSet",
+    "deployments": "Deployment",
+    "events": "Event",
+}
+CLUSTER_SCOPED = {"Node"}
+
+
+class APIServer:
+    def __init__(
+        self,
+        store: Store,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tokens: Optional[dict[str, str]] = None,  # token -> username; None = authn off
+    ):
+        self.store = store
+        self.tokens = tokens
+        self.registry = Registry()
+        self.request_count = self.registry.register(
+            Counter("apiserver_request_count", "total requests")
+        )
+        self.request_latency = self.registry.register(
+            Histogram("apiserver_request_latencies_microseconds")
+        )
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.httpd.server_address[0]}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def _make_handler(server: APIServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # -- plumbing ------------------------------------------------------
+        def log_message(self, *args):
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _error(self, code: int, reason: str, message: str) -> None:
+            self._send(code, {"kind": "Status", "code": code, "reason": reason, "message": message})
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length)) if length else {}
+
+        def _authn(self) -> bool:
+            if server.tokens is None:
+                return True
+            auth = self.headers.get("Authorization", "")
+            if auth.startswith("Bearer ") and auth[7:] in server.tokens:
+                return True
+            self._error(401, "Unauthorized", "invalid or missing bearer token")
+            return False
+
+        # -- dispatch ------------------------------------------------------
+        def _route(self, method: str) -> None:
+            import time
+
+            start = time.perf_counter()
+            server.request_count.inc()
+            try:
+                if not self._authn():
+                    return
+                self._dispatch(method)
+            except NotFoundError as e:
+                self._error(404, "NotFound", str(e))
+            except AlreadyExistsError as e:
+                self._error(409, "AlreadyExists", str(e))
+            except ConflictError as e:
+                self._error(409, "Conflict", str(e))
+            except ExpiredRevisionError as e:
+                self._error(410, "Expired", str(e))
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # panic recovery filter
+                logger.exception("handler panic")
+                try:
+                    self._error(500, "InternalError", str(e))
+                except Exception:
+                    pass
+            finally:
+                server.request_latency.observe((time.perf_counter() - start) * 1e6)
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_PUT(self):
+            self._route("PUT")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+        def _dispatch(self, method: str) -> None:
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            parts = [p for p in url.path.split("/") if p]
+
+            if url.path == "/healthz":
+                return self._send(200, {"status": "ok"})
+            if url.path == "/metrics":
+                text = server.registry.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+                return
+            if url.path == "/version":
+                from .. import __version__
+
+                return self._send(200, {"version": __version__})
+            if url.path == "/api/v1/bindings:batch" and method == "POST":
+                items = self._body().get("bindings", [])
+                errors = server.store.bind_many(
+                    [(b.get("podNamespace", "default"), b["podName"], b["nodeName"]) for b in items]
+                )
+                return self._send(200, {"errors": errors})
+
+            if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
+                return self._error(404, "NotFound", f"no route for {url.path}")
+            parts = parts[2:]
+
+            # collection routes: /api/v1/{resource}
+            if len(parts) == 1:
+                kind = RESOURCES.get(parts[0])
+                if kind is None:
+                    return self._error(404, "NotFound", f"unknown resource {parts[0]}")
+                if method == "GET":
+                    if q.get("watch", ["false"])[0] == "true":
+                        return self._serve_watch(kind, q)
+                    ns = q.get("namespace", [None])[0]
+                    items, rev = server.store.list(kind, ns)
+                    return self._send(200, {"items": items, "resourceVersion": rev})
+                if method == "POST":
+                    return self._send(201, server.store.create(kind, self._body()))
+                return self._error(405, "MethodNotAllowed", method)
+
+            # object routes: /api/v1/namespaces/{ns}/{resource}/{name}[/binding]
+            if parts[0] == "namespaces" and len(parts) in (4, 5):
+                ns = "" if parts[1] == "-" else parts[1]
+                kind = RESOURCES.get(parts[2])
+                name = parts[3]
+                if kind is None:
+                    return self._error(404, "NotFound", f"unknown resource {parts[2]}")
+                if len(parts) == 5:
+                    if parts[4] == "binding" and kind == "Pod" and method == "POST":
+                        body = self._body()
+                        errors = server.store.bind_many([(ns, name, body["nodeName"])])
+                        if errors[0] is not None:
+                            return self._error(409, "Conflict", errors[0])
+                        return self._send(201, {"status": "bound"})
+                    return self._error(404, "NotFound", f"unknown subresource {parts[4]}")
+                if method == "GET":
+                    return self._send(200, server.store.get(kind, ns, name))
+                if method == "PUT":
+                    obj = self._body()
+                    cas = q.get("cas", ["true"])[0] == "true"
+                    expect = None if cas else 0
+                    out = server.store.update(kind, obj, expect_rev=expect or None)
+                    return self._send(200, out)
+                if method == "DELETE":
+                    return self._send(200, server.store.delete(kind, ns, name))
+                return self._error(405, "MethodNotAllowed", method)
+
+            return self._error(404, "NotFound", f"no route for {url.path}")
+
+        # -- watch streaming (handlers/rest.go:276 watch upgrade) ----------
+        def _serve_watch(self, kind: str, q) -> None:
+            from_rev = None
+            if "resourceVersion" in q:
+                from_rev = int(q["resourceVersion"][0])
+            timeout = float(q.get("timeoutSeconds", ["30"])[0])
+            watch = server.store.watch(kind, from_revision=from_rev)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                import time as _t
+
+                deadline = _t.monotonic() + timeout
+                while _t.monotonic() < deadline:
+                    ev = watch.get(timeout=min(0.5, max(0.0, deadline - _t.monotonic())))
+                    if ev is None:
+                        continue
+                    line = (
+                        json.dumps(
+                            {
+                                "type": ev.type,
+                                "kind": ev.kind,
+                                "key": ev.key,
+                                "revision": ev.revision,
+                                "object": ev.object,
+                            }
+                        ).encode()
+                        + b"\n"
+                    )
+                    self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                watch.stop()
+
+    return Handler

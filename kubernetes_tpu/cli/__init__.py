@@ -1,0 +1,3 @@
+"""CLI layer (kubectl capability; SURVEY.md L8)."""
+
+from .kubectl import Kubectl, main

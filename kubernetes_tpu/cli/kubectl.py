@@ -1,0 +1,365 @@
+"""kubectl-equivalent CLI.
+
+Capability of the reference's kubectl core verbs (``pkg/kubectl``, SURVEY.md
+§2.8) at the depth this control plane serves:
+
+  get / describe / create -f / apply -f / delete / scale / cordon /
+  uncordon / drain / events / top nodes
+
+``apply`` is declarative create-or-update keyed on the last-applied
+configuration annotation (the essential of the reference's 3-way strategic
+merge, ``cmd/apply.go``): unchanged manifests are left alone, changed ones
+update spec/labels while preserving cluster-owned fields.  ``drain``
+cordons then evicts (``cmd/drain.go``).  Manifests are YAML or JSON, one or
+many documents.
+
+Speaks to an API server over HTTP (``--server``), or to an in-process
+clientset when embedded (tests, single-binary demos).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+import yaml
+
+from ..api import types as api
+from ..client.clientset import Clientset
+from ..client.remote import RemoteStore
+from ..store.store import AlreadyExistsError, NotFoundError
+
+LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+KIND_TO_RESOURCE = {
+    "Pod": "pods",
+    "Node": "nodes",
+    "Service": "services",
+    "ReplicaSet": "replicasets",
+    "Deployment": "deployments",
+    "Event": "events",
+}
+RESOURCE_ALIASES = {
+    "po": "pods",
+    "pod": "pods",
+    "pods": "pods",
+    "no": "nodes",
+    "node": "nodes",
+    "nodes": "nodes",
+    "svc": "services",
+    "service": "services",
+    "services": "services",
+    "rs": "replicasets",
+    "replicaset": "replicasets",
+    "replicasets": "replicasets",
+    "deploy": "deployments",
+    "deployment": "deployments",
+    "deployments": "deployments",
+    "ev": "events",
+    "events": "events",
+}
+RESOURCE_TO_KIND = {v: k for k, v in KIND_TO_RESOURCE.items()}
+
+
+class Kubectl:
+    def __init__(self, clientset: Clientset, out=None):
+        self.cs = clientset
+        self.out = out or sys.stdout
+
+    def _print(self, *cols_rows) -> None:
+        rows = [r for r in cols_rows]
+        widths = [max(len(str(r[i])) for r in rows) for i in range(len(rows[0]))]
+        for r in rows:
+            self.out.write("  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
+
+    # -- get ---------------------------------------------------------------
+    def get(self, resource: str, name: Optional[str] = None, namespace: Optional[str] = None,
+            output: str = "") -> int:
+        resource = RESOURCE_ALIASES.get(resource, resource)
+        kind = RESOURCE_TO_KIND.get(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return 1
+        client = self.cs.client_for(kind)
+        if name:
+            try:
+                objs = [client.get(name, namespace)]
+            except NotFoundError:
+                self.out.write(f'Error: {resource} "{name}" not found\n')
+                return 1
+        else:
+            objs, _ = client.list(namespace)
+        if output == "json":
+            docs = [o.to_dict() for o in objs]
+            self.out.write(json.dumps(docs[0] if name else {"items": docs}, indent=2) + "\n")
+            return 0
+        if output == "yaml":
+            docs = [o.to_dict() for o in objs]
+            self.out.write(yaml.safe_dump(docs[0] if name else {"items": docs}))
+            return 0
+        rows = [self._headers(kind)]
+        for o in objs:
+            rows.append(self._row(kind, o))
+        self._print(*rows)
+        return 0
+
+    def _headers(self, kind: str):
+        return {
+            "Pod": ("NAME", "STATUS", "NODE", "PRIORITY"),
+            "Node": ("NAME", "READY", "UNSCHEDULABLE", "CPU", "MEMORY"),
+            "Deployment": ("NAME", "DESIRED", "CURRENT", "UP-TO-DATE", "READY"),
+            "ReplicaSet": ("NAME", "DESIRED", "CURRENT", "READY"),
+            "Service": ("NAME", "SELECTOR"),
+            "Event": ("OBJECT", "TYPE", "REASON", "MESSAGE"),
+        }[kind]
+
+    def _row(self, kind: str, o):
+        if kind == "Pod":
+            return (o.meta.name, o.status.phase, o.spec.node_name or "<none>", o.spec.priority)
+        if kind == "Node":
+            ready = o.status.condition(api.NODE_READY)
+            return (
+                o.meta.name,
+                ready.status if ready else "Unknown",
+                o.spec.unschedulable,
+                str(o.status.allocatable.get(api.CPU, "")),
+                str(o.status.allocatable.get(api.MEMORY, "")),
+            )
+        if kind == "Deployment":
+            return (o.meta.name, o.replicas, o.status_replicas, o.status_updated_replicas,
+                    o.status_ready_replicas)
+        if kind == "ReplicaSet":
+            return (o.meta.name, o.replicas, o.status_replicas, o.status_ready_replicas)
+        if kind == "Service":
+            return (o.meta.name, ",".join(f"{k}={v}" for k, v in o.selector.items()))
+        if kind == "Event":
+            return (o.involved_key, o.type, o.reason, o.message[:80])
+        return (o.meta.name,)
+
+    # -- describe ----------------------------------------------------------
+    def describe(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
+        resource = RESOURCE_ALIASES.get(resource, resource)
+        kind = RESOURCE_TO_KIND.get(resource)
+        try:
+            obj = self.cs.client_for(kind).get(name, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(yaml.safe_dump(obj.to_dict(), sort_keys=False))
+        events, _ = self.cs.events.list()
+        related = [e for e in events if e.involved_key.endswith(f"/{name}") or e.involved_key == name]
+        if related:
+            self.out.write("Events:\n")
+            for e in related[-10:]:
+                self.out.write(f"  {e.type}\t{e.reason}\t{e.message}\n")
+        return 0
+
+    # -- create / apply / delete ------------------------------------------
+    def _load_manifests(self, path: str) -> list[dict]:
+        text = sys.stdin.read() if path == "-" else open(path).read()
+        return [d for d in yaml.safe_load_all(text) if d]
+
+    def create(self, filename: str) -> int:
+        rc = 0
+        for doc in self._load_manifests(filename):
+            kind = doc.get("kind", "")
+            if kind not in KIND_TO_RESOURCE:
+                self.out.write(f"error: unknown kind {kind!r} in manifest\n")
+                rc = 1
+                continue
+            try:
+                obj = self.cs.client_for(kind).create(api.from_dict(doc))
+                self.out.write(f"{KIND_TO_RESOURCE[kind]}/{obj.meta.name} created\n")
+            except AlreadyExistsError:
+                self.out.write(f"Error: {kind} already exists\n")
+                rc = 1
+        return rc
+
+    def apply(self, filename: str) -> int:
+        for doc in self._load_manifests(filename):
+            kind = doc.get("kind", "")
+            if kind not in KIND_TO_RESOURCE:
+                self.out.write(f"error: unknown kind {kind!r} in manifest\n")
+                return 1
+            client = self.cs.client_for(kind)
+            manifest = json.dumps(doc, sort_keys=True)
+            meta = doc.get("metadata") or {}
+            name = meta.get("name", "")
+            ns = meta.get("namespace", client.default_namespace)
+            try:
+                cur = client.get(name, ns)
+            except (NotFoundError, KeyError):
+                obj = api.from_dict(doc)
+                obj.meta.annotations[LAST_APPLIED] = manifest
+                client.create(obj)
+                self.out.write(f"{KIND_TO_RESOURCE[kind]}/{name} created\n")
+                continue
+            if cur.meta.annotations.get(LAST_APPLIED) == manifest:
+                self.out.write(f"{KIND_TO_RESOURCE[kind]}/{name} unchanged\n")
+                continue
+
+            def _merge(live):
+                desired = api.from_dict(doc)
+                desired.meta = live.meta  # preserve cluster-owned identity
+                desired.meta.labels = dict((doc.get("metadata") or {}).get("labels") or {})
+                desired.meta.annotations = dict(live.meta.annotations)
+                desired.meta.annotations[LAST_APPLIED] = manifest
+                if hasattr(live, "status"):
+                    desired.status = live.status  # status is cluster-owned
+                return desired
+
+            client.guaranteed_update(name, _merge, ns)
+            self.out.write(f"{KIND_TO_RESOURCE[kind]}/{name} configured\n")
+        return 0
+
+    def delete(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
+        resource = RESOURCE_ALIASES.get(resource, resource)
+        kind = RESOURCE_TO_KIND.get(resource)
+        try:
+            self.cs.client_for(kind).delete(name, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} deleted\n")
+        return 0
+
+    # -- scale / cordon / drain -------------------------------------------
+    def scale(self, resource: str, name: str, replicas: int, namespace: Optional[str] = None) -> int:
+        resource = RESOURCE_ALIASES.get(resource, resource)
+        kind = RESOURCE_TO_KIND.get(resource)
+        if kind not in ("Deployment", "ReplicaSet"):
+            self.out.write(f"error: cannot scale {resource}\n")
+            return 1
+
+        def _scale(obj):
+            obj.replicas = replicas
+            return obj
+
+        try:
+            self.cs.client_for(kind).guaranteed_update(name, _scale, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} scaled to {replicas}\n")
+        return 0
+
+    def cordon(self, name: str, on: bool = True) -> int:
+        def _set(node):
+            node.spec.unschedulable = on
+            return node
+
+        try:
+            self.cs.nodes.guaranteed_update(name, _set, "")
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: node "{name}" not found\n')
+            return 1
+        self.out.write(f"node/{name} {'cordoned' if on else 'uncordoned'}\n")
+        return 0
+
+    def drain(self, name: str) -> int:
+        """cordon + evict every pod on the node (cmd/drain.go)."""
+        rc = self.cordon(name, True)
+        if rc:
+            return rc
+        pods, _ = self.cs.pods.list()
+        for pod in pods:
+            if pod.spec.node_name == name:
+                try:
+                    self.cs.pods.delete(pod.meta.name, pod.meta.namespace)
+                    self.out.write(f"pod/{pod.meta.name} evicted\n")
+                except NotFoundError:
+                    pass
+        self.out.write(f"node/{name} drained\n")
+        return 0
+
+    def top_nodes(self) -> int:
+        nodes, _ = self.cs.nodes.list()
+        pods, _ = self.cs.pods.list()
+        from ..scheduler.units import CPU_MILLI, MEM_MIB, pod_request_vec
+
+        usage: dict[str, list[int]] = {}
+        for p in pods:
+            if p.spec.node_name:
+                vec = pod_request_vec(p)
+                u = usage.setdefault(p.spec.node_name, [0, 0])
+                u[0] += vec[CPU_MILLI]
+                u[1] += vec[MEM_MIB]
+        rows = [("NAME", "CPU(requested)", "MEMORY(requested)")]
+        for n in nodes:
+            u = usage.get(n.meta.name, [0, 0])
+            rows.append((n.meta.name, f"{u[0]}m", f"{u[1]}Mi"))
+        self._print(*rows)
+        return 0
+
+
+def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None, out=None) -> int:
+    # SUPPRESS so a subparser never clobbers a value parsed before the verb
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--server", default=argparse.SUPPRESS)
+    common.add_argument("--token", default=argparse.SUPPRESS)
+    common.add_argument("-n", "--namespace", default=argparse.SUPPRESS)
+    common.add_argument("-o", "--output", default=argparse.SUPPRESS, choices=["", "json", "yaml"])
+
+    parser = argparse.ArgumentParser(prog="kubectl-tpu", parents=[common])
+    sub = parser.add_subparsers(dest="verb", required=True)
+
+    p = sub.add_parser("get", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name", nargs="?")
+    p = sub.add_parser("describe", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name")
+    p = sub.add_parser("create", parents=[common])
+    p.add_argument("-f", "--filename", required=True)
+    p = sub.add_parser("apply", parents=[common])
+    p.add_argument("-f", "--filename", required=True)
+    p = sub.add_parser("delete", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name")
+    p = sub.add_parser("scale", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("--replicas", type=int, required=True)
+    p = sub.add_parser("cordon", parents=[common])
+    p.add_argument("name")
+    p = sub.add_parser("uncordon", parents=[common])
+    p.add_argument("name")
+    p = sub.add_parser("drain", parents=[common])
+    p.add_argument("name")
+    p = sub.add_parser("top", parents=[common])
+    p.add_argument("what", choices=["nodes"])
+
+    args = parser.parse_args(argv)
+    server = getattr(args, "server", "http://127.0.0.1:8080")
+    token = getattr(args, "token", None)
+    namespace = getattr(args, "namespace", None)
+    output = getattr(args, "output", "")
+    cs = clientset or Clientset(RemoteStore(server, token=token))
+    k = Kubectl(cs, out=out)
+    if args.verb == "get":
+        return k.get(args.resource, args.name, namespace, output)
+    if args.verb == "describe":
+        return k.describe(args.resource, args.name, namespace)
+    if args.verb == "create":
+        return k.create(args.filename)
+    if args.verb == "apply":
+        return k.apply(args.filename)
+    if args.verb == "delete":
+        return k.delete(args.resource, args.name, namespace)
+    if args.verb == "scale":
+        return k.scale(args.resource, args.name, args.replicas, namespace)
+    if args.verb == "cordon":
+        return k.cordon(args.name, True)
+    if args.verb == "uncordon":
+        return k.cordon(args.name, False)
+    if args.verb == "drain":
+        return k.drain(args.name)
+    if args.verb == "top":
+        return k.top_nodes()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 """Client machinery: clientset, informers, workqueues (SURVEY.md L5)."""
 
 from .clientset import BindConflictError, Clientset, PodClient, TypedClient
-from .informer import CacheMutationError, Handler, InformerFactory, PodNodeIndex, SharedInformer
+from .informer import CacheMutationError, Handler, InformerFactory, PodNodeIndex, PodOwnerIndex, SharedInformer
 from .workqueue import ExponentialBackoff, WorkQueue
+from .leaderelection import LeaderElector

@@ -225,3 +225,43 @@ class PodNodeIndex:
 
     def pods_on(self, node_name: str) -> list:
         return list(self._by_node.get(node_name, {}).values())
+
+
+class PodOwnerIndex:
+    """Pods indexed by controller-owner UID, plus orphans by namespace — the
+    index that makes ReplicaSet reconciliation O(pods-of-this-RS) instead of
+    O(cluster-pods) (client-go keeps the same index inside its Indexer)."""
+
+    def __init__(self, informer: "SharedInformer"):
+        self._by_owner: dict[str, dict[str, object]] = {}
+        self._orphans: dict[str, dict[str, object]] = {}  # namespace -> key -> pod
+        informer.add_handler(
+            Handler(
+                on_add=self._upsert,
+                on_update=lambda old, new: self._move(old, new),
+                on_delete=self._drop,
+            )
+        )
+
+    def _slot(self, pod):
+        ref = pod.meta.controller_ref()
+        if ref is not None:
+            return self._by_owner.setdefault(ref.uid, {})
+        return self._orphans.setdefault(pod.meta.namespace, {})
+
+    def _upsert(self, pod) -> None:
+        self._slot(pod)[pod.meta.key] = pod
+
+    def _move(self, old, new) -> None:
+        if old is not None:
+            self._slot(old).pop(old.meta.key, None)
+        self._upsert(new)
+
+    def _drop(self, pod) -> None:
+        self._slot(pod).pop(pod.meta.key, None)
+
+    def owned_by(self, uid: str) -> list:
+        return list(self._by_owner.get(uid, {}).values())
+
+    def orphans_in(self, namespace: str) -> list:
+        return list(self._orphans.get(namespace, {}).values())

@@ -1,0 +1,137 @@
+"""Leader election: active/passive HA via a store-held lease.
+
+Capability of ``client-go/tools/leaderelection``
+(``leaderelection.go:152 RunOrDie``, ``:172 acquire``): candidates race to
+CAS a lease object; the holder renews within the lease duration, standbys
+take over when the renewal goes stale.  The scheduler and controller
+manager run one active instance this way (SURVEY.md P6).
+
+The lease is an annotated Event-kind object (the reference uses an
+annotated Endpoints/ConfigMap the same way) with holder identity + renew
+deadline in injected-clock time; everything is CAS so split-brain is
+impossible at the store level."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional
+
+from ..api import types as api
+from ..api.meta import ObjectMeta
+from ..store.store import AlreadyExistsError, ConflictError, NotFoundError
+from .clientset import Clientset
+
+LEASE_ANNOTATION = "control-plane.alpha.kubernetes.io/leader"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        clientset: Clientset,
+        lock_name: str,
+        identity: str,
+        lease_duration: float = 15.0,
+        renew_deadline: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.clientset = clientset
+        self.lock_name = lock_name
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self._clock = clock
+        self._is_leader = False
+
+    # -- lease record ------------------------------------------------------
+    def _read(self) -> Optional[dict]:
+        try:
+            obj = self.clientset.events.get(self.lock_name, "kube-system")
+        except NotFoundError:
+            return None
+        raw = obj.meta.annotations.get(LEASE_ANNOTATION)
+        return json.loads(raw) if raw else None
+
+    def _record(self) -> dict:
+        return {
+            "holderIdentity": self.identity,
+            "renewTime": self._clock(),
+            "leaseDurationSeconds": self.lease_duration,
+        }
+
+    # -- acquire / renew (leaderelection.go:172 acquire, :202 renew) -------
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; returns True while this identity holds the
+        lease.  Callers loop this (or use ``run``)."""
+        now = self._clock()
+        cur = self._read()
+        if cur is None:
+            try:
+                self.clientset.events.create(
+                    api.Event(
+                        meta=ObjectMeta(
+                            name=self.lock_name,
+                            namespace="kube-system",
+                            annotations={LEASE_ANNOTATION: json.dumps(self._record())},
+                        ),
+                        reason="LeaderElection",
+                    )
+                )
+                self._is_leader = True
+                return True
+            except AlreadyExistsError:
+                cur = self._read()
+
+        holder = cur.get("holderIdentity") if cur else None
+        expired = cur is None or now > cur.get("renewTime", 0) + cur.get(
+            "leaseDurationSeconds", self.lease_duration
+        )
+        if holder != self.identity and not expired:
+            self._is_leader = False
+            return False
+
+        # ours to renew, or stale and up for grabs — CAS it
+        def _mutate(obj: api.Event) -> api.Event:
+            inner = json.loads(obj.meta.annotations.get(LEASE_ANNOTATION, "{}") or "{}")
+            inner_holder = inner.get("holderIdentity")
+            inner_expired = now > inner.get("renewTime", 0) + inner.get(
+                "leaseDurationSeconds", self.lease_duration
+            )
+            if inner_holder != self.identity and not inner_expired:
+                raise _LostRace()
+            obj.meta.annotations[LEASE_ANNOTATION] = json.dumps(self._record())
+            return obj
+
+        try:
+            self.clientset.events.guaranteed_update(self.lock_name, _mutate, "kube-system")
+            self._is_leader = True
+            return True
+        except (_LostRace, NotFoundError, ConflictError):
+            self._is_leader = False
+            return False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def release(self) -> None:
+        """Voluntarily drop the lease (clean shutdown)."""
+        if not self._is_leader:
+            return
+
+        def _mutate(obj: api.Event) -> api.Event:
+            inner = json.loads(obj.meta.annotations.get(LEASE_ANNOTATION, "{}") or "{}")
+            if inner.get("holderIdentity") == self.identity:
+                inner["renewTime"] = -1e18  # instantly stale at any clock
+                obj.meta.annotations[LEASE_ANNOTATION] = json.dumps(inner)
+            return obj
+
+        try:
+            self.clientset.events.guaranteed_update(self.lock_name, _mutate, "kube-system")
+        except NotFoundError:
+            pass
+        self._is_leader = False
+
+
+class _LostRace(Exception):
+    pass

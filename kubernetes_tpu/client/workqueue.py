@@ -14,6 +14,7 @@ from __future__ import annotations
 import heapq
 import threading
 import time
+from collections import deque
 from typing import Callable, Hashable, Optional
 
 
@@ -44,7 +45,7 @@ class WorkQueue:
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._cond = threading.Condition()
-        self._queue: list[Hashable] = []
+        self._queue: deque[Hashable] = deque()
         self._dirty: set[Hashable] = set()
         self._processing: set[Hashable] = set()
         self._shutdown = False
@@ -105,7 +106,7 @@ class WorkQueue:
             while True:
                 next_delay = self._drain_delayed_locked()
                 if self._queue:
-                    item = self._queue.pop(0)
+                    item = self._queue.popleft()
                     self._processing.add(item)
                     self._dirty.discard(item)
                     return item

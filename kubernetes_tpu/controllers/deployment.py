@@ -130,7 +130,6 @@ class DeploymentController(Controller):
                 want_new = min(new_rs.replicas + allowed_up, dep.replicas)
                 if want_new != new_rs.replicas:
                     self._scale_rs(new_rs, want_new)
-                    new_rs.replicas = want_new
                 # scale old down within maxUnavailable, counting only READY
                 # new replicas as available coverage
                 min_available = dep.replicas - dep.max_unavailable

@@ -36,7 +36,17 @@ class GarbageCollector(Controller):
         if inf is None:
             return True  # unknown kinds are never collected against
         owner = inf.get(f"{namespace}/{ref.name}")
-        return owner is not None and owner.meta.uid == ref.uid
+        if owner is not None and owner.meta.uid == ref.uid:
+            return True
+        # Informer caches race in threaded mode (a dependent's add can land
+        # before its owner's add on a different watch thread).  Absence must
+        # be confirmed against the LIVE API before deleting — the reference
+        # GC does the same quarantine re-check.
+        try:
+            live = self.clientset.client_for(ref.kind).get(ref.name, namespace)
+            return live.meta.uid == ref.uid
+        except NotFoundError:
+            return False
 
     def sync(self, key: str) -> None:
         kind, obj_key = key.split("|", 1)

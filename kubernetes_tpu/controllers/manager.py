@@ -43,13 +43,21 @@ class ControllerManager:
         kw = dict(controller_kw)
         if clock is not None:
             kw["clock"] = clock
+        consumed: set[str] = {"clock"}
         for name in enabled or list(DEFAULT_CONTROLLERS):
             ctor = DEFAULT_CONTROLLERS[name]
             accepted = set(inspect.signature(ctor.__init__).parameters)
             # pass each controller only the options it declares ("clock" is
             # universal via the Controller base)
             sub_kw = {k: v for k, v in kw.items() if k in accepted or k == "clock"}
+            consumed |= set(sub_kw)
             self.controllers[name] = ctor(clientset, informers=self.informers, **sub_kw)
+        leftover = set(kw) - consumed
+        if leftover:
+            raise TypeError(
+                f"options {sorted(leftover)} not accepted by any enabled controller "
+                f"({sorted(self.controllers)}) — typo or missing controller?"
+            )
 
     def start(self, manual: bool = True, workers_per_controller: int = 1) -> None:
         if manual:

@@ -60,8 +60,9 @@ class ReplicaSetController(Controller):
         self.expectations = Expectations()
         self.burst_replicas = burst_replicas
         self.watch("ReplicaSet")
-        from ..client.informer import Handler
+        from ..client.informer import Handler, PodOwnerIndex
 
+        self.pod_index = PodOwnerIndex(self.informers.informer("Pod"))
         self.informers.informer("Pod").add_handler(
             Handler(
                 on_add=lambda pod: self._pod_event(pod, "add"),
@@ -95,18 +96,20 @@ class ReplicaSetController(Controller):
 
     # -- reconcile ---------------------------------------------------------
     def _owned_and_orphans(self, rs: api.ReplicaSet):
-        owned, orphans = [], []
-        for pod in self.informer("Pod").list():
-            if pod.meta.namespace != rs.meta.namespace:
-                continue
-            if pod.status.phase in (api.SUCCEEDED, api.FAILED):
-                continue
-            ref = pod.meta.controller_ref()
-            if ref is not None:
-                if ref.kind == "ReplicaSet" and ref.uid == rs.meta.uid:
-                    owned.append(pod)
-            elif not rs.selector.is_empty() and rs.selector.matches(pod.meta.labels):
-                orphans.append(pod)
+        """O(pods-of-this-RS) via the owner-uid index, not O(cluster-pods)."""
+        owned = [
+            p
+            for p in self.pod_index.owned_by(rs.meta.uid)
+            if p.meta.namespace == rs.meta.namespace
+            and p.status.phase not in (api.SUCCEEDED, api.FAILED)
+        ]
+        orphans = []
+        if not rs.selector.is_empty():
+            for pod in self.pod_index.orphans_in(rs.meta.namespace):
+                if pod.status.phase in (api.SUCCEEDED, api.FAILED):
+                    continue
+                if rs.selector.matches(pod.meta.labels):
+                    orphans.append(pod)
         return owned, orphans
 
     def sync(self, key: str) -> None:

@@ -115,10 +115,13 @@ class HollowKubelet:
         return out
 
     def _set_running(self, pod: api.Pod, now: float) -> bool:
-        pod.status.phase = api.RUNNING
-        pod.status.host_ip = self.node_name
+        # pod may be a shared informer-cache object (PodNodeIndex path):
+        # never mutate it — build the status update on a private copy
+        update = api.Pod.from_dict(pod.to_dict())
+        update.status.phase = api.RUNNING
+        update.status.host_ip = self.node_name
         try:
-            self.clientset.pods.update_status(pod)
+            self.clientset.pods.update_status(update)
             return True
         except (NotFoundError, ConflictError):
             return False

@@ -35,6 +35,7 @@ from typing import Optional
 import numpy as np
 
 from ..api import types as api
+from ..native import MatchEngine
 from ..scheduler.nodeinfo import NodeInfo
 from ..scheduler.predicates import _pod_matches_term
 from ..scheduler.priorities import (
@@ -457,18 +458,44 @@ class Tensorizer:
             for port in info.used_ports:
                 if port in port_idx:
                     ports_used[j, port_idx[port]] = True
-            # existing matching-pod counts per spread group (zone sums are
-            # recomputed in-step from these, over the feasible mask)
-            for g, sels in g_selectors.items():
-                if not sels:
-                    continue
-                rep = reps[g]
-                cnt = 0
-                for q in info.pods:
-                    if q.meta.namespace == rep.meta.namespace and ssp._matches_any(sels, q):
-                        cnt += 1
-                if cnt:
-                    spread_counts[g, j] = cnt
+
+        # existing matching-pod counts per spread group (zone sums are
+        # recomputed in-step from these, over the feasible mask).  This is
+        # groups x existing-pods selector matching — tens of millions of
+        # probes on a loaded 150k-pod cluster — so it runs in the native
+        # engine (csrc/labelmatch.cpp); namespace scoping rides along as a
+        # reserved pseudo-label.
+        groups_with_sels = {g: sels for g, sels in g_selectors.items() if sels}
+        if groups_with_sels:
+            eng = MatchEngine()
+            NS_KEY = "\x00ns"
+            sel_ids: dict[int, list[int]] = {}
+            for g, sels in groups_with_sels.items():
+                ns_req = (NS_KEY, "Eq", [reps[g].meta.namespace])
+                ids = []
+                for kind, sel in sels:
+                    if kind == "simple":
+                        reqs = [ns_req] + [(k, "Eq", [str(v)]) for k, v in sel.items()]
+                    else:
+                        reqs = (
+                            [ns_req]
+                            + [(k, "Eq", [str(v)]) for k, v in sel.match_labels.items()]
+                            + [(r.key, r.operator, list(r.values)) for r in sel.match_expressions]
+                        )
+                    ids.append(eng.add_selector(reqs))
+                sel_ids[g] = ids
+            pod_lids: list[int] = []
+            pod_node_j: list[int] = []
+            for j, name in enumerate(static.node_names):
+                for q in node_info_map[name].pods:
+                    pod_lids.append(eng.add_labelmap({**q.meta.labels, NS_KEY: q.meta.namespace}))
+                    pod_node_j.append(j)
+            if pod_lids:
+                node_j = np.asarray(pod_node_j, dtype=np.int64)
+                for g, ids in sel_ids.items():
+                    hits = eng.match_any(ids, pod_lids)
+                    np.add.at(spread_counts[g], node_j[hits], 1)
+            eng.close()
 
         return InitialState(
             requested=requested,

@@ -1,0 +1,211 @@
+"""ctypes bridge to the native C++ engines in ``csrc/``.
+
+Builds ``liblabelmatch.so`` with g++ on first use (cached next to the
+sources); every consumer falls back to the pure-Python implementation when
+the toolchain is unavailable, so the framework never hard-depends on the
+native layer — it just gets faster with it (SURVEY.md §7.1's split: Python
+wiring, compiled hot loops)."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+logger = logging.getLogger("kubernetes_tpu.native")
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_SO_PATH = os.path.join(_CSRC, "liblabelmatch.so")
+_SRC_PATH = os.path.join(_CSRC, "labelmatch.cpp")
+
+_lib = None
+_lib_mu = threading.Lock()
+_build_failed = False
+
+OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT, OP_EQ = range(7)
+_OP_BY_NAME = {
+    "In": OP_IN,
+    "NotIn": OP_NOT_IN,
+    "Exists": OP_EXISTS,
+    "DoesNotExist": OP_DOES_NOT_EXIST,
+    "Gt": OP_GT,
+    "Lt": OP_LT,
+}
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC_PATH):
+        return _SO_PATH
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC_PATH, "-o", _SO_PATH],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO_PATH
+    except Exception as e:  # noqa: BLE001 - any failure -> Python fallback
+        logger.warning("native labelmatch build failed (%s); using Python fallback", e)
+        return None
+
+
+def get_lib():
+    """The loaded native library, or None (Python fallback)."""
+    global _lib, _build_failed
+    with _lib_mu:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.lm_new.restype = ctypes.c_void_p
+        lib.lm_free.argtypes = [ctypes.c_void_p]
+        lib.lm_add_labelmap.restype = ctypes.c_int32
+        lib.lm_add_labelmap.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int32,
+        ]
+        lib.lm_new_selector.restype = ctypes.c_int32
+        lib.lm_new_selector.argtypes = [ctypes.c_void_p]
+        lib.lm_sel_add_req.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int32,
+        ]
+        lib.lm_match_matrix.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.lm_match_any.argtypes = list(lib.lm_match_matrix.argtypes)
+        _lib = lib
+        return _lib
+
+
+def _carr_str(items: Sequence[str]):
+    arr = (ctypes.c_char_p * max(len(items), 1))()
+    for i, s in enumerate(items):
+        arr[i] = s.encode()
+    return arr
+
+
+class MatchEngine:
+    """Interned selector/labelmap matcher; transparently native or Python."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        self._h = self._lib.lm_new() if self._lib else None
+        # python fallback state
+        self._py_labelmaps: list[dict] = []
+        self._py_selectors: list[list] = []
+
+    def close(self) -> None:
+        if self._lib and self._h:
+            self._lib.lm_free(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    # -- registration ------------------------------------------------------
+    def add_labelmap(self, labels: dict) -> int:
+        if self._h:
+            keys = _carr_str(list(labels.keys()))
+            vals = _carr_str([str(v) for v in labels.values()])
+            return self._lib.lm_add_labelmap(self._h, keys, vals, len(labels))
+        self._py_labelmaps.append(dict(labels))
+        return len(self._py_labelmaps) - 1
+
+    def add_selector(self, requirements: list[tuple[str, str, list[str]]]) -> int:
+        """requirements: [(key, op_name, values)]; op "Eq" = key=value."""
+        if self._h:
+            sid = self._lib.lm_new_selector(self._h)
+            for key, op_name, values in requirements:
+                op = OP_EQ if op_name == "Eq" else _OP_BY_NAME[op_name]
+                self._lib.lm_sel_add_req(
+                    self._h, sid, key.encode(), op, _carr_str(values), len(values)
+                )
+            return sid
+        self._py_selectors.append(list(requirements))
+        return len(self._py_selectors) - 1
+
+    def add_simple_selector(self, selector: dict) -> int:
+        return self.add_selector([(k, "Eq", [str(v)]) for k, v in selector.items()])
+
+    def add_label_selector(self, sel) -> int:
+        """From an api.selectors.LabelSelector."""
+        reqs = [(k, "Eq", [str(v)]) for k, v in sel.match_labels.items()]
+        reqs += [(r.key, r.operator, list(r.values)) for r in sel.match_expressions]
+        return self.add_selector(reqs)
+
+    # -- matching ----------------------------------------------------------
+    def match_matrix(self, selector_ids: Sequence[int], labelmap_ids: Sequence[int]):
+        import numpy as np
+
+        ns, nl = len(selector_ids), len(labelmap_ids)
+        out = np.zeros((ns, nl), dtype=np.uint8)
+        if ns == 0 or nl == 0:
+            return out.astype(bool)
+        if self._h:
+            sarr = (ctypes.c_int32 * ns)(*selector_ids)
+            larr = (ctypes.c_int32 * nl)(*labelmap_ids)
+            self._lib.lm_match_matrix(
+                self._h, sarr, ns, larr, nl, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            )
+            return out.astype(bool)
+        for i, sid in enumerate(selector_ids):
+            for j, lid in enumerate(labelmap_ids):
+                out[i, j] = self._py_match(sid, lid)
+        return out.astype(bool)
+
+    def match_any(self, selector_ids: Sequence[int], labelmap_ids: Sequence[int]):
+        import numpy as np
+
+        nl = len(labelmap_ids)
+        out = np.zeros(nl, dtype=np.uint8)
+        if nl == 0 or len(selector_ids) == 0:
+            return out.astype(bool)
+        if self._h:
+            sarr = (ctypes.c_int32 * len(selector_ids))(*selector_ids)
+            larr = (ctypes.c_int32 * nl)(*labelmap_ids)
+            self._lib.lm_match_any(
+                self._h, sarr, len(selector_ids), larr, nl,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+            return out.astype(bool)
+        for j, lid in enumerate(labelmap_ids):
+            out[j] = any(self._py_match(sid, lid) for sid in selector_ids)
+        return out.astype(bool)
+
+    # -- python fallback ---------------------------------------------------
+    def _py_match(self, sid: int, lid: int) -> bool:
+        from .api.selectors import Requirement
+
+        labels = self._py_labelmaps[lid]
+        for key, op_name, values in self._py_selectors[sid]:
+            if op_name == "Eq":
+                if labels.get(key) != values[0]:
+                    return False
+            elif not Requirement(key, op_name, list(values)).matches(labels):
+                return False
+        return True

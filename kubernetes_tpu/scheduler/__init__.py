@@ -37,3 +37,11 @@ from .units import (
     pod_nonzero_request_vec,
     pod_request_vec,
 )
+from .extender import ExtenderError, HTTPExtender
+from .policy import (
+    PolicyError,
+    algorithm_from_policy,
+    algorithm_from_provider,
+    load_policy_file,
+)
+from .preemption import PreemptionTarget, find_preemption_target

@@ -1,0 +1,88 @@
+"""Priority preemption: make room for important pods by evicting less
+important ones.
+
+The reference has NO scheduler preemption (SURVEY.md §2.4 known-absent;
+only kubelet critical-pod preemption exists, ``preemption.go:66``) but
+BASELINE.json demands the modern ``DefaultPreemption`` PostFilter
+capability, so this is designed fresh rather than ported:
+
+- candidate nodes: where the pod would fit if every strictly-lower-priority
+  pod were gone (a vectorizable mask — the device helper in
+  ``ops/filters.preemption_candidates`` computes it over the node axis);
+- per-candidate victim selection: start from "all lower-priority pods
+  evicted", then *reprieve* victims back highest-priority-first while the
+  pod still fits — yielding a minimal victim set biased toward sparing
+  important pods;
+- node choice (deterministic spec): (1) lowest maximum victim priority,
+  (2) fewest victims, (3) smallest total victim request, (4) node order.
+
+Execution model: victims are deleted through the API (the disruption-aware
+eviction subresource when it lands), the preemptor is requeued immediately
+with its backoff reset — in this store victims vanish synchronously, so
+the retry schedules into the freed space.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api import types as api
+from .nodeinfo import NodeInfo
+from .predicates import PredicateContext, compute_metadata, pod_fits_on_node
+from .units import NUM_RESOURCES, pod_request_vec
+
+
+@dataclass
+class PreemptionTarget:
+    node_name: str
+    victims: list[api.Pod]
+
+
+def _fits_without(pod, meta, info: NodeInfo, removed: list[api.Pod], ctx, predicates) -> bool:
+    """Feasibility of `pod` on `info` with `removed` pods taken out."""
+    trial = info.clone()
+    for v in removed:
+        trial.remove_pod(v)
+    ok, _ = pod_fits_on_node(pod, meta, trial, ctx, predicates)
+    return ok
+
+
+def find_preemption_target(
+    pod: api.Pod,
+    node_info_map: dict[str, NodeInfo],
+    predicates=None,
+) -> Optional[PreemptionTarget]:
+    ctx = PredicateContext(node_info_map)
+    meta = compute_metadata(pod, ctx)
+    candidates: list[tuple[tuple, PreemptionTarget]] = []
+
+    for name in sorted(n for n, i in node_info_map.items() if i.node is not None):
+        info = node_info_map[name]
+        lower = [q for q in info.pods if q.spec.priority < pod.spec.priority]
+        if not lower:
+            continue
+        if not _fits_without(pod, meta, info, lower, ctx, predicates):
+            continue  # even evicting everything below doesn't help
+        # reprieve loop: starting from "evict all", try to re-admit victims
+        # highest-priority-first; whoever cannot be re-admitted stays a victim
+        victims = sorted(lower, key=lambda q: (-q.spec.priority, q.meta.key))
+        for q in list(victims):
+            trial = [v for v in victims if v is not q]
+            if _fits_without(pod, meta, info, trial, ctx, predicates):
+                victims = trial  # q reprieved
+        if not victims:
+            continue  # nothing actually needed evicting (shouldn't happen)
+        max_prio = max(v.spec.priority for v in victims)
+        total_req = [0] * NUM_RESOURCES
+        for v in victims:
+            vec = pod_request_vec(v)
+            for r in range(NUM_RESOURCES):
+                total_req[r] += vec[r]
+        rank = (max_prio, len(victims), sum(total_req), name)
+        candidates.append((rank, PreemptionTarget(node_name=name, victims=victims)))
+
+    if not candidates:
+        return None
+    candidates.sort(key=lambda t: t[0])
+    return candidates[0][1]

@@ -55,6 +55,7 @@ class Scheduler:
         assume_ttl: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
         emit_events: bool = True,
+        enable_preemption: bool = True,
     ):
         self.clientset = clientset
         self.algorithm = algorithm or GenericScheduler()
@@ -65,6 +66,7 @@ class Scheduler:
         self.backoff = PodBackoff(clock=clock)
         self.metrics = SchedulerMetrics()
         self.emit_events = emit_events
+        self.enable_preemption = enable_preemption
         self._clock = clock
         self._snapshot: dict[str, NodeInfo] = {}
         self._event_seq = 0
@@ -188,7 +190,11 @@ class Scheduler:
 
         Re-enqueues the *latest* version from the informer cache, not the
         popped object — a spec patch that landed while the pod was in
-        flight (e.g. adding the missing toleration) must not be lost."""
+        flight (e.g. adding the missing toleration) must not be lost.
+
+        For priority pods, tries preemption first (the PostFilter phase):
+        evicting a minimal set of lower-priority victims and requeueing the
+        preemptor without backoff into the freed space."""
         self.metrics.schedule_failures.inc()
         self._event(pod, "Warning", "FailedScheduling", str(err))
         latest = self.informers.informer("Pod").get(pod.meta.key)
@@ -196,8 +202,29 @@ class Scheduler:
             return  # deleted while we were scheduling it
         if latest.spec.node_name or not _is_scheduler_pod(latest, self.scheduler_name):
             return  # bound by someone else, or became terminal
+        if self.enable_preemption and latest.spec.priority > 0 and self._try_preempt(latest):
+            self.queue.add(latest)  # victims evicted; retry immediately
+            return
         delay = self.backoff.get_backoff(pod.meta.key)
         self.queue.add_after(latest, delay)
+
+    def _try_preempt(self, pod: api.Pod) -> bool:
+        from .preemption import find_preemption_target
+
+        target = find_preemption_target(pod, self.snapshot(), self.algorithm.predicates)
+        if target is None:
+            return False
+        for victim in target.victims:
+            try:
+                self.clientset.pods.delete(victim.meta.name, victim.meta.namespace)
+                self._event(
+                    victim, "Normal", "Preempted",
+                    f"Preempted by {pod.meta.key} (priority {pod.spec.priority}) on {target.node_name}",
+                )
+            except NotFoundError:
+                continue
+        self.pump()  # observe the deletions so the next attempt sees freed space
+        return True
 
     # -- the per-pod oracle loop (scheduler.go:253) ------------------------
     def schedule_one(self, timeout: Optional[float] = 0.0, async_bind: bool = False) -> bool:

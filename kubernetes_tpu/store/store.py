@@ -25,13 +25,26 @@ this store, rebuildable from snapshot + watch replay (SURVEY.md §5.3).
 
 from __future__ import annotations
 
-import copy
+# (copy module no longer needed: JSON-shaped fast deepcopy below)
 import queue
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
 from ..api.meta import new_uid
+
+
+def _fast_deepcopy(obj):
+    """Deep copy for JSON-shaped data (dict/list/scalars only) — the store's
+    wire form by construction.  ~3x faster than copy.deepcopy, which burns
+    time on memo bookkeeping and type dispatch the shape can't need."""
+    t = type(obj)
+    if t is dict:
+        return {k: _fast_deepcopy(v) for k, v in obj.items()}
+    if t is list:
+        return [_fast_deepcopy(v) for v in obj]
+    return obj  # str/int/float/bool/None are immutable
+
 
 def object_key(namespace: str, name: str) -> str:
     """Canonical store/informer key — MUST match ``ObjectMeta.key``:
@@ -132,7 +145,7 @@ class Store:
             if key in bucket:
                 raise AlreadyExistsError(f"{kind} {key} already exists")
             rev = self._next_rev()
-            data = copy.deepcopy(obj)
+            data = _fast_deepcopy(obj)
             m = data["metadata"]
             m.setdefault("namespace", "default")
             if not m.get("uid"):
@@ -140,8 +153,8 @@ class Store:
             m["resourceVersion"] = rev
             m["creationRevision"] = rev
             bucket[key] = _Item(data=data, revision=rev)
-            self._emit(WatchEvent(ADDED, kind, key, rev, copy.deepcopy(data)))
-            return copy.deepcopy(data)
+            self._emit(WatchEvent(ADDED, kind, key, rev, _fast_deepcopy(data)))
+            return _fast_deepcopy(data)
 
     def update(
         self, kind: str, obj: dict, expect_rev: Optional[int] = None, _trusted: bool = False
@@ -164,13 +177,13 @@ class Store:
                     f"{kind} {key}: expected rev {expect_rev}, have {item.revision}"
                 )
             rev = self._next_rev()
-            data = obj if _trusted else copy.deepcopy(obj)
+            data = obj if _trusted else _fast_deepcopy(obj)
             m = data["metadata"]
             m["uid"] = item.data["metadata"]["uid"]
             m["resourceVersion"] = rev
             m["creationRevision"] = item.data["metadata"].get("creationRevision", 0)
             bucket[key] = _Item(data=data, revision=rev)
-            ev_copy = copy.deepcopy(data)
+            ev_copy = _fast_deepcopy(data)
             self._emit(WatchEvent(MODIFIED, kind, key, rev, ev_copy))
             # the event copy doubles as the caller's return value: both are
             # read-only by contract, and the stored dict never escapes
@@ -239,7 +252,7 @@ class Store:
                 raise ConflictError(f"{kind} {key}")
             rev = self._next_rev()
             del bucket[key]
-            final = copy.deepcopy(item.data)
+            final = _fast_deepcopy(item.data)
             final["metadata"]["deletionRevision"] = rev
             self._emit(WatchEvent(DELETED, kind, key, rev, final))
             return final
@@ -250,7 +263,7 @@ class Store:
             item = self._objects.get(kind, {}).get(object_key(namespace, name))
             if item is None:
                 raise NotFoundError(f"{kind} {namespace}/{name}")
-            return copy.deepcopy(item.data)
+            return _fast_deepcopy(item.data)
 
     def list(self, kind: str, namespace: Optional[str] = None) -> tuple[list[dict], int]:
         """Returns (objects, list_revision) — the revision to start a watch
@@ -261,7 +274,7 @@ class Store:
             for key, item in self._objects.get(kind, {}).items():
                 ns = item.data["metadata"].get("namespace", "")
                 if namespace is None or ns == namespace:
-                    out.append(copy.deepcopy(item.data))
+                    out.append(_fast_deepcopy(item.data))
             out.sort(key=lambda d: (d["metadata"]["namespace"], d["metadata"]["name"]))
             return out, self._rev
 

@@ -1,0 +1,134 @@
+"""API server + remote client: the control plane over the wire."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import Binding, ObjectMeta, Pod
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.client.remote import RemoteStore
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import NotFoundError, Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture
+def server():
+    s = APIServer(Store())
+    s.start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def remote(server):
+    return Clientset(RemoteStore(server.url))
+
+
+def test_healthz_metrics_version(server):
+    for path, key in [("/healthz", "status"), ("/version", "version")]:
+        with urllib.request.urlopen(server.url + path) as r:
+            assert key in json.loads(r.read())
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        assert b"apiserver_request_count" in r.read()
+
+
+def test_remote_crud(remote):
+    remote.pods.create(make_pod("p1", cpu="1"))
+    got = remote.pods.get("p1")
+    assert got.meta.name == "p1" and got.meta.uid
+    pods, rev = remote.pods.list()
+    assert len(pods) == 1 and rev >= 1
+    remote.pods.delete("p1")
+    with pytest.raises(NotFoundError):
+        remote.pods.get("p1")
+
+
+def test_remote_cluster_scoped_node(remote):
+    remote.nodes.create(make_node("n1"))
+    assert remote.nodes.get("n1").meta.name == "n1"
+
+
+def test_remote_cas_conflict(remote):
+    remote.pods.create(make_pod("p1"))
+    a = remote.pods.get("p1")
+    b = remote.pods.get("p1")
+    a.meta.annotations["x"] = "1"
+    remote.pods.update(a)
+    b.meta.annotations["x"] = "2"
+    from kubernetes_tpu.store import ConflictError
+
+    with pytest.raises(ConflictError):
+        remote.pods.update(b)
+
+
+def test_remote_bind_and_batch(remote):
+    for i in range(3):
+        remote.pods.create(make_pod(f"p{i}"))
+    remote.pods.bind(Binding(pod_name="p0", node_name="n1"))
+    assert remote.pods.get("p0").spec.node_name == "n1"
+    errs = remote.pods.bind_many(
+        [Binding(pod_name="p1", node_name="n1"), Binding(pod_name="p2", node_name="n2")]
+    )
+    assert errs == [None, None]
+    assert remote.pods.get("p2").spec.node_name == "n2"
+
+
+def test_remote_watch_stream(remote):
+    pods, rev = remote.pods.list()
+    w = remote.pods.watch(from_revision=rev)
+    remote.pods.create(make_pod("w1"))
+    ev = w.get(timeout=5)
+    assert ev is not None and ev.type == "ADDED" and ev.key == "default/w1"
+    w.stop()
+
+
+def test_auth_rejects_bad_token():
+    s = APIServer(Store(), tokens={"sekrit": "admin"})
+    s.start()
+    try:
+        req = urllib.request.Request(s.url + "/api/v1/pods")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 401
+        ok = Clientset(RemoteStore(s.url, token="sekrit"))
+        ok.pods.create(make_pod("p"))
+        assert ok.pods.get("p").meta.name == "p"
+    finally:
+        s.stop()
+
+
+def test_scheduler_over_the_wire(server):
+    """The full scheduler running against the apiserver via HTTP only."""
+    local = Clientset(server.store)  # "kubectl" side writes in-proc
+    remote = Clientset(RemoteStore(server.url))  # scheduler side is remote
+    local.nodes.create(make_node("n1", cpu="4"))
+    local.nodes.create(make_node("n2", cpu="4"))
+    sched = Scheduler(remote, emit_events=False)
+    sched.start()
+    for i in range(6):
+        local.pods.create(make_pod(f"p{i}", cpu="500m"))
+    # the remote watch stream is asynchronous: poll until the events land
+    import time
+
+    deadline = time.time() + 10
+    n = 0
+    while time.time() < deadline and n < 6:
+        sched.pump()
+        n += sched.run_pending()
+        time.sleep(0.05)
+    assert n == 6
+    pods, _ = local.pods.list()
+    assert all(p.spec.node_name for p in pods)
+    assert {p.spec.node_name for p in pods} == {"n1", "n2"}
+
+
+def test_unknown_resource_404(server):
+    req = urllib.request.Request(server.url + "/api/v1/widgets")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 404
+    assert json.loads(ei.value.read())["reason"] == "NotFound"

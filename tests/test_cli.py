@@ -1,0 +1,134 @@
+"""kubectl CLI: verbs end-to-end against a live cluster + over HTTP."""
+
+import io
+
+import pytest
+import yaml
+
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.cli.kubectl import main as kubectl_main
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture
+def cs():
+    return Clientset(Store())
+
+
+def run(cs, *argv):
+    out = io.StringIO()
+    rc = kubectl_main(list(argv), clientset=cs, out=out)
+    return rc, out.getvalue()
+
+
+def test_create_get_delete(cs, tmp_path):
+    manifest = tmp_path / "pod.yaml"
+    manifest.write_text(
+        yaml.safe_dump(make_pod("web-1", cpu="500m", labels={"app": "web"}).to_dict())
+    )
+    rc, out = run(cs, "create", "-f", str(manifest))
+    assert rc == 0 and "pods/web-1 created" in out
+    rc, out = run(cs, "get", "pods")
+    assert rc == 0 and "web-1" in out and "Pending" in out
+    rc, out = run(cs, "get", "po", "web-1", "-o", "json")
+    assert rc == 0 and '"web-1"' in out
+    rc, out = run(cs, "delete", "pod", "web-1")
+    assert rc == 0
+    rc, out = run(cs, "get", "pods", "web-1")
+    assert rc == 1 and "not found" in out
+
+
+def test_apply_create_then_configure(cs, tmp_path):
+    dep = {
+        "kind": "Deployment",
+        "metadata": {"name": "web"},
+        "spec": {
+            "replicas": 2,
+            "selector": {"matchLabels": {"app": "web"}},
+            "template": {"metadata": {"labels": {"app": "web"}}, "spec": {"containers": []}},
+        },
+    }
+    f = tmp_path / "dep.yaml"
+    f.write_text(yaml.safe_dump(dep))
+    rc, out = run(cs, "apply", "-f", str(f))
+    assert rc == 0 and "created" in out
+    rc, out = run(cs, "apply", "-f", str(f))
+    assert "unchanged" in out
+    dep["spec"]["replicas"] = 5
+    f.write_text(yaml.safe_dump(dep))
+    rc, out = run(cs, "apply", "-f", str(f))
+    assert "configured" in out
+    assert cs.deployments.get("web").replicas == 5
+
+
+def test_scale(cs, tmp_path):
+    from kubernetes_tpu.api import LabelSelector, ObjectMeta, ReplicaSet
+
+    cs.replicasets.create(
+        ReplicaSet(meta=ObjectMeta(name="rs1"), replicas=1,
+                   selector=LabelSelector.from_match_labels({"a": "b"}))
+    )
+    rc, out = run(cs, "scale", "rs", "rs1", "--replicas", "7")
+    assert rc == 0
+    assert cs.replicasets.get("rs1").replicas == 7
+
+
+def test_cordon_drain_uncordon(cs):
+    cs.nodes.create(make_node("n1"))
+    cs.nodes.create(make_node("n2"))
+    cs.pods.create(make_pod("p1", node_name="n1"))
+    rc, out = run(cs, "drain", "n1")
+    assert rc == 0 and "pod/p1 evicted" in out
+    assert cs.nodes.get("n1").spec.unschedulable is True
+    assert cs.pods.list()[0] == []
+    # scheduler now avoids the cordoned node
+    sched = Scheduler(cs)
+    sched.start()
+    cs.pods.create(make_pod("p2"))
+    sched.pump()
+    sched.run_pending()
+    assert cs.pods.get("p2").spec.node_name == "n2"
+    rc, _ = run(cs, "uncordon", "n1")
+    assert cs.nodes.get("n1").spec.unschedulable is False
+
+
+def test_get_nodes_and_top(cs):
+    cs.nodes.create(make_node("n1", cpu="8", memory="16Gi"))
+    cs.pods.create(make_pod("p1", cpu="2", memory="1Gi", node_name="n1"))
+    rc, out = run(cs, "get", "nodes")
+    assert rc == 0 and "n1" in out and "True" in out
+    rc, out = run(cs, "top", "nodes")
+    assert rc == 0 and "2000m" in out and "1024Mi" in out
+
+
+def test_describe_includes_events(cs):
+    cs.nodes.create(make_node("n1", cpu="1"))
+    sched = Scheduler(cs)
+    sched.start()
+    cs.pods.create(make_pod("big", cpu="4"))
+    sched.pump()
+    sched.run_pending()
+    rc, out = run(cs, "describe", "pod", "big")
+    assert rc == 0 and "FailedScheduling" in out
+
+
+def test_cli_over_http(tmp_path):
+    from kubernetes_tpu.apiserver import APIServer
+
+    server = APIServer(Store())
+    server.start()
+    try:
+        manifest = tmp_path / "node.yaml"
+        manifest.write_text(yaml.safe_dump(make_node("n1").to_dict()))
+        out = io.StringIO()
+        rc = kubectl_main(
+            ["--server", server.url, "create", "-f", str(manifest)], out=out
+        )
+        assert rc == 0
+        out = io.StringIO()
+        rc = kubectl_main(["--server", server.url, "get", "nodes"], out=out)
+        assert rc == 0 and "n1" in out.getvalue()
+    finally:
+        server.stop()

@@ -1,0 +1,108 @@
+"""Native labelmatch engine: parity with the Python selector semantics."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api.selectors import LabelSelector, Requirement
+from kubernetes_tpu.native import MatchEngine, get_lib
+
+
+def random_labels(rng):
+    return {
+        f"k{rng.randrange(6)}": f"v{rng.randrange(4)}"
+        for _ in range(rng.randrange(5))
+    }
+
+
+def random_selector(rng):
+    reqs = []
+    for _ in range(rng.randrange(1, 4)):
+        op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt", "Eq"])
+        key = f"k{rng.randrange(6)}"
+        if op in ("Gt", "Lt"):
+            key = "num"
+            values = [str(rng.randrange(10))]
+        elif op in ("Exists", "DoesNotExist"):
+            values = []
+        else:
+            values = [f"v{rng.randrange(4)}" for _ in range(rng.randrange(1, 3))]
+        reqs.append((key, op, values))
+    return reqs
+
+
+def py_eval(reqs, labels):
+    for key, op, values in reqs:
+        if op == "Eq":
+            if labels.get(key) != values[0]:
+                return False
+        elif not Requirement(key, op, list(values)).matches(labels):
+            return False
+    return True
+
+
+def test_native_library_builds():
+    assert get_lib() is not None, "g++ toolchain present; native build must work"
+
+
+def test_match_matrix_parity_randomized():
+    rng = random.Random(0)
+    eng = MatchEngine()
+    assert eng.native
+    labelmaps = []
+    for _ in range(60):
+        labels = random_labels(rng)
+        if rng.random() < 0.5:
+            labels["num"] = str(rng.randrange(-5, 15))
+        labelmaps.append(labels)
+    selectors = [random_selector(rng) for _ in range(40)]
+    lids = [eng.add_labelmap(m) for m in labelmaps]
+    sids = [eng.add_selector(s) for s in selectors]
+    got = eng.match_matrix(sids, lids)
+    for i, reqs in enumerate(selectors):
+        for j, labels in enumerate(labelmaps):
+            assert got[i, j] == py_eval(reqs, labels), (reqs, labels)
+
+
+def test_match_any():
+    eng = MatchEngine()
+    lids = [eng.add_labelmap({"app": "web"}), eng.add_labelmap({"app": "db"}), eng.add_labelmap({})]
+    sids = [
+        eng.add_simple_selector({"app": "web"}),
+        eng.add_simple_selector({"app": "db"}),
+    ]
+    got = eng.match_any(sids, lids)
+    assert got.tolist() == [True, True, False]
+
+
+def test_label_selector_bridge():
+    eng = MatchEngine()
+    sel = LabelSelector(
+        match_labels={"app": "web"},
+        match_expressions=[Requirement("tier", "NotIn", ["legacy"])],
+    )
+    sid = eng.add_label_selector(sel)
+    lids = [
+        eng.add_labelmap({"app": "web", "tier": "modern"}),
+        eng.add_labelmap({"app": "web", "tier": "legacy"}),
+        eng.add_labelmap({"app": "web"}),  # missing key satisfies NotIn
+    ]
+    assert eng.match_matrix([sid], lids).tolist() == [[True, False, True]]
+
+
+def test_gt_lt_non_numeric():
+    eng = MatchEngine()
+    sid = eng.add_selector([("cores", "Gt", ["4"])])
+    lids = [eng.add_labelmap({"cores": "8"}), eng.add_labelmap({"cores": "abc"}), eng.add_labelmap({})]
+    assert eng.match_matrix([sid], lids).tolist() == [[True, False, False]]
+
+
+def test_python_fallback_parity(monkeypatch):
+    import kubernetes_tpu.native as native
+
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    eng = native.MatchEngine()
+    assert not eng.native
+    sid = eng.add_selector([("app", "In", ["web", "api"])])
+    lids = [eng.add_labelmap({"app": "web"}), eng.add_labelmap({"app": "db"})]
+    assert eng.match_matrix([sid], lids).tolist() == [[True, False]]

@@ -1,0 +1,200 @@
+"""Policy config, HTTP extender (real webhook server), leader election."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from kubernetes_tpu.api import Binding
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+from kubernetes_tpu.scheduler.extender import HTTPExtender
+from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+from kubernetes_tpu.scheduler.policy import (
+    PolicyError,
+    algorithm_from_policy,
+    algorithm_from_provider,
+)
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+# -- policy ------------------------------------------------------------------
+
+
+def build_map(nodes):
+    return {n.meta.name: NodeInfo(n) for n in nodes}
+
+
+def test_provider_selection():
+    default = algorithm_from_provider("DefaultProvider")
+    ca = algorithm_from_provider("ClusterAutoscalerProvider")
+    names_d = {type(p).__name__ for p, _ in default.priorities}
+    names_ca = {type(p).__name__ for p, _ in ca.priorities}
+    assert "LeastRequestedPriority" in names_d and "MostRequestedPriority" not in names_d
+    assert "MostRequestedPriority" in names_ca and "LeastRequestedPriority" not in names_ca
+    with pytest.raises(PolicyError):
+        algorithm_from_provider("NoSuch")
+
+
+def test_policy_json_selects_and_weights():
+    algo = algorithm_from_policy(
+        json.dumps(
+            {
+                "predicates": [{"name": "GeneralPredicates"}, {"name": "PodToleratesNodeTaints"}],
+                "priorities": [{"name": "MostRequestedPriority", "weight": 3}],
+            }
+        )
+    )
+    assert set(algo.predicates) == {"GeneralPredicates", "PodToleratesNodeTaints"}
+    assert [(type(p).__name__, w) for p, w in algo.priorities] == [
+        ("MostRequestedPriority", 3)
+    ]
+    # bin-pack behavior: picks the fuller node
+    m = build_map([make_node("n1", cpu="4"), make_node("n2", cpu="4")])
+    m["n1"].add_pod(make_pod("e", cpu="2", node_name="n1"))
+    res = algo.schedule(make_pod("p", cpu="1"), m)
+    assert res.node_name == "n1"
+
+
+def test_policy_rejects_unknown_names():
+    with pytest.raises(PolicyError):
+        algorithm_from_policy({"predicates": [{"name": "Nope"}]})
+    with pytest.raises(PolicyError):
+        algorithm_from_policy({"priorities": [{"name": "Nope"}]})
+    with pytest.raises(PolicyError):
+        algorithm_from_policy({"priorities": [{"name": "EqualPriority", "weight": 0}]})
+
+
+# -- extender (real HTTP webhook) -------------------------------------------
+
+
+class ExtenderHandler(http.server.BaseHTTPRequestHandler):
+    bound = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        if self.path == "/filter":
+            # refuse any node ending in 0
+            keep = [n for n in body["nodeNames"] if not n.endswith("0")]
+            failed = {n: "ends in 0" for n in body["nodeNames"] if n.endswith("0")}
+            out = {"nodeNames": keep, "failedNodes": failed}
+        elif self.path == "/prioritize":
+            # strongly prefer n3
+            out = [{"host": n, "score": 100 if n == "n3" else 0} for n in body["nodeNames"]]
+        elif self.path == "/bind":
+            ExtenderHandler.bound.append(body)
+            out = {}
+        else:
+            out = {}
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def extender_server():
+    server = http.server.HTTPServer(("127.0.0.1", 0), ExtenderHandler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_extender_filter_and_prioritize(extender_server):
+    ext = HTTPExtender(extender_server, filter_verb="filter", prioritize_verb="prioritize")
+    algo = GenericScheduler(extenders=[ext])
+    m = build_map([make_node(f"n{i}") for i in range(5)])
+    res = algo.schedule(make_pod("p", cpu="100m"), m)
+    assert res.node_name == "n3"  # extender score dominates
+    # and n0 was filtered out entirely
+    feasible, failures = algo.find_nodes_that_fit(
+        make_pod("q", cpu="100m"), sorted(m), m, __import__(
+            "kubernetes_tpu.scheduler.predicates", fromlist=["PredicateContext"]
+        ).PredicateContext(m),
+    )
+    assert "n0" not in feasible and failures["n0"] == ["ends in 0"]
+
+
+def test_extender_via_policy(extender_server):
+    algo = algorithm_from_policy(
+        {
+            "extenders": [
+                {"urlPrefix": extender_server, "filterVerb": "filter"},
+            ]
+        }
+    )
+    m = build_map([make_node("n0")])
+    from kubernetes_tpu.scheduler import FitError
+
+    with pytest.raises(FitError):
+        algo.schedule(make_pod("p"), m)
+
+
+# -- leader election ---------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_leader_election_single_holder():
+    cs = Clientset(Store())
+    clock = FakeClock()
+    a = LeaderElector(cs, "scheduler", "instance-a", clock=clock)
+    b = LeaderElector(cs, "scheduler", "instance-b", clock=clock)
+    assert a.try_acquire_or_renew() is True
+    assert b.try_acquire_or_renew() is False
+    # a renews within the lease; b still locked out
+    clock.now += 5
+    assert a.try_acquire_or_renew() is True
+    assert b.try_acquire_or_renew() is False
+
+
+def test_leader_failover_on_stale_lease():
+    cs = Clientset(Store())
+    clock = FakeClock()
+    a = LeaderElector(cs, "scheduler", "instance-a", lease_duration=15, clock=clock)
+    b = LeaderElector(cs, "scheduler", "instance-b", lease_duration=15, clock=clock)
+    assert a.try_acquire_or_renew()
+    clock.now += 20  # a dies silently; lease goes stale
+    assert b.try_acquire_or_renew() is True
+    assert b.is_leader
+    # a comes back but the lease is b's now
+    clock.now += 1
+    assert a.try_acquire_or_renew() is False
+
+
+def test_leader_release():
+    cs = Clientset(Store())
+    clock = FakeClock()
+    a = LeaderElector(cs, "cm", "a", clock=clock)
+    b = LeaderElector(cs, "cm", "b", clock=clock)
+    assert a.try_acquire_or_renew()
+    a.release()
+    assert b.try_acquire_or_renew() is True
+
+
+def test_leader_race_many_candidates():
+    cs = Clientset(Store())
+    clock = FakeClock()
+    electors = [LeaderElector(cs, "x", f"i{i}", clock=clock) for i in range(8)]
+    import threading as th
+
+    results = []
+    ts = [th.Thread(target=lambda e=e: results.append(e.try_acquire_or_renew())) for e in electors]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert sum(results) == 1, "exactly one leader"

@@ -263,3 +263,95 @@ def test_failed_pod_requeued_with_latest_spec(cluster):
     clock.now += 2.0
     sched.run_pending()
     assert cluster.pods.get("p").spec.node_name == "n1"
+
+
+# -- preemption --------------------------------------------------------------
+
+
+def make_prio_pod(name, priority, cpu="1", node_name=""):
+    p = make_pod(name, cpu=cpu, node_name=node_name)
+    p.spec.priority = priority
+    return p
+
+
+def test_preemption_evicts_lower_priority(cluster):
+    cluster.nodes.create(make_node("n1", cpu="2"))
+    sched = Scheduler(cluster)
+    sched.start()
+    # two low-priority pods fill the node
+    cluster.pods.create(make_prio_pod("low-a", 0, cpu="1"))
+    cluster.pods.create(make_prio_pod("low-b", 0, cpu="1"))
+    sched.pump()
+    sched.run_pending()
+    assert all(p.spec.node_name == "n1" for p in cluster.pods.list()[0])
+    # a high-priority pod arrives needing 1 cpu
+    cluster.pods.create(make_prio_pod("vip", 100, cpu="1"))
+    sched.pump()
+    sched.run_pending()
+    pods = {p.meta.name: p for p in cluster.pods.list()[0]}
+    assert "vip" in pods and pods["vip"].spec.node_name == "n1"
+    assert len(pods) == 2  # exactly one victim evicted
+    events, _ = cluster.events.list()
+    assert any(e.reason == "Preempted" for e in events)
+
+
+def test_preemption_minimal_victims(cluster):
+    cluster.nodes.create(make_node("n1", cpu="4"))
+    sched = Scheduler(cluster)
+    sched.start()
+    # priorities 1,2,3 each 1cpu + 1cpu free
+    for i, prio in enumerate([1, 2, 3]):
+        cluster.pods.create(make_prio_pod(f"p{prio}", prio, cpu="1"))
+    sched.pump()
+    sched.run_pending()
+    # vip needs 2cpu -> only 1 free -> evict exactly the LOWEST priority pod
+    cluster.pods.create(make_prio_pod("vip", 100, cpu="2"))
+    sched.pump()
+    sched.run_pending()
+    names = {p.meta.name for p in cluster.pods.list()[0]}
+    assert names == {"p2", "p3", "vip"}
+
+
+def test_no_preemption_among_equal_priority(cluster):
+    cluster.nodes.create(make_node("n1", cpu="1"))
+    sched = Scheduler(cluster)
+    sched.start()
+    cluster.pods.create(make_prio_pod("a", 50, cpu="1"))
+    sched.pump()
+    sched.run_pending()
+    cluster.pods.create(make_prio_pod("b", 50, cpu="1"))
+    sched.pump()
+    sched.run_pending()
+    assert cluster.pods.get("b").spec.node_name == ""
+    assert cluster.pods.get("a").spec.node_name == "n1"
+
+
+def test_preemption_prefers_cheapest_node(cluster):
+    # n1 holds a prio-5 pod, n2 a prio-1 pod; vip should preempt on n2
+    cluster.nodes.create(make_node("n1", cpu="1"))
+    cluster.nodes.create(make_node("n2", cpu="1"))
+    sched = Scheduler(cluster)
+    sched.start()
+    cluster.pods.create(make_prio_pod("mid", 5, cpu="1"))
+    sched.pump(); sched.run_pending()
+    cluster.pods.create(make_prio_pod("lowly", 1, cpu="1"))
+    sched.pump(); sched.run_pending()
+    placed = {p.meta.name: p.spec.node_name for p in cluster.pods.list()[0]}
+    cluster.pods.create(make_prio_pod("vip", 100, cpu="1"))
+    sched.pump(); sched.run_pending()
+    pods = {p.meta.name: p for p in cluster.pods.list()[0]}
+    assert "lowly" not in pods, "the lowest-priority victim should be chosen"
+    assert pods["vip"].spec.node_name == placed["lowly"]
+    assert "mid" in pods
+
+
+def test_preemption_disabled(cluster):
+    cluster.nodes.create(make_node("n1", cpu="1"))
+    sched = Scheduler(cluster, enable_preemption=False)
+    sched.start()
+    cluster.pods.create(make_prio_pod("low", 0, cpu="1"))
+    sched.pump(); sched.run_pending()
+    cluster.pods.create(make_prio_pod("vip", 100, cpu="1"))
+    sched.pump(); sched.run_pending()
+    assert cluster.pods.get("vip").spec.node_name == ""
+    assert cluster.pods.get("low").spec.node_name == "n1"
