@@ -1,0 +1,606 @@
+"""Namespace-, config-, quota-, storage- and autoscaling-related API types.
+
+Capability equivalents of the reference internal types:
+
+- Namespace, Secret, ConfigMap, ServiceAccount, Endpoints —
+  ``pkg/api/types.go`` (Namespace ~:3010, Secret ~:3330, ConfigMap,
+  ServiceAccount ~:2960, Endpoints ~:2480)
+- ResourceQuota / LimitRange — ``pkg/api/types.go`` (~:3180 / ~:3120),
+  enforced by admission (``plugin/pkg/admission/resourcequota``,
+  ``limitranger``) + usage recalculated by the quota controller
+- PodDisruptionBudget — ``pkg/apis/policy/types.go``, consumed by the
+  eviction subresource
+- HorizontalPodAutoscaler — ``pkg/apis/autoscaling/types.go``
+- PersistentVolume / PersistentVolumeClaim — ``pkg/api/types.go``
+  (~:380 / ~:450), bound by ``pkg/controller/volume/persistentvolume``
+- PriorityClass — ``pkg/apis/scheduling/types.go`` (PodPriority gate)
+- CertificateSigningRequest — ``pkg/apis/certificates/types.go``
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+from .quantity import Quantity
+from .selectors import LabelSelector
+from .types import (
+    ZONE_LABEL,
+    _res_from_dict,
+    _res_to_dict,
+    register_cluster_scoped as _register_cluster_scoped,
+    register_kind,
+)
+
+
+@_register_cluster_scoped
+@dataclass
+class Namespace:
+    """Namespace with phase + finalizers (reference ``pkg/api/types.go``
+    Namespace; lifecycle in ``pkg/controller/namespace``)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    phase: str = "Active"  # Active | Terminating
+    spec_finalizers: list[str] = field(default_factory=lambda: ["kubernetes"])
+
+    KIND = "Namespace"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {"finalizers": list(self.spec_finalizers)},
+            "status": {"phase": self.phase},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Namespace":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""
+        return cls(
+            meta=meta,
+            phase=(d.get("status") or {}).get("phase", "Active"),
+            spec_finalizers=list((d.get("spec") or {}).get("finalizers") or []),
+        )
+
+
+@register_kind
+@dataclass
+class Secret:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Opaque"
+    data: dict[str, str] = field(default_factory=dict)  # values pre-encoded
+
+    KIND = "Secret"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "type": self.type,
+            "data": dict(self.data),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Secret":
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            type=d.get("type", "Opaque"),
+            data=dict(d.get("data") or {}),
+        )
+
+
+@register_kind
+@dataclass
+class ConfigMap:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+
+    KIND = "ConfigMap"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "data": dict(self.data),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ConfigMap":
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            data=dict(d.get("data") or {}),
+        )
+
+
+@register_kind
+@dataclass
+class ServiceAccount:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: list[str] = field(default_factory=list)  # token Secret names
+
+    KIND = "ServiceAccount"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "secrets": list(self.secrets),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServiceAccount":
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            secrets=list(d.get("secrets") or []),
+        )
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+    target_pod: str = ""  # namespace/name of backing pod
+
+    def to_dict(self) -> dict:
+        return {"ip": self.ip, "nodeName": self.node_name, "targetPod": self.target_pod}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EndpointAddress":
+        return cls(
+            ip=d.get("ip", ""),
+            node_name=d.get("nodeName", ""),
+            target_pod=d.get("targetPod", ""),
+        )
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "port": self.port, "protocol": self.protocol}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EndpointPort":
+        return cls(
+            name=d.get("name", ""),
+            port=int(d.get("port", 0)),
+            protocol=d.get("protocol", "TCP"),
+        )
+
+
+@dataclass
+class EndpointSubset:
+    addresses: list[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: list[EndpointAddress] = field(default_factory=list)
+    ports: list[EndpointPort] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "addresses": [a.to_dict() for a in self.addresses],
+            "notReadyAddresses": [a.to_dict() for a in self.not_ready_addresses],
+            "ports": [p.to_dict() for p in self.ports],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EndpointSubset":
+        return cls(
+            addresses=[EndpointAddress.from_dict(a) for a in d.get("addresses") or []],
+            not_ready_addresses=[
+                EndpointAddress.from_dict(a) for a in d.get("notReadyAddresses") or []
+            ],
+            ports=[EndpointPort.from_dict(p) for p in d.get("ports") or []],
+        )
+
+
+@register_kind
+@dataclass
+class Endpoints:
+    """Service backend membership (reference ``pkg/api/types.go`` Endpoints;
+    maintained by ``pkg/controller/endpoint``)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: list[EndpointSubset] = field(default_factory=list)
+
+    KIND = "Endpoints"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "subsets": [s.to_dict() for s in self.subsets],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Endpoints":
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            subsets=[EndpointSubset.from_dict(s) for s in d.get("subsets") or []],
+        )
+
+
+@register_kind
+@dataclass
+class ResourceQuota:
+    """Per-namespace aggregate limits; ``hard`` is the ceiling, ``used`` is
+    maintained by admission + the quota controller."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: dict[str, Quantity] = field(default_factory=dict)
+    used: dict[str, Quantity] = field(default_factory=dict)
+    scopes: list[str] = field(default_factory=list)  # e.g. BestEffort, NotBestEffort
+
+    KIND = "ResourceQuota"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {"hard": _res_to_dict(self.hard), "scopes": list(self.scopes)},
+            "status": {"hard": _res_to_dict(self.hard), "used": _res_to_dict(self.used)},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ResourceQuota":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            hard=_res_from_dict(spec.get("hard")),
+            used=_res_from_dict(status.get("used")),
+            scopes=list(spec.get("scopes") or []),
+        )
+
+
+@dataclass
+class LimitRangeItem:
+    type: str = "Container"  # Container | Pod
+    max: dict[str, Quantity] = field(default_factory=dict)
+    min: dict[str, Quantity] = field(default_factory=dict)
+    default: dict[str, Quantity] = field(default_factory=dict)  # default limits
+    default_request: dict[str, Quantity] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "max": _res_to_dict(self.max),
+            "min": _res_to_dict(self.min),
+            "default": _res_to_dict(self.default),
+            "defaultRequest": _res_to_dict(self.default_request),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LimitRangeItem":
+        return cls(
+            type=d.get("type", "Container"),
+            max=_res_from_dict(d.get("max")),
+            min=_res_from_dict(d.get("min")),
+            default=_res_from_dict(d.get("default")),
+            default_request=_res_from_dict(d.get("defaultRequest")),
+        )
+
+
+@register_kind
+@dataclass
+class LimitRange:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    limits: list[LimitRangeItem] = field(default_factory=list)
+
+    KIND = "LimitRange"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {"limits": [l.to_dict() for l in self.limits]},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LimitRange":
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            limits=[
+                LimitRangeItem.from_dict(l)
+                for l in (d.get("spec") or {}).get("limits") or []
+            ],
+        )
+
+
+@register_kind
+@dataclass
+class PodDisruptionBudget:
+    """Voluntary-eviction budget (reference ``pkg/apis/policy/types.go``;
+    status maintained by ``pkg/controller/disruption``)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    min_available: int = 0
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    status_disruptions_allowed: int = 0
+    status_current_healthy: int = 0
+    status_desired_healthy: int = 0
+    status_expected_pods: int = 0
+
+    KIND = "PodDisruptionBudget"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "minAvailable": self.min_available,
+                "selector": self.selector.to_dict(),
+            },
+            "status": {
+                "disruptionsAllowed": self.status_disruptions_allowed,
+                "currentHealthy": self.status_current_healthy,
+                "desiredHealthy": self.status_desired_healthy,
+                "expectedPods": self.status_expected_pods,
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PodDisruptionBudget":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            min_available=int(spec.get("minAvailable", 0)),
+            selector=LabelSelector.from_dict(spec.get("selector")),
+            status_disruptions_allowed=int(status.get("disruptionsAllowed", 0)),
+            status_current_healthy=int(status.get("currentHealthy", 0)),
+            status_desired_healthy=int(status.get("desiredHealthy", 0)),
+            status_expected_pods=int(status.get("expectedPods", 0)),
+        )
+
+
+@register_kind
+@dataclass
+class HorizontalPodAutoscaler:
+    """Scale a target workload on observed utilization (reference
+    ``pkg/apis/autoscaling/types.go``; controller
+    ``pkg/controller/podautoscaler/horizontal.go``)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    target_kind: str = "Deployment"
+    target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_cpu_utilization: int = 80  # percent of requests
+    status_current_replicas: int = 0
+    status_desired_replicas: int = 0
+    status_current_utilization: int = 0
+    status_last_scale_time: float = 0.0
+
+    KIND = "HorizontalPodAutoscaler"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "scaleTargetRef": {"kind": self.target_kind, "name": self.target_name},
+                "minReplicas": self.min_replicas,
+                "maxReplicas": self.max_replicas,
+                "targetCPUUtilizationPercentage": self.target_cpu_utilization,
+            },
+            "status": {
+                "currentReplicas": self.status_current_replicas,
+                "desiredReplicas": self.status_desired_replicas,
+                "currentCPUUtilizationPercentage": self.status_current_utilization,
+                "lastScaleTime": self.status_last_scale_time,
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HorizontalPodAutoscaler":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        ref = spec.get("scaleTargetRef") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            target_kind=ref.get("kind", "Deployment"),
+            target_name=ref.get("name", ""),
+            min_replicas=int(spec.get("minReplicas", 1)),
+            max_replicas=int(spec.get("maxReplicas", 1)),
+            target_cpu_utilization=int(spec.get("targetCPUUtilizationPercentage", 80)),
+            status_current_replicas=int(status.get("currentReplicas", 0)),
+            status_desired_replicas=int(status.get("desiredReplicas", 0)),
+            status_current_utilization=int(
+                status.get("currentCPUUtilizationPercentage", 0)
+            ),
+            status_last_scale_time=float(status.get("lastScaleTime", 0.0)),
+        )
+
+
+@_register_cluster_scoped
+@dataclass
+class PersistentVolume:
+    """Cluster storage resource (reference ``pkg/api/types.go`` ~:380;
+    bound by the PV controller's claim↔volume matching)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: dict[str, Quantity] = field(default_factory=dict)  # {"storage": ...}
+    access_modes: list[str] = field(default_factory=lambda: ["ReadWriteOnce"])
+    storage_class: str = ""
+    zone: str = ""  # topology constraint (NoVolumeZoneConflict / NoVolumeNodeConflict)
+    reclaim_policy: str = "Retain"  # Retain | Delete | Recycle
+    phase: str = "Available"  # Available | Bound | Released | Failed
+    claim_ref: str = ""  # namespace/name of bound PVC
+
+    KIND = "PersistentVolume"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+    def to_dict(self) -> dict:
+        d = {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "capacity": _res_to_dict(self.capacity),
+                "accessModes": list(self.access_modes),
+                "storageClassName": self.storage_class,
+                "reclaimPolicy": self.reclaim_policy,
+            },
+            "status": {"phase": self.phase, "claimRef": self.claim_ref},
+        }
+        if self.zone:
+            d["metadata"].setdefault("labels", {})[ZONE_LABEL] = self.zone
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PersistentVolume":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            meta=meta,
+            capacity=_res_from_dict(spec.get("capacity")),
+            access_modes=list(spec.get("accessModes") or ["ReadWriteOnce"]),
+            storage_class=spec.get("storageClassName", ""),
+            zone=meta.labels.get(ZONE_LABEL, ""),
+            reclaim_policy=spec.get("reclaimPolicy", "Retain"),
+            phase=status.get("phase", "Available"),
+            claim_ref=status.get("claimRef", ""),
+        )
+
+
+@register_kind
+@dataclass
+class PersistentVolumeClaim:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    request_storage: Quantity = field(default_factory=lambda: Quantity(0))
+    access_modes: list[str] = field(default_factory=lambda: ["ReadWriteOnce"])
+    storage_class: str = ""
+    phase: str = "Pending"  # Pending | Bound | Lost
+    volume_name: str = ""  # bound PV name
+
+    KIND = "PersistentVolumeClaim"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "resources": {"requests": {"storage": str(self.request_storage)}},
+                "accessModes": list(self.access_modes),
+                "storageClassName": self.storage_class,
+                "volumeName": self.volume_name,
+            },
+            "status": {"phase": self.phase},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PersistentVolumeClaim":
+        spec = d.get("spec") or {}
+        req = ((spec.get("resources") or {}).get("requests") or {}).get("storage", 0)
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            request_storage=Quantity(req),
+            access_modes=list(spec.get("accessModes") or ["ReadWriteOnce"]),
+            storage_class=spec.get("storageClassName", ""),
+            phase=(d.get("status") or {}).get("phase", "Pending"),
+            volume_name=spec.get("volumeName", ""),
+        )
+
+
+@_register_cluster_scoped
+@dataclass
+class PriorityClass:
+    """Named pod priority (reference ``pkg/apis/scheduling/types.go``;
+    resolved into ``pod.spec.priority`` by the Priority admission plugin)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+
+    KIND = "PriorityClass"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "value": self.value,
+            "globalDefault": self.global_default,
+            "description": self.description,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PriorityClass":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""
+        return cls(
+            meta=meta,
+            value=int(d.get("value", 0)),
+            global_default=bool(d.get("globalDefault", False)),
+            description=d.get("description", ""),
+        )
+
+
+@_register_cluster_scoped
+@dataclass
+class CertificateSigningRequest:
+    """CSR (reference ``pkg/apis/certificates/types.go``; signed/approved by
+    ``pkg/controller/certificates``)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    request: str = ""  # opaque CSR payload
+    username: str = ""
+    usages: list[str] = field(default_factory=list)
+    conditions: list[dict] = field(default_factory=list)  # Approved | Denied
+    certificate: str = ""  # issued cert payload
+
+    KIND = "CertificateSigningRequest"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+    @property
+    def approved(self) -> bool:
+        return any(c.get("type") == "Approved" for c in self.conditions)
+
+    @property
+    def denied(self) -> bool:
+        return any(c.get("type") == "Denied" for c in self.conditions)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "request": self.request,
+                "username": self.username,
+                "usages": list(self.usages),
+            },
+            "status": {
+                "conditions": list(self.conditions),
+                "certificate": self.certificate,
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CertificateSigningRequest":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            meta=meta,
+            request=spec.get("request", ""),
+            username=spec.get("username", ""),
+            usages=list(spec.get("usages") or []),
+            conditions=list(status.get("conditions") or []),
+            certificate=status.get("certificate", ""),
+        )

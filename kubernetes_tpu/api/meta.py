@@ -53,6 +53,10 @@ class ObjectMeta:
     creation_revision: int = 0
     deletion_revision: Optional[int] = None  # tombstone for graceful deletion
     generation: int = 0
+    # Deletion is blocked until every finalizer is removed (reference
+    # registry/generic/registry/store.go:977 graceful deletion + finalizers;
+    # used by the namespace controller and the garbage collector).
+    finalizers: list[str] = field(default_factory=list)
 
     @property
     def key(self) -> str:
@@ -82,6 +86,8 @@ class ObjectMeta:
             d["ownerReferences"] = [r.to_dict() for r in self.owner_references]
         if self.deletion_revision is not None:
             d["deletionRevision"] = self.deletion_revision
+        if self.finalizers:
+            d["finalizers"] = list(self.finalizers)
         return d
 
     @classmethod
@@ -99,4 +105,5 @@ class ObjectMeta:
             ],
             deletion_revision=d.get("deletionRevision"),
             generation=int(d.get("generation", 0)),
+            finalizers=list(d.get("finalizers") or []),
         )

@@ -116,19 +116,60 @@ class ResourceRequirements:
 
 
 @dataclass
+class Probe:
+    """Liveness/readiness probe (reference ``pkg/api/types.go`` Probe;
+    executed by ``pkg/kubelet/prober``).  ``handler`` is "exec" | "http" |
+    "tcp"; the fake runtime interprets it."""
+
+    handler: str = "exec"
+    initial_delay_seconds: int = 0
+    period_seconds: int = 10
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+    def to_dict(self) -> dict:
+        return {
+            "handler": self.handler,
+            "initialDelaySeconds": self.initial_delay_seconds,
+            "periodSeconds": self.period_seconds,
+            "failureThreshold": self.failure_threshold,
+            "successThreshold": self.success_threshold,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["Probe"]:
+        if not d:
+            return None
+        return cls(
+            handler=d.get("handler", "exec"),
+            initial_delay_seconds=int(d.get("initialDelaySeconds", 0)),
+            period_seconds=int(d.get("periodSeconds", 10)),
+            failure_threshold=int(d.get("failureThreshold", 3)),
+            success_threshold=int(d.get("successThreshold", 1)),
+        )
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     ports: list[ContainerPort] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name,
             "image": self.image,
             "resources": self.resources.to_dict(),
             "ports": [p.to_dict() for p in self.ports],
         }
+        if self.liveness_probe:
+            d["livenessProbe"] = self.liveness_probe.to_dict()
+        if self.readiness_probe:
+            d["readinessProbe"] = self.readiness_probe.to_dict()
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Container":
@@ -137,6 +178,8 @@ class Container:
             image=d.get("image", ""),
             resources=ResourceRequirements.from_dict(d.get("resources")),
             ports=[ContainerPort.from_dict(p) for p in d.get("ports") or []],
+            liveness_probe=Probe.from_dict(d.get("livenessProbe")),
+            readiness_probe=Probe.from_dict(d.get("readinessProbe")),
         )
 
 
@@ -353,8 +396,12 @@ class PodSpec:
     tolerations: list[Toleration] = field(default_factory=list)
     volumes: list[Volume] = field(default_factory=list)
     priority: int = 0
+    priority_class_name: str = ""
     scheduler_name: str = "default-scheduler"
     restart_policy: str = "Always"
+    service_account_name: str = ""
+    termination_grace_period_seconds: int = 30
+    active_deadline_seconds: Optional[int] = None
 
     def to_dict(self) -> dict:
         return {
@@ -365,13 +412,18 @@ class PodSpec:
             "tolerations": [t.to_dict() for t in self.tolerations],
             "volumes": [v.to_dict() for v in self.volumes],
             "priority": self.priority,
+            "priorityClassName": self.priority_class_name,
             "schedulerName": self.scheduler_name,
             "restartPolicy": self.restart_policy,
+            "serviceAccountName": self.service_account_name,
+            "terminationGracePeriodSeconds": self.termination_grace_period_seconds,
+            "activeDeadlineSeconds": self.active_deadline_seconds,
         }
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "PodSpec":
         d = d or {}
+        ads = d.get("activeDeadlineSeconds")
         return cls(
             containers=[Container.from_dict(c) for c in d.get("containers") or []],
             node_name=d.get("nodeName", ""),
@@ -380,8 +432,46 @@ class PodSpec:
             tolerations=[Toleration.from_dict(t) for t in d.get("tolerations") or []],
             volumes=[Volume.from_dict(v) for v in d.get("volumes") or []],
             priority=int(d.get("priority", 0)),
+            priority_class_name=d.get("priorityClassName", ""),
             scheduler_name=d.get("schedulerName", "default-scheduler"),
             restart_policy=d.get("restartPolicy", "Always"),
+            service_account_name=d.get("serviceAccountName", ""),
+            termination_grace_period_seconds=int(d.get("terminationGracePeriodSeconds", 30)),
+            active_deadline_seconds=None if ads is None else int(ads),
+        )
+
+
+@dataclass
+class ContainerStatus:
+    """Per-container runtime state (reference ``pkg/api/types.go``
+    ContainerStatus; written by the kubelet status manager)."""
+
+    name: str = ""
+    state: str = "waiting"  # waiting | running | terminated
+    ready: bool = False
+    restart_count: int = 0
+    exit_code: int = 0
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "state": self.state,
+            "ready": self.ready,
+            "restartCount": self.restart_count,
+            "exitCode": self.exit_code,
+            "reason": self.reason,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ContainerStatus":
+        return cls(
+            name=d.get("name", ""),
+            state=d.get("state", "waiting"),
+            ready=bool(d.get("ready", False)),
+            restart_count=int(d.get("restartCount", 0)),
+            exit_code=int(d.get("exitCode", 0)),
+            reason=d.get("reason", ""),
         )
 
 
@@ -392,15 +482,22 @@ class PodStatus:
     host_ip: str = ""
     pod_ip: str = ""
     start_revision: int = 0
+    container_statuses: list[ContainerStatus] = field(default_factory=list)
+    reason: str = ""
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "phase": self.phase,
             "conditions": copy.deepcopy(self.conditions),
             "hostIP": self.host_ip,
             "podIP": self.pod_ip,
             "startRevision": self.start_revision,
         }
+        if self.container_statuses:
+            d["containerStatuses"] = [c.to_dict() for c in self.container_statuses]
+        if self.reason:
+            d["reason"] = self.reason
+        return d
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "PodStatus":
@@ -411,6 +508,10 @@ class PodStatus:
             host_ip=d.get("hostIP", ""),
             pod_ip=d.get("podIP", ""),
             start_revision=int(d.get("startRevision", 0)),
+            container_statuses=[
+                ContainerStatus.from_dict(c) for c in d.get("containerStatuses") or []
+            ],
+            reason=d.get("reason", ""),
         )
 
 
@@ -624,9 +725,44 @@ class Binding:
 
 
 @dataclass
+class ServicePort:
+    """Service port mapping (reference ``pkg/api/types.go`` ServicePort;
+    consumed by the proxy's NAT rule synthesis and the endpoint controller)."""
+
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+    target_port: int = 0
+    node_port: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "protocol": self.protocol,
+            "port": self.port,
+            "targetPort": self.target_port,
+            "nodePort": self.node_port,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServicePort":
+        return cls(
+            name=d.get("name", ""),
+            protocol=d.get("protocol", "TCP"),
+            port=int(d.get("port", 0)),
+            target_port=int(d.get("targetPort", 0)),
+            node_port=int(d.get("nodePort", 0)),
+        )
+
+
+@dataclass
 class Service:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     selector: dict[str, str] = field(default_factory=dict)
+    ports: list[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""  # "" = allocate; "None" = headless
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer
+    session_affinity: str = "None"  # None | ClientIP
 
     KIND = "Service"
 
@@ -634,14 +770,25 @@ class Service:
         return {
             "kind": self.KIND,
             "metadata": self.meta.to_dict(),
-            "spec": {"selector": dict(self.selector)},
+            "spec": {
+                "selector": dict(self.selector),
+                "ports": [p.to_dict() for p in self.ports],
+                "clusterIP": self.cluster_ip,
+                "type": self.type,
+                "sessionAffinity": self.session_affinity,
+            },
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "Service":
+        spec = d.get("spec") or {}
         return cls(
             meta=ObjectMeta.from_dict(d.get("metadata") or {}),
-            selector=dict((d.get("spec") or {}).get("selector") or {}),
+            selector=dict(spec.get("selector") or {}),
+            ports=[ServicePort.from_dict(p) for p in spec.get("ports") or []],
+            cluster_ip=spec.get("clusterIP", ""),
+            type=spec.get("type", "ClusterIP"),
+            session_affinity=spec.get("sessionAffinity", "None"),
         )
 
 
@@ -800,15 +947,45 @@ class Event:
         )
 
 
-# Registry of kinds for the store / clients
-KINDS = {
-    "Pod": Pod,
-    "Node": Node,
-    "Service": Service,
-    "ReplicaSet": ReplicaSet,
-    "Deployment": Deployment,
-    "Event": Event,
-}
+# Registry of kinds for the store / clients.  Sibling modules (apps,
+# cluster, rbac) register their kinds at import — the runtime.Scheme
+# analogue (reference apimachinery/pkg/runtime/scheme.go:569).  The
+# clientset, kubectl, and the wire apiserver all derive their kind→resource
+# tables from this one registry.
+KINDS: dict[str, type] = {}
+
+# Kinds whose objects live outside any namespace (store key = bare name).
+CLUSTER_SCOPED_KINDS: set[str] = set()
+
+# kind -> lowercase plural resource name (the REST path segment / kubectl
+# resource argument, reference RESTMapper semantics).
+KIND_PLURALS: dict[str, str] = {}
+
+
+def _pluralize(kind: str) -> str:
+    low = kind.lower()
+    if low.endswith("ss"):  # PriorityClass -> priorityclasses
+        return low + "es"
+    if low.endswith("s"):  # Endpoints -> endpoints
+        return low
+    return low + "s"
+
+
+def register_kind(cls, cluster_scoped: bool = False, plural: Optional[str] = None):
+    KINDS[cls.KIND] = cls
+    KIND_PLURALS[cls.KIND] = plural or _pluralize(cls.KIND)
+    if cluster_scoped:
+        CLUSTER_SCOPED_KINDS.add(cls.KIND)
+    return cls
+
+
+def register_cluster_scoped(cls):
+    return register_kind(cls, cluster_scoped=True)
+
+
+for _cls in (Pod, Service, ReplicaSet, Deployment, Event):
+    register_kind(_cls)
+register_kind(Node, cluster_scoped=True)
 
 
 def from_dict(d: dict):

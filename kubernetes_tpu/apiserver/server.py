@@ -46,16 +46,17 @@ from ..utils.metrics import Counter, Histogram, Registry
 
 logger = logging.getLogger("kubernetes_tpu.apiserver")
 
-# resource path segment -> kind
-RESOURCES = {
-    "pods": "Pod",
-    "nodes": "Node",
-    "services": "Service",
-    "replicasets": "ReplicaSet",
-    "deployments": "Deployment",
-    "events": "Event",
-}
-CLUSTER_SCOPED = {"Node"}
+# resource path segment -> kind, derived from the one type registry so
+# every registered kind (incl. late-registered CRDs) is wire-addressable.
+from ..api.types import CLUSTER_SCOPED_KINDS as CLUSTER_SCOPED  # noqa: E402
+from ..api.types import KIND_PLURALS  # noqa: E402
+
+
+def _resources() -> dict[str, str]:
+    return {plural: kind for kind, plural in KIND_PLURALS.items()}
+
+
+RESOURCES = _resources()
 
 
 class APIServer:
@@ -209,7 +210,10 @@ def _make_handler(server: APIServer):
                     items, rev = server.store.list(kind, ns)
                     return self._send(200, {"items": items, "resourceVersion": rev})
                 if method == "POST":
-                    return self._send(201, server.store.create(kind, self._body()))
+                    body = self._body()
+                    if kind in CLUSTER_SCOPED:
+                        body.setdefault("metadata", {})["namespace"] = ""
+                    return self._send(201, server.store.create(kind, body))
                 return self._error(405, "MethodNotAllowed", method)
 
             # object routes: /api/v1/namespaces/{ns}/{resource}/{name}[/binding]

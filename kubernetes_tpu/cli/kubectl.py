@@ -33,33 +33,45 @@ from ..store.store import AlreadyExistsError, NotFoundError
 
 LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
 
-KIND_TO_RESOURCE = {
-    "Pod": "pods",
-    "Node": "nodes",
-    "Service": "services",
-    "ReplicaSet": "replicasets",
-    "Deployment": "deployments",
-    "Event": "events",
-}
-RESOURCE_ALIASES = {
+# kind -> plural resource name, from the one type registry (RESTMapper
+# analogue) — new kinds (incl. CRDs) become kubectl-addressable on import.
+KIND_TO_RESOURCE = api.KIND_PLURALS
+
+_SHORT_NAMES = {
     "po": "pods",
-    "pod": "pods",
-    "pods": "pods",
     "no": "nodes",
-    "node": "nodes",
-    "nodes": "nodes",
     "svc": "services",
-    "service": "services",
-    "services": "services",
     "rs": "replicasets",
-    "replicaset": "replicasets",
-    "replicasets": "replicasets",
     "deploy": "deployments",
-    "deployment": "deployments",
-    "deployments": "deployments",
     "ev": "events",
-    "events": "events",
+    "ns": "namespaces",
+    "ds": "daemonsets",
+    "sts": "statefulsets",
+    "cj": "cronjobs",
+    "hpa": "horizontalpodautoscalers",
+    "pdb": "poddisruptionbudgets",
+    "pv": "persistentvolumes",
+    "pvc": "persistentvolumeclaims",
+    "sa": "serviceaccounts",
+    "quota": "resourcequotas",
+    "cm": "configmaps",
+    "ep": "endpoints",
+    "limits": "limitranges",
+    "pc": "priorityclasses",
+    "csr": "certificatesigningrequests",
 }
+
+
+def _resource_aliases() -> dict[str, str]:
+    """plural, singular (kind lowercased), and short names all resolve."""
+    out = dict(_SHORT_NAMES)
+    for kind, plural in KIND_TO_RESOURCE.items():
+        out[plural] = plural
+        out[kind.lower()] = plural
+    return out
+
+
+RESOURCE_ALIASES = _resource_aliases()
 RESOURCE_TO_KIND = {v: k for k, v in KIND_TO_RESOURCE.items()}
 
 
@@ -113,7 +125,11 @@ class Kubectl:
             "ReplicaSet": ("NAME", "DESIRED", "CURRENT", "READY"),
             "Service": ("NAME", "SELECTOR"),
             "Event": ("OBJECT", "TYPE", "REASON", "MESSAGE"),
-        }[kind]
+            "Job": ("NAME", "ACTIVE", "SUCCEEDED", "FAILED"),
+            "DaemonSet": ("NAME", "DESIRED", "CURRENT", "READY"),
+            "StatefulSet": ("NAME", "DESIRED", "CURRENT", "READY"),
+            "Namespace": ("NAME", "STATUS"),
+        }.get(kind, ("NAME",))
 
     def _row(self, kind: str, o):
         if kind == "Pod":
@@ -136,6 +152,14 @@ class Kubectl:
             return (o.meta.name, ",".join(f"{k}={v}" for k, v in o.selector.items()))
         if kind == "Event":
             return (o.involved_key, o.type, o.reason, o.message[:80])
+        if kind == "Job":
+            return (o.meta.name, o.status_active, o.status_succeeded, o.status_failed)
+        if kind == "DaemonSet":
+            return (o.meta.name, o.status_desired, o.status_current, o.status_ready)
+        if kind == "StatefulSet":
+            return (o.meta.name, o.replicas, o.status_current_replicas, o.status_ready_replicas)
+        if kind == "Namespace":
+            return (o.meta.name, o.phase)
         return (o.meta.name,)
 
     # -- describe ----------------------------------------------------------

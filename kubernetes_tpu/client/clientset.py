@@ -27,8 +27,9 @@ from ..store.store import Store, Watch
 
 
 # Kinds whose objects live outside any namespace (reference: node is
-# cluster-scoped; its store key is the bare name).
-CLUSTER_SCOPED_KINDS = {"Node"}
+# cluster-scoped; its store key is the bare name).  Populated by the type
+# registry (api.types.register_kind).
+from ..api.types import CLUSTER_SCOPED_KINDS  # noqa: E402
 
 
 class TypedClient:
@@ -123,24 +124,27 @@ class BindConflictError(Exception):
 
 
 class Clientset:
-    """One handle per kind (``clientset.Interface`` analogue)."""
+    """One handle per registered kind (``clientset.Interface`` analogue),
+    exposed under the kind's plural resource name (``cs.pods``,
+    ``cs.daemonsets``, …).  Kinds registered later (e.g. CRDs) are
+    reachable via ``client_for``."""
 
     def __init__(self, store: Store):
         self.store = store
         self.pods = PodClient(store)
-        self.nodes = TypedClient(store, "Node", api.Node)
-        self.services = TypedClient(store, "Service", api.Service)
-        self.replicasets = TypedClient(store, "ReplicaSet", api.ReplicaSet)
-        self.deployments = TypedClient(store, "Deployment", api.Deployment)
-        self.events = TypedClient(store, "Event", api.Event)
-        self._by_kind = {
-            "Pod": self.pods,
-            "Node": self.nodes,
-            "Service": self.services,
-            "ReplicaSet": self.replicasets,
-            "Deployment": self.deployments,
-            "Event": self.events,
-        }
+        self._by_kind: dict[str, TypedClient] = {"Pod": self.pods}
+        for kind, cls in api.KINDS.items():
+            if kind == "Pod":
+                continue
+            client = TypedClient(store, kind, cls)
+            self._by_kind[kind] = client
+            setattr(self, api.KIND_PLURALS[kind], client)
 
     def client_for(self, kind: str) -> TypedClient:
+        if kind not in self._by_kind:
+            # kind registered after construction (CRD): build on demand
+            cls = api.KINDS.get(kind)
+            if cls is None:
+                raise KeyError(kind)
+            self._by_kind[kind] = TypedClient(self.store, kind, cls)
         return self._by_kind[kind]

@@ -182,6 +182,17 @@ class Store:
             m["uid"] = item.data["metadata"]["uid"]
             m["resourceVersion"] = rev
             m["creationRevision"] = item.data["metadata"].get("creationRevision", 0)
+            # deletion tombstone is immutable once set (graceful deletion)
+            prior_del = item.data["metadata"].get("deletionRevision")
+            if prior_del is not None:
+                m["deletionRevision"] = prior_del
+                if not m.get("finalizers"):
+                    # last finalizer cleared on a deleting object → finish the
+                    # delete (store.go:977: deleteForEmptyFinalizers)
+                    del bucket[key]
+                    final = _fast_deepcopy(data)
+                    self._emit(WatchEvent(DELETED, kind, key, rev, final))
+                    return final
             bucket[key] = _Item(data=data, revision=rev)
             ev_copy = _fast_deepcopy(data)
             self._emit(WatchEvent(MODIFIED, kind, key, rev, ev_copy))
@@ -242,6 +253,11 @@ class Store:
                 continue
 
     def delete(self, kind: str, namespace: str, name: str, expect_rev: Optional[int] = None) -> dict:
+        """Delete, honoring finalizers (reference
+        ``registry/generic/registry/store.go:977`` graceful deletion): while
+        ``metadata.finalizers`` is non-empty the object is only *marked*
+        deleting (``deletionRevision`` tombstone, MODIFIED event); the actual
+        removal happens when an update clears the last finalizer."""
         with self._mu:
             key = object_key(namespace, name)
             bucket = self._objects.setdefault(kind, {})
@@ -251,6 +267,13 @@ class Store:
             if expect_rev is not None and item.revision != expect_rev:
                 raise ConflictError(f"{kind} {key}")
             rev = self._next_rev()
+            if item.data["metadata"].get("finalizers"):
+                item.data["metadata"]["deletionRevision"] = rev
+                item.data["metadata"]["resourceVersion"] = rev
+                item.revision = rev
+                marked = _fast_deepcopy(item.data)
+                self._emit(WatchEvent(MODIFIED, kind, key, rev, marked))
+                return marked
             del bucket[key]
             final = _fast_deepcopy(item.data)
             final["metadata"]["deletionRevision"] = rev

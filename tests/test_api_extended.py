@@ -1,0 +1,261 @@
+"""Round-trip + semantics tests for the extended API types
+(apps/cluster/rbac groups), patterned on the reference's serialization
+round-trip fuzz tests (``pkg/api/serialization_test.go``) at table depth."""
+
+import kubernetes_tpu.api as api
+from kubernetes_tpu.api import (
+    ClusterRole,
+    ClusterRoleBinding,
+    ConfigMap,
+    CronJob,
+    DaemonSet,
+    Endpoints,
+    EndpointAddress,
+    EndpointPort,
+    EndpointSubset,
+    HorizontalPodAutoscaler,
+    Job,
+    LimitRange,
+    LimitRangeItem,
+    Namespace,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodDisruptionBudget,
+    PolicyRule,
+    PriorityClass,
+    Quantity,
+    ResourceQuota,
+    Role,
+    RoleBinding,
+    Secret,
+    ServiceAccount,
+    StatefulSet,
+    Subject,
+)
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.api.types import (
+    Container,
+    PodTemplateSpec,
+    Probe,
+    Service,
+    ServicePort,
+    from_dict,
+)
+
+
+def roundtrip(obj):
+    d = obj.to_dict()
+    again = from_dict(d)
+    assert again.to_dict() == d, f"{obj.KIND} round-trip mismatch"
+    return again
+
+
+def test_job_roundtrip_and_conditions():
+    j = Job(
+        meta=ObjectMeta(name="burn", namespace="batchns"),
+        parallelism=3,
+        completions=None,
+        backoff_limit=2,
+        selector=LabelSelector(match_labels={"job": "burn"}),
+        template=PodTemplateSpec(labels={"job": "burn"}),
+        status_conditions=[{"type": "Complete", "status": "True"}],
+    )
+    again = roundtrip(j)
+    assert again.completions is None
+    assert again.complete and not again.failed
+
+
+def test_cronjob_roundtrip():
+    cj = CronJob(
+        meta=ObjectMeta(name="tick"),
+        schedule="*/5 * * * *",
+        concurrency_policy="Forbid",
+        job_template={"parallelism": 1},
+        status_active=["tick-001"],
+    )
+    again = roundtrip(cj)
+    assert again.schedule == "*/5 * * * *"
+    assert again.status_active == ["tick-001"]
+
+
+def test_daemonset_statefulset_roundtrip():
+    ds = DaemonSet(
+        meta=ObjectMeta(name="agent"),
+        selector=LabelSelector(match_labels={"ds": "agent"}),
+        status_desired=5,
+    )
+    assert roundtrip(ds).status_desired == 5
+    ss = StatefulSet(
+        meta=ObjectMeta(name="db"),
+        replicas=3,
+        service_name="db",
+        pod_management_policy="Parallel",
+    )
+    assert roundtrip(ss).pod_management_policy == "Parallel"
+
+
+def test_namespace_cluster_scoped_and_phase():
+    ns = Namespace(meta=ObjectMeta(name="prod"))
+    assert ns.meta.namespace == ""
+    again = roundtrip(ns)
+    assert again.phase == "Active"
+    assert again.spec_finalizers == ["kubernetes"]
+
+
+def test_quota_limitrange_roundtrip():
+    rq = ResourceQuota(
+        meta=ObjectMeta(name="compute", namespace="prod"),
+        hard={"cpu": Quantity("10"), "pods": Quantity("50")},
+        used={"cpu": Quantity("2")},
+    )
+    again = roundtrip(rq)
+    assert again.hard["pods"] == Quantity("50")
+    lr = LimitRange(
+        meta=ObjectMeta(name="defaults", namespace="prod"),
+        limits=[
+            LimitRangeItem(
+                type="Container",
+                default_request={"cpu": Quantity("100m")},
+                max={"memory": Quantity("1Gi")},
+            )
+        ],
+    )
+    again = roundtrip(lr)
+    assert again.limits[0].default_request["cpu"] == Quantity("100m")
+
+
+def test_endpoints_roundtrip():
+    ep = Endpoints(
+        meta=ObjectMeta(name="web", namespace="prod"),
+        subsets=[
+            EndpointSubset(
+                addresses=[EndpointAddress(ip="10.0.0.1", target_pod="prod/web-1")],
+                not_ready_addresses=[EndpointAddress(ip="10.0.0.2")],
+                ports=[EndpointPort(name="http", port=8080)],
+            )
+        ],
+    )
+    again = roundtrip(ep)
+    assert again.subsets[0].addresses[0].ip == "10.0.0.1"
+    assert again.subsets[0].not_ready_addresses[0].ip == "10.0.0.2"
+
+
+def test_pv_pvc_priorityclass_csr_roundtrip():
+    pv = PersistentVolume(
+        meta=ObjectMeta(name="disk-1"),
+        capacity={"storage": Quantity("100Gi")},
+        zone="zone-a",
+        phase="Available",
+    )
+    assert pv.meta.namespace == ""
+    assert roundtrip(pv).zone == "zone-a"
+    pvc = PersistentVolumeClaim(
+        meta=ObjectMeta(name="claim", namespace="prod"),
+        request_storage=Quantity("10Gi"),
+    )
+    assert roundtrip(pvc).request_storage == Quantity("10Gi")
+    pc = PriorityClass(meta=ObjectMeta(name="critical"), value=1000, global_default=True)
+    assert roundtrip(pc).value == 1000
+    csr = api.CertificateSigningRequest(
+        meta=ObjectMeta(name="node-1-csr"),
+        request="csr-bytes",
+        username="system:node:node-1",
+        conditions=[{"type": "Approved"}],
+    )
+    assert roundtrip(csr).approved
+
+
+def test_pdb_hpa_roundtrip():
+    pdb = PodDisruptionBudget(
+        meta=ObjectMeta(name="web-pdb", namespace="prod"),
+        min_available=2,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        status_disruptions_allowed=1,
+    )
+    assert roundtrip(pdb).status_disruptions_allowed == 1
+    hpa = HorizontalPodAutoscaler(
+        meta=ObjectMeta(name="web-hpa", namespace="prod"),
+        target_name="web",
+        min_replicas=2,
+        max_replicas=10,
+        target_cpu_utilization=50,
+    )
+    assert roundtrip(hpa).max_replicas == 10
+
+
+def test_rbac_roundtrip_and_rule_matching():
+    rule = PolicyRule(verbs=["get", "list"], resources=["pods"])
+    assert rule.matches("get", "pods")
+    assert not rule.matches("delete", "pods")
+    assert not rule.matches("get", "nodes")
+    star = PolicyRule(verbs=["*"], resources=["*"])
+    assert star.matches("anything", "whatever")
+    named = PolicyRule(verbs=["get"], resources=["secrets"], resource_names=["tok"])
+    assert named.matches("get", "secrets", "tok")
+    assert not named.matches("get", "secrets", "other")
+
+    role = Role(meta=ObjectMeta(name="reader", namespace="prod"), rules=[rule])
+    assert roundtrip(role).rules[0].verbs == ["get", "list"]
+    cr = ClusterRole(meta=ObjectMeta(name="admin"), rules=[star])
+    assert cr.meta.namespace == ""
+    roundtrip(cr)
+    rb = RoleBinding(
+        meta=ObjectMeta(name="rb", namespace="prod"),
+        subjects=[Subject(kind="User", name="alice")],
+        role_name="reader",
+    )
+    assert roundtrip(rb).subjects[0].name == "alice"
+    crb = ClusterRoleBinding(
+        meta=ObjectMeta(name="crb"),
+        subjects=[Subject(kind="Group", name="ops")],
+        role_name="admin",
+    )
+    assert roundtrip(crb).role_kind == "ClusterRole"
+
+
+def test_secret_configmap_serviceaccount_roundtrip():
+    assert roundtrip(Secret(meta=ObjectMeta(name="tok"), data={"k": "djE="})).data["k"] == "djE="
+    assert roundtrip(ConfigMap(meta=ObjectMeta(name="cfg"), data={"a": "1"})).data["a"] == "1"
+    sa = ServiceAccount(meta=ObjectMeta(name="default"), secrets=["default-token"])
+    assert roundtrip(sa).secrets == ["default-token"]
+
+
+def test_service_ports_and_probe_roundtrip():
+    svc = Service(
+        meta=ObjectMeta(name="web", namespace="prod"),
+        selector={"app": "web"},
+        ports=[ServicePort(name="http", port=80, target_port=8080)],
+        cluster_ip="10.96.0.10",
+        session_affinity="ClientIP",
+    )
+    again = roundtrip(svc)
+    assert again.ports[0].target_port == 8080
+    assert again.session_affinity == "ClientIP"
+
+    c = Container(
+        name="app",
+        image="app:v1",
+        liveness_probe=Probe(handler="http", period_seconds=5),
+        readiness_probe=Probe(handler="tcp"),
+    )
+    d = c.to_dict()
+    again = Container.from_dict(d)
+    assert again.liveness_probe.period_seconds == 5
+    assert again.readiness_probe.handler == "tcp"
+
+
+def test_clientset_has_all_kind_clients():
+    from kubernetes_tpu.client.clientset import Clientset
+    from kubernetes_tpu.store.store import Store
+
+    cs = Clientset(Store())
+    ns = cs.namespaces.create(Namespace(meta=ObjectMeta(name="prod")))
+    assert ns.meta.uid
+    got = cs.namespaces.get("prod")
+    assert got.phase == "Active"
+    j = cs.jobs.create(Job(meta=ObjectMeta(name="j1", namespace="default")))
+    assert cs.jobs.get("j1").meta.name == "j1"
+    # cluster-scoped kinds key by bare name
+    pc = cs.priorityclasses.create(PriorityClass(meta=ObjectMeta(name="high"), value=10))
+    assert cs.priorityclasses.get("high").value == 10
