@@ -1,0 +1,26 @@
+"""Admission control (SURVEY.md §2.3 — apiserver/pkg/admission +
+plugin/pkg/admission/*): mutating/validating plugin chain on the write
+path, plus the quota evaluator library (pkg/quota)."""
+
+from .framework import (
+    CREATE,
+    DELETE,
+    UPDATE,
+    AdmissionChain,
+    AdmissionDenied,
+    AdmissionPlugin,
+    AdmittedStore,
+    Attributes,
+)
+from .plugins import (
+    IMMORTAL_NAMESPACES,
+    DefaultTolerationSeconds,
+    LimitPodHardAntiAffinityTopology,
+    LimitRanger,
+    NamespaceLifecycle,
+    Priority,
+    ResourceQuota,
+    ServiceAccount,
+    default_chain,
+)
+from . import quota

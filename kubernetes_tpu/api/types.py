@@ -199,6 +199,8 @@ class Volume:
     disk_kind: str = ""  # "gce-pd" | "aws-ebs" | "azure-disk" | "rbd" | "iscsi" | ""
     read_only: bool = False
     pvc_name: str = ""
+    secret_name: str = ""  # secret-backed volume (kubelet mounts, node authz)
+    config_map_name: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -207,6 +209,8 @@ class Volume:
             "diskKind": self.disk_kind,
             "readOnly": self.read_only,
             "pvcName": self.pvc_name,
+            "secretName": self.secret_name,
+            "configMapName": self.config_map_name,
         }
 
     @classmethod
@@ -217,6 +221,8 @@ class Volume:
             disk_kind=d.get("diskKind", ""),
             read_only=bool(d.get("readOnly", False)),
             pvc_name=d.get("pvcName", ""),
+            secret_name=d.get("secretName", ""),
+            config_map_name=d.get("configMapName", ""),
         )
 
 

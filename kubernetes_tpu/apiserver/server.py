@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..admission.framework import AdmissionDenied
 from ..store.store import (
     AlreadyExistsError,
     ConflictError,
@@ -60,15 +61,36 @@ RESOURCES = _resources()
 
 
 class APIServer:
+    """HTTP front end over the store.
+
+    Filter order mirrors the reference's handler chain
+    (``server/config.go:469 DefaultBuildHandlerChain``): panic recovery →
+    request-info → authentication → audit → authorization → dispatch.
+    ``tokens`` is the legacy static-token shorthand; pass ``authenticator``
+    / ``authorizer`` / ``auditor`` for the full stack (admission runs in
+    the store itself when constructed over an ``AdmittedStore``)."""
+
     def __init__(
         self,
         store: Store,
         host: str = "127.0.0.1",
         port: int = 0,
         tokens: Optional[dict[str, str]] = None,  # token -> username; None = authn off
+        authenticator=None,
+        authorizer=None,
+        auditor=None,
     ):
         self.store = store
         self.tokens = tokens
+        self.authenticator = authenticator
+        if authenticator is None and tokens is not None:
+            from ..auth import TokenFileAuthenticator, UnionAuthenticator
+
+            self.authenticator = UnionAuthenticator(
+                TokenFileAuthenticator(tokens), allow_anonymous=False
+            )
+        self.authorizer = authorizer
+        self.auditor = auditor
         self.registry = Registry()
         self.request_count = self.registry.register(
             Counter("apiserver_request_count", "total requests")
@@ -104,6 +126,7 @@ def _make_handler(server: APIServer):
             pass
 
         def _send(self, code: int, obj) -> None:
+            self._last_code = code
             data = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -115,17 +138,77 @@ def _make_handler(server: APIServer):
             self._send(code, {"kind": "Status", "code": code, "reason": reason, "message": message})
 
         def _body(self) -> dict:
-            length = int(self.headers.get("Content-Length", 0))
-            return json.loads(self.rfile.read(length)) if length else {}
+            # cached: the auth filters peek at the body (namespace for
+            # authorization) before dispatch consumes it
+            if not hasattr(self, "_cached_body"):
+                length = int(self.headers.get("Content-Length", 0))
+                self._cached_body = json.loads(self.rfile.read(length)) if length else {}
+            return self._cached_body
 
-        def _authn(self) -> bool:
-            if server.tokens is None:
-                return True
-            auth = self.headers.get("Authorization", "")
-            if auth.startswith("Bearer ") and auth[7:] in server.tokens:
-                return True
-            self._error(401, "Unauthorized", "invalid or missing bearer token")
-            return False
+        def _request_info(self, method: str):
+            """(verb, resource, namespace, name) — the request-info filter
+            (reference ``endpoints/filters/requestinfo``)."""
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            parts = [p for p in url.path.split("/") if p]
+            verb = {"POST": "create", "PUT": "update", "DELETE": "delete"}.get(method, "get")
+            resource, ns, name = "", "", ""
+            if len(parts) >= 3 and parts[0] == "api" and parts[1] == "v1":
+                rest = parts[2:]
+                if len(rest) == 1:
+                    resource = rest[0]
+                    if method == "GET":
+                        verb = "watch" if q.get("watch", ["false"])[0] == "true" else "list"
+                        ns = q.get("namespace", [""])[0] or ""
+                    elif method == "POST":
+                        # namespace rides in the body on collection creates
+                        try:
+                            ns = (self._body().get("metadata") or {}).get("namespace", "")
+                        except Exception:
+                            ns = ""
+                elif rest[0] == "namespaces" and len(rest) >= 4:
+                    ns = "" if rest[1] == "-" else rest[1]
+                    resource = rest[2]
+                    name = rest[3]
+                    if len(rest) == 5 and rest[4] == "binding":
+                        verb = "bind"
+            return verb, resource, ns, name
+
+        def _auth_filters(self, method: str) -> bool:
+            """authentication → audit(RequestReceived) → authorization.
+            Returns False (response already sent) on 401/403."""
+            self._user = None
+            if server.authenticator is not None:
+                user = server.authenticator.authenticate(self.headers)
+                if user is None:
+                    self._error(401, "Unauthorized", "invalid or missing credentials")
+                    return False
+                self._user = user
+            verb, resource, ns, name = self._request_info(method)
+            if server.auditor is not None:
+                server.auditor.record(
+                    "RequestReceived",
+                    self._user.name if self._user else "",
+                    verb, resource, ns, name,
+                )
+            if server.authorizer is not None:
+                from ..auth import ALLOW, ANONYMOUS, AuthzAttributes
+
+                # no authenticator configured -> authorize as anonymous
+                # (fail closed, never skip an explicit authorizer)
+                user = self._user if self._user is not None else ANONYMOUS
+                decision, reason = server.authorizer.authorize(AuthzAttributes(
+                    user=user, verb=verb, resource=resource,
+                    namespace=ns, name=name, path=urlparse(self.path).path,
+                ))
+                if decision != ALLOW:
+                    self._error(403, "Forbidden", reason)
+                    return False
+            # per-request identity for admission plugins (thread-local on
+            # AdmittedStore, so concurrent handler threads don't race)
+            if self._user is not None and hasattr(server.store, "user"):
+                server.store.user = self._user.name
+            return True
 
         # -- dispatch ------------------------------------------------------
         def _route(self, method: str) -> None:
@@ -133,10 +216,13 @@ def _make_handler(server: APIServer):
 
             start = time.perf_counter()
             server.request_count.inc()
+            self._last_code = 0
             try:
-                if not self._authn():
+                if not self._auth_filters(method):
                     return
                 self._dispatch(method)
+            except AdmissionDenied as e:
+                self._error(403, "Forbidden", str(e))
             except NotFoundError as e:
                 self._error(404, "NotFound", str(e))
             except AlreadyExistsError as e:
@@ -155,6 +241,13 @@ def _make_handler(server: APIServer):
                     pass
             finally:
                 server.request_latency.observe((time.perf_counter() - start) * 1e6)
+                if server.auditor is not None:
+                    verb, resource, ns, name = self._request_info(method)
+                    server.auditor.record(
+                        "ResponseComplete",
+                        self._user.name if getattr(self, "_user", None) else "",
+                        verb, resource, ns, name, code=self._last_code,
+                    )
 
         def do_GET(self):
             self._route("GET")
@@ -177,6 +270,7 @@ def _make_handler(server: APIServer):
                 return self._send(200, {"status": "ok"})
             if url.path == "/metrics":
                 text = server.registry.expose().encode()
+                self._last_code = 200
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(text)))
@@ -253,6 +347,7 @@ def _make_handler(server: APIServer):
             timeout = float(q.get("timeoutSeconds", ["30"])[0])
             watch = server.store.watch(kind, from_revision=from_rev)
             try:
+                self._last_code = 200
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")

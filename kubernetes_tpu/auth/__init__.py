@@ -1,0 +1,34 @@
+"""AuthN/AuthZ/audit stack (SURVEY.md §2.9, §5.5)."""
+
+from .audit import (
+    AuditEvent,
+    AuditPolicy,
+    Auditor,
+    LogBackend,
+    MemoryBackend,
+)
+from .audit import PolicyRule as AuditPolicyRule
+from .authn import (
+    ANONYMOUS,
+    Authenticator,
+    RequestHeaderAuthenticator,
+    ServiceAccountTokenAuthenticator,
+    ServiceAccountTokenMinter,
+    TokenFileAuthenticator,
+    UnionAuthenticator,
+    UserInfo,
+)
+from .authz import (
+    ALLOW,
+    DENY,
+    NO_OPINION,
+    ABACAuthorizer,
+    AlwaysAllow,
+    AuthzAttributes,
+    Authorizer,
+    BootstrapPolicyAuthorizer,
+    NodeAuthorizer,
+    RBACAuthorizer,
+    UnionAuthorizer,
+    WebhookAuthorizer,
+)

@@ -1,0 +1,153 @@
+"""Authentication (SURVEY.md §2.9): who is making this request.
+
+Capability equivalents of the reference's authenticator stack
+(``pkg/kubeapiserver/authenticator/config.go`` builds a union of x509,
+token-file, service-account-JWT, bootstrap-token and webhook
+authenticators; interfaces in ``apiserver/pkg/authentication``).
+
+Transport note: the reference's x509 path authenticates the TLS client
+cert; this server speaks plain HTTP in-proc, so every credential rides the
+``Authorization`` header and identity-asserting headers play the role of
+client certs (the reference itself has this shape as the front-proxy
+``RequestHeader`` authenticator).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class UserInfo:
+    """Reference ``authentication/user.Info``."""
+
+    name: str = ""
+    groups: list[str] = field(default_factory=list)
+
+    @property
+    def authenticated(self) -> bool:
+        return bool(self.name) and self.name != ANONYMOUS.name
+
+
+ANONYMOUS = UserInfo(name="system:anonymous", groups=["system:unauthenticated"])
+
+
+class Authenticator:
+    """Returns a UserInfo or None (not my credential type / invalid)."""
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        raise NotImplementedError
+
+
+class TokenFileAuthenticator(Authenticator):
+    """Static bearer tokens (reference ``--token-auth-file``,
+    ``plugin/pkg/auth/authenticator/token/tokenfile``)."""
+
+    def __init__(self, tokens: dict[str, UserInfo | str]):
+        self.tokens: dict[str, UserInfo] = {
+            t: (u if isinstance(u, UserInfo) else UserInfo(name=u))
+            for t, u in tokens.items()
+        }
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return None
+        return self.tokens.get(auth[7:])
+
+
+class RequestHeaderAuthenticator(Authenticator):
+    """Identity asserted via X-Remote-User / X-Remote-Group headers — the
+    front-proxy / client-cert stand-in (reference
+    ``apiserver/pkg/authentication/request/headerrequest``)."""
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        name = headers.get("X-Remote-User", "")
+        if not name:
+            return None
+        groups = [g for g in headers.get("X-Remote-Group", "").split(",") if g]
+        return UserInfo(name=name, groups=groups)
+
+
+class ServiceAccountTokenAuthenticator(Authenticator):
+    """Verifies tokens minted by :class:`ServiceAccountTokenMinter`
+    (reference ``pkg/serviceaccount/jwt.go`` — JWTs signed with the cluster
+    key; here HMAC-SHA256 in JWT layout, no external deps)."""
+
+    def __init__(self, minter: "ServiceAccountTokenMinter"):
+        self.minter = minter
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return None
+        claims = self.minter.verify(auth[7:])
+        if claims is None:
+            return None
+        namespace, name = claims
+        return UserInfo(
+            name=f"system:serviceaccount:{namespace}:{name}",
+            groups=["system:serviceaccounts", f"system:serviceaccounts:{namespace}"],
+        )
+
+
+class UnionAuthenticator(Authenticator):
+    """First authenticator that recognizes the credential wins (reference
+    ``authentication/request/union``)."""
+
+    def __init__(self, *authenticators: Authenticator, allow_anonymous: bool = True):
+        self.authenticators = list(authenticators)
+        self.allow_anonymous = allow_anonymous
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        for a in self.authenticators:
+            user = a.authenticate(headers)
+            if user is not None:
+                return user
+        return ANONYMOUS if self.allow_anonymous else None
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class ServiceAccountTokenMinter:
+    """Mints and verifies service-account bearer tokens (reference
+    ``pkg/serviceaccount`` TokenGenerator; the controller writes them into
+    token Secrets)."""
+
+    def __init__(self, signing_key: bytes = b"cluster-signing-key"):
+        self.key = signing_key
+
+    def mint(self, namespace: str, name: str) -> str:
+        header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        payload = _b64(json.dumps({
+            "sub": f"system:serviceaccount:{namespace}:{name}",
+            "kubernetes.io/serviceaccount/namespace": namespace,
+            "kubernetes.io/serviceaccount/service-account.name": name,
+        }).encode())
+        sig = _b64(hmac.new(self.key, f"{header}.{payload}".encode(), hashlib.sha256).digest())
+        return f"{header}.{payload}.{sig}"
+
+    def verify(self, token: str) -> Optional[tuple[str, str]]:
+        try:
+            header, payload, sig = token.split(".")
+            expect = _b64(hmac.new(self.key, f"{header}.{payload}".encode(), hashlib.sha256).digest())
+            if not hmac.compare_digest(sig, expect):
+                return None
+            claims = json.loads(_unb64(payload))
+            return (
+                claims["kubernetes.io/serviceaccount/namespace"],
+                claims["kubernetes.io/serviceaccount/service-account.name"],
+            )
+        except (ValueError, KeyError):
+            return None

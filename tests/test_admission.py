@@ -1,0 +1,249 @@
+"""Admission chain tests, patterned on the reference's plugin unit tests
+(``plugin/pkg/admission/*/admission_test.go``)."""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.admission import (
+    AdmissionDenied,
+    AdmittedStore,
+    default_chain,
+)
+from kubernetes_tpu.admission import quota as quotalib
+from kubernetes_tpu.api import (
+    Container,
+    LimitRange,
+    LimitRangeItem,
+    Namespace,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PriorityClass,
+    Quantity,
+    ResourceQuota,
+    ResourceRequirements,
+    ServiceAccount,
+)
+from kubernetes_tpu.client.clientset import Clientset
+
+
+def make_cs() -> Clientset:
+    return Clientset(AdmittedStore(default_chain()))
+
+
+def make_pod(name, ns="default", cpu=None, memory=None, **spec_kw):
+    res = ResourceRequirements()
+    if cpu:
+        res.requests["cpu"] = Quantity(cpu)
+    if memory:
+        res.requests["memory"] = Quantity(memory)
+    return Pod(
+        meta=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(name="c", resources=res)], **spec_kw),
+    )
+
+
+# -- NamespaceLifecycle -----------------------------------------------------
+
+
+def test_create_in_missing_namespace_denied():
+    cs = make_cs()
+    with pytest.raises(AdmissionDenied, match="not found"):
+        cs.pods.create(make_pod("p", ns="nope"))
+
+
+def test_create_in_immortal_and_existing_namespace_ok():
+    cs = make_cs()
+    cs.pods.create(make_pod("p"))  # default is immortal
+    cs.namespaces.create(Namespace(meta=ObjectMeta(name="prod")))
+    cs.pods.create(make_pod("p2", ns="prod"))
+
+
+def test_create_in_terminating_namespace_denied():
+    cs = make_cs()
+    ns = Namespace(meta=ObjectMeta(name="dying"))
+    ns.phase = "Terminating"
+    cs.namespaces.create(ns)
+    with pytest.raises(AdmissionDenied, match="terminating"):
+        cs.pods.create(make_pod("p", ns="dying"))
+
+
+def test_immortal_namespace_delete_denied():
+    cs = make_cs()
+    cs.namespaces.create(Namespace(meta=ObjectMeta(name="default")))
+    with pytest.raises(AdmissionDenied, match="immortal"):
+        cs.namespaces.delete("default")
+
+
+# -- LimitRanger ------------------------------------------------------------
+
+
+def test_limitranger_defaults_and_max():
+    cs = make_cs()
+    cs.limitranges.create(LimitRange(
+        meta=ObjectMeta(name="lr", namespace="default"),
+        limits=[LimitRangeItem(
+            type="Container",
+            default_request={"cpu": Quantity("100m")},
+            default={"memory": Quantity("256Mi")},
+            max={"memory": Quantity("1Gi")},
+        )],
+    ))
+    pod = cs.pods.create(make_pod("defaulted"))
+    c = pod.spec.containers[0]
+    assert c.resources.requests["cpu"] == Quantity("100m")
+    assert c.resources.limits["memory"] == Quantity("256Mi")
+    assert c.resources.requests["memory"] == Quantity("256Mi")
+
+    with pytest.raises(AdmissionDenied, match="maximum memory"):
+        cs.pods.create(make_pod("fat", memory="2Gi"))
+
+
+def test_limitranger_min_denied():
+    cs = make_cs()
+    cs.limitranges.create(LimitRange(
+        meta=ObjectMeta(name="lr", namespace="default"),
+        limits=[LimitRangeItem(type="Container", min={"cpu": Quantity("50m")})],
+    ))
+    with pytest.raises(AdmissionDenied, match="minimum cpu"):
+        cs.pods.create(make_pod("tiny", cpu="10m"))
+
+
+# -- ServiceAccount ---------------------------------------------------------
+
+
+def test_serviceaccount_defaulted_and_missing_denied():
+    cs = make_cs()
+    pod = cs.pods.create(make_pod("p"))
+    assert pod.spec.service_account_name == "default"
+    with pytest.raises(AdmissionDenied, match="service account"):
+        cs.pods.create(make_pod("p2", service_account_name="builder"))
+    cs.serviceaccounts.create(ServiceAccount(meta=ObjectMeta(name="builder", namespace="default")))
+    cs.pods.create(make_pod("p3", service_account_name="builder"))
+
+
+# -- DefaultTolerationSeconds ----------------------------------------------
+
+
+def test_default_tolerations_added():
+    cs = make_cs()
+    pod = cs.pods.create(make_pod("p"))
+    keys = {t.key: t.toleration_seconds for t in pod.spec.tolerations}
+    assert keys.get("node.alpha.kubernetes.io/notReady") == 300
+    assert keys.get("node.alpha.kubernetes.io/unreachable") == 300
+
+
+# -- Priority ---------------------------------------------------------------
+
+
+def test_priority_class_resolution():
+    cs = make_cs()
+    cs.priorityclasses.create(PriorityClass(meta=ObjectMeta(name="high"), value=1000))
+    pod = cs.pods.create(make_pod("p", priority_class_name="high"))
+    assert pod.spec.priority == 1000
+    with pytest.raises(AdmissionDenied, match="PriorityClass"):
+        cs.pods.create(make_pod("p2", priority_class_name="missing"))
+
+
+def test_priority_global_default():
+    cs = make_cs()
+    cs.priorityclasses.create(
+        PriorityClass(meta=ObjectMeta(name="standard"), value=7, global_default=True))
+    pod = cs.pods.create(make_pod("p"))
+    assert pod.spec.priority == 7
+    assert pod.spec.priority_class_name == "standard"
+
+
+# -- anti-affinity topology guard ------------------------------------------
+
+
+def test_hard_antiaffinity_topology_denied():
+    from kubernetes_tpu.api import Affinity, PodAffinityTerm
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    cs = make_cs()
+    bad = make_pod("p")
+    bad.spec.affinity = Affinity(
+        pod_anti_affinity_required=[PodAffinityTerm(
+            selector=LabelSelector(match_labels={"app": "x"}),
+            topology_key="failure-domain.beta.kubernetes.io/zone",
+        )],
+    )
+    with pytest.raises(AdmissionDenied, match="topologyKey"):
+        cs.pods.create(bad)
+
+
+# -- ResourceQuota ----------------------------------------------------------
+
+
+def test_quota_enforced_and_released():
+    cs = make_cs()
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="q", namespace="default"),
+        hard={"pods": Quantity("2"), "requests.cpu": Quantity("1")},
+    ))
+    cs.pods.create(make_pod("a", cpu="600m"))
+    with pytest.raises(AdmissionDenied, match="exceeded quota"):
+        cs.pods.create(make_pod("b", cpu="600m"))  # cpu over
+    cs.pods.create(make_pod("c", cpu="200m"))
+    with pytest.raises(AdmissionDenied, match="exceeded quota"):
+        cs.pods.create(make_pod("d"))  # pod count over
+    used = cs.resourcequotas.get("q").used
+    assert used["pods"] == Quantity(2)
+    cs.pods.delete("a")
+    used = cs.resourcequotas.get("q").used
+    assert used["pods"] == Quantity(1)
+    cs.pods.create(make_pod("e", cpu="100m"))  # fits again
+
+
+def test_quota_concurrent_creates_never_over_admit():
+    cs = make_cs()
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="q", namespace="default"),
+        hard={"pods": Quantity("5")},
+    ))
+    admitted, denied = [], []
+
+    def worker(i):
+        try:
+            cs.pods.create(make_pod(f"p{i}"))
+            admitted.append(i)
+        except AdmissionDenied:
+            denied.append(i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(admitted) == 5
+    assert len(denied) == 7
+    assert cs.resourcequotas.get("q").used["pods"] == Quantity(5)
+
+
+def test_quota_scopes():
+    cs = make_cs()
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="be", namespace="default"),
+        hard={"pods": Quantity("1")},
+        scopes=["BestEffort"],
+    ))
+    cs.pods.create(make_pod("rich", cpu="100m"))  # NotBestEffort: untracked
+    cs.pods.create(make_pod("poor1"))
+    with pytest.raises(AdmissionDenied):
+        cs.pods.create(make_pod("poor2"))
+
+
+# -- evaluator unit behavior -------------------------------------------------
+
+
+def test_usage_for_terminal_pod_is_free():
+    pod = make_pod("done").to_dict()
+    pod["status"]["phase"] = "Succeeded"
+    assert quotalib.usage_for("Pod", pod) == {}
+
+
+def test_counted_kinds():
+    svc = {"kind": "Service", "metadata": {"name": "s"}}
+    assert quotalib.usage_for("Service", svc) == {"services": Quantity(1)}

@@ -1,0 +1,261 @@
+"""AuthN/AuthZ/audit tests, patterned on the reference's
+``plugin/pkg/auth/authorizer/rbac/rbac_test.go`` and
+``apiserver/pkg/authentication`` unit tests."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    ClusterRole,
+    ClusterRoleBinding,
+    ObjectMeta,
+    PolicyRule,
+    Role,
+    RoleBinding,
+    Subject,
+)
+from kubernetes_tpu.auth import (
+    ALLOW,
+    ANONYMOUS,
+    DENY,
+    NO_OPINION,
+    ABACAuthorizer,
+    Auditor,
+    AuditPolicy,
+    AuditPolicyRule,
+    AuthzAttributes,
+    BootstrapPolicyAuthorizer,
+    NodeAuthorizer,
+    RBACAuthorizer,
+    RequestHeaderAuthenticator,
+    ServiceAccountTokenAuthenticator,
+    ServiceAccountTokenMinter,
+    TokenFileAuthenticator,
+    UnionAuthenticator,
+    UnionAuthorizer,
+    UserInfo,
+)
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.store.store import Store
+
+
+# -- authenticators ---------------------------------------------------------
+
+
+def test_token_file_authenticator():
+    a = TokenFileAuthenticator({"s3cret": UserInfo(name="alice", groups=["dev"])})
+    assert a.authenticate({"Authorization": "Bearer s3cret"}).name == "alice"
+    assert a.authenticate({"Authorization": "Bearer wrong"}) is None
+    assert a.authenticate({}) is None
+
+
+def test_service_account_tokens():
+    minter = ServiceAccountTokenMinter(b"key-1")
+    tok = minter.mint("prod", "builder")
+    a = ServiceAccountTokenAuthenticator(minter)
+    user = a.authenticate({"Authorization": f"Bearer {tok}"})
+    assert user.name == "system:serviceaccount:prod:builder"
+    assert "system:serviceaccounts:prod" in user.groups
+    # token signed with another key is rejected
+    other = ServiceAccountTokenMinter(b"key-2").mint("prod", "builder")
+    assert a.authenticate({"Authorization": f"Bearer {other}"}) is None
+    # tampered payload is rejected
+    h, p, s = tok.split(".")
+    assert a.authenticate({"Authorization": f"Bearer {h}.{p}x.{s}"}) is None
+
+
+def test_union_authenticator_and_anonymous():
+    tokens = TokenFileAuthenticator({"t": "bob"})
+    u = UnionAuthenticator(tokens, RequestHeaderAuthenticator())
+    assert u.authenticate({"Authorization": "Bearer t"}).name == "bob"
+    assert u.authenticate({"X-Remote-User": "carol", "X-Remote-Group": "ops,dev"}).groups == ["ops", "dev"]
+    assert u.authenticate({}) is ANONYMOUS
+    strict = UnionAuthenticator(tokens, allow_anonymous=False)
+    assert strict.authenticate({}) is None
+
+
+# -- RBAC -------------------------------------------------------------------
+
+
+@pytest.fixture
+def rbac_cs():
+    cs = Clientset(Store())
+    cs.clusterroles.create(ClusterRole(
+        meta=ObjectMeta(name="pod-reader"),
+        rules=[PolicyRule(verbs=["get", "list", "watch"], resources=["pods"])],
+    ))
+    cs.clusterrolebindings.create(ClusterRoleBinding(
+        meta=ObjectMeta(name="devs-read-pods"),
+        subjects=[Subject(kind="Group", name="dev")],
+        role_name="pod-reader",
+    ))
+    cs.roles.create(Role(
+        meta=ObjectMeta(name="deployer", namespace="prod"),
+        rules=[PolicyRule(verbs=["*"], resources=["deployments", "replicasets"])],
+    ))
+    cs.rolebindings.create(RoleBinding(
+        meta=ObjectMeta(name="alice-deploys", namespace="prod"),
+        subjects=[Subject(kind="User", name="alice")],
+        role_kind="Role",
+        role_name="deployer",
+    ))
+    return cs
+
+
+def test_rbac_cluster_and_namespaced(rbac_cs):
+    authz = RBACAuthorizer(rbac_cs.store)
+    dev = UserInfo(name="bob", groups=["dev"])
+    assert authz.authorize(AuthzAttributes(dev, "get", "pods", "anyns"))[0] == ALLOW
+    assert authz.authorize(AuthzAttributes(dev, "delete", "pods", "anyns"))[0] == NO_OPINION
+    alice = UserInfo(name="alice")
+    assert authz.authorize(AuthzAttributes(alice, "update", "deployments", "prod"))[0] == ALLOW
+    assert authz.authorize(AuthzAttributes(alice, "update", "deployments", "dev"))[0] == NO_OPINION
+    assert authz.authorize(AuthzAttributes(alice, "update", "pods", "prod"))[0] == NO_OPINION
+
+
+def test_rbac_serviceaccount_subject(rbac_cs):
+    rbac_cs.rolebindings.create(RoleBinding(
+        meta=ObjectMeta(name="sa-deploys", namespace="prod"),
+        subjects=[Subject(kind="ServiceAccount", name="ci", namespace="prod")],
+        role_kind="Role",
+        role_name="deployer",
+    ))
+    authz = RBACAuthorizer(rbac_cs.store)
+    sa = UserInfo(name="system:serviceaccount:prod:ci", groups=["system:serviceaccounts"])
+    assert authz.authorize(AuthzAttributes(sa, "create", "replicasets", "prod"))[0] == ALLOW
+
+
+# -- Node authorizer --------------------------------------------------------
+
+
+def test_node_authorizer_scopes_to_own_node():
+    cs = Clientset(Store())
+    cs.store.create("Pod", {"kind": "Pod", "metadata": {"name": "p1", "namespace": "default"},
+                            "spec": {"nodeName": "node-1"}})
+    authz = NodeAuthorizer(cs.store)
+    n1 = UserInfo(name="system:node:node-1", groups=["system:nodes"])
+    assert authz.authorize(AuthzAttributes(n1, "get", "nodes", "", "node-1"))[0] == ALLOW
+    assert authz.authorize(AuthzAttributes(n1, "get", "nodes", "", "node-2"))[0] == DENY
+    assert authz.authorize(AuthzAttributes(n1, "update", "pods", "default", "p1"))[0] == ALLOW
+    n2 = UserInfo(name="system:node:node-2", groups=["system:nodes"])
+    assert authz.authorize(AuthzAttributes(n2, "update", "pods", "default", "p1"))[0] == DENY
+    alice = UserInfo(name="alice")
+    assert authz.authorize(AuthzAttributes(alice, "get", "pods", "default", "p1"))[0] == NO_OPINION
+
+
+# -- ABAC / union / bootstrap ----------------------------------------------
+
+
+def test_abac_and_union():
+    abac = ABACAuthorizer([
+        {"user": "viewer", "resource": "*", "readonly": True},
+        {"group": "admins", "resource": "*", "verb": "*"},
+    ])
+    viewer = UserInfo(name="viewer")
+    assert abac.authorize(AuthzAttributes(viewer, "list", "pods", ""))[0] == ALLOW
+    assert abac.authorize(AuthzAttributes(viewer, "delete", "pods", ""))[0] == NO_OPINION
+    union = UnionAuthorizer(BootstrapPolicyAuthorizer(), abac)
+    root = UserInfo(name="root", groups=["system:masters"])
+    assert union.authorize(AuthzAttributes(root, "delete", "nodes", ""))[0] == ALLOW
+    nobody = UserInfo(name="nobody")
+    assert union.authorize(AuthzAttributes(nobody, "get", "pods", ""))[0] == DENY
+
+
+# -- audit ------------------------------------------------------------------
+
+
+def test_audit_policy_levels(tmp_path):
+    auditor = Auditor(policy=AuditPolicy(rules=[
+        AuditPolicyRule(level="None", resources=["events"]),
+        AuditPolicyRule(level="Request", verbs=["create"]),
+    ]))
+    auditor.record("ResponseComplete", "alice", "create", "pods", "default", "p",
+                   code=201, request_object={"kind": "Pod"})
+    auditor.record("ResponseComplete", "alice", "get", "events", "default", "e")
+    auditor.record("ResponseComplete", "alice", "get", "pods", "default", "p", code=200)
+    events = auditor.memory.events
+    assert len(events) == 2  # events resource suppressed
+    assert events[0].request_object == {"kind": "Pod"}  # Request level keeps body
+    assert events[1].request_object is None  # Metadata level strips body
+
+
+# -- wire-level integration -------------------------------------------------
+
+
+def test_apiserver_full_auth_stack():
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.remote import RemoteError, RemoteStore
+
+    cs = Clientset(Store())
+    cs.clusterroles.create(ClusterRole(
+        meta=ObjectMeta(name="reader"),
+        rules=[PolicyRule(verbs=["get", "list", "watch"], resources=["*"])],
+    ))
+    cs.clusterrolebindings.create(ClusterRoleBinding(
+        meta=ObjectMeta(name="alice-reads"),
+        subjects=[Subject(kind="User", name="alice")],
+        role_name="reader",
+    ))
+    auditor = Auditor()
+    server = APIServer(
+        cs.store,
+        authenticator=UnionAuthenticator(
+            TokenFileAuthenticator({"alice-token": "alice", "root-token": UserInfo(
+                name="root", groups=["system:masters"])}),
+            allow_anonymous=False,
+        ),
+        authorizer=UnionAuthorizer(BootstrapPolicyAuthorizer(), RBACAuthorizer(cs.store)),
+        auditor=auditor,
+    )
+    server.start()
+    try:
+        # no credentials -> 401
+        anon = RemoteStore(server.url)
+        with pytest.raises(RemoteError):
+            anon.list("Pod")
+        # alice can read but not write
+        alice = RemoteStore(server.url, token="alice-token")
+        alice.list("Pod")
+        with pytest.raises(RemoteError):
+            alice.create("Pod", {"kind": "Pod", "metadata": {"name": "p"}})
+        # root can write
+        root = RemoteStore(server.url, token="root-token")
+        root.create("Pod", {"kind": "Pod", "metadata": {"name": "p"}})
+        # audit saw the denied create with a 403
+        codes = [(e.verb, e.code) for e in auditor.memory.events
+                 if e.stage == "ResponseComplete" and e.user == "alice"]
+        assert ("create", 403) in codes
+    finally:
+        server.stop()
+
+
+def test_apiserver_namespaced_rolebinding_authorizes_create():
+    """Creates land on the collection route (namespace in the body); the
+    request-info filter must still extract it or RoleBindings never match."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.remote import RemoteError, RemoteStore
+
+    cs = Clientset(Store())
+    cs.roles.create(Role(
+        meta=ObjectMeta(name="writer", namespace="prod"),
+        rules=[PolicyRule(verbs=["create"], resources=["pods"])],
+    ))
+    cs.rolebindings.create(RoleBinding(
+        meta=ObjectMeta(name="bob-writes", namespace="prod"),
+        subjects=[Subject(kind="User", name="bob")],
+        role_kind="Role",
+        role_name="writer",
+    ))
+    server = APIServer(
+        cs.store,
+        authenticator=UnionAuthenticator(
+            TokenFileAuthenticator({"bob-token": "bob"}), allow_anonymous=False),
+        authorizer=RBACAuthorizer(cs.store),
+    )
+    server.start()
+    try:
+        bob = RemoteStore(server.url, token="bob-token")
+        bob.create("Pod", {"kind": "Pod", "metadata": {"name": "p", "namespace": "prod"}})
+        with pytest.raises(RemoteError):  # other namespace: no grant
+            bob.create("Pod", {"kind": "Pod", "metadata": {"name": "p2", "namespace": "dev"}})
+    finally:
+        server.stop()
