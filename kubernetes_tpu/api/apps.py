@@ -35,6 +35,7 @@ class Job:
     status_succeeded: int = 0
     status_failed: int = 0
     status_conditions: list[dict] = field(default_factory=list)  # Complete | Failed
+    status_start_time: float = 0.0  # controller clock at first sync
 
     KIND = "Job"
 
@@ -65,6 +66,7 @@ class Job:
                 "succeeded": self.status_succeeded,
                 "failed": self.status_failed,
                 "conditions": list(self.status_conditions),
+                "startTime": self.status_start_time,
             },
         }
 
@@ -86,6 +88,7 @@ class Job:
             status_succeeded=int(status.get("succeeded", 0)),
             status_failed=int(status.get("failed", 0)),
             status_conditions=list(status.get("conditions") or []),
+            status_start_time=float(status.get("startTime", 0.0)),
         )
 
 

@@ -324,6 +324,14 @@ def _make_handler(server: APIServer):
                         if errors[0] is not None:
                             return self._error(409, "Conflict", errors[0])
                         return self._send(201, {"status": "bound"})
+                    if parts[4] == "eviction" and kind == "Pod" and method == "POST":
+                        from ..client.clientset import Clientset, EvictionDisallowed
+
+                        try:
+                            Clientset(server.store).pods.evict(name, ns)
+                        except EvictionDisallowed as e:
+                            return self._error(429, "TooManyRequests", str(e))
+                        return self._send(201, {"status": "evicted"})
                     return self._error(404, "NotFound", f"unknown subresource {parts[4]}")
                 if method == "GET":
                     return self._send(200, server.store.get(kind, ns, name))

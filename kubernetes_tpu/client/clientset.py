@@ -118,9 +118,67 @@ class PodClient(TypedClient):
             [(b.pod_namespace, b.pod_name, b.node_name) for b in bindings]
         )
 
+    def evict(self, name: str, namespace: Optional[str] = None) -> None:
+        """PDB-aware voluntary eviction — the ``pods/eviction`` subresource
+        (reference ``pkg/registry/core/pod/rest/eviction.go``): every PDB
+        selecting the pod must have ``disruptionsAllowed > 0``; the budget
+        is CAS-decremented before the delete so racing evictions cannot
+        overdraw it (the disruption controller replenishes)."""
+        from ..api.selectors import LabelSelector
+        from ..store.store import ConflictError
+
+        if namespace is None:
+            namespace = self.default_namespace
+        pod = self.get(name, namespace)
+        pdbs, _ = self._store.list("PodDisruptionBudget", namespace)
+        charged: list[str] = []
+        try:
+            for pdb in pdbs:
+                sel = LabelSelector.from_dict((pdb.get("spec") or {}).get("selector"))
+                if not sel.matches(pod.meta.labels):
+                    continue
+                pdb_name = pdb["metadata"]["name"]
+
+                def _decrement(cur: dict) -> dict:
+                    status = cur.setdefault("status", {})
+                    allowed = int(status.get("disruptionsAllowed", 0))
+                    if allowed <= 0:
+                        raise EvictionDisallowed(
+                            f"cannot evict {namespace}/{name}: PDB {pdb_name} "
+                            "allows no disruptions"
+                        )
+                    status["disruptionsAllowed"] = allowed - 1
+                    return cur
+
+                self._store.guaranteed_update(
+                    "PodDisruptionBudget", namespace, pdb_name, _decrement
+                )
+                charged.append(pdb_name)
+            self.delete(name, namespace)
+        except Exception:
+            # roll the budget back for any PDB already charged
+            for pdb_name in charged:
+                def _refund(cur: dict) -> dict:
+                    status = cur.setdefault("status", {})
+                    status["disruptionsAllowed"] = int(status.get("disruptionsAllowed", 0)) + 1
+                    return cur
+
+                try:
+                    self._store.guaranteed_update(
+                        "PodDisruptionBudget", namespace, pdb_name, _refund
+                    )
+                except KeyError:
+                    pass
+            raise
+
 
 class BindConflictError(Exception):
     pass
+
+
+class EvictionDisallowed(Exception):
+    """Eviction refused by a PodDisruptionBudget (HTTP 429 in the
+    reference's eviction subresource)."""
 
 
 class Clientset:

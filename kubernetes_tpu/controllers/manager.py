@@ -14,16 +14,43 @@ from typing import Callable, Optional
 from ..client.clientset import Clientset
 from ..client.informer import InformerFactory
 from .base import Controller
+from .certificates import CertificateController
+from .cronjob import CronJobController
+from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
+from .endpoint import EndpointController
 from .garbagecollector import GarbageCollector
+from .horizontal import HorizontalPodAutoscalerController
+from .job import JobController
+from .namespace import NamespaceController
 from .node_lifecycle import NodeLifecycleController
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
+from .serviceaccounts import ServiceAccountController
+from .statefulset import StatefulSetController
+from .ttl import TTLController
 
+# registry of startable loops (reference controllermanager.go:315-339)
 DEFAULT_CONTROLLERS: dict[str, Callable] = {
     "deployment": DeploymentController,
     "replicaset": ReplicaSetController,
     "garbagecollector": GarbageCollector,
     "node-lifecycle": NodeLifecycleController,
+    "job": JobController,
+    "cronjob": CronJobController,
+    "daemonset": DaemonSetController,
+    "statefulset": StatefulSetController,
+    "endpoint": EndpointController,
+    "namespace": NamespaceController,
+    "resourcequota": ResourceQuotaController,
+    "podgc": PodGCController,
+    "ttl": TTLController,
+    "disruption": DisruptionController,
+    "horizontalpodautoscaler": HorizontalPodAutoscalerController,
+    "serviceaccount": ServiceAccountController,
+    "certificates": CertificateController,
 }
 
 

@@ -1,0 +1,48 @@
+"""TTL controller: anneal node object-cache TTL annotations with cluster
+size (capability of ``pkg/controller/ttl/ttlcontroller.go`` — kubelets
+read ``node.alpha.kubernetes.io/ttl`` to decide how long secrets/
+configmaps may be cached; bigger clusters get longer TTLs to shed
+apiserver load)."""
+
+from __future__ import annotations
+
+from ..api import types as api
+from ..store.store import NotFoundError
+from .base import Controller
+
+TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
+
+# (cluster-size threshold, ttl seconds) — reference ttlcontroller.go
+_BOUNDARIES = [(0, 0), (100, 15), (500, 30), (1000, 60), (2000, 300)]
+
+
+def ttl_for(num_nodes: int) -> int:
+    ttl = 0
+    for threshold, seconds in _BOUNDARIES:
+        if num_nodes >= threshold:
+            ttl = seconds
+    return ttl
+
+
+class TTLController(Controller):
+    name = "ttl"
+
+    def __init__(self, clientset, informers=None, **kw):
+        super().__init__(clientset, informers, **kw)
+        self.watch("Node", key_fn=lambda n: n.meta.name)
+
+    def sync(self, key: str) -> None:
+        nodes, _ = self.clientset.nodes.list()
+        want = str(ttl_for(len(nodes)))
+        try:
+            node = self.clientset.nodes.get(key)
+        except NotFoundError:
+            return
+        if node.meta.annotations.get(TTL_ANNOTATION) == want:
+            return
+
+        def _stamp(cur: api.Node) -> api.Node:
+            cur.meta.annotations[TTL_ANNOTATION] = want
+            return cur
+
+        self.clientset.nodes.guaranteed_update(key, _stamp)

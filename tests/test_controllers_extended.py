@@ -1,0 +1,440 @@
+"""Tests for the extended controller set (job, cronjob, daemonset,
+statefulset, endpoint, namespace, quota, podgc, ttl, disruption, HPA,
+serviceaccount, certificates), patterned on the reference's controller
+unit tests against fake clientsets (SURVEY.md §4.2)."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    CronJob,
+    DaemonSet,
+    HorizontalPodAutoscaler,
+    Job,
+    Namespace,
+    ObjectMeta,
+    PodDisruptionBudget,
+    Quantity,
+    ResourceQuota,
+    Service,
+    ServicePort,
+    StatefulSet,
+    CertificateSigningRequest,
+)
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.api.types import PodTemplateSpec
+from kubernetes_tpu.client.clientset import Clientset, EvictionDisallowed
+from kubernetes_tpu.controllers import (
+    CertificateController,
+    CronJobController,
+    DaemonSetController,
+    DisruptionController,
+    EndpointController,
+    HorizontalPodAutoscalerController,
+    JobController,
+    NamespaceController,
+    PodGCController,
+    ResourceQuotaController,
+    ServiceAccountController,
+    StatefulSetController,
+    TTLController,
+)
+from kubernetes_tpu.store.store import NotFoundError, Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture
+def cs():
+    return Clientset(Store())
+
+
+def run_pods(cs, selector_labels=None, phase=api.RUNNING):
+    """Mark matching pods Running (a stand-in kubelet)."""
+    for p in cs.pods.list(None)[0]:
+        if selector_labels and not all(
+            p.meta.labels.get(k) == v for k, v in selector_labels.items()
+        ):
+            continue
+        if p.status.phase == api.PENDING:
+            p.status.phase = phase
+            cs.pods.update_status(p)
+
+
+class FakeClock:
+    def __init__(self, start=0.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+# -- Job --------------------------------------------------------------------
+
+
+def job_template(labels):
+    return PodTemplateSpec(labels=dict(labels))
+
+
+def test_job_runs_to_completion(cs):
+    ctrl = JobController(cs)
+    cs.jobs.create(Job(
+        meta=ObjectMeta(name="burn", namespace="default"),
+        parallelism=2, completions=3,
+        selector=LabelSelector(match_labels={"job": "burn"}),
+        template=job_template({"job": "burn"}),
+    ))
+    ctrl.reconcile_all()
+    pods = cs.pods.list(None)[0]
+    assert len(pods) == 2  # parallelism cap
+    # two finish
+    for p in pods:
+        p.status.phase = api.SUCCEEDED
+        cs.pods.update_status(p)
+    ctrl.reconcile_all()
+    job = cs.jobs.get("burn")
+    assert job.status_succeeded == 2 and not job.complete
+    active = [p for p in cs.pods.list(None)[0] if p.status.phase == api.PENDING]
+    assert len(active) == 1  # one remaining completion
+    for p in active:
+        p.status.phase = api.SUCCEEDED
+        cs.pods.update_status(p)
+    ctrl.reconcile_all()
+    job = cs.jobs.get("burn")
+    assert job.complete and job.status_succeeded == 3
+
+
+def test_job_backoff_limit_fails_job(cs):
+    ctrl = JobController(cs)
+    cs.jobs.create(Job(
+        meta=ObjectMeta(name="flaky", namespace="default"),
+        parallelism=1, completions=1, backoff_limit=1,
+        template=job_template({"job": "flaky"}),
+    ))
+    for _ in range(3):
+        ctrl.reconcile_all()
+        pending = [p for p in cs.pods.list(None)[0]
+                   if p.status.phase == api.PENDING]
+        if not pending:
+            break
+        for p in pending:
+            p.status.phase = api.FAILED
+            cs.pods.update_status(p)
+    ctrl.reconcile_all()
+    job = cs.jobs.get("flaky")
+    assert job.failed
+    assert job.status_failed > job.backoff_limit
+
+
+# -- CronJob ----------------------------------------------------------------
+
+
+def test_cronjob_spawns_and_forbids(cs):
+    clock = FakeClock(start=3600.0)  # top of an hour, epoch-ish
+    ctrl = CronJobController(cs, clock=clock)
+    cs.cronjobs.create(CronJob(
+        meta=ObjectMeta(name="tick", namespace="default"),
+        schedule="* * * * *",
+        concurrency_policy="Forbid",
+        job_template={"parallelism": 1, "completions": 1,
+                      "template": {"metadata": {"labels": {"cron": "tick"}}}},
+    ))
+    ctrl.tick()
+    ctrl.reconcile_all()
+    jobs = cs.jobs.list(None)[0]
+    assert len(jobs) == 1
+    # next minute: previous job still running -> Forbid skips
+    clock.now += 60
+    ctrl.tick()
+    ctrl.reconcile_all()
+    assert len(cs.jobs.list(None)[0]) == 1
+    # finish it; next minute schedules again
+    j = cs.jobs.list(None)[0][0]
+    j.status_conditions = [{"type": "Complete", "status": "True"}]
+    cs.jobs.update_status(j)
+    clock.now += 60
+    ctrl.tick()
+    ctrl.reconcile_all()
+    assert len(cs.jobs.list(None)[0]) == 2
+
+
+# -- DaemonSet --------------------------------------------------------------
+
+
+def test_daemonset_one_pod_per_matching_node(cs):
+    for i in range(3):
+        cs.nodes.create(make_node(f"n{i}", labels={"kubernetes.io/hostname": f"n{i}",
+                                                   "disk": "ssd" if i < 2 else "hdd"}))
+    ctrl = DaemonSetController(cs)
+    ds = DaemonSet(
+        meta=ObjectMeta(name="agent", namespace="default"),
+        selector=LabelSelector(match_labels={"ds": "agent"}),
+        template=PodTemplateSpec(labels={"ds": "agent"}),
+    )
+    ds.template.spec.node_selector = {"disk": "ssd"}
+    cs.daemonsets.create(ds)
+    ctrl.reconcile_all()
+    pods = cs.pods.list(None)[0]
+    assert sorted(p.spec.node_name for p in pods) == ["n0", "n1"]  # own scheduling
+    got = cs.daemonsets.get("agent")
+    assert got.status_desired == 2 and got.status_current == 2
+    # node relabeled away -> pod removed
+    def _relabel(n):
+        n.meta.labels["disk"] = "hdd"
+        return n
+    cs.nodes.guaranteed_update("n1", _relabel)
+    ctrl.reconcile_all()
+    assert sorted(p.spec.node_name for p in cs.pods.list(None)[0]) == ["n0"]
+
+
+# -- StatefulSet ------------------------------------------------------------
+
+
+def test_statefulset_ordered_scale_up_and_down(cs):
+    ctrl = StatefulSetController(cs)
+    cs.statefulsets.create(StatefulSet(
+        meta=ObjectMeta(name="db", namespace="default"),
+        replicas=3,
+        selector=LabelSelector(match_labels={"app": "db"}),
+        template=PodTemplateSpec(labels={"app": "db"}),
+    ))
+    ctrl.reconcile_all()
+    assert [p.meta.name for p in cs.pods.list(None)[0]] == ["db-0"]  # one at a time
+    run_pods(cs)
+    ctrl.reconcile_all()
+    names = sorted(p.meta.name for p in cs.pods.list(None)[0])
+    assert names == ["db-0", "db-1"]
+    run_pods(cs)
+    ctrl.reconcile_all()
+    run_pods(cs)
+    ctrl.reconcile_all()
+    assert sorted(p.meta.name for p in cs.pods.list(None)[0]) == ["db-0", "db-1", "db-2"]
+    # scale down deletes the highest ordinal first
+    def _scale(ss):
+        ss.replicas = 1
+        return ss
+    cs.statefulsets.guaranteed_update("db", _scale)
+    # each sync removes exactly one (the highest ordinal); pod-delete events
+    # requeue until quiescent
+    ctrl.informers.pump_all()
+    ctrl.sync_once()
+    assert sorted(p.meta.name for p in cs.pods.list(None)[0]) == ["db-0", "db-1"]
+    ctrl.reconcile_all()
+    assert sorted(p.meta.name for p in cs.pods.list(None)[0]) == ["db-0"]
+
+
+# -- Endpoints --------------------------------------------------------------
+
+
+def test_endpoints_track_ready_pods(cs):
+    ctrl = EndpointController(cs)
+    cs.services.create(Service(
+        meta=ObjectMeta(name="web", namespace="default"),
+        selector={"app": "web"},
+        ports=[ServicePort(name="http", port=80, target_port=8080)],
+    ))
+    p1 = make_pod("w1", labels={"app": "web"}, node_name="n1")
+    p1.status.phase = api.RUNNING
+    p1.status.pod_ip = "10.0.0.1"
+    p1.status.conditions = [{"type": "Ready", "status": "True"}]
+    cs.pods.create(p1)
+    p2 = make_pod("w2", labels={"app": "web"}, node_name="n2")
+    p2.status.phase = api.RUNNING
+    p2.status.pod_ip = "10.0.0.2"
+    p2.status.conditions = [{"type": "Ready", "status": "False"}]
+    cs.pods.create(p2)
+    ctrl.reconcile_all()
+    ep = cs.endpoints.get("web")
+    assert [a.ip for a in ep.subsets[0].addresses] == ["10.0.0.1"]
+    assert [a.ip for a in ep.subsets[0].not_ready_addresses] == ["10.0.0.2"]
+    assert ep.subsets[0].ports[0].port == 8080
+    # service deleted -> endpoints deleted
+    cs.services.delete("web")
+    ctrl.reconcile_all()
+    with pytest.raises(NotFoundError):
+        cs.endpoints.get("web")
+
+
+# -- Namespace --------------------------------------------------------------
+
+
+def test_namespace_cascading_teardown(cs):
+    ctrl = NamespaceController(cs)
+    cs.namespaces.create(Namespace(meta=ObjectMeta(name="doomed")))
+    ctrl.reconcile_all()  # arms the finalizer
+    cs.pods.create(make_pod("p1", namespace="doomed"))
+    cs.services.create(Service(meta=ObjectMeta(name="s1", namespace="doomed")))
+    cs.namespaces.delete("doomed")  # only marks: finalizer armed
+    got = cs.namespaces.get("doomed")
+    assert got.meta.deletion_revision is not None
+    ctrl.reconcile_all()
+    with pytest.raises(NotFoundError):
+        cs.pods.get("p1", namespace="doomed")
+    with pytest.raises(NotFoundError):
+        cs.services.get("s1", namespace="doomed")
+    with pytest.raises(NotFoundError):
+        cs.namespaces.get("doomed")  # finalizer cleared -> gone
+
+
+# -- ResourceQuota controller ------------------------------------------------
+
+
+def test_quota_controller_recomputes_usage(cs):
+    ctrl = ResourceQuotaController(cs)
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="q", namespace="default"),
+        hard={"pods": Quantity("10"), "requests.cpu": Quantity("4")},
+        used={"pods": Quantity("99")},  # drifted ledger
+    ))
+    cs.pods.create(make_pod("a", cpu="500m"))
+    cs.pods.create(make_pod("b", cpu="250m"))
+    ctrl.reconcile_all()
+    rq = cs.resourcequotas.get("q")
+    assert rq.used["pods"] == Quantity(2)
+    assert rq.used["requests.cpu"] == Quantity("750m")
+
+
+# -- PodGC ------------------------------------------------------------------
+
+
+def test_podgc_deletes_orphans_and_excess_terminated(cs):
+    cs.nodes.create(make_node("alive"))
+    ctrl = PodGCController(cs, terminated_pod_threshold=1)
+    cs.pods.create(make_pod("on-dead-node", node_name="ghost"))
+    t1 = make_pod("done-1")
+    t1.status.phase = api.SUCCEEDED
+    cs.pods.create(t1)
+    t2 = make_pod("done-2")
+    t2.status.phase = api.SUCCEEDED
+    cs.pods.create(t2)
+    deleted = ctrl.tick()
+    assert deleted == 2  # orphan + oldest terminated beyond threshold
+    names = {p.meta.name for p in cs.pods.list(None)[0]}
+    assert "on-dead-node" not in names
+    assert names == {"done-2"}
+
+
+# -- TTL --------------------------------------------------------------------
+
+
+def test_ttl_annotation_scales_with_cluster_size(cs):
+    ctrl = TTLController(cs)
+    for i in range(150):
+        cs.nodes.create(make_node(f"n{i}"))
+    ctrl.reconcile_all()
+    node = cs.nodes.get("n0")
+    assert node.meta.annotations["node.alpha.kubernetes.io/ttl"] == "15"
+
+
+# -- Disruption + eviction ---------------------------------------------------
+
+
+def test_pdb_gates_eviction(cs):
+    ctrl = DisruptionController(cs)
+    cs.poddisruptionbudgets.create(PodDisruptionBudget(
+        meta=ObjectMeta(name="web-pdb", namespace="default"),
+        min_available=2,
+        selector=LabelSelector(match_labels={"app": "web"}),
+    ))
+    for i in range(3):
+        p = make_pod(f"w{i}", labels={"app": "web"})
+        p.status.phase = api.RUNNING
+        cs.pods.create(p)
+    ctrl.reconcile_all()
+    pdb = cs.poddisruptionbudgets.get("web-pdb")
+    assert pdb.status_disruptions_allowed == 1
+    cs.pods.evict("w0")  # first eviction allowed
+    with pytest.raises(NotFoundError):
+        cs.pods.get("w0")
+    with pytest.raises(EvictionDisallowed):
+        cs.pods.evict("w1")  # budget exhausted until controller resyncs
+    ctrl.reconcile_all()
+    pdb = cs.poddisruptionbudgets.get("web-pdb")
+    assert pdb.status_disruptions_allowed == 0  # 2 healthy, need 2
+
+
+# -- HPA --------------------------------------------------------------------
+
+
+def test_hpa_scales_target_on_utilization(cs):
+    from kubernetes_tpu.api import Deployment
+
+    cs.deployments.create(Deployment(
+        meta=ObjectMeta(name="web", namespace="default"),
+        replicas=2,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=PodTemplateSpec(labels={"app": "web"}),
+    ))
+    for i in range(2):
+        p = make_pod(f"w{i}", labels={"app": "web"}, cpu="100m")
+        p.status.phase = api.RUNNING
+        cs.pods.create(p)
+    hot = {"w0": 200.0, "w1": 160.0}
+    ctrl = HorizontalPodAutoscalerController(
+        cs, metrics=lambda pod: hot.get(pod.meta.name, 0.0))
+    cs.horizontalpodautoscalers.create(HorizontalPodAutoscaler(
+        meta=ObjectMeta(name="web-hpa", namespace="default"),
+        target_kind="Deployment", target_name="web",
+        min_replicas=1, max_replicas=10, target_cpu_utilization=90,
+    ))
+    ctrl.tick()
+    ctrl.reconcile_all()
+    dep = cs.deployments.get("web")
+    assert dep.replicas == 4  # ceil(2 * 180/90)
+    hpa = cs.horizontalpodautoscalers.get("web-hpa")
+    assert hpa.status_desired_replicas == 4
+    # fully idle -> clamp down to minReplicas
+    hot.update({"w0": 0.0, "w1": 0.0})
+    ctrl.tick()
+    ctrl.reconcile_all()
+    assert cs.deployments.get("web").replicas == 1
+
+
+# -- ServiceAccount + certificates ------------------------------------------
+
+
+def test_serviceaccount_default_and_token(cs):
+    ctrl = ServiceAccountController(cs)
+    cs.namespaces.create(Namespace(meta=ObjectMeta(name="prod")))
+    ctrl.reconcile_all()
+    sa = cs.serviceaccounts.get("default", namespace="prod")
+    assert sa.secrets == ["default-token"]
+    secret = cs.secrets.get("default-token", namespace="prod")
+    assert secret.type == "kubernetes.io/service-account-token"
+    # minted token verifies
+    ns_name = ctrl.minter.verify(secret.data["token"])
+    assert ns_name == ("prod", "default")
+
+
+def test_certificates_auto_approve_and_sign(cs):
+    ctrl = CertificateController(cs, auto_approve_users={"system:bootstrap:abc"})
+    cs.certificatesigningrequests.create(CertificateSigningRequest(
+        meta=ObjectMeta(name="node-1"),
+        request="pem-ish-bytes",
+        username="system:bootstrap:abc",
+    ))
+    ctrl.reconcile_all()
+    csr = cs.certificatesigningrequests.get("node-1")
+    assert csr.approved
+    assert csr.certificate.startswith("signed:system:bootstrap:abc:")
+    # unknown user is not auto-approved
+    cs.certificatesigningrequests.create(CertificateSigningRequest(
+        meta=ObjectMeta(name="stranger"), request="x", username="eve"))
+    ctrl.reconcile_all()
+    assert not cs.certificatesigningrequests.get("stranger").approved
+
+
+def test_controller_manager_runs_extended_set(cs):
+    from kubernetes_tpu.controllers import ControllerManager
+
+    mgr = ControllerManager(cs, enabled=[
+        "replicaset", "deployment", "job", "endpoint", "serviceaccount",
+    ])
+    cs.jobs.create(Job(
+        meta=ObjectMeta(name="j", namespace="default"),
+        parallelism=1, completions=1,
+        template=job_template({"job": "j"}),
+    ))
+    mgr.start(manual=True)
+    mgr.reconcile_all()
+    assert len(cs.pods.list(None)[0]) == 1
+    mgr.stop()

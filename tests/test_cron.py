@@ -1,0 +1,92 @@
+"""Cron schedule semantics (standard cron incl. the DOM/DOW OR rule)."""
+
+import calendar
+import time
+
+import pytest
+
+from kubernetes_tpu.utils.cron import CronSchedule
+
+
+def ts(y, mo, d, h=0, mi=0):
+    return calendar.timegm((y, mo, d, h, mi, 0, 0, 0, 0))
+
+
+def test_every_minute():
+    s = CronSchedule.parse("* * * * *")
+    assert s.matches(ts(2026, 7, 29, 12, 34))
+
+
+def test_steps_and_ranges():
+    s = CronSchedule.parse("*/15 9-17 * * 1-5")
+    assert s.matches(ts(2026, 7, 29, 9, 30))  # Wednesday
+    assert not s.matches(ts(2026, 7, 29, 8, 30))
+    assert not s.matches(ts(2026, 7, 26, 9, 30))  # Sunday
+    assert not s.matches(ts(2026, 7, 29, 9, 20))
+
+
+def test_dom_dow_or_rule():
+    # midnight on the 13th OR on Fridays (both fields restricted -> OR)
+    s = CronSchedule.parse("0 0 13 * 5")
+    assert s.matches(ts(2026, 7, 13))  # Monday the 13th: DOM matches
+    assert s.matches(ts(2026, 7, 17))  # Friday the 17th: DOW matches
+    assert not s.matches(ts(2026, 7, 14))  # Tuesday the 14th: neither
+
+
+def test_dom_only_and_dow_only_still_and():
+    s = CronSchedule.parse("0 0 13 * *")
+    assert s.matches(ts(2026, 7, 13))
+    assert not s.matches(ts(2026, 7, 17))
+    s = CronSchedule.parse("0 0 * * 5")
+    assert s.matches(ts(2026, 7, 17))
+    assert not s.matches(ts(2026, 7, 13))
+
+
+def test_next_after_and_unmet():
+    s = CronSchedule.parse("*/10 * * * *")
+    start = ts(2026, 7, 29, 12, 5)
+    nxt = s.next_after(start)
+    assert time.gmtime(nxt).tm_min == 10
+    unmet = s.unmet_since(ts(2026, 7, 29, 12, 0), ts(2026, 7, 29, 12, 35))
+    assert [time.gmtime(u).tm_min for u in unmet] == [10, 20, 30]
+
+
+def test_invalid_expressions():
+    with pytest.raises(ValueError):
+        CronSchedule.parse("* * * *")
+    with pytest.raises(ValueError):
+        CronSchedule.parse("61 * * * *")
+
+
+def test_job_deadline_survives_controller_restart():
+    """activeDeadlineSeconds is measured from persisted status.startTime."""
+    from kubernetes_tpu.api import Job, ObjectMeta
+    from kubernetes_tpu.api.types import PodTemplateSpec
+    from kubernetes_tpu.client.clientset import Clientset
+    from kubernetes_tpu.controllers import JobController
+    from kubernetes_tpu.store.store import Store
+
+    class Clock:
+        now = 1000.0
+
+        def __call__(self):
+            return self.now
+
+    clock = Clock()
+    cs = Clientset(Store())
+    ctrl = JobController(cs, clock=clock)
+    cs.jobs.create(Job(
+        meta=ObjectMeta(name="slow", namespace="default"),
+        parallelism=1, completions=1, active_deadline_seconds=300,
+        template=PodTemplateSpec(labels={"job": "slow"}),
+    ))
+    ctrl.reconcile_all()
+    assert cs.jobs.get("slow").status_start_time == 1000.0
+    # "restart": a brand-new controller instance, clock past the deadline
+    clock.now = 1400.0
+    ctrl2 = JobController(cs, clock=clock)
+    cs.jobs.update(cs.jobs.get("slow"))  # nudge an event
+    ctrl2.reconcile_all()
+    job = cs.jobs.get("slow")
+    assert job.failed
+    assert any(c.get("reason") == "DeadlineExceeded" for c in job.status_conditions)
