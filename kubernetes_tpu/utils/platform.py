@@ -1,0 +1,51 @@
+"""Virtual-CPU JAX platform provisioning.
+
+The scheduling kernels are tested multi-chip on a virtual N-device CPU
+platform (``--xla_force_host_platform_device_count``), because real
+multi-chip hardware is not available in CI.  The ambient environment may
+point ``JAX_PLATFORMS`` at a live TPU tunnel — and a pre-baked
+``jax_platforms`` config value outranks the env var — so forcing must
+happen before jax initializes AND override the config.  Shared by
+``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Force jax onto a virtual ``n_devices``-device CPU platform.
+
+    Must be called before jax first initializes a backend.  Raises if jax
+    already initialized on a different platform or with too few devices
+    (the env/config knobs are silently inert once a backend exists).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _COUNT_FLAG in flags:
+        # Replace an ambient count (which may be smaller) rather than
+        # trusting it.
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", f"{_COUNT_FLAG}={n_devices}", flags)
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    if devices[0].platform != "cpu":
+        raise RuntimeError(
+            f"jax already initialized on platform {devices[0].platform!r}; "
+            "force_virtual_cpu must run before any jax backend use"
+        )
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"virtual CPU platform has {len(devices)} devices, need "
+            f"{n_devices}: jax initialized before force_virtual_cpu could "
+            f"set {_COUNT_FLAG}"
+        )

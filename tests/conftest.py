@@ -7,15 +7,6 @@ point JAX_PLATFORMS at a live TPU tunnel).  Bench runs (bench.py) use the
 real TPU instead.
 """
 
-import os
+from kubernetes_tpu.utils.platform import force_virtual_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The environment may pre-bake jax_platforms (e.g. "axon,cpu" for a TPU
-# tunnel) at a higher precedence than the env var — force it via config.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)
