@@ -17,12 +17,14 @@ Capability equivalents of the reference's default plugin set for this era
 from __future__ import annotations
 
 from ..api.quantity import Quantity
+from ..store.store import NotFoundError
 from ..api.types import CPU, MEMORY, HOSTNAME_LABEL
 from . import quota as quotalib
 from .framework import (
     CREATE,
     DELETE,
     AdmissionChain,
+    AdmissionDenied,
     AdmissionPlugin,
     Attributes,
 )
@@ -30,6 +32,23 @@ from .framework import (
 # Namespaces that always exist and can never be deleted (reference
 # ``namespace/lifecycle/admission.go`` immortalNamespaces).
 IMMORTAL_NAMESPACES = {"default", "kube-system", "kube-public"}
+
+
+class PodPrepareForCreate(AdmissionPlugin):
+    """Resets client-supplied pod status on create: every pod starts
+    Pending (reference ``pkg/registry/core/pod/strategy.go
+    PrepareForCreate`` wipes Status).  This also makes the ResourceQuota
+    charge/release ledger symmetric — a pod can never enter the cluster
+    already terminal, so everything released at delete was charged at
+    create."""
+
+    name = "PodPrepareForCreate"
+    operations = (CREATE,)
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.kind != "Pod":
+            return
+        attrs.obj["status"] = {"phase": "Pending"}
 
 
 class NamespaceLifecycle(AdmissionPlugin):
@@ -238,16 +257,43 @@ class ResourceQuota(AdmissionPlugin):
     operations = (CREATE, DELETE)
 
     def validate(self, attrs: Attributes) -> None:
+        release = attrs.operation == DELETE
         obj = attrs.obj if attrs.operation == CREATE else attrs.old_obj
+        # Deleting a TERMINAL pod releases nothing here: its usage was
+        # already dropped by the quota controller's churn-driven resync at
+        # the Succeeded/Failed transition, and decrementing again would
+        # deflate status.used below the truth (over-admission).  Releasing
+        # only live usage mirrors the reference, where admission never
+        # lowers used past what replenishment computed; the controller
+        # MUST run alongside this plugin to reclaim terminal-pod usage.
         usage = quotalib.usage_for(attrs.kind, obj)
         if not usage:
             return
         quotas, _ = attrs.store.list("ResourceQuota", attrs.namespace)
+        charged: list[dict] = []
         for rq in quotas:
             scopes = (rq.get("spec") or {}).get("scopes") or []
             if not quotalib.matches_scopes(scopes, attrs.kind, obj):
                 continue
-            self._charge(attrs, rq, usage, release=(attrs.operation == DELETE))
+            try:
+                self._charge(attrs, rq, usage, release=release)
+            except NotFoundError:
+                # quota vanished between list and CAS: it constrains nothing
+                # anymore, skip it
+                continue
+            except Exception:
+                # deny (or any CAS failure) on a later quota: undo charges
+                # already applied to earlier quotas so the failed write
+                # leaves no quota inflated; a failed undo must not mask the
+                # original error — the controller resync heals the leak
+                for prev in charged:
+                    try:
+                        self._charge(attrs, prev, usage, release=True)
+                    except Exception:
+                        pass
+                raise
+            if not release:
+                charged.append(rq)
 
     def _charge(self, attrs: Attributes, rq: dict, usage, release: bool) -> None:
         name = rq["metadata"]["name"]
@@ -279,6 +325,7 @@ def default_chain() -> AdmissionChain:
     """The default plugin order (quota last, like the reference's
     ``plugins.go`` recommended order)."""
     return AdmissionChain([
+        PodPrepareForCreate(),
         NamespaceLifecycle(),
         LimitRanger(),
         ServiceAccount(),

@@ -45,7 +45,9 @@ def usage_for(kind: str, obj: Optional[dict]) -> dict[str, Quantity]:
 
     Pod usage follows the reference's rule (``evaluator/core/pods.go``):
     terminal pods consume nothing; cpu/memory usage = sum of container
-    requests (and limits for the limits.* resources)."""
+    requests (and limits for the limits.* resources).  Terminal-pod usage
+    is reclaimed by the quota CONTROLLER at the phase transition, never by
+    the admission delete path (see ResourceQuota.validate)."""
     if obj is None:
         return {}
     if kind == "Pod":

@@ -985,6 +985,16 @@ def register_kind(cls, cluster_scoped: bool = False, plural: Optional[str] = Non
     return cls
 
 
+def kind_for_plural(plural: str) -> Optional[str]:
+    """Resource segment -> kind, read from the live registry per call so
+    late-registered (CRD-style) kinds resolve immediately.  Snapshots the
+    registry so a concurrent register_kind can't break iteration."""
+    for kind, p in list(KIND_PLURALS.items()):
+        if p == plural:
+            return kind
+    return None
+
+
 def register_cluster_scoped(cls):
     return register_kind(cls, cluster_scoped=True)
 

@@ -50,14 +50,7 @@ logger = logging.getLogger("kubernetes_tpu.apiserver")
 # resource path segment -> kind, derived from the one type registry so
 # every registered kind (incl. late-registered CRDs) is wire-addressable.
 from ..api.types import CLUSTER_SCOPED_KINDS as CLUSTER_SCOPED  # noqa: E402
-from ..api.types import KIND_PLURALS  # noqa: E402
-
-
-def _resources() -> dict[str, str]:
-    return {plural: kind for kind, plural in KIND_PLURALS.items()}
-
-
-RESOURCES = _resources()
+from ..api.types import kind_for_plural as _kind_for  # noqa: E402
 
 
 class APIServer:
@@ -172,6 +165,11 @@ def _make_handler(server: APIServer):
                     name = rest[3]
                     if len(rest) == 5 and rest[4] == "binding":
                         verb = "bind"
+                    elif len(rest) == 5 and rest[4] == "eviction":
+                        # distinct verb so create-pods rights do not imply
+                        # eviction (reference treats pods/eviction as its
+                        # own subresource)
+                        verb = "evict"
             return verb, resource, ns, name
 
         def _auth_filters(self, method: str) -> bool:
@@ -294,7 +292,7 @@ def _make_handler(server: APIServer):
 
             # collection routes: /api/v1/{resource}
             if len(parts) == 1:
-                kind = RESOURCES.get(parts[0])
+                kind = _kind_for(parts[0])
                 if kind is None:
                     return self._error(404, "NotFound", f"unknown resource {parts[0]}")
                 if method == "GET":
@@ -313,7 +311,7 @@ def _make_handler(server: APIServer):
             # object routes: /api/v1/namespaces/{ns}/{resource}/{name}[/binding]
             if parts[0] == "namespaces" and len(parts) in (4, 5):
                 ns = "" if parts[1] == "-" else parts[1]
-                kind = RESOURCES.get(parts[2])
+                kind = _kind_for(parts[2])
                 name = parts[3]
                 if kind is None:
                     return self._error(404, "NotFound", f"unknown resource {parts[2]}")

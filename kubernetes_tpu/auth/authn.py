@@ -109,6 +109,12 @@ class UnionAuthenticator(Authenticator):
             user = a.authenticate(headers)
             if user is not None:
                 return user
+        # A credential that is PRESENT but unrecognized fails with 401; it
+        # must not be downgraded to anonymous (the reference rejects
+        # malformed/unknown bearer tokens rather than treating the request
+        # as unauthenticated).
+        if headers.get("Authorization", ""):
+            return None
         return ANONYMOUS if self.allow_anonymous else None
 
 

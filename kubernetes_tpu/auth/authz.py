@@ -127,10 +127,13 @@ class NodeAuthorizer(Authorizer):
         if not attrs.user.name.startswith(self.NODE_USER_PREFIX):
             return NO_OPINION, "not a node user"
         node_name = attrs.user.name[len(self.NODE_USER_PREFIX):]
+        # Out-of-scope checks return NO_OPINION (not DENY) so a union can
+        # still consult RBAC for explicit grants to node identities — the
+        # reference node authorizer never hard-denies.
         if attrs.resource == "nodes":
             if attrs.name in ("", node_name):
                 return ALLOW, "node accessing own Node object"
-            return DENY, f"node {node_name} may not access node {attrs.name}"
+            return NO_OPINION, f"node {node_name} has no default access to node {attrs.name}"
         if attrs.resource == "pods":
             if attrs.verb in ("list", "watch"):
                 return ALLOW, "node watching pod assignments"
@@ -141,7 +144,7 @@ class NodeAuthorizer(Authorizer):
                     return NO_OPINION, "pod not found"
                 if (pod.get("spec") or {}).get("nodeName") == node_name:
                     return ALLOW, "pod is bound to this node"
-                return DENY, f"pod not bound to node {node_name}"
+                return NO_OPINION, f"pod not bound to node {node_name}"
         if attrs.resource in ("secrets", "configmaps"):
             # graph edge: secret/configmap referenced by a pod on this node
             pods, _ = self.store.list("Pod", attrs.namespace)
@@ -151,7 +154,7 @@ class NodeAuthorizer(Authorizer):
                 for v in (pod.get("spec") or {}).get("volumes") or []:
                     if v.get("secretName") == attrs.name or v.get("configMapName") == attrs.name:
                         return ALLOW, "referenced by pod on this node"
-            return DENY, f"{attrs.resource[:-1]} not referenced by any pod on {node_name}"
+            return NO_OPINION, f"{attrs.resource[:-1]} not referenced by any pod on {node_name}"
         if attrs.resource in ("events",):
             return ALLOW, "nodes may emit events"
         return NO_OPINION, "resource outside node scope"

@@ -27,6 +27,7 @@ from typing import Optional
 import yaml
 
 from ..api import types as api
+from ..api.types import kind_for_plural
 from ..client.clientset import Clientset
 from ..client.remote import RemoteStore
 from ..store.store import AlreadyExistsError, NotFoundError
@@ -71,8 +72,11 @@ def _resource_aliases() -> dict[str, str]:
     return out
 
 
-RESOURCE_ALIASES = _resource_aliases()
-RESOURCE_TO_KIND = {v: k for k, v in KIND_TO_RESOURCE.items()}
+def _resolve(resource: str):
+    # Alias -> (plural, kind), computed per call so kinds registered
+    # after module import (CRD-style) resolve immediately.
+    plural = _resource_aliases().get(resource, resource)
+    return plural, kind_for_plural(plural)
 
 
 class Kubectl:
@@ -89,8 +93,7 @@ class Kubectl:
     # -- get ---------------------------------------------------------------
     def get(self, resource: str, name: Optional[str] = None, namespace: Optional[str] = None,
             output: str = "") -> int:
-        resource = RESOURCE_ALIASES.get(resource, resource)
-        kind = RESOURCE_TO_KIND.get(resource)
+        resource, kind = _resolve(resource)
         if kind is None:
             self.out.write(f"error: unknown resource {resource!r}\n")
             return 1
@@ -164,8 +167,7 @@ class Kubectl:
 
     # -- describe ----------------------------------------------------------
     def describe(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
-        resource = RESOURCE_ALIASES.get(resource, resource)
-        kind = RESOURCE_TO_KIND.get(resource)
+        resource, kind = _resolve(resource)
         try:
             obj = self.cs.client_for(kind).get(name, namespace)
         except (NotFoundError, KeyError):
@@ -239,8 +241,7 @@ class Kubectl:
         return 0
 
     def delete(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
-        resource = RESOURCE_ALIASES.get(resource, resource)
-        kind = RESOURCE_TO_KIND.get(resource)
+        resource, kind = _resolve(resource)
         try:
             self.cs.client_for(kind).delete(name, namespace)
         except (NotFoundError, KeyError):
@@ -251,8 +252,7 @@ class Kubectl:
 
     # -- scale / cordon / drain -------------------------------------------
     def scale(self, resource: str, name: str, replicas: int, namespace: Optional[str] = None) -> int:
-        resource = RESOURCE_ALIASES.get(resource, resource)
-        kind = RESOURCE_TO_KIND.get(resource)
+        resource, kind = _resolve(resource)
         if kind not in ("Deployment", "ReplicaSet"):
             self.out.write(f"error: cannot scale {resource}\n")
             return 1

@@ -142,12 +142,12 @@ class RemoteStore:
 
     @staticmethod
     def _resource(kind: str) -> str:
-        from ..apiserver.server import RESOURCES
+        from ..api.types import KIND_PLURALS
 
-        for res, k in RESOURCES.items():
-            if k == kind:
-                return res
-        raise RemoteError(f"unknown kind {kind}")
+        plural = KIND_PLURALS.get(kind)
+        if plural is None:
+            raise RemoteError(f"unknown kind {kind}")
+        return plural
 
     # -- Store interface ---------------------------------------------------
     def create(self, kind: str, obj: dict) -> dict:

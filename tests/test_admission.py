@@ -247,3 +247,66 @@ def test_usage_for_terminal_pod_is_free():
 def test_counted_kinds():
     svc = {"kind": "Service", "metadata": {"name": "s"}}
     assert quotalib.usage_for("Service", svc) == {"services": Quantity(1)}
+
+
+def test_quota_terminal_pod_reclaimed_by_controller_not_delete():
+    """Terminal-pod usage is reclaimed by the quota controller at the phase
+    transition; the admission delete path must NOT decrement again (that
+    double-release would deflate used and over-admit)."""
+    from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+
+    cs = make_cs()
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="q", namespace="default"),
+        hard={"pods": Quantity("1")},
+    ))
+    cs.pods.create(make_pod("a"))
+    assert cs.resourcequotas.get("q").used["pods"] == Quantity(1)
+    # pod finishes; the controller's churn-driven resync reclaims its usage
+    def finish(cur):
+        cur.setdefault("status", {})["phase"] = "Succeeded"
+        return cur
+    cs.store.guaranteed_update("Pod", "default", "a", finish)
+    ctl = ResourceQuotaController(cs)
+    ctl.sync("default/q")
+    assert cs.resourcequotas.get("q").used["pods"] == Quantity(0)
+    cs.pods.create(make_pod("b"))  # freed slot is reusable while a exists
+    # deleting the terminal pod releases nothing further (no double-release)
+    cs.pods.delete("a")
+    assert cs.resourcequotas.get("q").used["pods"] == Quantity(1)
+
+
+def test_quota_deny_rolls_back_earlier_charges():
+    """With multiple matching quotas, a deny by a later quota must not
+    leave earlier quotas charged."""
+    cs = make_cs()
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="q-loose", namespace="default"),
+        hard={"pods": Quantity("10")},
+    ))
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="q-tight", namespace="default"),
+        hard={"pods": Quantity("0")},
+    ))
+    with pytest.raises(AdmissionDenied):
+        cs.pods.create(make_pod("a"))
+    used = cs.resourcequotas.get("q-loose").used
+    assert used.get("pods", Quantity(0)) == Quantity(0)
+
+
+def test_pod_created_terminal_is_normalized_and_charged():
+    """Client-supplied terminal status is wiped at create (PrepareForCreate)
+    so the quota ledger stays symmetric: no over-admission via
+    create-terminal-then-delete."""
+    cs = make_cs()
+    cs.resourcequotas.create(ResourceQuota(
+        meta=ObjectMeta(name="q", namespace="default"),
+        hard={"pods": Quantity("2")},
+    ))
+    cs.pods.create(make_pod("a"))
+    cs.pods.create(make_pod("b"))
+    sneaky = make_pod("sneaky").to_dict()
+    sneaky["status"] = {"phase": "Succeeded"}
+    with pytest.raises(AdmissionDenied):  # charged like any pod -> over quota
+        cs.store.create("Pod", sneaky)
+    assert cs.resourcequotas.get("q").used["pods"] == Quantity(2)

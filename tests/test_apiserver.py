@@ -132,3 +132,26 @@ def test_unknown_resource_404(server):
         urllib.request.urlopen(req)
     assert ei.value.code == 404
     assert json.loads(ei.value.read())["reason"] == "NotFound"
+
+
+def test_late_registered_kind_is_wire_addressable(server):
+    """Kinds registered after server start (CRD-style) must resolve on the
+    wire immediately — resource lookup is per-request, not an import-time
+    snapshot."""
+    from kubernetes_tpu.api.types import KIND_PLURALS, KINDS
+
+    class Widget:
+        KIND = "Widget"
+
+    from kubernetes_tpu.api.types import register_kind
+
+    register_kind(Widget)
+    try:
+        server.store.create("Widget", {"kind": "Widget",
+                                       "metadata": {"name": "w", "namespace": "default"}})
+        with urllib.request.urlopen(server.url + "/api/v1/widgets") as resp:
+            items = json.loads(resp.read())["items"]
+        assert [i["metadata"]["name"] for i in items] == ["w"]
+    finally:
+        KINDS.pop("Widget", None)
+        KIND_PLURALS.pop("Widget", None)

@@ -8,6 +8,7 @@ from kubernetes_tpu.api import (
     ClusterRole,
     ClusterRoleBinding,
     ObjectMeta,
+    Pod,
     PolicyRule,
     Role,
     RoleBinding,
@@ -134,10 +135,15 @@ def test_node_authorizer_scopes_to_own_node():
     authz = NodeAuthorizer(cs.store)
     n1 = UserInfo(name="system:node:node-1", groups=["system:nodes"])
     assert authz.authorize(AuthzAttributes(n1, "get", "nodes", "", "node-1"))[0] == ALLOW
-    assert authz.authorize(AuthzAttributes(n1, "get", "nodes", "", "node-2"))[0] == DENY
+    # out-of-scope is NO_OPINION (not DENY) so RBAC grants to node users
+    # still work downstream in a union (reference node authorizer shape)
+    assert authz.authorize(AuthzAttributes(n1, "get", "nodes", "", "node-2"))[0] == NO_OPINION
     assert authz.authorize(AuthzAttributes(n1, "update", "pods", "default", "p1"))[0] == ALLOW
     n2 = UserInfo(name="system:node:node-2", groups=["system:nodes"])
-    assert authz.authorize(AuthzAttributes(n2, "update", "pods", "default", "p1"))[0] == DENY
+    assert authz.authorize(AuthzAttributes(n2, "update", "pods", "default", "p1"))[0] == NO_OPINION
+    # a bare union (no RBAC) still ends in deny for out-of-scope access
+    assert UnionAuthorizer(authz).authorize(
+        AuthzAttributes(n2, "update", "pods", "default", "p1"))[0] == DENY
     alice = UserInfo(name="alice")
     assert authz.authorize(AuthzAttributes(alice, "get", "pods", "default", "p1"))[0] == NO_OPINION
 
@@ -259,3 +265,68 @@ def test_apiserver_namespaced_rolebinding_authorizes_create():
             bob.create("Pod", {"kind": "Pod", "metadata": {"name": "p2", "namespace": "dev"}})
     finally:
         server.stop()
+
+
+def test_eviction_requires_evict_verb_not_create():
+    """POST pods/{name}/eviction maps to verb 'evict' — create-pods rights
+    alone must not let a user evict (delete) arbitrary pods."""
+    import json as _json
+    import urllib.request
+
+    from kubernetes_tpu.apiserver.server import APIServer
+
+    cs = Clientset(Store())
+    cs.pods.create(Pod(meta=ObjectMeta(name="victim", namespace="prod")))
+    cs.roles.create(Role(
+        meta=ObjectMeta(name="creator", namespace="prod"),
+        rules=[PolicyRule(verbs=["create"], resources=["pods"])],
+    ))
+    cs.roles.create(Role(
+        meta=ObjectMeta(name="evictor", namespace="prod"),
+        rules=[PolicyRule(verbs=["evict"], resources=["pods"])],
+    ))
+    cs.rolebindings.create(RoleBinding(
+        meta=ObjectMeta(name="carol-creates", namespace="prod"),
+        subjects=[Subject(kind="User", name="carol")],
+        role_kind="Role", role_name="creator",
+    ))
+    cs.rolebindings.create(RoleBinding(
+        meta=ObjectMeta(name="dave-evicts", namespace="prod"),
+        subjects=[Subject(kind="User", name="dave")],
+        role_kind="Role", role_name="evictor",
+    ))
+    server = APIServer(
+        cs.store,
+        authenticator=UnionAuthenticator(
+            TokenFileAuthenticator({"carol-token": "carol", "dave-token": "dave"}),
+            allow_anonymous=False),
+        authorizer=RBACAuthorizer(cs.store),
+    )
+    server.start()
+    try:
+        def post_eviction(token):
+            req = urllib.request.Request(
+                server.url + "/api/v1/namespaces/prod/pods/victim/eviction",
+                data=_json.dumps({}).encode(), method="POST",
+                headers={"Authorization": f"Bearer {token}"})
+            return urllib.request.urlopen(req)
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_eviction("carol-token")
+        assert ei.value.code == 403
+        assert post_eviction("dave-token").status == 201
+        with pytest.raises(KeyError):
+            cs.store.get("Pod", "prod", "victim")
+    finally:
+        server.stop()
+
+
+def test_present_but_invalid_bearer_is_401_even_with_anonymous():
+    """A malformed/unknown Bearer token must fail authentication, not be
+    downgraded to system:anonymous (reference behavior)."""
+    tokens = TokenFileAuthenticator({"good": "alice"})
+    lax = UnionAuthenticator(tokens, allow_anonymous=True)
+    assert lax.authenticate({"Authorization": "Bearer good"}).name == "alice"
+    assert lax.authenticate({}) is ANONYMOUS
+    assert lax.authenticate({"Authorization": "Bearer WRONG"}) is None
+    assert lax.authenticate({"Authorization": "Basic dXNlcjpwdw=="}) is None
