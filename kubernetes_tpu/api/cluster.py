@@ -429,7 +429,10 @@ class PersistentVolume:
     capacity: dict[str, Quantity] = field(default_factory=dict)  # {"storage": ...}
     access_modes: list[str] = field(default_factory=lambda: ["ReadWriteOnce"])
     storage_class: str = ""
-    zone: str = ""  # topology constraint (NoVolumeZoneConflict / NoVolumeNodeConflict)
+    zone: str = ""  # topology constraint (NoVolumeZoneConflict)
+    # Local-volume pinning (NoVolumeNodeConflict, reference
+    # predicates.go:1323 via the volume.alpha node-affinity annotation):
+    node_affinity: "object" = None  # Optional[selectors.NodeSelector]
     reclaim_policy: str = "Retain"  # Retain | Delete | Recycle
     phase: str = "Available"  # Available | Bound | Released | Failed
     claim_ref: str = ""  # namespace/name of bound PVC
@@ -451,12 +454,16 @@ class PersistentVolume:
             },
             "status": {"phase": self.phase, "claimRef": self.claim_ref},
         }
+        if self.node_affinity is not None:
+            d["spec"]["nodeAffinity"] = self.node_affinity.to_dict()
         if self.zone:
             d["metadata"].setdefault("labels", {})[ZONE_LABEL] = self.zone
         return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "PersistentVolume":
+        from .selectors import NodeSelector
+
         meta = ObjectMeta.from_dict(d.get("metadata") or {})
         meta.namespace = ""
         spec = d.get("spec") or {}
@@ -467,6 +474,7 @@ class PersistentVolume:
             access_modes=list(spec.get("accessModes") or ["ReadWriteOnce"]),
             storage_class=spec.get("storageClassName", ""),
             zone=meta.labels.get(ZONE_LABEL, ""),
+            node_affinity=NodeSelector.from_dict(spec.get("nodeAffinity")),
             reclaim_policy=spec.get("reclaimPolicy", "Retain"),
             phase=status.get("phase", "Available"),
             claim_ref=status.get("claimRef", ""),

@@ -1,3 +1,3 @@
 """Tensorized cluster-state models (the NodeInfo → device-array bridge)."""
 
-from .snapshot import BatchStatic, InitialState, Tensorizer, kernel_eligible, pod_signature_key
+from .snapshot import BatchStatic, InitialState, Tensorizer, pod_signature_key

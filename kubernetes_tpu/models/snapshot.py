@@ -37,7 +37,11 @@ import numpy as np
 from ..api import types as api
 from ..native import MatchEngine
 from ..scheduler.nodeinfo import NodeInfo
-from ..scheduler.predicates import _pod_matches_term
+from ..scheduler.predicates import (
+    VOLUME_COUNT_LIMITS,
+    _READONLY_SHARED_KINDS,
+    _pod_matches_term,
+)
 from ..scheduler.priorities import (
     PREFER_AVOID_PODS_ANNOTATION,
     PriorityContext,
@@ -83,21 +87,23 @@ def pod_signature_key(pod: api.Pod) -> str:
     return json.dumps(parts, sort_keys=True, default=str)
 
 
-def kernel_eligible(pod: api.Pod) -> bool:
-    """Phase-A kernel scope: everything except inter-pod (anti)affinity and
-    volume-bearing pods (those route to the oracle segment path; widened in
-    later phases)."""
-    if pod.spec.volumes:
-        return False
-    a = pod.spec.affinity
-    if a is not None and (
-        a.pod_affinity_required
-        or a.pod_affinity_preferred
-        or a.pod_anti_affinity_required
-        or a.pod_anti_affinity_preferred
-    ):
-        return False
-    return True
+@dataclass
+class _AffinityTerm:
+    """One flattened (anti)affinity term carried by a batch signature.
+
+    Phase B puts the batch pods' own terms on device: each term becomes a
+    row of the [T, G] match matrix, a row of the [T, N] topology-domain map,
+    and entries in the symmetry/own weight tables the scan step contracts
+    against (reference semantics: ``predicates.go:982,1065,1146``,
+    ``interpod_affinity.go:119``)."""
+
+    owner: int  # signature index
+    kind: str  # RA | RAA | PA | PAA
+    weight: int  # symmetry scoring weight (RA: hard weight, PA: +w, PAA: -w)
+    term: api.PodAffinityTerm
+
+
+_VOL_KINDS = list(VOLUME_COUNT_LIMITS)  # fixed kind axis for [K, N] counts
 
 
 @dataclass
@@ -130,9 +136,36 @@ class BatchStatic:
     # spreading
     g_has_spread: np.ndarray  # [G] bool (has matching selectors)
     spread_inc: np.ndarray  # [G, G] int32: landing of sig h bumps counts of sig g
-    # inter-pod affinity contributions from EXISTING pods (phase-A pods carry
-    # no affinity terms themselves, so these are fully static):
+    # inter-pod affinity contributions from EXISTING pods (static: existing
+    # pods do not move during the batch):
     interpod_raw: np.ndarray  # [G, N] int32 (scoring symmetry, may be negative)
+
+    # -- phase B: the batch pods' own (anti)affinity terms on device --------
+    # T >= 1 (padded with an inert term when the batch carries none)
+    terms: "list[_AffinityTerm]" = field(default_factory=list)
+    term_matches_sig: np.ndarray = None  # [T, G] bool: sig-g pod in term t's scope
+    term_owner: np.ndarray = None  # [T] int32
+    sym_w: np.ndarray = None  # [T] int32 symmetry scoring weight
+    own_w: np.ndarray = None  # [G, T] int32 own soft-term weight (PA +w / PAA -w)
+    own_ra: np.ndarray = None  # [G, T] bool own required-affinity terms
+    own_raa: np.ndarray = None  # [G, T] bool own required-anti terms
+    own_all: np.ndarray = None  # [G, T] bool any term owned by sig
+    is_raa: np.ndarray = None  # [T] bool required anti (symmetry forbids)
+    self_match: np.ndarray = None  # [T] bool owner matches own term (first-pod rule)
+    node_domain: np.ndarray = None  # [T, N] int32 global domain id (trash slot if key absent)
+    dom_valid: np.ndarray = None  # [T, N] bool node carries the topology key
+    num_domains: int = 1  # D_total + 1 (last slot = trash)
+
+    # -- phase B: volumes on device ----------------------------------------
+    # V >= 1 (padded); volume identity = (disk_kind, disk_id)
+    vol_vocab: list = field(default_factory=list)
+    g_vols: np.ndarray = None  # [G, V] bool sig references volume
+    g_ro_ok: np.ndarray = None  # [G, V] bool all refs read-only AND kind sharable
+    g_vol_ns: np.ndarray = None  # [G, V] bool placing sig makes vol non-sharable
+    kind_onehot: np.ndarray = None  # [K, V] int32
+    g_has_kind: np.ndarray = None  # [G, K] bool sig has >=1 vol of limited kind
+    vol_limits: np.ndarray = None  # [K] int32
+
     # scoring mode flags
     weights: dict = field(default_factory=dict)
 
@@ -147,6 +180,13 @@ class InitialState:
     ports_used: np.ndarray  # [N, Pv] bool
     spread_counts: np.ndarray  # [G, N] int32
     round_robin: int
+    # phase B dynamic state
+    dom_match: np.ndarray = None  # [D+1] int32: pods matching term t, per domain
+    dom_owner: np.ndarray = None  # [D+1] int32: placed owners of term t, per domain
+    total_match: np.ndarray = None  # [T] int32: pods matching term t anywhere
+    vol_any: np.ndarray = None  # [V, N] bool volume instance present
+    vol_ns: np.ndarray = None  # [V, N] bool non-sharable instance present
+    nk: np.ndarray = None  # [K, N] int32 distinct limited-kind disks on node
 
 
 def _pad_to(n: int, multiple: int) -> int:
@@ -156,9 +196,17 @@ def _pad_to(n: int, multiple: int) -> int:
 
 
 class Tensorizer:
-    def __init__(self, pad_multiple: int = 128, max_groups: int = 512):
+    def __init__(
+        self,
+        pad_multiple: int = 128,
+        max_groups: int = 512,
+        max_terms: int = 128,
+        max_vols: int = 256,
+    ):
         self.pad_multiple = pad_multiple
         self.max_groups = max_groups
+        self.max_terms = max_terms
+        self.max_vols = max_vols
 
     # -- static ------------------------------------------------------------
     def build_static(
@@ -198,6 +246,24 @@ class Tensorizer:
                 reps.append(pod)
             group_of_pod[i] = gid
         G = len(reps)
+
+        # cheap tensor-budget probes BEFORE the expensive [G, N] loops: the
+        # backend's binary-split fallback re-tensorizes each half, so an
+        # over-budget segment must be rejected for near-free
+        n_terms = 0
+        vol_count: set[tuple[str, str]] = set()
+        for rep in reps:
+            a = rep.spec.affinity
+            if a is not None:
+                n_terms += sum(1 for t in a.pod_affinity_required if t.topology_key)
+                n_terms += sum(1 for t in a.pod_anti_affinity_required if t.topology_key)
+                n_terms += sum(1 for wt in a.pod_affinity_preferred if wt.term.topology_key)
+                n_terms += sum(1 for wt in a.pod_anti_affinity_preferred if wt.term.topology_key)
+            for vol in rep.spec.volumes:
+                if vol.disk_id:
+                    vol_count.add((vol.disk_kind, vol.disk_id))
+        if n_terms > self.max_terms or len(vol_count) > self.max_vols:
+            return None
 
         # node-side basics
         node_exists = np.zeros(n_pad, dtype=bool)
@@ -378,6 +444,162 @@ class Tensorizer:
                                 static_ok[g, j] = False
                                 break
 
+        # -- phase B: the batch's own (anti)affinity terms ------------------
+        # Flatten every term carried by a signature into one table; empty
+        # topology keys on REQUIRED terms make the owner statically
+        # infeasible everywhere (predicates.go:1181 "empty topologyKey is
+        # not allowed"), and soft terms with empty keys never contribute
+        # (interpod_affinity.go add() skips them) so both drop from the
+        # table after marking.
+        terms: list[_AffinityTerm] = []
+        hard_w = pctx.hard_pod_affinity_weight
+        for g, rep in enumerate(reps):
+            a = rep.spec.affinity
+            if a is None:
+                continue
+            for t in a.pod_affinity_required:
+                if not t.topology_key:
+                    static_ok[g, :] = False
+                    continue
+                terms.append(_AffinityTerm(g, "RA", hard_w, t))
+            for t in a.pod_anti_affinity_required:
+                if not t.topology_key:
+                    static_ok[g, :] = False
+                    continue
+                terms.append(_AffinityTerm(g, "RAA", 0, t))
+            for wt in a.pod_affinity_preferred:
+                if wt.term.topology_key:
+                    terms.append(_AffinityTerm(g, "PA", wt.weight, wt.term))
+            for wt in a.pod_anti_affinity_preferred:
+                if wt.term.topology_key:
+                    terms.append(_AffinityTerm(g, "PAA", -wt.weight, wt.term))
+        T = max(len(terms), 1)
+
+        term_matches_sig = np.zeros((T, G), dtype=bool)
+        term_owner = np.zeros(T, dtype=np.int32)
+        sym_w = np.zeros(T, dtype=np.int32)
+        own_w = np.zeros((G, T), dtype=np.int32)
+        own_ra = np.zeros((G, T), dtype=bool)
+        own_raa = np.zeros((G, T), dtype=bool)
+        own_all = np.zeros((G, T), dtype=bool)
+        is_raa = np.zeros(T, dtype=bool)
+        self_match = np.zeros(T, dtype=bool)
+        for t, at in enumerate(terms):
+            owner_rep = reps[at.owner]
+            term_owner[t] = at.owner
+            own_all[at.owner, t] = True
+            for g, rep in enumerate(reps):
+                term_matches_sig[t, g] = _pod_matches_term(rep, owner_rep, at.term)
+            self_match[t] = term_matches_sig[t, at.owner]
+            if at.kind == "RA":
+                own_ra[at.owner, t] = True
+                sym_w[t] = at.weight
+            elif at.kind == "RAA":
+                own_raa[at.owner, t] = True
+                is_raa[t] = True
+            else:  # PA / PAA soft terms
+                own_w[at.owner, t] = at.weight
+                sym_w[t] = at.weight
+
+        # topology domains: per distinct key, enumerate label values over the
+        # node axis once; each term gets its own global domain-id range so
+        # the flat [D+1] count arrays stay per-term (last slot = trash for
+        # nodes missing the key — never read unmasked)
+        key_vals: dict[str, tuple[np.ndarray, int]] = {}
+        for at in terms:
+            key = at.term.topology_key
+            if key in key_vals:
+                continue
+            vocab: dict[str, int] = {}
+            arr = np.full(n_pad, -1, dtype=np.int32)
+            for j, info in enumerate(infos):
+                v = info.node.meta.labels.get(key)
+                if v is not None:
+                    arr[j] = vocab.setdefault(v, len(vocab))
+            key_vals[key] = (arr, len(vocab))
+        node_domain = np.zeros((T, n_pad), dtype=np.int32)
+        dom_valid = np.zeros((T, n_pad), dtype=bool)
+        offset = 0
+        for t, at in enumerate(terms):
+            arr, count = key_vals[at.term.topology_key]
+            dom_valid[t] = arr >= 0
+            node_domain[t] = np.where(arr >= 0, offset + arr, 0)  # trash fixed below
+            offset += count
+        trash = offset
+        node_domain[~dom_valid] = trash
+        if not terms:
+            dom_valid[:] = False
+            node_domain[:] = trash
+        num_domains = trash + 1
+
+        # -- phase B: volumes ----------------------------------------------
+        vol_vocab: dict[tuple[str, str], int] = {}
+        for rep in reps:
+            for vol in rep.spec.volumes:
+                if vol.disk_id:
+                    vol_vocab.setdefault((vol.disk_kind, vol.disk_id), len(vol_vocab))
+        V = max(len(vol_vocab), 1)
+        K = len(_VOL_KINDS)
+        g_vols = np.zeros((G, V), dtype=bool)
+        g_all_ro = np.ones((G, V), dtype=bool)
+        sharable = np.zeros(V, dtype=bool)
+        vol_kind_row = np.full(V, -1, dtype=np.int32)
+        for (kind, _id), v in vol_vocab.items():
+            sharable[v] = kind in _READONLY_SHARED_KINDS
+            if kind in VOLUME_COUNT_LIMITS:
+                vol_kind_row[v] = _VOL_KINDS.index(kind)
+        for g, rep in enumerate(reps):
+            for vol in rep.spec.volumes:
+                if not vol.disk_id:
+                    continue
+                v = vol_vocab[(vol.disk_kind, vol.disk_id)]
+                g_vols[g, v] = True
+                g_all_ro[g, v] &= vol.read_only
+        g_ro_ok = g_vols & sharable[None, :] & g_all_ro
+        g_vol_ns = g_vols & ~g_ro_ok
+        kind_onehot = np.zeros((K, V), dtype=np.int32)
+        for v in range(V):
+            if vol_kind_row[v] >= 0:
+                kind_onehot[vol_kind_row[v], v] = 1
+        g_has_kind = (g_vols.astype(np.int32) @ kind_onehot.T) > 0  # [G, K]
+        vol_limits = np.array([VOLUME_COUNT_LIMITS[k] for k in _VOL_KINDS], dtype=np.int32)
+
+        # PVC-backed volumes: zone / PV-node-affinity constraints are static
+        # per (signature, node) — PVC↔PV bindings do not change mid-batch —
+        # so they fold into static_ok (oracle: no_volume_zone_conflict /
+        # no_volume_node_conflict, predicates.go:402,1323)
+        for g, rep in enumerate(reps):
+            pvc_vols = [v for v in rep.spec.volumes if v.pvc_name]
+            if not pvc_vols:
+                continue
+            pv_zones: list[str] = []
+            pv_sels: list = []
+            unresolved = False
+            for vol in pvc_vols:
+                pvc = pctx.pvcs.get(f"{rep.meta.namespace}/{vol.pvc_name}")
+                pv = pctx.pvs.get(pvc.volume_name) if pvc is not None and pvc.volume_name else None
+                if pv is None:
+                    unresolved = True
+                    break
+                if pv.zone:
+                    pv_zones.append(pv.zone)
+                if pv.node_affinity is not None:
+                    pv_sels.append(pv.node_affinity)
+            if unresolved:
+                static_ok[g, :] = False
+                continue
+            if pv_zones or pv_sels:
+                for j, info in enumerate(infos):
+                    if not static_ok[g, j]:
+                        continue
+                    labels = info.node.meta.labels
+                    node_zone_label = labels.get(api.ZONE_LABEL, "")
+                    if any(z != node_zone_label for z in pv_zones):
+                        static_ok[g, j] = False
+                        continue
+                    if any(not sel.matches(labels) for sel in pv_sels):
+                        static_ok[g, j] = False
+
         # spreading: selectors per signature; inc matrix between signatures
         ssp = SelectorSpreadPriority()
         g_selectors = [ssp._selectors_for_pod(rep, pctx) for rep in reps]
@@ -413,6 +635,26 @@ class Tensorizer:
             g_has_spread=g_has_spread,
             spread_inc=spread_inc,
             interpod_raw=interpod_raw,
+            terms=terms,
+            term_matches_sig=term_matches_sig,
+            term_owner=term_owner,
+            sym_w=sym_w,
+            own_w=own_w,
+            own_ra=own_ra,
+            own_raa=own_raa,
+            own_all=own_all,
+            is_raa=is_raa,
+            self_match=self_match,
+            node_domain=node_domain,
+            dom_valid=dom_valid,
+            num_domains=num_domains,
+            vol_vocab=list(vol_vocab),
+            g_vols=g_vols,
+            g_ro_ok=g_ro_ok,
+            g_vol_ns=g_vol_ns,
+            kind_onehot=kind_onehot,
+            g_has_kind=g_has_kind,
+            vol_limits=vol_limits,
             weights={
                 "least": least_requested_weight,
                 "most": most_requested_weight,
@@ -459,14 +701,20 @@ class Tensorizer:
                 if port in port_idx:
                     ports_used[j, port_idx[port]] = True
 
-        # existing matching-pod counts per spread group (zone sums are
-        # recomputed in-step from these, over the feasible mask).  This is
-        # groups x existing-pods selector matching — tens of millions of
-        # probes on a loaded 150k-pod cluster — so it runs in the native
-        # engine (csrc/labelmatch.cpp); namespace scoping rides along as a
-        # reserved pseudo-label.
+        # existing matching-pod counts per spread group and per affinity
+        # term (zone sums are recomputed in-step from these, over the
+        # feasible mask).  This is (groups + terms) x existing-pods selector
+        # matching — tens of millions of probes on a loaded 150k-pod cluster
+        # — so it runs in the native engine (csrc/labelmatch.cpp); namespace
+        # scoping rides along as a reserved pseudo-label.
         groups_with_sels = {g: sels for g, sels in g_selectors.items() if sels}
-        if groups_with_sels:
+        T = static.term_matches_sig.shape[0]
+        dom_match = np.zeros(static.num_domains, dtype=np.int32)
+        total_match = np.zeros(T, dtype=np.int32)
+        matchable_terms = [
+            (t, at) for t, at in enumerate(static.terms) if at.term.selector is not None
+        ]
+        if groups_with_sels or matchable_terms:
             eng = MatchEngine()
             NS_KEY = "\x00ns"
             sel_ids: dict[int, list[int]] = {}
@@ -484,6 +732,18 @@ class Tensorizer:
                         )
                     ids.append(eng.add_selector(reqs))
                 sel_ids[g] = ids
+            # one selector per affinity term: namespace-scope ∈ term
+            # namespaces (empty → owner's namespace) AND the term selector
+            term_sids: list[int] = []
+            for t, at in matchable_terms:
+                namespaces = at.term.namespaces or [reps[at.owner].meta.namespace]
+                sel = at.term.selector
+                reqs = (
+                    [(NS_KEY, "In", [str(n) for n in namespaces])]
+                    + [(k, "Eq", [str(v)]) for k, v in sel.match_labels.items()]
+                    + [(r.key, r.operator, list(r.values)) for r in sel.match_expressions]
+                )
+                term_sids.append(eng.add_selector(reqs))
             pod_lids: list[int] = []
             pod_node_j: list[int] = []
             for j, name in enumerate(static.node_names):
@@ -495,7 +755,41 @@ class Tensorizer:
                 for g, ids in sel_ids.items():
                     hits = eng.match_any(ids, pod_lids)
                     np.add.at(spread_counts[g], node_j[hits], 1)
+                if matchable_terms:
+                    tm = eng.match_matrix(term_sids, pod_lids)  # [T_real, L]
+                    for row, (t, _at) in enumerate(matchable_terms):
+                        hits = tm[row]
+                        total_match[t] = int(hits.sum())
+                        np.add.at(dom_match, static.node_domain[t, node_j[hits]], 1)
             eng.close()
+        dom_match[static.num_domains - 1] = 0  # trash slot stays clean
+
+        # volume occupancy from existing pods: instance presence and
+        # non-sharable presence per batch-vocab volume, plus distinct
+        # limited-kind disk counts per node (NoDiskConflict /
+        # MaxVolumeCount dynamic state)
+        V = static.g_vols.shape[1]
+        K = len(_VOL_KINDS)
+        vol_idx = {key: v for v, key in enumerate(static.vol_vocab)}
+        vol_any = np.zeros((V, n_pad), dtype=bool)
+        vol_ns = np.zeros((V, n_pad), dtype=bool)
+        nk = np.zeros((K, n_pad), dtype=np.int32)
+        kind_pos = {k: i for i, k in enumerate(_VOL_KINDS)}
+        for j, name in enumerate(static.node_names):
+            seen: dict[str, set] = {}
+            for q in node_info_map[name].pods:
+                for vol in q.spec.volumes:
+                    if not vol.disk_id:
+                        continue
+                    if vol.disk_kind in kind_pos:
+                        seen.setdefault(vol.disk_kind, set()).add(vol.disk_id)
+                    v = vol_idx.get((vol.disk_kind, vol.disk_id))
+                    if v is not None:
+                        vol_any[v, j] = True
+                        if not (vol.disk_kind in _READONLY_SHARED_KINDS and vol.read_only):
+                            vol_ns[v, j] = True
+            for kind, ids in seen.items():
+                nk[kind_pos[kind], j] = len(ids)
 
         return InitialState(
             requested=requested,
@@ -504,4 +798,10 @@ class Tensorizer:
             ports_used=ports_used,
             spread_counts=spread_counts,
             round_robin=round_robin,
+            dom_match=dom_match,
+            dom_owner=np.zeros(static.num_domains, dtype=np.int32),
+            total_match=total_match,
+            vol_any=vol_any,
+            vol_ns=vol_ns,
+            nk=nk,
         )

@@ -3,17 +3,15 @@
 Plugs into ``Scheduler.schedule_pending_batch`` (the seam the reference
 exposes as the HTTP extender, ``core/extender.go`` — here it is in-process
 and batch-shaped).  Guarantees **binding parity with the oracle**: the
-drained FIFO batch is split into maximal runs of kernel-eligible pods;
-eligible runs execute on device via the scan kernel, ineligible pods run
-through the oracle *in order* against the same evolving state, so the
-sequence of (pod → node) decisions is exactly what a pure-oracle run
-produces.
+drained FIFO batch executes on device via the scan kernel — including
+inter-pod (anti)affinity and volume predicates (phase B) — reproducing
+the sequential-greedy decision sequence a pure-oracle run produces.
 
 Fallback ladder (every rung preserves parity):
 1. unsupported predicate/priority/extender config → all-oracle;
-2. segment exceeds the signature budget (max_groups) → that segment oracle;
-3. kernel-ineligible pod (volumes / own affinity terms, phase A) → that pod
-   oracle, between device segments.
+2. segment exceeds a tensor budget (max_groups signatures / max_terms
+   affinity terms / max_vols distinct disks) → binary split, each half
+   re-tensorized against the evolving state; single-pod leaves → oracle.
 """
 
 from __future__ import annotations
@@ -38,7 +36,7 @@ from ..scheduler.priorities import (
     SelectorSpreadPriority,
     TaintTolerationPriority,
 )
-from ..models.snapshot import Tensorizer, kernel_eligible
+from ..models.snapshot import Tensorizer
 from .batch_kernel import schedule_batch_arrays
 
 logger = logging.getLogger("kubernetes_tpu.backend")
@@ -109,6 +107,8 @@ class TPUBatchBackend:
             services=pctx.services,
             replicasets=pctx.replicasets,
             hard_pod_affinity_weight=pctx.hard_pod_affinity_weight,
+            pvcs=pctx.pvcs,
+            pvs=pctx.pvs,
         )
 
         assignments: list[Optional[str]] = [None] * len(pods)
@@ -145,8 +145,15 @@ class TPUBatchBackend:
                 interpod_weight=weights["interpod"],
             )
             if static is None:
-                for i, pod in segment:
-                    run_oracle(pod, i)
+                # over a budget (signatures / affinity terms / volumes):
+                # halve the segment — each half re-tensorizes against the
+                # updated working state, so sequential parity is preserved
+                if len(segment) == 1:
+                    run_oracle(segment[0][1], segment[0][0])
+                    return
+                mid = len(segment) // 2
+                run_kernel_segment(segment[:mid])
+                run_kernel_segment(segment[mid:])
                 return
             init = self.tensorizer.initial_state(
                 static, work_map, work_pctx, seg_pods, round_robin=self.algorithm._round_robin
@@ -164,15 +171,8 @@ class TPUBatchBackend:
                 run_oracle(pod, i)
             return assignments
 
-        segment: list[tuple[int, api.Pod]] = []
-        for i, pod in enumerate(pods):
-            if kernel_eligible(pod):
-                segment.append((i, pod))
-                continue
-            if segment:
-                run_kernel_segment(segment)
-                segment = []
-            run_oracle(pod, i)
-        if segment:
-            run_kernel_segment(segment)
+        # Phase B: every pod is kernel-expressible (inter-pod affinity and
+        # volumes run on device); the whole batch is one segment, recursively
+        # split only on tensor-budget overflow.
+        run_kernel_segment(list(enumerate(pods)))
         return assignments

@@ -42,6 +42,13 @@ class ScanState(NamedTuple):
     ports_used: jnp.ndarray  # [N, Pv] bool
     spread_counts: jnp.ndarray  # [G, N] int32
     round_robin: jnp.ndarray  # [] int32
+    # phase B: affinity-term domain counters + volume occupancy
+    dom_match: jnp.ndarray  # [D+1] int32 pods matching term t per topology domain
+    dom_owner: jnp.ndarray  # [D+1] int32 placed term owners per topology domain
+    total_match: jnp.ndarray  # [T] int32 pods matching term t anywhere
+    vol_any: jnp.ndarray  # [V, N] bool
+    vol_ns: jnp.ndarray  # [V, N] bool non-sharable instance present
+    nk: jnp.ndarray  # [K, N] int32 distinct limited-kind disks
 
 
 class StaticArrays(NamedTuple):
@@ -62,6 +69,24 @@ class StaticArrays(NamedTuple):
     g_ports: jnp.ndarray  # [G, Pv] bool
     g_has_spread: jnp.ndarray  # [G] bool
     spread_inc: jnp.ndarray  # [G, G] int32
+    # phase B: the batch's own (anti)affinity terms
+    term_matches_sig: jnp.ndarray  # [T, G] bool
+    sym_w: jnp.ndarray  # [T] int32
+    own_w: jnp.ndarray  # [G, T] int32
+    own_ra: jnp.ndarray  # [G, T] bool
+    own_raa: jnp.ndarray  # [G, T] bool
+    own_all: jnp.ndarray  # [G, T] bool
+    is_raa: jnp.ndarray  # [T] bool
+    self_match: jnp.ndarray  # [T] bool
+    node_domain: jnp.ndarray  # [T, N] int32 (trash slot id where key absent)
+    dom_valid: jnp.ndarray  # [T, N] bool
+    # phase B: volumes
+    g_vols: jnp.ndarray  # [G, V] bool
+    g_ro_ok: jnp.ndarray  # [G, V] bool
+    g_vol_ns: jnp.ndarray  # [G, V] bool
+    kind_onehot: jnp.ndarray  # [K, V] int32
+    g_has_kind: jnp.ndarray  # [G, K] bool
+    vol_limits: jnp.ndarray  # [K] int32
 
 
 def to_device(static: BatchStatic) -> StaticArrays:
@@ -80,6 +105,22 @@ def to_device(static: BatchStatic) -> StaticArrays:
         g_ports=jnp.asarray(static.g_ports),
         g_has_spread=jnp.asarray(static.g_has_spread),
         spread_inc=jnp.asarray(static.spread_inc),
+        term_matches_sig=jnp.asarray(static.term_matches_sig),
+        sym_w=jnp.asarray(static.sym_w),
+        own_w=jnp.asarray(static.own_w),
+        own_ra=jnp.asarray(static.own_ra),
+        own_raa=jnp.asarray(static.own_raa),
+        own_all=jnp.asarray(static.own_all),
+        is_raa=jnp.asarray(static.is_raa),
+        self_match=jnp.asarray(static.self_match),
+        node_domain=jnp.asarray(static.node_domain),
+        dom_valid=jnp.asarray(static.dom_valid),
+        g_vols=jnp.asarray(static.g_vols),
+        g_ro_ok=jnp.asarray(static.g_ro_ok),
+        g_vol_ns=jnp.asarray(static.g_vol_ns),
+        kind_onehot=jnp.asarray(static.kind_onehot),
+        g_has_kind=jnp.asarray(static.g_has_kind),
+        vol_limits=jnp.asarray(static.vol_limits),
     )
 
 
@@ -91,6 +132,12 @@ def state_to_device(init: InitialState) -> ScanState:
         ports_used=jnp.asarray(init.ports_used),
         spread_counts=jnp.asarray(init.spread_counts),
         round_robin=jnp.asarray(init.round_robin, dtype=jnp.int32),
+        dom_match=jnp.asarray(init.dom_match),
+        dom_owner=jnp.asarray(init.dom_owner),
+        total_match=jnp.asarray(init.total_match),
+        vol_any=jnp.asarray(init.vol_any),
+        vol_ns=jnp.asarray(init.vol_ns),
+        nk=jnp.asarray(init.nk),
     )
 
 
@@ -142,7 +189,47 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
         )
         pods_ok = state.pod_count + 1 <= dev.node_alloc_pods
         ports_ok = ~jnp.any(state.ports_used & g_ports, axis=1)
-        feasible = dev.static_ok[gid] & fit & pods_ok & ports_ok & dev.node_exists
+
+        # inter-pod affinity vs ALREADY-PLACED batch pods (the static_ok
+        # mask covers existing pods; these domain counters cover the scan
+        # carry — the batch generalization of the oracle's work_map feedback)
+        m_g = dev.term_matches_sig[:, gid]  # [T] bool: this pod in term t's scope
+        dm = state.dom_match[dev.node_domain] * dev.dom_valid  # [T, N] int32
+        downer = state.dom_owner[dev.node_domain] * dev.dom_valid  # [T, N]
+        # symmetry: placed pods' required anti-affinity forbids their domains
+        # for matching candidates (predicates.go:1146)
+        sym_anti_bad = jnp.any((m_g & dev.is_raa)[:, None] & (downer > 0), axis=0)
+        # the pod's own required affinity: some matching pod in-domain, or
+        # the first-pod rule (no matching pod anywhere + self-match,
+        # predicates.go:1196-1216)
+        first_ok = (state.total_match == 0) & dev.self_match  # [T]
+        ra_ok = (dm > 0) | first_ok[:, None]  # [T, N]
+        own_ra_bad = jnp.any(dev.own_ra[gid][:, None] & ~ra_ok, axis=0)
+        # the pod's own required anti-affinity: no matching pod in-domain
+        own_raa_bad = jnp.any(dev.own_raa[gid][:, None] & (dm > 0), axis=0)
+
+        # volumes: NoDiskConflict + MaxVolumeCount against placed state
+        gv = dev.g_vols[gid]  # [V] bool
+        blocked = jnp.where(dev.g_ro_ok[gid][:, None], state.vol_ns, state.vol_any)
+        disk_bad = jnp.any(gv[:, None] & blocked, axis=0)
+        new_v = (gv[:, None] & ~state.vol_any).astype(jnp.int32)  # [V, N]
+        count_new = dev.kind_onehot @ new_v  # [K, N]
+        over = dev.g_has_kind[gid][:, None] & (
+            state.nk + count_new > dev.vol_limits[:, None]
+        )
+        vol_bad = disk_bad | jnp.any(over, axis=0)
+
+        feasible = (
+            dev.static_ok[gid]
+            & fit
+            & pods_ok
+            & ports_ok
+            & dev.node_exists
+            & ~sym_anti_bad
+            & ~own_ra_bad
+            & ~own_raa_bad
+            & ~vol_bad
+        )
         n_feasible = jnp.sum(feasible.astype(jnp.int32))
 
         # -- scores (priorities) --------------------------------------
@@ -194,7 +281,15 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
                 dev.taint_intol_raw[gid], feasible, reverse=True
             )
         if w["interpod"]:
-            raw = dev.interpod_raw[gid]
+            # static (existing pods' symmetric terms) + dynamic: the pod's
+            # own soft terms against all matching pods in-domain, and placed
+            # batch owners' symmetric terms against this pod
+            # (interpod_affinity.go:160-186)
+            raw = (
+                dev.interpod_raw[gid]
+                + dev.own_w[gid] @ dm
+                + (m_g.astype(jnp.int32) * dev.sym_w) @ downer
+            )
             max_c = jnp.maximum(0, jnp.max(jnp.where(feasible, raw, INT32_MIN)))
             min_c = jnp.minimum(0, jnp.min(jnp.where(feasible, raw, INT32_MAX)))
             rng = max_c - min_c
@@ -223,6 +318,14 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
         safe = jnp.maximum(chosen, 0)
         onehot = (jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe) & landed
         oh_i = onehot.astype(jnp.int32)
+        # affinity domain counters: the landed pod counts toward every term
+        # whose scope it falls in, and toward terms it owns (all updates
+        # land in the trash slot when the chosen node lacks the key)
+        ids = dev.node_domain[:, safe]  # [T]
+        m_i = (m_g & landed).astype(jnp.int32)
+        own_i = (dev.own_all[gid] & landed).astype(jnp.int32)
+        # volume occupancy on the chosen node
+        newv_chosen = (gv & ~state.vol_any[:, safe] & landed).astype(jnp.int32)  # [V]
         new_state = ScanState(
             requested=state.requested + oh_i[:, None] * g_req[None, :],
             nonzero_requested=state.nonzero_requested + oh_i[:, None] * g_nz[None, :],
@@ -231,6 +334,12 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
             spread_counts=state.spread_counts
             + dev.spread_inc[:, gid][:, None] * oh_i[None, :],
             round_robin=rr,
+            dom_match=state.dom_match.at[ids].add(m_i),
+            dom_owner=state.dom_owner.at[ids].add(own_i),
+            total_match=state.total_match + m_i,
+            vol_any=state.vol_any | (gv[:, None] & onehot[None, :]),
+            vol_ns=state.vol_ns | (dev.g_vol_ns[gid][:, None] & onehot[None, :]),
+            nk=state.nk + (dev.kind_onehot @ newv_chosen)[:, None] * oh_i[None, :],
         )
         return new_state, chosen
 

@@ -66,6 +66,24 @@ def shard_static(dev: StaticArrays, mesh: Mesh) -> StaticArrays:
         g_ports=jax.device_put(dev.g_ports, repl),
         g_has_spread=jax.device_put(dev.g_has_spread, repl),
         spread_inc=jax.device_put(dev.spread_inc, repl),
+        # phase B: the [.., N] maps shard with the node axis; the per-term /
+        # per-signature tables replicate (small)
+        term_matches_sig=jax.device_put(dev.term_matches_sig, repl),
+        sym_w=jax.device_put(dev.sym_w, repl),
+        own_w=jax.device_put(dev.own_w, repl),
+        own_ra=jax.device_put(dev.own_ra, repl),
+        own_raa=jax.device_put(dev.own_raa, repl),
+        own_all=jax.device_put(dev.own_all, repl),
+        is_raa=jax.device_put(dev.is_raa, repl),
+        self_match=jax.device_put(dev.self_match, repl),
+        node_domain=jax.device_put(dev.node_domain, g_n),
+        dom_valid=jax.device_put(dev.dom_valid, g_n),
+        g_vols=jax.device_put(dev.g_vols, repl),
+        g_ro_ok=jax.device_put(dev.g_ro_ok, repl),
+        g_vol_ns=jax.device_put(dev.g_vol_ns, repl),
+        kind_onehot=jax.device_put(dev.kind_onehot, repl),
+        g_has_kind=jax.device_put(dev.g_has_kind, repl),
+        vol_limits=jax.device_put(dev.vol_limits, repl),
     )
 
 
@@ -81,6 +99,14 @@ def shard_state(state: ScanState, mesh: Mesh) -> ScanState:
         ports_used=jax.device_put(state.ports_used, n_r),
         spread_counts=jax.device_put(state.spread_counts, g_n),
         round_robin=jax.device_put(state.round_robin, repl),
+        # phase B: flat domain counters replicate (updated via a gathered
+        # column of ids — an all-reduce'd scatter); volume maps shard on N
+        dom_match=jax.device_put(state.dom_match, repl),
+        dom_owner=jax.device_put(state.dom_owner, repl),
+        total_match=jax.device_put(state.total_match, repl),
+        vol_any=jax.device_put(state.vol_any, g_n),
+        vol_ns=jax.device_put(state.vol_ns, g_n),
+        nk=jax.device_put(state.nk, g_n),
     )
 
 

@@ -129,13 +129,13 @@ class GenericScheduler:
         node_names = sorted(n for n, i in node_info_map.items() if i.node is not None)
         if not node_names:
             raise FitError(pod, {})
-        ctx = PredicateContext(node_info_map)
+        pctx = pctx or PriorityContext(node_info_map)
+        ctx = PredicateContext(node_info_map, pvcs=pctx.pvcs, pvs=pctx.pvs)
         feasible, failures = self.find_nodes_that_fit(pod, node_names, node_info_map, ctx)
         if not feasible:
             raise FitError(pod, failures)
         if len(feasible) == 1:
             return ScheduleResult(feasible[0], 1, len(node_names))
-        pctx = pctx or PriorityContext(node_info_map)
         prioritized = self.prioritize_nodes(pod, feasible, node_info_map, pctx)
         host = self.select_host(prioritized)
         return ScheduleResult(

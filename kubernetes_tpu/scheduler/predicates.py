@@ -38,6 +38,9 @@ MEMORY_PRESSURE = "node(s) had memory pressure"
 DISK_PRESSURE = "node(s) had disk pressure"
 DISK_CONFLICT = "node(s) had no available disk"
 MAX_VOLUME_COUNT = "node(s) exceed max volume count"
+VOLUME_ZONE_CONFLICT = "node(s) had volume zone conflict"
+VOLUME_NODE_CONFLICT = "node(s) didn't match PersistentVolume's node affinity"
+UNBOUND_PVC = "pod has unbound/missing PersistentVolumeClaim"
 AFFINITY_NOT_MATCH = "node(s) didn't satisfy inter-pod (anti)affinity"
 NODE_UNSCHEDULABLE = "node(s) were unschedulable"
 NODE_NOT_READY = "node(s) were not ready"
@@ -73,10 +76,32 @@ class PredicateContext:
     would dominate the filter phase (the reference avoids this with
     predicate metadata, ``predicates/metadata.go``)."""
 
-    def __init__(self, node_info_map: dict[str, NodeInfo]):
+    def __init__(
+        self,
+        node_info_map: dict[str, NodeInfo],
+        pvcs: Optional[dict[str, object]] = None,
+        pvs: Optional[dict[str, object]] = None,
+    ):
         self.node_info_map = node_info_map
+        # "ns/name" -> PersistentVolumeClaim; name -> PersistentVolume
+        # (the reference threads pvcLister/pvLister into the volume
+        # predicates via ConfigFactory, factory.go:120)
+        self.pvcs = pvcs or {}
+        self.pvs = pvs or {}
         self._all_pods: Optional[list[tuple[api.Pod, NodeInfo]]] = None
         self._all_pods_with_affinity: Optional[list[tuple[api.Pod, NodeInfo]]] = None
+
+    def bound_pv_for(self, pod: api.Pod, vol: api.Volume):
+        """Resolve a pod volume's PVC reference to its bound PV.
+        Returns (pv, ok): ok=False means missing/unbound claim (the
+        reference fails scheduling on lookup errors, predicates.go:430)."""
+        pvc = self.pvcs.get(f"{pod.meta.namespace}/{vol.pvc_name}")
+        if pvc is None or not pvc.volume_name:
+            return None, False
+        pv = self.pvs.get(pvc.volume_name)
+        if pv is None:
+            return None, False
+        return pv, True
 
     def all_pods_with_affinity(self) -> list[tuple[api.Pod, NodeInfo]]:
         if self._all_pods_with_affinity is None:
@@ -309,6 +334,46 @@ def max_volume_count(pod, meta, info: NodeInfo, ctx) -> tuple[bool, list[str]]:
     return True, []
 
 
+def no_volume_zone_conflict(pod, meta, info: NodeInfo, ctx: PredicateContext) -> tuple[bool, list[str]]:
+    """reference ``VolumeZoneChecker.predicate`` (predicates.go:402): a pod
+    referencing a PVC bound to a zone-labelled PV may only land on nodes in
+    that zone; missing/unbound claims fail scheduling outright."""
+    vols = [v for v in pod.spec.volumes if v.pvc_name]
+    if not vols:
+        return True, []
+    if info.node is None:
+        return False, [VOLUME_ZONE_CONFLICT]
+    node_zone = info.node.meta.labels.get(api.ZONE_LABEL, "")
+    for vol in vols:
+        pv, ok = ctx.bound_pv_for(pod, vol)
+        if not ok:
+            return False, [UNBOUND_PVC]
+        if pv.zone and pv.zone != node_zone:
+            return False, [VOLUME_ZONE_CONFLICT]
+    return True, []
+
+
+def no_volume_node_conflict(pod, meta, info: NodeInfo, ctx: PredicateContext) -> tuple[bool, list[str]]:
+    """reference ``VolumeNodeChecker.predicate`` (predicates.go:1323): a PV
+    carrying node affinity (local volumes) pins its pods to matching nodes.
+    Unlike the zone check, unresolvable claims are skipped here — the zone
+    predicate already reports them (mirrors the reference's split where the
+    node checker tolerates nil PVs)."""
+    vols = [v for v in pod.spec.volumes if v.pvc_name]
+    if not vols:
+        return True, []
+    if info.node is None:
+        return False, [VOLUME_NODE_CONFLICT]
+    labels = info.node.meta.labels
+    for vol in vols:
+        pv, ok = ctx.bound_pv_for(pod, vol)
+        if not ok:
+            continue
+        if pv.node_affinity is not None and not pv.node_affinity.matches(labels):
+            return False, [VOLUME_NODE_CONFLICT]
+    return True, []
+
+
 # ---------------------------------------------------------------------------
 # Inter-pod affinity / anti-affinity (the reference's hot spot,
 # predicates.go:982 MatchInterPodAffinity)
@@ -387,6 +452,8 @@ DEFAULT_PREDICATES: dict[str, PredicateFn] = {
     "CheckNodeCondition": check_node_condition,
     "NoDiskConflict": no_disk_conflict,
     "MaxVolumeCount": max_volume_count,
+    "NoVolumeZoneConflict": no_volume_zone_conflict,
+    "NoVolumeNodeConflict": no_volume_node_conflict,
     "GeneralPredicates": general_predicates,
     "PodToleratesNodeTaints": pod_tolerates_node_taints,
     "CheckNodeMemoryPressure": check_node_memory_pressure,

@@ -52,8 +52,10 @@ def find_preemption_target(
     pod: api.Pod,
     node_info_map: dict[str, NodeInfo],
     predicates=None,
+    pvcs=None,
+    pvs=None,
 ) -> Optional[PreemptionTarget]:
-    ctx = PredicateContext(node_info_map)
+    ctx = PredicateContext(node_info_map, pvcs=pvcs, pvs=pvs)
     meta = compute_metadata(pod, ctx)
     candidates: list[tuple[tuple, PreemptionTarget]] = []
 

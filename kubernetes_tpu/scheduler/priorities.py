@@ -54,11 +54,18 @@ class PriorityContext:
         services: Optional[list[api.Service]] = None,
         replicasets: Optional[list[api.ReplicaSet]] = None,
         hard_pod_affinity_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT,
+        pvcs: Optional[dict[str, object]] = None,
+        pvs: Optional[dict[str, object]] = None,
     ):
         self.node_info_map = node_info_map
         self.services = services or []
         self.replicasets = replicasets or []
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        # volume listers consumed by the predicate context ("ns/name" -> PVC,
+        # name -> PV); carried here so one context object reaches both the
+        # scoring and (via GenericScheduler.schedule) the filtering phase
+        self.pvcs = pvcs or {}
+        self.pvs = pvs or {}
 
 
 def _zone_key(node: Optional[api.Node]) -> str:

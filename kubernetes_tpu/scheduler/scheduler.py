@@ -92,9 +92,14 @@ class Scheduler:
                 on_delete=lambda n: self.cache.remove_node(n.meta.name),
             )
         )
-        # services/replicasets: cache-only informers for spreading priorities
+        # services/replicasets: cache-only informers for spreading priorities;
+        # PVs/PVCs: volume predicates (the reference wires 8 informers,
+        # factory.go:120 — pods, nodes, PVs, PVCs, RCs, RSs, statefulsets,
+        # services)
         self.informers.informer("Service")
         self.informers.informer("ReplicaSet")
+        self.informers.informer("PersistentVolume")
+        self.informers.informer("PersistentVolumeClaim")
 
     def _on_pod_add(self, pod: api.Pod) -> None:
         if pod.spec.node_name:
@@ -143,7 +148,11 @@ class Scheduler:
     def priority_context(self, snapshot: dict[str, NodeInfo]) -> PriorityContext:
         services = self.informers.informer("Service").list()
         replicasets = self.informers.informer("ReplicaSet").list()
-        return PriorityContext(snapshot, services=services, replicasets=replicasets)
+        pvs = {pv.meta.name: pv for pv in self.informers.informer("PersistentVolume").list()}
+        pvcs = {pvc.meta.key: pvc for pvc in self.informers.informer("PersistentVolumeClaim").list()}
+        return PriorityContext(
+            snapshot, services=services, replicasets=replicasets, pvcs=pvcs, pvs=pvs
+        )
 
     # -- events / SLIs -----------------------------------------------------
     def _event(self, pod: api.Pod, etype: str, reason: str, message: str) -> None:
@@ -211,7 +220,11 @@ class Scheduler:
     def _try_preempt(self, pod: api.Pod) -> bool:
         from .preemption import find_preemption_target
 
-        target = find_preemption_target(pod, self.snapshot(), self.algorithm.predicates)
+        pvs = {pv.meta.name: pv for pv in self.informers.informer("PersistentVolume").list()}
+        pvcs = {c.meta.key: c for c in self.informers.informer("PersistentVolumeClaim").list()}
+        target = find_preemption_target(
+            pod, self.snapshot(), self.algorithm.predicates, pvcs=pvcs, pvs=pvs
+        )
         if target is None:
             return False
         for victim in target.victims:

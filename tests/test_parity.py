@@ -43,6 +43,7 @@ def oracle_batch(pods, node_info_map, pctx, algorithm):
     wctx = PriorityContext(
         work, services=pctx.services, replicasets=pctx.replicasets,
         hard_pod_affinity_weight=pctx.hard_pod_affinity_weight,
+        pvcs=pctx.pvcs, pvs=pctx.pvs,
     )
     out = []
     for pod in pods:
@@ -191,7 +192,9 @@ def test_parity_unschedulable_overflow():
     backend = assert_parity(pods, m, PriorityContext(m))
 
 
-def test_parity_mixed_eligible_ineligible_segments():
+def test_parity_mixed_affinity_volume_batch_stays_on_kernel():
+    # phase B: pods carrying their own anti-affinity terms and disk volumes
+    # are kernel-expressible — the whole mixed batch runs on device
     rng = random.Random(8)
     m = build_cluster(rng, 15, zones=2)
     aff = Affinity(
@@ -216,8 +219,9 @@ def test_parity_mixed_eligible_ineligible_segments():
         else:
             pods.append(make_pod(f"plain-{i}", cpu="200m", memory="256Mi", labels={"app": "web"}))
     backend = assert_parity(pods, m, PriorityContext(m))
-    assert backend.stats["oracle_pods"] > 0
-    assert backend.stats["segments"] >= 2
+    assert backend.stats["oracle_pods"] == 0
+    assert backend.stats["kernel_pods"] == 90
+    assert backend.stats["segments"] == 1
 
 
 def test_parity_existing_affinity_pods_affect_eligible_batch():
@@ -278,3 +282,306 @@ def test_backend_in_scheduler_end_to_end():
     from collections import Counter
     counts = Counter(p.spec.node_name for p in pods)
     assert max(counts.values()) <= 110
+
+
+# ---------------------------------------------------------------------------
+# Phase B: pending pods carry their OWN (anti)affinity terms and volumes —
+# all of it must run on the kernel with oracle-identical bindings
+# ---------------------------------------------------------------------------
+
+
+def _assert_all_kernel(backend, n):
+    assert backend.stats["oracle_pods"] == 0
+    assert backend.stats["kernel_pods"] == n
+
+
+def test_parity_batch_required_anti_affinity_self():
+    # every pod anti-affines with its own label on hostname -> at most one
+    # per node; later pods respect earlier batch placements on both paths
+    rng = random.Random(20)
+    m = build_cluster(rng, 10, zones=2, existing_per_node=0)
+    aff = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "solo"}),
+                topology_key="kubernetes.io/hostname",
+            )
+        ]
+    )
+    pods = [
+        make_pod(f"solo-{i}", cpu="100m", labels={"app": "solo"}, affinity=aff)
+        for i in range(14)
+    ]
+    backend = assert_parity(pods, m, PriorityContext(m))
+    _assert_all_kernel(backend, 14)
+
+
+def test_parity_batch_required_affinity_first_pod_rule():
+    # required affinity to own label: the first pod lands anywhere (first-pod
+    # rule, predicates.go:1196-1216), the rest pack into its zone
+    rng = random.Random(21)
+    m = build_cluster(rng, 12, zones=3, existing_per_node=0)
+    aff = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "herd"}),
+                topology_key=ZONE,
+            )
+        ]
+    )
+    pods = [
+        make_pod(f"herd-{i}", cpu="100m", labels={"app": "herd"}, affinity=aff)
+        for i in range(9)
+    ]
+    backend = assert_parity(pods, m, PriorityContext(m))
+    _assert_all_kernel(backend, 9)
+    # all placed in one zone
+    algo = GenericScheduler()
+    got = TPUBatchBackend(algorithm=algo).schedule_batch(pods, m, PriorityContext(m))
+    zones = {m[n].node.meta.labels[ZONE] for n in got}
+    assert len(zones) == 1
+
+
+def test_parity_batch_required_affinity_unsatisfiable():
+    # required affinity to a label no pod has (and the pod itself lacks):
+    # every pod unschedulable on both paths
+    rng = random.Random(22)
+    m = build_cluster(rng, 6, zones=2, existing_per_node=0)
+    aff = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "ghost"}),
+                topology_key=ZONE,
+            )
+        ]
+    )
+    pods = [make_pod(f"p-{i}", cpu="100m", labels={"app": "real"}, affinity=aff) for i in range(4)]
+    algo = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo)
+    got = backend.schedule_batch(pods, m, PriorityContext(m))
+    want = oracle_batch(pods, m, PriorityContext(m), GenericScheduler())
+    assert got == want == [None] * 4
+
+
+def test_parity_batch_preferred_affinity_scoring():
+    # soft co-location with earlier batch pods must shift scores identically
+    rng = random.Random(23)
+    m = build_cluster(rng, 9, zones=3, existing_per_node=1)
+    pref = Affinity(
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=50,
+                term=PodAffinityTerm(
+                    selector=LabelSelector.from_match_labels({"app": "web"}),
+                    topology_key=ZONE,
+                ),
+            )
+        ]
+    )
+    anti = Affinity(
+        pod_anti_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=30,
+                term=PodAffinityTerm(
+                    selector=LabelSelector.from_match_labels({"app": "web"}),
+                    topology_key=ZONE,
+                ),
+            )
+        ]
+    )
+    pods = []
+    for i in range(30):
+        if i % 3 == 0:
+            pods.append(make_pod(f"seed-{i}", cpu="100m", labels={"app": "web"}))
+        elif i % 3 == 1:
+            pods.append(make_pod(f"follow-{i}", cpu="100m", labels={"app": "f"}, affinity=pref))
+        else:
+            pods.append(make_pod(f"avoid-{i}", cpu="100m", labels={"app": "a"}, affinity=anti))
+    backend = assert_parity(pods, m, PriorityContext(m))
+    _assert_all_kernel(backend, 30)
+
+
+def test_parity_batch_symmetric_required_affinity_weight():
+    # a placed batch pod's REQUIRED affinity term scores symmetrically onto
+    # later matching pods via hard_pod_affinity_weight
+    rng = random.Random(24)
+    m = build_cluster(rng, 8, zones=2, existing_per_node=0)
+    req = Affinity(
+        pod_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "web"}),
+                topology_key=ZONE,
+            )
+        ]
+    )
+    pods = [make_pod("web-seed", cpu="100m", labels={"app": "web"})]
+    pods.append(make_pod("clingy", cpu="100m", labels={"app": "clingy"}, affinity=req))
+    pods += [make_pod(f"web-{i}", cpu="100m", labels={"app": "web"}) for i in range(10)]
+    pctx = PriorityContext(m, hard_pod_affinity_weight=40)
+    backend = assert_parity(pods, m, pctx)
+    _assert_all_kernel(backend, 12)
+
+
+def test_parity_volume_disk_conflict_and_limits():
+    from kubernetes_tpu.scheduler.predicates import VOLUME_COUNT_LIMITS
+
+    rng = random.Random(25)
+    m = build_cluster(rng, 8, zones=2, existing_per_node=0)
+    pods = []
+    for i in range(40):
+        if i % 4 == 0:
+            # exclusive EBS disk: two pods sharing an id conflict
+            pods.append(
+                make_pod(
+                    f"ebs-{i}", cpu="50m",
+                    volumes=[Volume(name="v", disk_id=f"ebs-{i % 6}", disk_kind="aws-ebs")],
+                )
+            )
+        elif i % 4 == 1:
+            # read-only gce-pd: sharable across pods
+            pods.append(
+                make_pod(
+                    f"pd-ro-{i}", cpu="50m",
+                    volumes=[Volume(name="v", disk_id="pd-shared", disk_kind="gce-pd", read_only=True)],
+                )
+            )
+        elif i % 4 == 2:
+            # writable gce-pd: NOT sharable
+            pods.append(
+                make_pod(
+                    f"pd-rw-{i}", cpu="50m",
+                    volumes=[Volume(name="v", disk_id=f"pd-rw-{i % 5}", disk_kind="gce-pd")],
+                )
+            )
+        else:
+            pods.append(make_pod(f"plain-{i}", cpu="100m", memory="128Mi"))
+    backend = assert_parity(pods, m, PriorityContext(m))
+    _assert_all_kernel(backend, 40)
+
+
+def test_parity_max_volume_count_enforced():
+    # one tiny node; azure-disk limit is 16: the 17th distinct disk pod must
+    # fail on both paths
+    m = {}
+    node = make_node("only", cpu="64", memory="128Gi", pods=110)
+    m["only"] = NodeInfo(node)
+    pods = [
+        make_pod(
+            f"az-{i}", cpu="10m",
+            volumes=[Volume(name="v", disk_id=f"az-{i}", disk_kind="azure-disk")],
+        )
+        for i in range(18)
+    ]
+    algo = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo)
+    got = backend.schedule_batch(pods, m, PriorityContext(m))
+    want = oracle_batch(pods, m, PriorityContext(m), GenericScheduler())
+    assert got == want
+    assert got.count(None) == 2  # 16 fit, 2 spill
+
+
+def test_parity_pvc_zone_and_node_affinity():
+    from kubernetes_tpu.api import PersistentVolume, PersistentVolumeClaim
+    from kubernetes_tpu.api.selectors import NodeSelector, NodeSelectorTerm, Requirement
+
+    rng = random.Random(26)
+    m = build_cluster(rng, 9, zones=3, existing_per_node=0)
+    names = sorted(m.keys())
+    pvs = {
+        "pv-z1": PersistentVolume(meta=ObjectMeta(name="pv-z1"), zone="zone-1", phase="Bound"),
+        "pv-local": PersistentVolume(
+            meta=ObjectMeta(name="pv-local"),
+            phase="Bound",
+            node_affinity=NodeSelector(
+                terms=[NodeSelectorTerm(match_expressions=[
+                    Requirement("kubernetes.io/hostname", "In", [names[4]])
+                ])]
+            ),
+        ),
+    }
+    pvcs = {
+        "default/claim-z1": PersistentVolumeClaim(
+            meta=ObjectMeta(name="claim-z1"), volume_name="pv-z1", phase="Bound"
+        ),
+        "default/claim-local": PersistentVolumeClaim(
+            meta=ObjectMeta(name="claim-local"), volume_name="pv-local", phase="Bound"
+        ),
+        "default/claim-unbound": PersistentVolumeClaim(meta=ObjectMeta(name="claim-unbound")),
+    }
+    pctx = PriorityContext(m, pvcs=pvcs, pvs=pvs)
+    pods = []
+    for i in range(24):
+        if i % 4 == 0:
+            pods.append(make_pod(f"zonal-{i}", cpu="50m",
+                                 volumes=[Volume(name="v", pvc_name="claim-z1")]))
+        elif i % 4 == 1:
+            pods.append(make_pod(f"local-{i}", cpu="50m",
+                                 volumes=[Volume(name="v", pvc_name="claim-local")]))
+        elif i % 4 == 2:
+            pods.append(make_pod(f"lost-{i}", cpu="50m",
+                                 volumes=[Volume(name="v", pvc_name="claim-unbound")]))
+        else:
+            pods.append(make_pod(f"plain-{i}", cpu="100m"))
+    algo = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo)
+    got = backend.schedule_batch(pods, m, pctx)
+    want = oracle_batch(pods, m, pctx, GenericScheduler())
+    assert got == want
+    # zonal pods in zone-1, local pods on names[4], unbound-claim pods fail
+    for pod, node in zip(pods, got):
+        if pod.meta.name.startswith("zonal"):
+            assert m[node].node.meta.labels[ZONE] == "zone-1"
+        elif pod.meta.name.startswith("local"):
+            assert node == names[4]
+        elif pod.meta.name.startswith("lost"):
+            assert node is None
+
+
+def test_parity_large_randomized_with_affinity_and_volumes():
+    # the honest mixed workload: ~20% affinity-bearing, ~10% volume-bearing
+    rng = random.Random(27)
+    m = build_cluster(rng, 40, zones=4, tainted_frac=0.1, existing_per_node=2)
+    svcs = [Service(meta=ObjectMeta(name=a), selector={"app": a}) for a in ("web", "db")]
+    pctx = PriorityContext(m, services=svcs)
+    soft = Affinity(
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=10,
+                term=PodAffinityTerm(
+                    selector=LabelSelector.from_match_labels({"app": "web"}),
+                    topology_key=ZONE,
+                ),
+            )
+        ]
+    )
+    anti = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "lonely"}),
+                topology_key="kubernetes.io/hostname",
+            )
+        ]
+    )
+    pods = []
+    for i in range(300):
+        r = rng.random()
+        if r < 0.1:
+            pods.append(make_pod(f"soft-{i}", cpu="100m", labels={"app": "web"}, affinity=soft))
+        elif r < 0.2:
+            pods.append(make_pod(f"lonely-{i}", cpu="100m", labels={"app": "lonely"}, affinity=anti))
+        elif r < 0.3:
+            pods.append(
+                make_pod(
+                    f"vol-{i}", cpu="100m",
+                    volumes=[Volume(name="v", disk_id=f"pd-{rng.randrange(30)}",
+                                    disk_kind=rng.choice(["gce-pd", "aws-ebs"]))],
+                )
+            )
+        else:
+            t = rng.choice([
+                dict(cpu="100m", memory="128Mi", labels={"app": "web"}),
+                dict(cpu="500m", memory="512Mi", labels={"app": "db"}),
+            ])
+            pods.append(make_pod(f"plain-{i}", **t))
+    backend = assert_parity(pods, m, pctx)
+    _assert_all_kernel(backend, 300)
