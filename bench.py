@@ -11,9 +11,16 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Presets:
   smoke  —   200 nodes ×   1k pods (fast sanity)
-  basic  —   500 nodes ×   2k pods (BASELINE.json configs[0], default)
+  basic  —   500 nodes ×   2k pods (BASELINE.json configs[0])
   dense  —  1000 nodes ×  10k pods
+  mixed  —  1000 nodes ×  10k pods, mixed workload (default: ~20% affinity
+            pods, ~10% volume pods, taints/zones/services — the honest
+            preset; the phase-B kernel keeps all of it on device)
   north  —  5000 nodes × 150k pods (the north-star scale)
+
+``--parity`` additionally runs the pure sequential CPU oracle over an
+identical cluster and asserts assignment-for-assignment equality (the
+"identical bindings" half of the north star), reporting it in the JSON.
 """
 
 from __future__ import annotations
@@ -26,46 +33,149 @@ import time
 
 
 PRESETS = {
-    "smoke": (200, 1_000),
-    "basic": (500, 2_000),
-    "dense": (1_000, 10_000),
-    "north": (5_000, 150_000),
+    "smoke": (200, 1_000, "plain"),
+    "basic": (500, 2_000, "plain"),
+    "dense": (1_000, 10_000, "plain"),
+    "mixed": (1_000, 10_000, "mixed"),
+    "north": (5_000, 150_000, "mixed"),
 }
 
+ZONE = "failure-domain.beta.kubernetes.io/zone"
 
-def build_cluster(clientset, n_nodes: int, rng: random.Random):
+
+def make_nodes(n_nodes: int, rng: random.Random, workload: str):
+    from kubernetes_tpu.api import Taint
     from kubernetes_tpu.testutil import make_node
 
+    nodes = []
     for i in range(n_nodes):
-        clientset.nodes.create(
+        labels = {
+            "kubernetes.io/hostname": f"node-{i:05d}",
+            ZONE: f"zone-{i % 3}",
+        }
+        taints = []
+        if workload == "mixed":
+            if rng.random() < 0.3:
+                labels["disk"] = rng.choice(["ssd", "hdd"])
+            if rng.random() < 0.1:
+                taints.append(Taint(key="dedicated", value="special", effect="NoSchedule"))
+        nodes.append(
             make_node(
                 f"node-{i:05d}",
                 cpu=rng.choice(["8", "16", "32"]),
                 memory=rng.choice(["16Gi", "32Gi", "64Gi"]),
                 pods=110,
-                labels={
-                    "kubernetes.io/hostname": f"node-{i:05d}",
-                    "failure-domain.beta.kubernetes.io/zone": f"zone-{i % 3}",
-                },
+                labels=labels,
+                taints=taints,
             )
         )
+    return nodes
 
 
-def make_pods(n_pods: int, rng: random.Random):
+def make_services():
+    from kubernetes_tpu.api import ObjectMeta, Service
+
+    return [
+        Service(meta=ObjectMeta(name=app), selector={"app": app})
+        for app in ("web", "api", "db")
+    ]
+
+
+def make_pods(n_pods: int, rng: random.Random, workload: str):
+    """Pending-pod flood.  ``plain``: 4 homogeneous RC-style templates.
+    ``mixed``: adds ~20% affinity-bearing pods (soft zone co-location +
+    required hostname anti-affinity — the reference's own hot spot,
+    predicates.go:982), ~10% disk-volume pods, node selectors, and
+    toleration-bearing pods for the tainted capacity."""
+    from kubernetes_tpu.api import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        Toleration,
+        Volume,
+        WeightedPodAffinityTerm,
+    )
     from kubernetes_tpu.testutil import make_pod
 
-    # RC-of-pods style flood (scheduler_perf creates pods via RCs): a few
-    # homogeneous templates, like real workloads
-    templates = [
+    plain_templates = [
         dict(cpu="100m", memory="128Mi", labels={"app": "web"}),
         dict(cpu="250m", memory="256Mi", labels={"app": "api"}),
         dict(cpu="500m", memory="512Mi", labels={"app": "db"}),
         dict(cpu="1", memory="1Gi", labels={"app": "batch"}),
     ]
-    return [make_pod(f"pod-{i:06d}", **templates[i % len(templates)]) for i in range(n_pods)]
+    if workload == "plain":
+        return [
+            make_pod(f"pod-{i:06d}", **plain_templates[i % len(plain_templates)])
+            for i in range(n_pods)
+        ]
+
+    soft = Affinity(
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=10,
+                term=PodAffinityTerm(
+                    selector=LabelSelector.from_match_labels({"app": "web"}),
+                    topology_key=ZONE,
+                ),
+            )
+        ]
+    )
+    anti = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "lonely"}),
+                topology_key="kubernetes.io/hostname",
+            )
+        ]
+    )
+    pods = []
+    for i in range(n_pods):
+        r = rng.random()
+        if r < 0.10:
+            pods.append(
+                make_pod(f"soft-{i:06d}", cpu="100m", memory="128Mi",
+                         labels={"app": "web"}, affinity=soft)
+            )
+        elif r < 0.20:
+            pods.append(
+                make_pod(f"lonely-{i:06d}", cpu="100m", memory="128Mi",
+                         labels={"app": "lonely"}, affinity=anti)
+            )
+        elif r < 0.30:
+            pods.append(
+                make_pod(
+                    f"vol-{i:06d}", cpu="100m", memory="128Mi", labels={"app": "api"},
+                    volumes=[Volume(name="v", disk_id=f"pd-{rng.randrange(2 * n_pods)}",
+                                    disk_kind=rng.choice(["gce-pd", "aws-ebs"]))],
+                )
+            )
+        elif r < 0.35:
+            pods.append(
+                make_pod(f"ssd-{i:06d}", cpu="250m", memory="256Mi",
+                         labels={"app": "db"}, node_selector={"disk": "ssd"})
+            )
+        elif r < 0.40:
+            pods.append(
+                make_pod(
+                    f"tol-{i:06d}", cpu="200m", memory="128Mi", labels={"app": "batch"},
+                    tolerations=[Toleration(key="dedicated", operator="Exists")],
+                )
+            )
+        else:
+            pods.append(
+                make_pod(f"pod-{i:06d}", **plain_templates[i % len(plain_templates)])
+            )
+    return pods
 
 
-def run_once(n_nodes: int, n_pods: int, use_backend: bool, seed: int = 0) -> dict:
+def run_once(
+    n_nodes: int,
+    n_pods: int,
+    use_backend: bool,
+    workload: str,
+    seed: int = 0,
+    emit_events: bool = False,
+) -> dict:
     from kubernetes_tpu.client import Clientset
     from kubernetes_tpu.ops import TPUBatchBackend
     from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
@@ -73,13 +183,17 @@ def run_once(n_nodes: int, n_pods: int, use_backend: bool, seed: int = 0) -> dic
 
     rng = random.Random(seed)
     cs = Clientset(Store(event_log_window=max(200_000, 2 * (n_nodes + n_pods))))
-    build_cluster(cs, n_nodes, rng)
-    for pod in make_pods(n_pods, rng):
+    for node in make_nodes(n_nodes, rng, workload):
+        cs.nodes.create(node)
+    if workload == "mixed":
+        for svc in make_services():
+            cs.services.create(svc)
+    for pod in make_pods(n_pods, rng, workload):
         cs.pods.create(pod)
 
     algo = GenericScheduler()
     backend = TPUBatchBackend(algorithm=algo) if use_backend else None
-    sched = Scheduler(cs, algorithm=algo, backend=backend, emit_events=False)
+    sched = Scheduler(cs, algorithm=algo, backend=backend, emit_events=emit_events)
     sched.start()
 
     start = time.perf_counter()
@@ -89,64 +203,119 @@ def run_once(n_nodes: int, n_pods: int, use_backend: bool, seed: int = 0) -> dic
         bound = sched.run_pending()
         failed = 0
     elapsed = time.perf_counter() - start
-    return {
+    result = {
         "bound": bound,
         "failed": failed,
         "elapsed_s": elapsed,
         "pods_per_sec": bound / elapsed if elapsed > 0 else 0.0,
     }
+    if use_backend:
+        result["backend_stats"] = dict(backend.stats)
+    # final pod→node assignment map, for parity comparison across runs
+    pods, _ = cs.pods.list()
+    result["assignments"] = {p.meta.key: p.spec.node_name or None for p in pods}
+    return result
+
+
+def run_parity(backend_res: dict, n_nodes: int, n_pods: int, workload: str, seed: int) -> dict:
+    """The north star's 'identical bindings' gate: the oracle runs over an
+    identical cluster (same seed) through the full store→bind path; its
+    assignment map must match the timed backend run key-for-key."""
+    oracle_res = run_once(n_nodes, n_pods, use_backend=False, workload=workload, seed=seed)
+    b, o = backend_res["assignments"], oracle_res["assignments"]
+    assert set(b) == set(o), "pod sets diverged"
+    mismatches = [(k, o[k], b[k]) for k in o if o[k] != b[k]]
+    return {
+        "checked": len(o),
+        "mismatches": len(mismatches),
+        "sample": mismatches[:5],
+        "oracle_pods_per_sec": round(oracle_res["pods_per_sec"], 1),
+        "backend_pods_per_sec": round(backend_res["pods_per_sec"], 1),
+    }
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--preset", choices=PRESETS, default="basic")
+    parser.add_argument("--preset", choices=PRESETS, default="mixed")
     parser.add_argument("--nodes", type=int, default=None)
     parser.add_argument("--pods", type=int, default=None)
+    parser.add_argument("--workload", choices=["plain", "mixed"], default=None)
+    parser.add_argument("--events", action="store_true",
+                        help="emit Scheduled/FailedScheduling events on the timed run")
     parser.add_argument("--oracle", action="store_true", help="bench the CPU oracle path instead")
+    parser.add_argument(
+        "--parity",
+        action="store_true",
+        help="also run the sequential oracle over an identical cluster and "
+        "assert identical bindings (reported in the JSON line)",
+    )
     parser.add_argument(
         "--compare", action="store_true", help="also run the oracle and report speedup to stderr"
     )
     args = parser.parse_args()
-    n_nodes, n_pods = PRESETS[args.preset]
+    n_nodes, n_pods, workload = PRESETS[args.preset]
     if args.nodes:
         n_nodes = args.nodes
     if args.pods:
         n_pods = args.pods
+    if args.workload:
+        workload = args.workload
 
-    # warm-up at the same shapes: triggers all XLA compilation so the timed
-    # run measures steady-state throughput (first TPU compile is ~20-40s)
+    # warm-up at the same scale (different seed): triggers XLA compilation of
+    # every segment-shape bucket the timed run will hit, so the timed run
+    # measures steady-state throughput (first TPU compile is ~5s per bucket)
     if not args.oracle:
-        run_once(n_nodes, n_pods, use_backend=True, seed=1)
+        run_once(n_nodes, n_pods, use_backend=True, workload=workload, seed=1)
 
-    result = run_once(n_nodes, n_pods, use_backend=not args.oracle, seed=0)
+    result = run_once(
+        n_nodes, n_pods, use_backend=not args.oracle, workload=workload,
+        seed=0, emit_events=args.events,
+    )
     if result["bound"] == 0:
         print(json.dumps({"metric": "pods-scheduled/sec", "value": 0, "unit": "pods/s", "vs_baseline": 0}))
         sys.exit(1)
 
+    parity = None
+    if args.parity:
+        parity = run_parity(result, n_nodes, n_pods, workload, seed=0)
+        print(
+            f"# parity: {parity['checked']} pods checked, "
+            f"{parity['mismatches']} mismatches "
+            f"(oracle {parity['oracle_pods_per_sec']} pods/s)",
+            file=sys.stderr,
+        )
+
     if args.compare:
-        oracle = run_once(n_nodes, min(n_pods, 2_000), use_backend=False, seed=0)
+        oracle = run_once(
+            n_nodes, min(n_pods, 2_000), use_backend=False, workload=workload, seed=0
+        )
         print(
             f"# oracle: {oracle['pods_per_sec']:.1f} pods/s on {min(n_pods, 2000)} pods; "
             f"backend speedup {result['pods_per_sec'] / max(oracle['pods_per_sec'], 1e-9):.1f}x",
             file=sys.stderr,
         )
 
+    stats = result.get("backend_stats", {})
     print(
-        f"# {args.preset}: {result['bound']} bound / {result['failed']} failed "
-        f"in {result['elapsed_s']:.2f}s on {n_nodes} nodes",
+        f"# {args.preset}[{workload}]: {result['bound']} bound / {result['failed']} failed "
+        f"in {result['elapsed_s']:.2f}s on {n_nodes} nodes "
+        f"(kernel={stats.get('kernel_pods', 0)} oracle={stats.get('oracle_pods', 0)} "
+        f"segments={stats.get('segments', 0)} events={'on' if args.events else 'off'})",
         file=sys.stderr,
     )
     # baseline: the reference harness's expected throughput (100 pods/s)
-    print(
-        json.dumps(
-            {
-                "metric": "pods-scheduled/sec",
-                "value": round(result["pods_per_sec"], 1),
-                "unit": "pods/s",
-                "vs_baseline": round(result["pods_per_sec"] / 100.0, 2),
-            }
-        )
-    )
+    line = {
+        "metric": "pods-scheduled/sec",
+        "value": round(result["pods_per_sec"], 1),
+        "unit": "pods/s",
+        "vs_baseline": round(result["pods_per_sec"] / 100.0, 2),
+    }
+    if parity is not None:
+        line["parity_checked"] = parity["checked"]
+        line["parity_mismatches"] = parity["mismatches"]
+    print(json.dumps(line))
+    if parity is not None and parity["mismatches"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -73,7 +73,12 @@ def pod_signature_key(pod: api.Pod) -> str:
         "nodeName": pod.spec.node_name,
         "affinity": pod.spec.affinity.to_dict() if pod.spec.affinity else None,
         "tolerations": [t.to_dict() for t in pod.spec.tolerations],
-        "volumes": [v.to_dict() for v in pod.spec.volumes],
+        # direct-disk volumes are deliberately EXCLUDED: their identity lives
+        # on the per-pod volume-slot axis (pod_vol_ids), not the signature
+        # axis — otherwise every distinct disk id would mint a new signature
+        # and G would grow with the batch.  PVC-backed and other volumes stay
+        # in the key (their constraints fold into the static [G, N] masks).
+        "volumes": [v.to_dict() for v in pod.spec.volumes if not v.disk_id],
         "owner": (ref.kind, ref.uid) if ref else None,
         "containers": [
             (
@@ -85,6 +90,30 @@ def pod_signature_key(pod: api.Pod) -> str:
         ],
     }
     return json.dumps(parts, sort_keys=True, default=str)
+
+
+def count_affinity_terms(pod: api.Pod) -> int:
+    """Number of (anti)affinity term rows this pod contributes to the [T, G]
+    tables (empty-topology-key terms never become rows).  Shared by the
+    build_static budget probe and the backend's segmenter so both always
+    agree on what fits."""
+    a = pod.spec.affinity
+    if a is None:
+        return 0
+    return (
+        sum(1 for t in a.pod_affinity_required if t.topology_key)
+        + sum(1 for t in a.pod_anti_affinity_required if t.topology_key)
+        + sum(1 for wt in a.pod_affinity_preferred if wt.term.topology_key)
+        + sum(1 for wt in a.pod_anti_affinity_preferred if wt.term.topology_key)
+    )
+
+
+def pod_disk_vols(pod: api.Pod) -> set:
+    """Distinct (disk_kind, disk_id) identities the pod references — the
+    per-pod volume-slot budget unit (same sharing contract as above)."""
+    if not pod.spec.volumes:
+        return set()
+    return {(v.disk_kind, v.disk_id) for v in pod.spec.volumes if v.disk_id}
 
 
 @dataclass
@@ -144,7 +173,6 @@ class BatchStatic:
     # T >= 1 (padded with an inert term when the batch carries none)
     terms: "list[_AffinityTerm]" = field(default_factory=list)
     term_matches_sig: np.ndarray = None  # [T, G] bool: sig-g pod in term t's scope
-    term_owner: np.ndarray = None  # [T] int32
     sym_w: np.ndarray = None  # [T] int32 symmetry scoring weight
     own_w: np.ndarray = None  # [G, T] int32 own soft-term weight (PA +w / PAA -w)
     own_ra: np.ndarray = None  # [G, T] bool own required-affinity terms
@@ -157,14 +185,20 @@ class BatchStatic:
     num_domains: int = 1  # D_total + 1 (last slot = trash)
 
     # -- phase B: volumes on device ----------------------------------------
-    # V >= 1 (padded); volume identity = (disk_kind, disk_id)
+    # Per-POD slot lists: each pod references <= W distinct (kind, id) disks;
+    # slot s holds an index into the [V, N] dynamic occupancy arrays
+    # (sentinel = v_state-1, an always-empty row for unused slots).  Keeping
+    # volume identity off the signature axis keeps G independent of how many
+    # distinct disks the batch carries, and makes the per-step device cost
+    # O(W·N) instead of O(V·N).
     vol_vocab: list = field(default_factory=list)
-    g_vols: np.ndarray = None  # [G, V] bool sig references volume
-    g_ro_ok: np.ndarray = None  # [G, V] bool all refs read-only AND kind sharable
-    g_vol_ns: np.ndarray = None  # [G, V] bool placing sig makes vol non-sharable
-    kind_onehot: np.ndarray = None  # [K, V] int32
-    g_has_kind: np.ndarray = None  # [G, K] bool sig has >=1 vol of limited kind
+    v_state: int = 1  # padded row count of the dynamic [V, N] arrays
+    pod_vol_ids: np.ndarray = None  # [P, W] int32 (sentinel for unused slots)
+    pod_vol_valid: np.ndarray = None  # [P, W] bool
+    pod_vol_ro_ok: np.ndarray = None  # [P, W] bool (all refs ro AND kind sharable)
+    pod_vol_kind: np.ndarray = None  # [P, W] int32 (K = kind without a count limit)
     vol_limits: np.ndarray = None  # [K] int32
+    trash_slot: int = 0  # domain trash index (pre-padding)
 
     # scoring mode flags
     weights: dict = field(default_factory=dict)
@@ -201,12 +235,27 @@ class Tensorizer:
         pad_multiple: int = 128,
         max_groups: int = 512,
         max_terms: int = 128,
-        max_vols: int = 256,
+        max_vols: int = 1024,
+        vols_per_pod: int = 8,
+        group_multiple: int = 32,
+        term_multiple: int = 16,
+        vol_multiple: int = 256,
+        domain_multiple: int = 512,
+        port_multiple: int = 8,
     ):
+        # Every shape-determining axis is padded to a bucket multiple so XLA
+        # compiles ONE kernel per bucket combination instead of one per
+        # batch (SURVEY.md §7.4 hard part 2: dynamic shapes vs static XLA).
         self.pad_multiple = pad_multiple
         self.max_groups = max_groups
         self.max_terms = max_terms
         self.max_vols = max_vols
+        self.vols_per_pod = vols_per_pod
+        self.group_multiple = group_multiple
+        self.term_multiple = term_multiple
+        self.vol_multiple = vol_multiple
+        self.domain_multiple = domain_multiple
+        self.port_multiple = port_multiple
 
     # -- static ------------------------------------------------------------
     def build_static(
@@ -248,20 +297,15 @@ class Tensorizer:
         G = len(reps)
 
         # cheap tensor-budget probes BEFORE the expensive [G, N] loops: the
-        # backend's binary-split fallback re-tensorizes each half, so an
+        # backend's split fallback re-tensorizes each piece, so an
         # over-budget segment must be rejected for near-free
-        n_terms = 0
+        n_terms = sum(count_affinity_terms(rep) for rep in reps)
         vol_count: set[tuple[str, str]] = set()
-        for rep in reps:
-            a = rep.spec.affinity
-            if a is not None:
-                n_terms += sum(1 for t in a.pod_affinity_required if t.topology_key)
-                n_terms += sum(1 for t in a.pod_anti_affinity_required if t.topology_key)
-                n_terms += sum(1 for wt in a.pod_affinity_preferred if wt.term.topology_key)
-                n_terms += sum(1 for wt in a.pod_anti_affinity_preferred if wt.term.topology_key)
-            for vol in rep.spec.volumes:
-                if vol.disk_id:
-                    vol_count.add((vol.disk_kind, vol.disk_id))
+        for pod in pods:
+            per_pod = pod_disk_vols(pod)
+            if len(per_pod) > self.vols_per_pod:
+                return None  # caller falls back to oracle for this pod
+            vol_count |= per_pod
         if n_terms > self.max_terms or len(vol_count) > self.max_vols:
             return None
 
@@ -288,7 +332,7 @@ class Tensorizer:
             for port in rep.host_ports():
                 if port not in port_vocab:
                     port_vocab[port] = len(port_vocab)
-        pv = max(len(port_vocab), 1)
+        pv = _pad_to(len(port_vocab), self.port_multiple)
         g_ports = np.zeros((G, pv), dtype=bool)
         for g, rep in enumerate(reps):
             for port in rep.host_ports():
@@ -473,10 +517,9 @@ class Tensorizer:
             for wt in a.pod_anti_affinity_preferred:
                 if wt.term.topology_key:
                     terms.append(_AffinityTerm(g, "PAA", -wt.weight, wt.term))
-        T = max(len(terms), 1)
+        T = _pad_to(len(terms), self.term_multiple)  # padded rows stay inert
 
         term_matches_sig = np.zeros((T, G), dtype=bool)
-        term_owner = np.zeros(T, dtype=np.int32)
         sym_w = np.zeros(T, dtype=np.int32)
         own_w = np.zeros((G, T), dtype=np.int32)
         own_ra = np.zeros((G, T), dtype=bool)
@@ -486,7 +529,6 @@ class Tensorizer:
         self_match = np.zeros(T, dtype=bool)
         for t, at in enumerate(terms):
             owner_rep = reps[at.owner]
-            term_owner[t] = at.owner
             own_all[at.owner, t] = True
             for g, rep in enumerate(reps):
                 term_matches_sig[t, g] = _pod_matches_term(rep, owner_rep, at.term)
@@ -530,38 +572,40 @@ class Tensorizer:
         if not terms:
             dom_valid[:] = False
             node_domain[:] = trash
-        num_domains = trash + 1
+        num_domains = 8 if not terms else _pad_to(trash + 1, self.domain_multiple)
 
-        # -- phase B: volumes ----------------------------------------------
-        vol_vocab: dict[tuple[str, str], int] = {}
-        for rep in reps:
-            for vol in rep.spec.volumes:
-                if vol.disk_id:
-                    vol_vocab.setdefault((vol.disk_kind, vol.disk_id), len(vol_vocab))
-        V = max(len(vol_vocab), 1)
+        # -- phase B: volumes (per-pod slot lists) --------------------------
+        # Volume identity lives on the pod axis, not the signature axis:
+        # each pod gets <= W slots pointing into the [V, N] occupancy arrays.
         K = len(_VOL_KINDS)
-        g_vols = np.zeros((G, V), dtype=bool)
-        g_all_ro = np.ones((G, V), dtype=bool)
-        sharable = np.zeros(V, dtype=bool)
-        vol_kind_row = np.full(V, -1, dtype=np.int32)
-        for (kind, _id), v in vol_vocab.items():
-            sharable[v] = kind in _READONLY_SHARED_KINDS
-            if kind in VOLUME_COUNT_LIMITS:
-                vol_kind_row[v] = _VOL_KINDS.index(kind)
-        for g, rep in enumerate(reps):
-            for vol in rep.spec.volumes:
+        W = self.vols_per_pod
+        P = len(pods)
+        vol_vocab: dict[tuple[str, str], int] = {}
+        pod_vol_ids = np.zeros((P, W), dtype=np.int32)
+        pod_vol_valid = np.zeros((P, W), dtype=bool)
+        pod_vol_ro_ok = np.zeros((P, W), dtype=bool)
+        pod_vol_kind = np.zeros((P, W), dtype=np.int32)
+        for i, pod in enumerate(pods):
+            if not pod.spec.volumes:
+                continue
+            per_pod: dict[tuple[str, str], bool] = {}  # all-refs-read-only
+            for vol in pod.spec.volumes:
                 if not vol.disk_id:
                     continue
-                v = vol_vocab[(vol.disk_kind, vol.disk_id)]
-                g_vols[g, v] = True
-                g_all_ro[g, v] &= vol.read_only
-        g_ro_ok = g_vols & sharable[None, :] & g_all_ro
-        g_vol_ns = g_vols & ~g_ro_ok
-        kind_onehot = np.zeros((K, V), dtype=np.int32)
-        for v in range(V):
-            if vol_kind_row[v] >= 0:
-                kind_onehot[vol_kind_row[v], v] = 1
-        g_has_kind = (g_vols.astype(np.int32) @ kind_onehot.T) > 0  # [G, K]
+                key = (vol.disk_kind, vol.disk_id)
+                per_pod[key] = per_pod.get(key, True) and vol.read_only
+            for s, (key, all_ro) in enumerate(per_pod.items()):
+                v = vol_vocab.setdefault(key, len(vol_vocab))
+                pod_vol_ids[i, s] = v
+                pod_vol_valid[i, s] = True
+                pod_vol_ro_ok[i, s] = all_ro and key[0] in _READONLY_SHARED_KINDS
+                pod_vol_kind[i, s] = (
+                    _VOL_KINDS.index(key[0]) if key[0] in VOLUME_COUNT_LIMITS else K
+                )
+        # volume-less segments keep a tiny (never-touched) state footprint;
+        # the kernel's use_vols flag skips the volume logic entirely
+        v_state = 8 if not vol_vocab else _pad_to(len(vol_vocab) + 1, self.vol_multiple)
+        pod_vol_ids[~pod_vol_valid] = v_state - 1  # sentinel: always-empty row
         vol_limits = np.array([VOLUME_COUNT_LIMITS[k] for k in _VOL_KINDS], dtype=np.int32)
 
         # PVC-backed volumes: zone / PV-node-affinity constraints are static
@@ -614,6 +658,28 @@ class Tensorizer:
                 if ssp._matches_any(g_selectors[g], reps[h]):
                     spread_inc[g, h] = 1
 
+        # -- bucket-pad the signature axis ----------------------------------
+        # Padded rows are never referenced (group_of_pod < G) but keep the
+        # compiled kernel's shapes stable across batches.
+        Gp = _pad_to(G, self.group_multiple)
+        if Gp != G:
+            pad_g = Gp - G
+            static_ok = np.pad(static_ok, ((0, pad_g), (0, 0)))
+            node_aff_raw = np.pad(node_aff_raw, ((0, pad_g), (0, 0)))
+            taint_intol_raw = np.pad(taint_intol_raw, ((0, pad_g), (0, 0)))
+            static_score = np.pad(static_score, ((0, pad_g), (0, 0)))
+            interpod_raw = np.pad(interpod_raw, ((0, pad_g), (0, 0)))
+            g_request = np.pad(g_request, ((0, pad_g), (0, 0)))
+            g_nonzero = np.pad(g_nonzero, ((0, pad_g), (0, 0)))
+            g_ports = np.pad(g_ports, ((0, pad_g), (0, 0)))
+            g_has_spread = np.pad(g_has_spread, (0, pad_g))
+            spread_inc = np.pad(spread_inc, ((0, pad_g), (0, pad_g)))
+            term_matches_sig = np.pad(term_matches_sig, ((0, 0), (0, pad_g)))
+            own_w = np.pad(own_w, ((0, pad_g), (0, 0)))
+            own_ra = np.pad(own_ra, ((0, pad_g), (0, 0)))
+            own_raa = np.pad(own_raa, ((0, pad_g), (0, 0)))
+            own_all = np.pad(own_all, ((0, pad_g), (0, 0)))
+
         return BatchStatic(
             node_names=node_names,
             n_pad=n_pad,
@@ -637,7 +703,6 @@ class Tensorizer:
             interpod_raw=interpod_raw,
             terms=terms,
             term_matches_sig=term_matches_sig,
-            term_owner=term_owner,
             sym_w=sym_w,
             own_w=own_w,
             own_ra=own_ra,
@@ -648,12 +713,13 @@ class Tensorizer:
             node_domain=node_domain,
             dom_valid=dom_valid,
             num_domains=num_domains,
+            trash_slot=trash,
             vol_vocab=list(vol_vocab),
-            g_vols=g_vols,
-            g_ro_ok=g_ro_ok,
-            g_vol_ns=g_vol_ns,
-            kind_onehot=kind_onehot,
-            g_has_kind=g_has_kind,
+            v_state=v_state,
+            pod_vol_ids=pod_vol_ids,
+            pod_vol_valid=pod_vol_valid,
+            pod_vol_ro_ok=pod_vol_ro_ok,
+            pod_vol_kind=pod_vol_kind,
             vol_limits=vol_limits,
             weights={
                 "least": least_requested_weight,
@@ -680,7 +746,7 @@ class Tensorizer:
         requested = np.zeros((n_pad, NUM_RESOURCES), dtype=np.int32)
         nonzero = np.zeros((n_pad, 2), dtype=np.int32)
         pod_count = np.zeros(n_pad, dtype=np.int32)
-        ports_used = np.zeros((n_pad, max(len(static.port_vocab), 1)), dtype=bool)
+        ports_used = np.zeros((n_pad, static.g_ports.shape[1]), dtype=bool)
         port_idx = {p: i for i, p in enumerate(static.port_vocab)}
         spread_counts = np.zeros((G, n_pad), dtype=np.int32)
 
@@ -762,13 +828,13 @@ class Tensorizer:
                         total_match[t] = int(hits.sum())
                         np.add.at(dom_match, static.node_domain[t, node_j[hits]], 1)
             eng.close()
-        dom_match[static.num_domains - 1] = 0  # trash slot stays clean
+        dom_match[static.trash_slot] = 0  # trash slot stays clean
 
         # volume occupancy from existing pods: instance presence and
         # non-sharable presence per batch-vocab volume, plus distinct
         # limited-kind disk counts per node (NoDiskConflict /
         # MaxVolumeCount dynamic state)
-        V = static.g_vols.shape[1]
+        V = static.v_state
         K = len(_VOL_KINDS)
         vol_idx = {key: v for v, key in enumerate(static.vol_vocab)}
         vol_any = np.zeros((V, n_pad), dtype=bool)
@@ -778,6 +844,8 @@ class Tensorizer:
         for j, name in enumerate(static.node_names):
             seen: dict[str, set] = {}
             for q in node_info_map[name].pods:
+                if not q.spec.volumes:
+                    continue
                 for vol in q.spec.volumes:
                     if not vol.disk_id:
                         continue

@@ -9,9 +9,13 @@ the sequential-greedy decision sequence a pure-oracle run produces.
 
 Fallback ladder (every rung preserves parity):
 1. unsupported predicate/priority/extender config → all-oracle;
-2. segment exceeds a tensor budget (max_groups signatures / max_terms
-   affinity terms / max_vols distinct disks) → binary split, each half
-   re-tensorized against the evolving state; single-pod leaves → oracle.
+2. one ordered greedy pass cuts the batch into segments that respect the
+   tensor budgets (max_groups signatures / max_terms affinity terms /
+   max_vols distinct disks / max_segment_pods scan length), each segment
+   re-tensorized against the evolving state;
+3. pods no kernel can express (> vols_per_pod distinct disks) run as
+   singleton oracle segments; a binary split inside run_kernel_segment
+   remains as a safety net should build_static still reject a segment.
 """
 
 from __future__ import annotations
@@ -36,7 +40,12 @@ from ..scheduler.priorities import (
     SelectorSpreadPriority,
     TaintTolerationPriority,
 )
-from ..models.snapshot import Tensorizer
+from ..models.snapshot import (
+    Tensorizer,
+    count_affinity_terms,
+    pod_disk_vols,
+    pod_signature_key,
+)
 from .batch_kernel import schedule_batch_arrays
 
 logger = logging.getLogger("kubernetes_tpu.backend")
@@ -55,10 +64,59 @@ _PRIORITY_WEIGHT_KEY = {
 
 
 class TPUBatchBackend:
-    def __init__(self, algorithm: Optional[GenericScheduler] = None, tensorizer: Optional[Tensorizer] = None):
+    def __init__(
+        self,
+        algorithm: Optional[GenericScheduler] = None,
+        tensorizer: Optional[Tensorizer] = None,
+        max_segment_pods: int = 4096,  # power of two = one scan-length bucket
+    ):
         self.algorithm = algorithm or GenericScheduler()
         self.tensorizer = tensorizer or Tensorizer()
+        self.max_segment_pods = max_segment_pods
         self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0}
+
+    # -- greedy segmentation ------------------------------------------------
+    def _segments(self, pods: list[api.Pod]) -> list[tuple[str, list[tuple[int, api.Pod]]]]:
+        """Split the (ordered) batch into kernel segments that respect the
+        tensor budgets, walking pod order once — every cut point preserves
+        sequential-greedy parity because each segment re-tensorizes against
+        the state left by its predecessors.  Pods no kernel can express
+        (> vols_per_pod distinct disks) become singleton oracle segments."""
+        tz = self.tensorizer
+        out: list[tuple[str, list[tuple[int, api.Pod]]]] = []
+        cur: list[tuple[int, api.Pod]] = []
+        sigs: set[str] = set()
+        vols: set = set()
+        n_terms = 0
+
+        def flush() -> None:
+            nonlocal cur, sigs, vols, n_terms
+            if cur:
+                out.append(("kernel", cur))
+            cur, sigs, vols, n_terms = [], set(), set(), 0
+
+        for i, pod in enumerate(pods):
+            pv = pod_disk_vols(pod)
+            if len(pv) > tz.vols_per_pod:
+                flush()
+                out.append(("oracle", [(i, pod)]))
+                continue
+            key = pod_signature_key(pod)
+            t_new = count_affinity_terms(pod) if key not in sigs else 0
+            if cur and (
+                len(cur) >= self.max_segment_pods
+                or (key not in sigs and len(sigs) >= tz.max_groups)
+                or n_terms + t_new > tz.max_terms
+                or len(vols | pv) > tz.max_vols
+            ):
+                flush()
+                t_new = count_affinity_terms(pod)
+            sigs.add(key)
+            n_terms += t_new
+            vols |= pv
+            cur.append((i, pod))
+        flush()
+        return out
 
     # -- config support check ---------------------------------------------
     def _kernel_weights(self) -> Optional[dict]:
@@ -172,7 +230,14 @@ class TPUBatchBackend:
             return assignments
 
         # Phase B: every pod is kernel-expressible (inter-pod affinity and
-        # volumes run on device); the whole batch is one segment, recursively
-        # split only on tensor-budget overflow.
-        run_kernel_segment(list(enumerate(pods)))
+        # volumes run on device).  One ordered pass cuts the batch into
+        # budget-respecting segments up front (no trial-and-error splits);
+        # the binary split inside run_kernel_segment remains only as a
+        # safety net should build_static still reject a segment.
+        for kind, segment in self._segments(pods):
+            if kind == "oracle":
+                for i, pod in segment:
+                    run_oracle(pod, i)
+            else:
+                run_kernel_segment(segment)
         return assignments

@@ -80,12 +80,7 @@ class StaticArrays(NamedTuple):
     self_match: jnp.ndarray  # [T] bool
     node_domain: jnp.ndarray  # [T, N] int32 (trash slot id where key absent)
     dom_valid: jnp.ndarray  # [T, N] bool
-    # phase B: volumes
-    g_vols: jnp.ndarray  # [G, V] bool
-    g_ro_ok: jnp.ndarray  # [G, V] bool
-    g_vol_ns: jnp.ndarray  # [G, V] bool
-    kind_onehot: jnp.ndarray  # [K, V] int32
-    g_has_kind: jnp.ndarray  # [G, K] bool
+    # phase B: volumes (identity rides the per-pod xs slots, not here)
     vol_limits: jnp.ndarray  # [K] int32
 
 
@@ -115,12 +110,40 @@ def to_device(static: BatchStatic) -> StaticArrays:
         self_match=jnp.asarray(static.self_match),
         node_domain=jnp.asarray(static.node_domain),
         dom_valid=jnp.asarray(static.dom_valid),
-        g_vols=jnp.asarray(static.g_vols),
-        g_ro_ok=jnp.asarray(static.g_ro_ok),
-        g_vol_ns=jnp.asarray(static.g_vol_ns),
-        kind_onehot=jnp.asarray(static.kind_onehot),
-        g_has_kind=jnp.asarray(static.g_has_kind),
         vol_limits=jnp.asarray(static.vol_limits),
+    )
+
+
+def batch_xs(static: BatchStatic, min_length: int = 512):
+    """Per-pod scan inputs, padded to a power-of-two bucket length so the
+    scan's trip count (and therefore the compiled executable) is stable
+    across batches: with the backend's max_segment_pods also a power of
+    two, every full segment and every tail lands in the same bucket.
+    Padded entries carry valid=False and are inert in the step."""
+    p_real = len(static.group_of_pod)
+    p_pad = max(min_length, 1)
+    while p_pad < p_real:
+        p_pad *= 2
+    w = static.pod_vol_ids.shape[1]
+    gids = np.zeros(p_pad, dtype=np.int32)
+    gids[:p_real] = static.group_of_pod
+    pvalid = np.zeros(p_pad, dtype=bool)
+    pvalid[:p_real] = True
+    vids = np.full((p_pad, w), static.v_state - 1, dtype=np.int32)
+    vids[:p_real] = static.pod_vol_ids
+    vval = np.zeros((p_pad, w), dtype=bool)
+    vval[:p_real] = static.pod_vol_valid
+    vro = np.zeros((p_pad, w), dtype=bool)
+    vro[:p_real] = static.pod_vol_ro_ok
+    vkind = np.zeros((p_pad, w), dtype=np.int32)
+    vkind[:p_real] = static.pod_vol_kind
+    return (
+        jnp.asarray(gids),
+        jnp.asarray(pvalid),
+        jnp.asarray(vids),
+        jnp.asarray(vval),
+        jnp.asarray(vro),
+        jnp.asarray(vkind),
     )
 
 
@@ -175,10 +198,20 @@ def _normalized_max(raw, feasible, reverse: bool):
     return jnp.where(max_c > 0, (MAX_PRIORITY * raw) // jnp.maximum(max_c, 1), 0)
 
 
-def make_step(dev: StaticArrays, num_zones: int, w: dict):
-    """Builds the scan step: (state, group_id) -> (state', chosen_node)."""
+def make_step(
+    dev: StaticArrays, num_zones: int, w: dict, use_terms: bool = True, use_vols: bool = True
+):
+    """Builds the scan step: (state, xs) -> (state', chosen_node).
 
-    def step(state: ScanState, gid):
+    ``use_terms`` / ``use_vols`` are compile-time flags (part of the cached
+    runner key): segments whose batch carries no (anti)affinity terms or no
+    direct-disk volumes skip those blocks entirely instead of paying the
+    gather/scatter cost on inert state every step."""
+
+    def step(state: ScanState, xs):
+        # per-pod inputs: signature id, validity (False = scan-length
+        # padding), and the pod's volume slots
+        gid, pvalid, vol_ids, vol_valid, vol_ro_ok, vol_kind = xs
         g_req = dev.g_request[gid]  # [R]
         g_nz = dev.g_nonzero[gid]  # [2]
         g_ports = dev.g_ports[gid]  # [Pv]
@@ -190,46 +223,46 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
         pods_ok = state.pod_count + 1 <= dev.node_alloc_pods
         ports_ok = ~jnp.any(state.ports_used & g_ports, axis=1)
 
-        # inter-pod affinity vs ALREADY-PLACED batch pods (the static_ok
-        # mask covers existing pods; these domain counters cover the scan
-        # carry — the batch generalization of the oracle's work_map feedback)
-        m_g = dev.term_matches_sig[:, gid]  # [T] bool: this pod in term t's scope
-        dm = state.dom_match[dev.node_domain] * dev.dom_valid  # [T, N] int32
-        downer = state.dom_owner[dev.node_domain] * dev.dom_valid  # [T, N]
-        # symmetry: placed pods' required anti-affinity forbids their domains
-        # for matching candidates (predicates.go:1146)
-        sym_anti_bad = jnp.any((m_g & dev.is_raa)[:, None] & (downer > 0), axis=0)
-        # the pod's own required affinity: some matching pod in-domain, or
-        # the first-pod rule (no matching pod anywhere + self-match,
-        # predicates.go:1196-1216)
-        first_ok = (state.total_match == 0) & dev.self_match  # [T]
-        ra_ok = (dm > 0) | first_ok[:, None]  # [T, N]
-        own_ra_bad = jnp.any(dev.own_ra[gid][:, None] & ~ra_ok, axis=0)
-        # the pod's own required anti-affinity: no matching pod in-domain
-        own_raa_bad = jnp.any(dev.own_raa[gid][:, None] & (dm > 0), axis=0)
+        feasible = dev.static_ok[gid] & fit & pods_ok & ports_ok & dev.node_exists
 
-        # volumes: NoDiskConflict + MaxVolumeCount against placed state
-        gv = dev.g_vols[gid]  # [V] bool
-        blocked = jnp.where(dev.g_ro_ok[gid][:, None], state.vol_ns, state.vol_any)
-        disk_bad = jnp.any(gv[:, None] & blocked, axis=0)
-        new_v = (gv[:, None] & ~state.vol_any).astype(jnp.int32)  # [V, N]
-        count_new = dev.kind_onehot @ new_v  # [K, N]
-        over = dev.g_has_kind[gid][:, None] & (
-            state.nk + count_new > dev.vol_limits[:, None]
-        )
-        vol_bad = disk_bad | jnp.any(over, axis=0)
+        if use_terms:
+            # inter-pod affinity vs ALREADY-PLACED batch pods (the static_ok
+            # mask covers existing pods; these domain counters cover the scan
+            # carry — the batch generalization of the oracle's work_map feedback)
+            m_g = dev.term_matches_sig[:, gid]  # [T] bool: pod in term t's scope
+            dm = state.dom_match[dev.node_domain] * dev.dom_valid  # [T, N] int32
+            downer = state.dom_owner[dev.node_domain] * dev.dom_valid  # [T, N]
+            # symmetry: placed pods' required anti-affinity forbids their
+            # domains for matching candidates (predicates.go:1146)
+            sym_anti_bad = jnp.any((m_g & dev.is_raa)[:, None] & (downer > 0), axis=0)
+            # the pod's own required affinity: some matching pod in-domain, or
+            # the first-pod rule (no matching pod anywhere + self-match,
+            # predicates.go:1196-1216)
+            first_ok = (state.total_match == 0) & dev.self_match  # [T]
+            ra_ok = (dm > 0) | first_ok[:, None]  # [T, N]
+            own_ra_bad = jnp.any(dev.own_ra[gid][:, None] & ~ra_ok, axis=0)
+            # the pod's own required anti-affinity: no matching pod in-domain
+            own_raa_bad = jnp.any(dev.own_raa[gid][:, None] & (dm > 0), axis=0)
+            feasible = feasible & ~sym_anti_bad & ~own_ra_bad & ~own_raa_bad
 
-        feasible = (
-            dev.static_ok[gid]
-            & fit
-            & pods_ok
-            & ports_ok
-            & dev.node_exists
-            & ~sym_anti_bad
-            & ~own_ra_bad
-            & ~own_raa_bad
-            & ~vol_bad
-        )
+        if use_vols:
+            # volumes: NoDiskConflict + MaxVolumeCount against placed state.
+            # Only the pod's own <= W slots are touched: gather their [W, N]
+            # occupancy rows instead of sweeping the whole [V, N] state.
+            rows_any = state.vol_any[vol_ids]  # [W, N]
+            rows_ns = state.vol_ns[vol_ids]  # [W, N]
+            blocked = jnp.where(vol_ro_ok[:, None], rows_ns, rows_any)
+            disk_bad = jnp.any(vol_valid[:, None] & blocked, axis=0)
+            new_v = vol_valid[:, None] & ~rows_any  # [W, N] would-be-new instance
+            k_range = jnp.arange(dev.vol_limits.shape[0], dtype=jnp.int32)
+            k_onehot = (
+                (k_range[:, None] == vol_kind[None, :]) & vol_valid[None, :]
+            ).astype(jnp.int32)  # [K, W]
+            count_new = k_onehot @ new_v.astype(jnp.int32)  # [K, N]
+            has_kind = jnp.any(k_onehot > 0, axis=1)  # [K]
+            over = has_kind[:, None] & (state.nk + count_new > dev.vol_limits[:, None])
+            vol_bad = disk_bad | jnp.any(over, axis=0)
+            feasible = feasible & ~vol_bad
         n_feasible = jnp.sum(feasible.astype(jnp.int32))
 
         # -- scores (priorities) --------------------------------------
@@ -285,11 +318,9 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
             # own soft terms against all matching pods in-domain, and placed
             # batch owners' symmetric terms against this pod
             # (interpod_affinity.go:160-186)
-            raw = (
-                dev.interpod_raw[gid]
-                + dev.own_w[gid] @ dm
-                + (m_g.astype(jnp.int32) * dev.sym_w) @ downer
-            )
+            raw = dev.interpod_raw[gid]
+            if use_terms:
+                raw = raw + dev.own_w[gid] @ dm + (m_g.astype(jnp.int32) * dev.sym_w) @ downer
             max_c = jnp.maximum(0, jnp.max(jnp.where(feasible, raw, INT32_MIN)))
             min_c = jnp.minimum(0, jnp.min(jnp.where(feasible, raw, INT32_MAX)))
             rng = max_c - min_c
@@ -306,26 +337,46 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
         pick_among_ties = jnp.argmax(ties & (cum == idx + 1))
         only = jnp.argmax(feasible)
         chosen = jnp.where(
-            n_feasible == 0,
+            (n_feasible == 0) | ~pvalid,
             jnp.int32(-1),
             jnp.where(n_feasible == 1, only, pick_among_ties).astype(jnp.int32),
         )
         # reference: selectHost (and its counter) runs only when >=2 feasible
-        rr = state.round_robin + (n_feasible >= 2).astype(jnp.int32)
+        rr = state.round_robin + ((n_feasible >= 2) & pvalid).astype(jnp.int32)
 
         # -- commit (assume) ------------------------------------------
         landed = chosen >= 0
         safe = jnp.maximum(chosen, 0)
         onehot = (jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe) & landed
         oh_i = onehot.astype(jnp.int32)
-        # affinity domain counters: the landed pod counts toward every term
-        # whose scope it falls in, and toward terms it owns (all updates
-        # land in the trash slot when the chosen node lacks the key)
-        ids = dev.node_domain[:, safe]  # [T]
-        m_i = (m_g & landed).astype(jnp.int32)
-        own_i = (dev.own_all[gid] & landed).astype(jnp.int32)
-        # volume occupancy on the chosen node
-        newv_chosen = (gv & ~state.vol_any[:, safe] & landed).astype(jnp.int32)  # [V]
+        if use_terms:
+            # affinity domain counters: the landed pod counts toward every
+            # term whose scope it falls in, and toward terms it owns (all
+            # updates land in the trash slot when the chosen node lacks the
+            # key)
+            ids = dev.node_domain[:, safe]  # [T]
+            m_i = (m_g & landed).astype(jnp.int32)
+            own_i = (dev.own_all[gid] & landed).astype(jnp.int32)
+            dom_match = state.dom_match.at[ids].add(m_i)
+            dom_owner = state.dom_owner.at[ids].add(own_i)
+            total_match = state.total_match + m_i
+        else:
+            dom_match, dom_owner, total_match = (
+                state.dom_match,
+                state.dom_owner,
+                state.total_match,
+            )
+        if use_vols:
+            # volume occupancy on the chosen node: scatter the pod's slots
+            # into the [V, N] maps (invalid slots aim at the sentinel row and
+            # write False — a no-op under max)
+            vol_upd = (vol_valid & landed)[:, None] & onehot[None, :]  # [W, N]
+            newv_chosen = (vol_valid & new_v[:, safe] & landed).astype(jnp.int32)  # [W]
+            vol_any = state.vol_any.at[vol_ids].max(vol_upd)
+            vol_ns = state.vol_ns.at[vol_ids].max(vol_upd & ~vol_ro_ok[:, None])
+            nk = state.nk + (k_onehot @ newv_chosen)[:, None] * oh_i[None, :]
+        else:
+            vol_any, vol_ns, nk = state.vol_any, state.vol_ns, state.nk
         new_state = ScanState(
             requested=state.requested + oh_i[:, None] * g_req[None, :],
             nonzero_requested=state.nonzero_requested + oh_i[:, None] * g_nz[None, :],
@@ -334,12 +385,12 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
             spread_counts=state.spread_counts
             + dev.spread_inc[:, gid][:, None] * oh_i[None, :],
             round_robin=rr,
-            dom_match=state.dom_match.at[ids].add(m_i),
-            dom_owner=state.dom_owner.at[ids].add(own_i),
-            total_match=state.total_match + m_i,
-            vol_any=state.vol_any | (gv[:, None] & onehot[None, :]),
-            vol_ns=state.vol_ns | (dev.g_vol_ns[gid][:, None] & onehot[None, :]),
-            nk=state.nk + (dev.kind_onehot @ newv_chosen)[:, None] * oh_i[None, :],
+            dom_match=dom_match,
+            dom_owner=dom_owner,
+            total_match=total_match,
+            vol_any=vol_any,
+            vol_ns=vol_ns,
+            nk=nk,
         )
         return new_state, chosen
 
@@ -347,15 +398,25 @@ def make_step(dev: StaticArrays, num_zones: int, w: dict):
 
 
 @lru_cache(maxsize=64)
-def _runner(num_zones: int, weights: tuple):
+def _runner(num_zones: int, weights: tuple, use_terms: bool = True, use_vols: bool = True):
     w = dict(zip(WEIGHT_KEYS, weights))
 
     @jax.jit
-    def run(dev: StaticArrays, group_ids, state: ScanState):
-        step = make_step(dev, num_zones, w)
-        return jax.lax.scan(step, state, group_ids)
+    def run(dev: StaticArrays, xs, state: ScanState):
+        step = make_step(dev, num_zones, w, use_terms=use_terms, use_vols=use_vols)
+        return jax.lax.scan(step, state, xs)
 
     return run
+
+
+def _runner_for(static: BatchStatic):
+    weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
+    return _runner(
+        int(static.num_zones),
+        weights,
+        use_terms=bool(static.terms),
+        use_vols=bool(static.vol_vocab),
+    )
 
 
 def schedule_batch_arrays(static: BatchStatic, init: InitialState) -> tuple[np.ndarray, int]:
@@ -363,8 +424,7 @@ def schedule_batch_arrays(static: BatchStatic, init: InitialState) -> tuple[np.n
     final round-robin counter)."""
     dev = to_device(static)
     state = state_to_device(init)
-    group_ids = jnp.asarray(static.group_of_pod)
-    weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
-    run = _runner(int(static.num_zones), weights)
-    final_state, chosen = run(dev, group_ids, state)
-    return np.asarray(chosen), int(final_state.round_robin)
+    xs = batch_xs(static)
+    run = _runner_for(static)
+    final_state, chosen = run(dev, xs, state)
+    return np.asarray(chosen)[: len(static.group_of_pod)], int(final_state.round_robin)

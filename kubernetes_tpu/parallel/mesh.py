@@ -27,8 +27,8 @@ from ..models.snapshot import BatchStatic, InitialState
 from ..ops.batch_kernel import (
     StaticArrays,
     ScanState,
-    WEIGHT_KEYS,
-    _runner,
+    _runner_for,
+    batch_xs,
     state_to_device,
     to_device,
 )
@@ -78,11 +78,6 @@ def shard_static(dev: StaticArrays, mesh: Mesh) -> StaticArrays:
         self_match=jax.device_put(dev.self_match, repl),
         node_domain=jax.device_put(dev.node_domain, g_n),
         dom_valid=jax.device_put(dev.dom_valid, g_n),
-        g_vols=jax.device_put(dev.g_vols, repl),
-        g_ro_ok=jax.device_put(dev.g_ro_ok, repl),
-        g_vol_ns=jax.device_put(dev.g_vol_ns, repl),
-        kind_onehot=jax.device_put(dev.kind_onehot, repl),
-        g_has_kind=jax.device_put(dev.g_has_kind, repl),
         vol_limits=jax.device_put(dev.vol_limits, repl),
     )
 
@@ -117,12 +112,9 @@ def schedule_batch_sharded(
 
     The padded node count must divide evenly by the mesh size (the
     tensorizer's ``pad_multiple`` should be a multiple of it)."""
-    import jax.numpy as jnp
-
     dev = shard_static(to_device(static), mesh)
     state = shard_state(state_to_device(init), mesh)
-    group_ids = jnp.asarray(static.group_of_pod)
-    weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
-    run = _runner(int(static.num_zones), weights)
-    final_state, chosen = run(dev, group_ids, state)
-    return np.asarray(chosen), int(final_state.round_robin)
+    xs = batch_xs(static)  # per-pod inputs replicate (scan slices [W] rows)
+    run = _runner_for(static)
+    final_state, chosen = run(dev, xs, state)
+    return np.asarray(chosen)[: len(static.group_of_pod)], int(final_state.round_robin)

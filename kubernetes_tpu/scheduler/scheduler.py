@@ -145,11 +145,17 @@ class Scheduler:
         self.cache.snapshot_into(self._snapshot)
         return self._snapshot
 
+    def _volume_listers(self) -> tuple[dict, dict]:
+        """(pvs by name, pvcs by namespaced key) — shared by scheduling and
+        preemption so both resolve claims identically."""
+        pvs = {pv.meta.name: pv for pv in self.informers.informer("PersistentVolume").list()}
+        pvcs = {pvc.meta.key: pvc for pvc in self.informers.informer("PersistentVolumeClaim").list()}
+        return pvs, pvcs
+
     def priority_context(self, snapshot: dict[str, NodeInfo]) -> PriorityContext:
         services = self.informers.informer("Service").list()
         replicasets = self.informers.informer("ReplicaSet").list()
-        pvs = {pv.meta.name: pv for pv in self.informers.informer("PersistentVolume").list()}
-        pvcs = {pvc.meta.key: pvc for pvc in self.informers.informer("PersistentVolumeClaim").list()}
+        pvs, pvcs = self._volume_listers()
         return PriorityContext(
             snapshot, services=services, replicasets=replicasets, pvcs=pvcs, pvs=pvs
         )
@@ -220,8 +226,7 @@ class Scheduler:
     def _try_preempt(self, pod: api.Pod) -> bool:
         from .preemption import find_preemption_target
 
-        pvs = {pv.meta.name: pv for pv in self.informers.informer("PersistentVolume").list()}
-        pvcs = {c.meta.key: c for c in self.informers.informer("PersistentVolumeClaim").list()}
+        pvs, pvcs = self._volume_listers()
         target = find_preemption_target(
             pod, self.snapshot(), self.algorithm.predicates, pvcs=pvcs, pvs=pvs
         )
