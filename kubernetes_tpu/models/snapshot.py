@@ -64,7 +64,17 @@ def pod_signature_key(pod: api.Pod) -> str:
     """Canonical scheduling-equivalence key (the ecache hash analogue:
     reference ``equivalence_cache.go:98 getEquivalenceHash`` uses the
     controller ref; this key is exact over everything predicates and
-    priorities read, so it is strictly safer)."""
+    priorities read, so it is strictly safer).
+
+    Memoized on the pod object: the backend's segmenter and build_static
+    both key every pod of every segment, and the json serialization is the
+    dominant host cost at 150k-pod scale.  Safe because batch pods are
+    immutable while in flight (informer objects; mutation is a bug the
+    cache mutation detector exists to catch) — a spec patch produces a new
+    object and therefore a fresh key."""
+    cached = getattr(pod, "_sig_key", None)
+    if cached is not None:
+        return cached
     ref = pod.meta.controller_ref()
     parts = {
         "ns": pod.meta.namespace,
@@ -89,7 +99,12 @@ def pod_signature_key(pod: api.Pod) -> str:
             for c in pod.spec.containers
         ],
     }
-    return json.dumps(parts, sort_keys=True, default=str)
+    key = json.dumps(parts, sort_keys=True, default=str)
+    try:
+        object.__setattr__(pod, "_sig_key", key)
+    except AttributeError:
+        pass  # slotted/frozen pod stand-ins: just skip the memo
+    return key
 
 
 def count_affinity_terms(pod: api.Pod) -> int:
@@ -180,9 +195,8 @@ class BatchStatic:
     own_all: np.ndarray = None  # [G, T] bool any term owned by sig
     is_raa: np.ndarray = None  # [T] bool required anti (symmetry forbids)
     self_match: np.ndarray = None  # [T] bool owner matches own term (first-pod rule)
-    node_domain: np.ndarray = None  # [T, N] int32 global domain id (trash slot if key absent)
+    node_domain: np.ndarray = None  # [T, N] int32 domain id (trash where key absent)
     dom_valid: np.ndarray = None  # [T, N] bool node carries the topology key
-    num_domains: int = 1  # D_total + 1 (last slot = trash)
 
     # -- phase B: volumes on device ----------------------------------------
     # Per-POD slot lists: each pod references <= W distinct (kind, id) disks;
@@ -198,7 +212,6 @@ class BatchStatic:
     pod_vol_ro_ok: np.ndarray = None  # [P, W] bool (all refs ro AND kind sharable)
     pod_vol_kind: np.ndarray = None  # [P, W] int32 (K = kind without a count limit)
     vol_limits: np.ndarray = None  # [K] int32
-    trash_slot: int = 0  # domain trash index (pre-padding)
 
     # scoring mode flags
     weights: dict = field(default_factory=dict)
@@ -215,8 +228,14 @@ class InitialState:
     spread_counts: np.ndarray  # [G, N] int32
     round_robin: int
     # phase B dynamic state
-    dom_match: np.ndarray = None  # [D+1] int32: pods matching term t, per domain
-    dom_owner: np.ndarray = None  # [D+1] int32: placed owners of term t, per domain
+    # Affinity-domain state is kept EXPANDED over the node axis — dm[t, j] is
+    # the count of pods matching term t in node j's topology domain (0 where
+    # the node lacks the key).  The expansion trades a little memory for
+    # scatter/gather-free steps: reads are plain rows and the placement
+    # update is an elementwise same-domain mask — TPU-friendly on both the
+    # XLA and Pallas paths.
+    dm: np.ndarray = None  # [T, N] int32: pods matching term t, per node's domain
+    downer: np.ndarray = None  # [T, N] int32: placed owners of term t, per node's domain
     total_match: np.ndarray = None  # [T] int32: pods matching term t anywhere
     vol_any: np.ndarray = None  # [V, N] bool volume instance present
     vol_ns: np.ndarray = None  # [V, N] bool non-sharable instance present
@@ -240,7 +259,6 @@ class Tensorizer:
         group_multiple: int = 32,
         term_multiple: int = 16,
         vol_multiple: int = 256,
-        domain_multiple: int = 512,
         port_multiple: int = 8,
     ):
         # Every shape-determining axis is padded to a bucket multiple so XLA
@@ -254,7 +272,6 @@ class Tensorizer:
         self.group_multiple = group_multiple
         self.term_multiple = term_multiple
         self.vol_multiple = vol_multiple
-        self.domain_multiple = domain_multiple
         self.port_multiple = port_multiple
 
     # -- static ------------------------------------------------------------
@@ -572,7 +589,6 @@ class Tensorizer:
         if not terms:
             dom_valid[:] = False
             node_domain[:] = trash
-        num_domains = 8 if not terms else _pad_to(trash + 1, self.domain_multiple)
 
         # -- phase B: volumes (per-pod slot lists) --------------------------
         # Volume identity lives on the pod axis, not the signature axis:
@@ -712,8 +728,6 @@ class Tensorizer:
             self_match=self_match,
             node_domain=node_domain,
             dom_valid=dom_valid,
-            num_domains=num_domains,
-            trash_slot=trash,
             vol_vocab=list(vol_vocab),
             v_state=v_state,
             pod_vol_ids=pod_vol_ids,
@@ -775,7 +789,11 @@ class Tensorizer:
         # scoping rides along as a reserved pseudo-label.
         groups_with_sels = {g: sels for g, sels in g_selectors.items() if sels}
         T = static.term_matches_sig.shape[0]
-        dom_match = np.zeros(static.num_domains, dtype=np.int32)
+        # per-term flat domain counts, expanded to [T, N] after the fill
+        # (trash id = node_domain.max() where the key is absent — its counts
+        # vanish in the expansion because dom_valid masks them)
+        n_dom = int(static.node_domain.max()) + 1 if static.terms else 1
+        dom_match = np.zeros(n_dom, dtype=np.int32)
         total_match = np.zeros(T, dtype=np.int32)
         matchable_terms = [
             (t, at) for t, at in enumerate(static.terms) if at.term.selector is not None
@@ -828,7 +846,7 @@ class Tensorizer:
                         total_match[t] = int(hits.sum())
                         np.add.at(dom_match, static.node_domain[t, node_j[hits]], 1)
             eng.close()
-        dom_match[static.trash_slot] = 0  # trash slot stays clean
+        dm = (dom_match[static.node_domain] * static.dom_valid).astype(np.int32)
 
         # volume occupancy from existing pods: instance presence and
         # non-sharable presence per batch-vocab volume, plus distinct
@@ -866,8 +884,8 @@ class Tensorizer:
             ports_used=ports_used,
             spread_counts=spread_counts,
             round_robin=round_robin,
-            dom_match=dom_match,
-            dom_owner=np.zeros(static.num_domains, dtype=np.int32),
+            dm=dm,
+            downer=np.zeros((T, n_pad), dtype=np.int32),
             total_match=total_match,
             vol_any=vol_any,
             vol_ns=vol_ns,

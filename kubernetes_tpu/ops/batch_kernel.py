@@ -43,8 +43,8 @@ class ScanState(NamedTuple):
     spread_counts: jnp.ndarray  # [G, N] int32
     round_robin: jnp.ndarray  # [] int32
     # phase B: affinity-term domain counters + volume occupancy
-    dom_match: jnp.ndarray  # [D+1] int32 pods matching term t per topology domain
-    dom_owner: jnp.ndarray  # [D+1] int32 placed term owners per topology domain
+    dm: jnp.ndarray  # [T, N] int32 pods matching term t in node n's domain
+    downer: jnp.ndarray  # [T, N] int32 placed term owners in node n's domain
     total_match: jnp.ndarray  # [T] int32 pods matching term t anywhere
     vol_any: jnp.ndarray  # [V, N] bool
     vol_ns: jnp.ndarray  # [V, N] bool non-sharable instance present
@@ -155,8 +155,8 @@ def state_to_device(init: InitialState) -> ScanState:
         ports_used=jnp.asarray(init.ports_used),
         spread_counts=jnp.asarray(init.spread_counts),
         round_robin=jnp.asarray(init.round_robin, dtype=jnp.int32),
-        dom_match=jnp.asarray(init.dom_match),
-        dom_owner=jnp.asarray(init.dom_owner),
+        dm=jnp.asarray(init.dm),
+        downer=jnp.asarray(init.downer),
         total_match=jnp.asarray(init.total_match),
         vol_any=jnp.asarray(init.vol_any),
         vol_ns=jnp.asarray(init.vol_ns),
@@ -230,8 +230,8 @@ def make_step(
             # mask covers existing pods; these domain counters cover the scan
             # carry — the batch generalization of the oracle's work_map feedback)
             m_g = dev.term_matches_sig[:, gid]  # [T] bool: pod in term t's scope
-            dm = state.dom_match[dev.node_domain] * dev.dom_valid  # [T, N] int32
-            downer = state.dom_owner[dev.node_domain] * dev.dom_valid  # [T, N]
+            dm = state.dm  # [T, N] int32 (already key-masked; see InitialState)
+            downer = state.downer  # [T, N]
             # symmetry: placed pods' required anti-affinity forbids their
             # domains for matching candidates (predicates.go:1146)
             sym_anti_bad = jnp.any((m_g & dev.is_raa)[:, None] & (downer > 0), axis=0)
@@ -350,22 +350,25 @@ def make_step(
         onehot = (jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe) & landed
         oh_i = onehot.astype(jnp.int32)
         if use_terms:
-            # affinity domain counters: the landed pod counts toward every
-            # term whose scope it falls in, and toward terms it owns (all
-            # updates land in the trash slot when the chosen node lacks the
-            # key)
-            ids = dev.node_domain[:, safe]  # [T]
+            # affinity domain counters, expanded over nodes: the landed pod
+            # counts toward every node sharing the chosen node's topology
+            # domain for each term it matches/owns — a scatter-free
+            # elementwise same-domain mask (no-op when the chosen node lacks
+            # the key, mirroring the old trash-slot semantics)
+            d_at_safe = dev.node_domain[:, safe]  # [T]
+            valid_at_safe = dev.dom_valid[:, safe]  # [T]
+            same_dom = (
+                (dev.node_domain == d_at_safe[:, None])
+                & dev.dom_valid
+                & valid_at_safe[:, None]
+            )  # [T, N]
             m_i = (m_g & landed).astype(jnp.int32)
             own_i = (dev.own_all[gid] & landed).astype(jnp.int32)
-            dom_match = state.dom_match.at[ids].add(m_i)
-            dom_owner = state.dom_owner.at[ids].add(own_i)
+            dm_new = state.dm + same_dom * m_i[:, None]
+            downer_new = state.downer + same_dom * own_i[:, None]
             total_match = state.total_match + m_i
         else:
-            dom_match, dom_owner, total_match = (
-                state.dom_match,
-                state.dom_owner,
-                state.total_match,
-            )
+            dm_new, downer_new, total_match = state.dm, state.downer, state.total_match
         if use_vols:
             # volume occupancy on the chosen node: scatter the pod's slots
             # into the [V, N] maps (invalid slots aim at the sentinel row and
@@ -385,8 +388,8 @@ def make_step(
             spread_counts=state.spread_counts
             + dev.spread_inc[:, gid][:, None] * oh_i[None, :],
             round_robin=rr,
-            dom_match=dom_match,
-            dom_owner=dom_owner,
+            dm=dm_new,
+            downer=downer_new,
             total_match=total_match,
             vol_any=vol_any,
             vol_ns=vol_ns,

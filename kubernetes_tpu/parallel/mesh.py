@@ -94,10 +94,12 @@ def shard_state(state: ScanState, mesh: Mesh) -> ScanState:
         ports_used=jax.device_put(state.ports_used, n_r),
         spread_counts=jax.device_put(state.spread_counts, g_n),
         round_robin=jax.device_put(state.round_robin, repl),
-        # phase B: flat domain counters replicate (updated via a gathered
-        # column of ids — an all-reduce'd scatter); volume maps shard on N
-        dom_match=jax.device_put(state.dom_match, repl),
-        dom_owner=jax.device_put(state.dom_owner, repl),
+        # phase B: the [T, N] expanded domain counters shard on the node
+        # axis like every other per-node map (updates are elementwise
+        # same-domain masks — no cross-shard scatter); total_match is the
+        # only replicated affinity state
+        dm=jax.device_put(state.dm, g_n),
+        downer=jax.device_put(state.downer, g_n),
         total_match=jax.device_put(state.total_match, repl),
         vol_any=jax.device_put(state.vol_any, g_n),
         vol_ns=jax.device_put(state.vol_ns, g_n),
