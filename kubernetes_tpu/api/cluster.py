@@ -522,6 +522,47 @@ class PersistentVolumeClaim:
 
 @_register_cluster_scoped
 @dataclass
+class StorageClass:
+    """Dynamic-provisioning template (reference ``pkg/apis/storage/types.go``;
+    consumed by the PV controller's provisioner and the DefaultStorageClass
+    admission plugin)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""  # "" = no dynamic provisioning for this class
+    reclaim_policy: str = "Delete"
+    parameters: dict = field(default_factory=dict)
+    is_default: bool = False  # reference: the is-default-class annotation
+
+    KIND = "StorageClass"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "provisioner": self.provisioner,
+            "reclaimPolicy": self.reclaim_policy,
+            "parameters": dict(self.parameters),
+            "isDefault": self.is_default,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StorageClass":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""
+        return cls(
+            meta=meta,
+            provisioner=d.get("provisioner", ""),
+            reclaim_policy=d.get("reclaimPolicy", "Delete"),
+            parameters=dict(d.get("parameters") or {}),
+            is_default=bool(d.get("isDefault")),
+        )
+
+
+@_register_cluster_scoped
+@dataclass
 class PriorityClass:
     """Named pod priority (reference ``pkg/apis/scheduling/types.go``;
     resolved into ``pod.spec.priority`` by the Priority admission plugin)."""

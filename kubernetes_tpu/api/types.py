@@ -643,6 +643,9 @@ class NodeStatus:
     allocatable: dict[str, Quantity] = field(default_factory=dict)
     conditions: list[NodeCondition] = field(default_factory=list)
     images: list[dict] = field(default_factory=list)  # {"names": [...], "sizeBytes": N}
+    # PV names attached to this node, written by the attach/detach
+    # controller (reference ``node.status.volumesAttached``)
+    volumes_attached: list[str] = field(default_factory=list)
 
     def condition(self, ctype: str) -> Optional[NodeCondition]:
         for c in self.conditions:
@@ -656,6 +659,7 @@ class NodeStatus:
             "allocatable": _res_to_dict(self.allocatable),
             "conditions": [c.to_dict() for c in self.conditions],
             "images": copy.deepcopy(self.images),
+            "volumesAttached": list(self.volumes_attached),
         }
 
     @classmethod
@@ -666,6 +670,7 @@ class NodeStatus:
             allocatable=_res_from_dict(d.get("allocatable")),
             conditions=[NodeCondition.from_dict(c) for c in d.get("conditions") or []],
             images=copy.deepcopy(d.get("images") or []),
+            volumes_attached=list(d.get("volumesAttached") or []),
         )
 
 

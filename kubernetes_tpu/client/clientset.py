@@ -39,25 +39,40 @@ class TypedClient:
         self._cls = cls
         self.default_namespace = "" if kind in CLUSTER_SCOPED_KINDS else "default"
 
+    def _ns(self, namespace: Optional[str]) -> str:
+        """Resolve the effective namespace.  Cluster-scoped kinds ignore any
+        caller/object namespace (reference: the registry's scope strategy,
+        not the caller, decides key shape) — otherwise an ObjectMeta
+        carrying the "default" namespace stores the object where
+        cluster-scoped get/update can never find it."""
+        if self.default_namespace == "":
+            return ""
+        return self.default_namespace if namespace is None else namespace
+
+    def _to_wire(self, obj) -> dict:
+        d = obj.to_dict()
+        meta = d.setdefault("metadata", {})
+        meta["namespace"] = self._ns(meta.get("namespace"))
+        return d
+
     def create(self, obj):
-        return self._cls.from_dict(self._store.create(self.kind, obj.to_dict()))
+        return self._cls.from_dict(self._store.create(self.kind, self._to_wire(obj)))
 
     def get(self, name: str, namespace: Optional[str] = None):
-        if namespace is None:
-            namespace = self.default_namespace
-        return self._cls.from_dict(self._store.get(self.kind, namespace, name))
+        return self._cls.from_dict(self._store.get(self.kind, self._ns(namespace), name))
 
     def list(self, namespace: Optional[str] = None):
+        if namespace is not None:
+            namespace = self._ns(namespace)
         dicts, rev = self._store.list(self.kind, namespace)
         return [self._cls.from_dict(d) for d in dicts], rev
 
     def update(self, obj):
-        return self._cls.from_dict(self._store.update(self.kind, obj.to_dict()))
+        return self._cls.from_dict(self._store.update(self.kind, self._to_wire(obj)))
 
     def guaranteed_update(self, name: str, mutate: Callable, namespace: Optional[str] = None):
         """mutate receives a typed object, returns the new typed object."""
-        if namespace is None:
-            namespace = self.default_namespace
+        namespace = self._ns(namespace)
 
         def _mutate_dict(d: dict) -> dict:
             return mutate(self._cls.from_dict(d)).to_dict()
@@ -79,9 +94,7 @@ class TypedClient:
         return self.guaranteed_update(obj.meta.name, _mutate, obj.meta.namespace)
 
     def delete(self, name: str, namespace: Optional[str] = None):
-        if namespace is None:
-            namespace = self.default_namespace
-        return self._cls.from_dict(self._store.delete(self.kind, namespace, name))
+        return self._cls.from_dict(self._store.delete(self.kind, self._ns(namespace), name))
 
     def watch(self, from_revision: Optional[int] = None) -> Watch:
         return self._store.watch(self.kind, from_revision)

@@ -31,6 +31,7 @@ from .resourcequota import ResourceQuotaController
 from .serviceaccounts import ServiceAccountController
 from .statefulset import StatefulSetController
 from .ttl import TTLController
+from .volume import AttachDetachController, PersistentVolumeController
 
 # registry of startable loops (reference controllermanager.go:315-339)
 DEFAULT_CONTROLLERS: dict[str, Callable] = {
@@ -48,6 +49,8 @@ DEFAULT_CONTROLLERS: dict[str, Callable] = {
     "podgc": PodGCController,
     "ttl": TTLController,
     "disruption": DisruptionController,
+    "persistentvolume": PersistentVolumeController,
+    "attachdetach": AttachDetachController,
     "horizontalpodautoscaler": HorizontalPodAutoscalerController,
     "serviceaccount": ServiceAccountController,
     "certificates": CertificateController,

@@ -145,3 +145,20 @@ def test_workqueue_rate_limited_backoff():
     q.add_rate_limited("a")
     t["now"] += 0.006
     assert q.get(timeout=0) == "a"
+
+
+def test_cluster_scoped_create_ignores_object_namespace():
+    """A Node built with a defaulted ObjectMeta (namespace='default') must
+    still be stored and retrievable under the cluster scope."""
+    from kubernetes_tpu.api import Node, NodeStatus, ObjectMeta, Quantity
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.store import Store
+
+    cs = Clientset(Store())
+    cs.nodes.create(Node(meta=ObjectMeta(name="n0")))
+    assert cs.nodes.get("n0").meta.namespace == ""
+    # scoped verbs tolerate a stray namespace argument the same way
+    cs.nodes.guaranteed_update("n0", lambda n: n, "default")
+    assert cs.nodes.get("n0", "default").meta.name == "n0"
+    cs.nodes.delete("n0", "default")
+    assert [n.meta.name for n in cs.nodes.list()[0]] == []

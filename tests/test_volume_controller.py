@@ -1,0 +1,213 @@
+"""Volume lifecycle: PV↔PVC binding phase machine + attach/detach.
+
+Behavioral spec from the reference ``pkg/controller/volume``
+(``persistentvolume/pv_controller.go``, ``attachdetach/``)."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Quantity,
+    StorageClass,
+    Volume,
+)
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.controllers.volume import (
+    AttachDetachController,
+    PersistentVolumeController,
+)
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def make_pv(name, storage="10Gi", cls="", modes=None, policy="Retain"):
+    return PersistentVolume(
+        meta=ObjectMeta(name=name),
+        capacity={"storage": Quantity(storage)},
+        access_modes=modes or ["ReadWriteOnce"],
+        storage_class=cls,
+        reclaim_policy=policy,
+    )
+
+
+def make_pvc(name, storage="5Gi", cls="", modes=None, volume_name="", namespace="default"):
+    return PersistentVolumeClaim(
+        meta=ObjectMeta(name=name, namespace=namespace),
+        request_storage=Quantity(storage),
+        access_modes=modes or ["ReadWriteOnce"],
+        storage_class=cls,
+        volume_name=volume_name,
+    )
+
+
+@pytest.fixture()
+def cs():
+    return Clientset(Store())
+
+
+def drive(ctrl):
+    ctrl.informers.start_all_manual()
+    for _ in range(10):
+        ctrl.informers.pump_all()
+        progressed = 0
+        while ctrl.sync_once():
+            progressed += 1
+        if not progressed:
+            break
+
+
+def test_bind_smallest_satisfying_volume(cs):
+    cs.persistentvolumes.create(make_pv("big", "100Gi"))
+    cs.persistentvolumes.create(make_pv("small", "8Gi"))
+    cs.persistentvolumes.create(make_pv("tiny", "1Gi"))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    drive(PersistentVolumeController(cs))
+    pvc = cs.persistentvolumeclaims.get("claim", "default")
+    assert pvc.phase == "Bound" and pvc.volume_name == "small"
+    assert cs.persistentvolumes.get("small").phase == "Bound"
+    assert cs.persistentvolumes.get("small").claim_ref == "default/claim"
+    assert cs.persistentvolumes.get("big").phase == "Available"
+
+
+def test_class_and_access_mode_must_match(cs):
+    cs.persistentvolumes.create(make_pv("wrong-class", "10Gi", cls="fast"))
+    cs.persistentvolumes.create(make_pv("wrong-mode", "10Gi", modes=["ReadOnlyMany"]))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    drive(PersistentVolumeController(cs))
+    assert cs.persistentvolumeclaims.get("claim", "default").phase == "Pending"
+
+
+def test_pre_bound_claim_waits_for_named_volume(cs):
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi", volume_name="target"))
+    ctrl = PersistentVolumeController(cs)
+    drive(ctrl)
+    assert cs.persistentvolumeclaims.get("claim", "default").phase == "Pending"
+    cs.persistentvolumes.create(make_pv("target", "20Gi"))
+    drive(ctrl)
+    pvc = cs.persistentvolumeclaims.get("claim", "default")
+    assert pvc.phase == "Bound" and pvc.volume_name == "target"
+
+
+def test_dynamic_provisioning_via_storage_class(cs):
+    cs.storageclasses.create(
+        StorageClass(meta=ObjectMeta(name="fast"), provisioner="kubernetes.io/gce-pd")
+    )
+    cs.persistentvolumeclaims.create(make_pvc("claim", "30Gi", cls="fast"))
+    drive(PersistentVolumeController(cs))
+    pvc = cs.persistentvolumeclaims.get("claim", "default")
+    assert pvc.phase == "Bound"
+    pv = cs.persistentvolumes.get(pvc.volume_name)
+    assert pv.capacity["storage"] == Quantity("30Gi")
+    assert pv.reclaim_policy == "Delete"  # class default
+    assert pv.claim_ref == "default/claim"
+
+
+def test_reclaim_policies_on_claim_deletion(cs):
+    for name, policy in (("keep", "Retain"), ("drop", "Delete"), ("wipe", "Recycle")):
+        cs.persistentvolumes.create(make_pv(name, "10Gi", policy=policy))
+    ctrl = PersistentVolumeController(cs)
+    for claim, vol in (("c1", "keep"), ("c2", "drop"), ("c3", "wipe")):
+        cs.persistentvolumeclaims.create(make_pvc(claim, "5Gi", volume_name=vol))
+    drive(ctrl)
+    for claim in ("c1", "c2", "c3"):
+        assert cs.persistentvolumeclaims.get(claim, "default").phase == "Bound"
+    for claim in ("c1", "c2", "c3"):
+        cs.persistentvolumeclaims.delete(claim, "default")
+    drive(ctrl)
+    assert cs.persistentvolumes.get("keep").phase == "Released"
+    pvs, _ = cs.persistentvolumes.list()
+    assert "drop" not in [p.meta.name for p in pvs]  # Delete policy
+    wiped = cs.persistentvolumes.get("wipe")
+    assert wiped.phase == "Available" and wiped.claim_ref == ""
+
+
+def test_bound_claim_goes_lost_when_volume_vanishes(cs):
+    cs.persistentvolumes.create(make_pv("pv1", "10Gi"))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    ctrl = PersistentVolumeController(cs)
+    drive(ctrl)
+    assert cs.persistentvolumeclaims.get("claim", "default").phase == "Bound"
+    cs.persistentvolumes.delete("pv1")
+    drive(ctrl)
+    assert cs.persistentvolumeclaims.get("claim", "default").phase == "Lost"
+
+
+def test_attach_detach_follows_scheduled_pods(cs):
+    cs.nodes.create(make_node("n1"))
+    cs.persistentvolumes.create(make_pv("pv1", "10Gi"))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    pvctrl = PersistentVolumeController(cs)
+    drive(pvctrl)
+    cs.pods.create(
+        make_pod("user", cpu="100m", node_name="n1",
+                 volumes=[Volume(name="v", pvc_name="claim")])
+    )
+    ad = AttachDetachController(cs)
+    drive(ad)
+    assert cs.nodes.get("n1").status.volumes_attached == ["pv1"]
+    # pod removed -> volume detaches
+    cs.pods.delete("user", "default")
+    drive(ad)
+    assert cs.nodes.get("n1").status.volumes_attached == []
+
+
+def test_storage_class_created_after_claim_unblocks_provisioning(cs):
+    """A claim naming a not-yet-existing class must provision once the
+    class appears (the SC informer handler requeues pending claims)."""
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi", cls="late"))
+    ctrl = PersistentVolumeController(cs)
+    drive(ctrl)
+    assert cs.persistentvolumeclaims.get("claim", "default").phase == "Pending"
+    cs.storageclasses.create(
+        StorageClass(meta=ObjectMeta(name="late"), provisioner="kubernetes.io/gce-pd")
+    )
+    drive(ctrl)
+    assert cs.persistentvolumeclaims.get("claim", "default").phase == "Bound"
+
+
+def test_default_storage_class_provisions_classless_claim(cs):
+    cs.storageclasses.create(
+        StorageClass(meta=ObjectMeta(name="standard"),
+                     provisioner="kubernetes.io/gce-pd", is_default=True)
+    )
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    drive(PersistentVolumeController(cs))
+    pvc = cs.persistentvolumeclaims.get("claim", "default")
+    assert pvc.phase == "Bound"
+    assert cs.persistentvolumes.get(pvc.volume_name).storage_class == "standard"
+
+
+def test_provision_name_collision_does_not_steal_bound_volume(cs):
+    """Claims 'a-b/c' and 'a/b-c' collide on the provisioned PV name; the
+    loser must stay Pending, not overwrite the winner's claimRef."""
+    cs.storageclasses.create(
+        StorageClass(meta=ObjectMeta(name="fast"), provisioner="p")
+    )
+    cs.persistentvolumeclaims.create(make_pvc("c", "5Gi", cls="fast", namespace="a-b"))
+    ctrl = PersistentVolumeController(cs)
+    drive(ctrl)
+    assert cs.persistentvolumeclaims.get("c", "a-b").phase == "Bound"
+    cs.persistentvolumeclaims.create(make_pvc("b-c", "5Gi", cls="fast", namespace="a"))
+    drive(ctrl)
+    assert cs.persistentvolumeclaims.get("b-c", "a").phase == "Pending"
+    assert cs.persistentvolumes.get("pvc-a-b-c").claim_ref == "a-b/c"
+
+
+def test_attach_follows_late_claim_binding(cs):
+    """Pod lands on a node while its PVC is still Pending; once the PV
+    controller binds the claim, the attach controller must converge."""
+    cs.nodes.create(make_node("n1"))
+    cs.pods.create(
+        make_pod("user", cpu="100m", node_name="n1",
+                 volumes=[Volume(name="v", pvc_name="claim")])
+    )
+    ad = AttachDetachController(cs)
+    drive(ad)
+    assert cs.nodes.get("n1").status.volumes_attached == []
+    cs.persistentvolumes.create(make_pv("pv1", "10Gi"))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    drive(PersistentVolumeController(cs))
+    drive(ad)  # PVC bind event requeues n1
+    assert cs.nodes.get("n1").status.volumes_attached == ["pv1"]
