@@ -50,6 +50,15 @@ from .batch_kernel import schedule_batch_arrays
 
 logger = logging.getLogger("kubernetes_tpu.backend")
 
+
+def _device_platform() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
 _PRIORITY_WEIGHT_KEY = {
     LeastRequestedPriority: "least",
     MostRequestedPriority: "most",
@@ -69,11 +78,27 @@ class TPUBatchBackend:
         algorithm: Optional[GenericScheduler] = None,
         tensorizer: Optional[Tensorizer] = None,
         max_segment_pods: int = 4096,  # power of two = one scan-length bucket
+        kernel_impl: str = "auto",  # auto | pallas | xla
     ):
         self.algorithm = algorithm or GenericScheduler()
         self.tensorizer = tensorizer or Tensorizer()
         self.max_segment_pods = max_segment_pods
-        self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0}
+        self.kernel_impl = kernel_impl
+        self._pallas_failed = False
+        self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0, "pallas_segments": 0}
+
+    def _use_pallas(self, static) -> bool:
+        """Fused Pallas kernel on real TPU; XLA scan everywhere else (CPU
+        tests, unsupported shapes) and after any runtime failure."""
+        if self.kernel_impl == "xla" or self._pallas_failed:
+            return False
+        from .pallas_kernel import supports_pallas
+
+        if not supports_pallas(static):
+            return False
+        if self.kernel_impl == "pallas":
+            return True
+        return _device_platform() == "tpu"
 
     # -- greedy segmentation ------------------------------------------------
     def _segments(self, pods: list[api.Pod]) -> list[tuple[str, list[tuple[int, api.Pod]]]]:
@@ -216,7 +241,18 @@ class TPUBatchBackend:
             init = self.tensorizer.initial_state(
                 static, work_map, work_pctx, seg_pods, round_robin=self.algorithm._round_robin
             )
-            chosen, final_rr = schedule_batch_arrays(static, init)
+            if self._use_pallas(static):
+                from .pallas_kernel import schedule_batch_pallas
+
+                try:
+                    chosen, final_rr = schedule_batch_pallas(static, init)
+                    self.stats["pallas_segments"] += 1
+                except Exception:
+                    logger.exception("pallas kernel failed; falling back to XLA scan")
+                    self._pallas_failed = True
+                    chosen, final_rr = schedule_batch_arrays(static, init)
+            else:
+                chosen, final_rr = schedule_batch_arrays(static, init)
             self.algorithm._round_robin = final_rr
             for (i, pod), idx in zip(segment, chosen):
                 node_name = static.node_names[int(idx)] if int(idx) >= 0 else None
