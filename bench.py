@@ -195,6 +195,10 @@ def run_once(
     backend = TPUBatchBackend(algorithm=algo) if use_backend else None
     sched = Scheduler(cs, algorithm=algo, backend=backend, emit_events=emit_events)
     sched.start()
+    if emit_events:
+        # production shape: the hot loop enqueues, the sink thread
+        # correlates + writes concurrently with the timed work
+        sched.broadcaster.start()
 
     start = time.perf_counter()
     if use_backend:
@@ -211,6 +215,11 @@ def run_once(
     }
     if use_backend:
         result["backend_stats"] = dict(backend.stats)
+    if emit_events:
+        # drain the remaining queue off-clock, then report what the
+        # correlator actually did during the run
+        sched.broadcaster.stop(drain=True)
+        result["event_stats"] = dict(sched.broadcaster.correlator.stats)
     # final pod→node assignment map, for parity comparison across runs
     pods, _ = cs.pods.list()
     result["assignments"] = {p.meta.key: p.spec.node_name or None for p in pods}

@@ -4,3 +4,4 @@ from .clientset import BindConflictError, Clientset, PodClient, TypedClient
 from .informer import CacheMutationError, Handler, InformerFactory, PodNodeIndex, PodOwnerIndex, SharedInformer
 from .workqueue import ExponentialBackoff, WorkQueue
 from .leaderelection import LeaderElector
+from .record import EventBroadcaster, EventCorrelator, EventRecorder

@@ -1,0 +1,288 @@
+"""Event recording: correlate, aggregate, rate-limit, write asynchronously.
+
+Capability of the reference's ``client-go/tools/record`` stack:
+
+- ``EventBroadcaster`` — emitters never block on the API: events enter a
+  bounded in-memory queue consumed by a background writer thread
+  (reference: the watch channel + ``StartRecordingToSink``).  When the
+  queue is full the newest event is dropped and counted (the reference
+  drops on sink backpressure via its rate limiter).
+- ``EventCorrelator`` (``tools/record/events_cache.go``) —
+  - *aggregation*: more than ``max_similar`` events in the same group
+    (source + object + type + reason) inside ``similar_window`` collapse
+    into ONE "(combined from similar events)" event whose count rises;
+  - *dedup*: an identical event (same message too) bumps ``count`` on the
+    stored object via CAS instead of minting a new one;
+  - *spam filter*: a token bucket per source+object (``burst`` tokens,
+    one refill per ``refill_period``) drops floods outright.
+
+The TPU-native consequence: the scheduler's hot batch loop only appends
+to a deque; all store writes happen off the timed path, exactly like the
+reference's async goroutine sink.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..api import types as api
+
+logger = logging.getLogger("kubernetes_tpu.record")
+
+
+@dataclass
+class _PendingEvent:
+    involved_kind: str
+    involved_key: str  # namespace/name (or bare name for cluster-scoped)
+    namespace: str
+    etype: str
+    reason: str
+    message: str
+    time: float = 0.0  # emitter-side clock; correlation uses THIS, not
+    # drain time, so a backed-up sink doesn't warp windows/buckets
+
+
+class _TokenBucket:
+    __slots__ = ("tokens", "last")
+
+    def __init__(self, burst: int, now: float):
+        self.tokens = float(burst)
+        self.last = now
+
+    def take(self, burst: int, refill_period: float, now: float) -> bool:
+        if refill_period > 0:
+            self.tokens = min(
+                float(burst), self.tokens + (now - self.last) / refill_period
+            )
+        self.last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class EventCorrelator:
+    """Pure decision logic, shared by sync and async paths.
+
+    ``observe`` returns one of:
+    - ``("create", event_dict)`` — mint a new Event object;
+    - ``("patch", stored_name, namespace)`` — bump count on a prior event;
+    - ``("drop", None, None)`` — spam-filtered.
+    """
+
+    def __init__(
+        self,
+        source: str = "",
+        clock: Callable[[], float] = time.monotonic,
+        max_similar: int = 10,
+        similar_window: float = 600.0,
+        burst: int = 25,
+        refill_period: float = 300.0 / 25.0,
+        cache_size: int = 4096,
+    ):
+        self.source = source
+        self.clock = clock
+        self.max_similar = max_similar
+        self.similar_window = similar_window
+        self.burst = burst
+        self.refill_period = refill_period
+        self._lock = threading.Lock()
+        # spam filter state per source+object (LRU: hits refresh recency)
+        self._buckets: collections.OrderedDict[str, _TokenBucket] = collections.OrderedDict()
+        # aggregation state per similarity group: [count, window_start]
+        self._similar: collections.OrderedDict[tuple, list] = collections.OrderedDict()
+        # dedup cache: full event identity -> stored event name
+        self._seen: collections.OrderedDict[tuple, str] = collections.OrderedDict()
+        self._cache_size = cache_size
+        self._name_seq = 0
+        self.stats = {"created": 0, "patched": 0, "dropped_spam": 0, "aggregated": 0}
+
+    def _trim(self, od: collections.OrderedDict) -> None:
+        while len(od) > self._cache_size:
+            od.popitem(last=False)
+
+    def observe(self, ev: _PendingEvent):
+        now = ev.time
+        with self._lock:
+            # -- spam filter (EventSourceObjectSpamFilter) ------------------
+            bkey = f"{self.source}\x00{ev.involved_key}"
+            bucket = self._buckets.get(bkey)
+            if bucket is None:
+                bucket = self._buckets[bkey] = _TokenBucket(self.burst, now)
+                self._trim(self._buckets)
+            else:
+                self._buckets.move_to_end(bkey)
+            if not bucket.take(self.burst, self.refill_period, now):
+                self.stats["dropped_spam"] += 1
+                return ("drop", None, None)
+
+            # -- aggregation by similarity group ----------------------------
+            group = (ev.involved_kind, ev.involved_key, ev.etype, ev.reason)
+            rec = self._similar.get(group)
+            if rec is None or now - rec[1] > self.similar_window:
+                rec = self._similar[group] = [0, now]
+                self._trim(self._similar)
+            else:
+                self._similar.move_to_end(group)
+            rec[0] += 1
+            message = ev.message
+            aggregated = rec[0] > self.max_similar
+            if aggregated:
+                message = f"(combined from similar events): {ev.message}"
+                self.stats["aggregated"] += 1
+
+            # -- dedup (bump count on an identical prior event) -------------
+            ident = group if aggregated else group + (ev.message,)
+            stored = self._seen.get(ident)
+            if stored is not None:
+                self._seen.move_to_end(ident)
+                self.stats["patched"] += 1
+                return ("patch", stored, ev.namespace)
+
+            self._name_seq += 1
+            _, name = (ev.involved_key.rsplit("/", 1) + [ev.involved_key])[:2] \
+                if "/" in ev.involved_key else ("", ev.involved_key)
+            stored_name = f"{name}.{self._name_seq:x}"
+            self._seen[ident] = stored_name
+            self._trim(self._seen)
+            self.stats["created"] += 1
+            return (
+                "create",
+                api.Event(
+                    meta=api.ObjectMeta(name=stored_name, namespace=ev.namespace),
+                    involved_kind=ev.involved_kind,
+                    involved_key=ev.involved_key,
+                    reason=ev.reason,
+                    message=message,
+                    type=ev.etype,
+                    count=1,
+                ),
+                ev.namespace,
+            )
+
+
+class EventBroadcaster:
+    """Bounded queue + background writer (StartRecordingToSink)."""
+
+    def __init__(
+        self,
+        clientset,
+        source: str = "",
+        clock: Callable[[], float] = time.monotonic,
+        max_queued: int = 100_000,
+        correlator: Optional[EventCorrelator] = None,
+    ):
+        self.clientset = clientset
+        self.correlator = correlator or EventCorrelator(source=source, clock=clock)
+        self._queue: collections.deque[_PendingEvent] = collections.deque()
+        self._max_queued = max_queued
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self.dropped_overflow = 0
+
+    # -- emitter side (hot path: append only) ------------------------------
+    def enqueue(self, ev: _PendingEvent) -> None:
+        with self._cv:
+            if len(self._queue) >= self._max_queued:
+                self.dropped_overflow += 1
+                return
+            self._queue.append(ev)
+            self._cv.notify()
+
+    def recorder(self, involved_kind: str = "Pod") -> "EventRecorder":
+        return EventRecorder(self, involved_kind)
+
+    # -- sink side ---------------------------------------------------------
+    def _write(self, decision) -> None:
+        action, payload, namespace = decision
+        try:
+            if action == "create":
+                self.clientset.events.create(payload)
+            elif action == "patch":
+                def _bump(cur: api.Event) -> api.Event:
+                    cur.count += 1
+                    return cur
+
+                self.clientset.events.guaranteed_update(payload, _bump, namespace)
+        except Exception:  # events are best-effort, like the reference sink
+            logger.debug("event write failed", exc_info=True)
+
+    def process_one(self) -> bool:
+        """Synchronous drain step (tests / manual pumping)."""
+        with self._cv:
+            if not self._queue:
+                return False
+            ev = self._queue.popleft()
+        self._write(self.correlator.observe(ev))
+        return True
+
+    def flush(self) -> int:
+        n = 0
+        while self.process_one():
+            n += 1
+        return n
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait(timeout=0.2)
+                if self._stopped and not self._queue:
+                    return
+                ev = self._queue.popleft() if self._queue else None
+            if ev is not None:
+                self._write(self.correlator.observe(ev))
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None
+
+    def stop(self, drain: bool = True) -> None:
+        with self._cv:
+            self._stopped = True
+            if not drain:
+                self._queue.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+
+class EventRecorder:
+    """The per-component emitting facade (reference ``EventRecorder``)."""
+
+    def __init__(self, broadcaster: EventBroadcaster, involved_kind: str = "Pod"):
+        self.broadcaster = broadcaster
+        self.involved_kind = involved_kind
+
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
+        meta = getattr(obj, "meta", None)
+        key = meta.key if meta is not None else str(obj)
+        namespace = meta.namespace if meta is not None else "default"
+        self.broadcaster.enqueue(
+            _PendingEvent(
+                involved_kind=getattr(obj, "KIND", self.involved_kind),
+                involved_key=key,
+                namespace=namespace,
+                etype=etype,
+                reason=reason,
+                message=message,
+                time=self.broadcaster.correlator.clock(),
+            )
+        )

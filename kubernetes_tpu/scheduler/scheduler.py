@@ -28,6 +28,7 @@ from typing import Callable, Optional
 from ..api import types as api
 from ..client.clientset import BindConflictError, Clientset
 from ..client.informer import Handler, InformerFactory
+from ..client.record import EventBroadcaster
 from ..store.store import NotFoundError
 from ..utils.metrics import SchedulerMetrics
 from ..utils.trace import Trace
@@ -69,7 +70,12 @@ class Scheduler:
         self.enable_preemption = enable_preemption
         self._clock = clock
         self._snapshot: dict[str, NodeInfo] = {}
-        self._event_seq = 0
+        # async event pipeline (client-go tools/record): the hot path only
+        # enqueues; correlation + store writes happen on the sink thread
+        self.broadcaster = EventBroadcaster(
+            clientset, source=scheduler_name, clock=clock
+        )
+        self._recorder = self.broadcaster.recorder("Pod")
 
         self.informers = InformerFactory(clientset)
         self._wire_informers()
@@ -129,15 +135,22 @@ class Scheduler:
             self.queue.remove(pod.meta.key)
 
     def start(self, manual: bool = True) -> None:
-        """Seed informers.  manual=True (tests, bench) → caller pumps;
-        manual=False → informer threads run the watch loops."""
+        """Seed informers.  manual=True (tests, bench) → caller pumps and
+        events drain via ``broadcaster.flush()``; manual=False → informer
+        threads run the watch loops and the event sink thread runs."""
         if manual:
             self.informers.start_all_manual()
         else:
             self.informers.start_all()
+            if self.emit_events:
+                self.broadcaster.start()
 
     def pump(self) -> int:
-        return self.informers.pump_all()
+        n = self.informers.pump_all()
+        if not self.broadcaster.running:
+            # manual drive: no sink thread, so drain events synchronously
+            self.broadcaster.flush()
+        return n
 
     # -- snapshot ----------------------------------------------------------
     def snapshot(self) -> dict[str, NodeInfo]:
@@ -164,22 +177,7 @@ class Scheduler:
     def _event(self, pod: api.Pod, etype: str, reason: str, message: str) -> None:
         if not self.emit_events:
             return
-        self._event_seq += 1
-        try:
-            self.clientset.events.create(
-                api.Event(
-                    meta=api.ObjectMeta(
-                        name=f"{pod.meta.name}.{self._event_seq}", namespace=pod.meta.namespace
-                    ),
-                    involved_kind="Pod",
-                    involved_key=pod.meta.key,
-                    reason=reason,
-                    message=message,
-                    type=etype,
-                )
-            )
-        except Exception:  # events are best-effort (reference: rate-limited drops)
-            logger.debug("event emit failed", exc_info=True)
+        self._recorder.event(pod, etype, reason, message)
 
     # -- bind + failure handling ------------------------------------------
     def _bind(self, pod: api.Pod, node_name: str) -> bool:
@@ -343,6 +341,10 @@ class Scheduler:
                 self._event(pod, "Warning", "FailedBinding", err)
                 failed += 1
             self.metrics.e2e_scheduling_latency.observe((now - start) * 1e6)
+        if self.emit_events and not self.broadcaster.running:
+            # manual drive (no sink thread): drain synchronously so the
+            # batch path's events land just like the per-pod path's
+            self.broadcaster.flush()
         return (bound, failed)
 
     # -- housekeeping ------------------------------------------------------
