@@ -1,0 +1,4 @@
+"""Service dataplane (SURVEY.md L7: kube-proxy, ``pkg/proxy``)."""
+
+from .proxier import EndpointInfo, Proxier, Rule, ServicePortName
+from .hollow import HollowProxy, HollowProxyFleet
