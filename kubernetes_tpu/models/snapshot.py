@@ -211,6 +211,10 @@ class BatchStatic:
     pod_vol_valid: np.ndarray = None  # [P, W] bool
     pod_vol_ro_ok: np.ndarray = None  # [P, W] bool (all refs ro AND kind sharable)
     pod_vol_kind: np.ndarray = None  # [P, W] int32 (K = kind without a count limit)
+    # conflict-free disks: valid for MaxVolumeCount, no occupancy identity
+    # (they read the sentinel row and are masked out of the state write)
+    pod_vol_count_only: np.ndarray = None  # [P, W] bool
+    use_vols: bool = False  # compile-time flag: any volume slot in segment
     vol_limits: np.ndarray = None  # [K] int32
 
     # scoring mode flags
@@ -289,6 +293,7 @@ class Tensorizer:
         prefer_avoid_weight: int = 10000,
         image_weight: int = 0,
         interpod_weight: int = 1,
+        mounted_disks: Optional[set] = None,
     ) -> Optional[BatchStatic]:
         node_names = sorted(n for n, i in node_info_map.items() if i.node is not None)
         n_real = len(node_names)
@@ -315,15 +320,32 @@ class Tensorizer:
 
         # cheap tensor-budget probes BEFORE the expensive [G, N] loops: the
         # backend's split fallback re-tensorizes each piece, so an
-        # over-budget segment must be rejected for near-free
+        # over-budget segment must be rejected for near-free.
+        #
+        # Only CONFLICT-CAPABLE disks need identity rows in the [V, N]
+        # occupancy state: a disk referenced by exactly one pod in the
+        # segment and mounted nowhere can never trip NoDiskConflict — by
+        # the time a later segment references it again it is mounted and
+        # re-enters the vocab there.  Everything else becomes a
+        # "count-only" slot (MaxVolumeCount still sees it; see phase B).
         n_terms = sum(count_affinity_terms(rep) for rep in reps)
-        vol_count: set[tuple[str, str]] = set()
+        if mounted_disks is None:
+            mounted_disks = set()
+            for info in infos:
+                for q in info.pods:
+                    mounted_disks |= pod_disk_vols(q)
+        seen_once: set[tuple[str, str]] = set()
+        conflict_vols: set[tuple[str, str]] = set()
         for pod in pods:
             per_pod = pod_disk_vols(pod)
             if len(per_pod) > self.vols_per_pod:
                 return None  # caller falls back to oracle for this pod
-            vol_count |= per_pod
-        if n_terms > self.max_terms or len(vol_count) > self.max_vols:
+            for d in per_pod:
+                if d in mounted_disks or d in seen_once:
+                    conflict_vols.add(d)
+                else:
+                    seen_once.add(d)
+        if n_terms > self.max_terms or len(conflict_vols) > self.max_vols:
             return None
 
         # node-side basics
@@ -601,6 +623,7 @@ class Tensorizer:
         pod_vol_valid = np.zeros((P, W), dtype=bool)
         pod_vol_ro_ok = np.zeros((P, W), dtype=bool)
         pod_vol_kind = np.zeros((P, W), dtype=np.int32)
+        any_count_only = False
         for i, pod in enumerate(pods):
             if not pod.spec.volumes:
                 continue
@@ -611,17 +634,30 @@ class Tensorizer:
                 key = (vol.disk_kind, vol.disk_id)
                 per_pod[key] = per_pod.get(key, True) and vol.read_only
             for s, (key, all_ro) in enumerate(per_pod.items()):
-                v = vol_vocab.setdefault(key, len(vol_vocab))
-                pod_vol_ids[i, s] = v
+                if key in conflict_vols:
+                    v = vol_vocab.setdefault(key, len(vol_vocab))
+                    pod_vol_ids[i, s] = v
+                else:
+                    # count-only: no conflict identity — reads the
+                    # always-empty sentinel row (never blocked, always
+                    # "new" for MaxVolumeCount) and is excluded from the
+                    # occupancy write (kernel masks it out)
+                    pod_vol_ids[i, s] = -1  # fixed up to sentinel below
+                    any_count_only = True
                 pod_vol_valid[i, s] = True
                 pod_vol_ro_ok[i, s] = all_ro and key[0] in _READONLY_SHARED_KINDS
                 pod_vol_kind[i, s] = (
                     _VOL_KINDS.index(key[0]) if key[0] in VOLUME_COUNT_LIMITS else K
                 )
         # volume-less segments keep a tiny (never-touched) state footprint;
-        # the kernel's use_vols flag skips the volume logic entirely
+        # the kernel's use_vols flag skips the volume logic entirely.
+        # The vocab holds conflict-capable disks only, so its bucketed pad
+        # is small and stable across random workload mixes — shape-bucket
+        # stability is what lets one warm-up compile cover every segment.
+        use_vols = bool(vol_vocab) or any_count_only
         v_state = 8 if not vol_vocab else _pad_to(len(vol_vocab) + 1, self.vol_multiple)
-        pod_vol_ids[~pod_vol_valid] = v_state - 1  # sentinel: always-empty row
+        pod_vol_count_only = pod_vol_valid & (pod_vol_ids < 0)
+        pod_vol_ids[~pod_vol_valid | pod_vol_count_only] = v_state - 1  # sentinel row
         vol_limits = np.array([VOLUME_COUNT_LIMITS[k] for k in _VOL_KINDS], dtype=np.int32)
 
         # PVC-backed volumes: zone / PV-node-affinity constraints are static
@@ -734,6 +770,8 @@ class Tensorizer:
             pod_vol_valid=pod_vol_valid,
             pod_vol_ro_ok=pod_vol_ro_ok,
             pod_vol_kind=pod_vol_kind,
+            pod_vol_count_only=pod_vol_count_only,
+            use_vols=use_vols,
             vol_limits=vol_limits,
             weights={
                 "least": least_requested_weight,

@@ -101,24 +101,32 @@ class TPUBatchBackend:
         return _device_platform() == "tpu"
 
     # -- greedy segmentation ------------------------------------------------
-    def _segments(self, pods: list[api.Pod]) -> list[tuple[str, list[tuple[int, api.Pod]]]]:
+    def _segments(
+        self, pods: list[api.Pod], mounted_disks: Optional[set] = None
+    ) -> list[tuple[str, list[tuple[int, api.Pod]]]]:
         """Split the (ordered) batch into kernel segments that respect the
         tensor budgets, walking pod order once — every cut point preserves
         sequential-greedy parity because each segment re-tensorizes against
         the state left by its predecessors.  Pods no kernel can express
-        (> vols_per_pod distinct disks) become singleton oracle segments."""
+        (> vols_per_pod distinct disks) become singleton oracle segments.
+
+        The volume budget counts CONFLICT-CAPABLE disks only (shared
+        within the segment or already mounted) — build_static gives
+        singleton unmounted disks no identity row, so they cost nothing."""
         tz = self.tensorizer
+        mounted = mounted_disks if mounted_disks is not None else set()
         out: list[tuple[str, list[tuple[int, api.Pod]]]] = []
         cur: list[tuple[int, api.Pod]] = []
         sigs: set[str] = set()
-        vols: set = set()
+        vols_once: set = set()
+        vols_conflict: set = set()
         n_terms = 0
 
         def flush() -> None:
-            nonlocal cur, sigs, vols, n_terms
+            nonlocal cur, sigs, vols_once, vols_conflict, n_terms
             if cur:
                 out.append(("kernel", cur))
-            cur, sigs, vols, n_terms = [], set(), set(), 0
+            cur, sigs, vols_once, vols_conflict, n_terms = [], set(), set(), set(), 0
 
         for i, pod in enumerate(pods):
             pv = pod_disk_vols(pod)
@@ -126,19 +134,22 @@ class TPUBatchBackend:
                 flush()
                 out.append(("oracle", [(i, pod)]))
                 continue
+            pv_conflict = {d for d in pv if d in mounted or d in vols_once}
             key = pod_signature_key(pod)
             t_new = count_affinity_terms(pod) if key not in sigs else 0
             if cur and (
                 len(cur) >= self.max_segment_pods
                 or (key not in sigs and len(sigs) >= tz.max_groups)
                 or n_terms + t_new > tz.max_terms
-                or len(vols | pv) > tz.max_vols
+                or len(vols_conflict | pv_conflict) > tz.max_vols
             ):
                 flush()
                 t_new = count_affinity_terms(pod)
+                pv_conflict = {d for d in pv if d in mounted}
             sigs.add(key)
             n_terms += t_new
-            vols |= pv
+            vols_conflict |= pv_conflict
+            vols_once |= pv
             cur.append((i, pod))
         flush()
         return out
@@ -196,12 +207,21 @@ class TPUBatchBackend:
 
         assignments: list[Optional[str]] = [None] * len(pods)
 
+        # disks mounted by pods already on nodes; grows as the batch binds.
+        # Segmentation and the tensorizer use it to give identity rows only
+        # to conflict-capable disks (everything else is count-only).
+        mounted_disks: set = set()
+        for info in work_map.values():
+            for q in info.pods:
+                mounted_disks |= pod_disk_vols(q)
+
         def apply(pod: api.Pod, node_name: Optional[str], i: int) -> None:
             assignments[i] = node_name
             if node_name is not None:
                 info = work_map.get(node_name)
                 if info is not None:
                     info.add_pod(pod)
+                mounted_disks.update(pod_disk_vols(pod))
 
         def run_oracle(pod: api.Pod, i: int) -> None:
             try:
@@ -226,6 +246,7 @@ class TPUBatchBackend:
                 prefer_avoid_weight=weights["prefer_avoid"],
                 image_weight=weights["image"],
                 interpod_weight=weights["interpod"],
+                mounted_disks=mounted_disks,
             )
             if static is None:
                 # over a budget (signatures / affinity terms / volumes):
@@ -270,7 +291,7 @@ class TPUBatchBackend:
         # budget-respecting segments up front (no trial-and-error splits);
         # the binary split inside run_kernel_segment remains only as a
         # safety net should build_static still reject a segment.
-        for kind, segment in self._segments(pods):
+        for kind, segment in self._segments(pods, mounted_disks=mounted_disks):
             if kind == "oracle":
                 for i, pod in segment:
                     run_oracle(pod, i)

@@ -137,6 +137,9 @@ def batch_xs(static: BatchStatic, min_length: int = 512):
     vro[:p_real] = static.pod_vol_ro_ok
     vkind = np.zeros((p_pad, w), dtype=np.int32)
     vkind[:p_real] = static.pod_vol_kind
+    vco = np.zeros((p_pad, w), dtype=bool)
+    if static.pod_vol_count_only is not None:
+        vco[:p_real] = static.pod_vol_count_only
     return (
         jnp.asarray(gids),
         jnp.asarray(pvalid),
@@ -144,6 +147,7 @@ def batch_xs(static: BatchStatic, min_length: int = 512):
         jnp.asarray(vval),
         jnp.asarray(vro),
         jnp.asarray(vkind),
+        jnp.asarray(vco),
     )
 
 
@@ -211,7 +215,7 @@ def make_step(
     def step(state: ScanState, xs):
         # per-pod inputs: signature id, validity (False = scan-length
         # padding), and the pod's volume slots
-        gid, pvalid, vol_ids, vol_valid, vol_ro_ok, vol_kind = xs
+        gid, pvalid, vol_ids, vol_valid, vol_ro_ok, vol_kind, vol_count_only = xs
         g_req = dev.g_request[gid]  # [R]
         g_nz = dev.g_nonzero[gid]  # [2]
         g_ports = dev.g_ports[gid]  # [Pv]
@@ -371,9 +375,10 @@ def make_step(
             dm_new, downer_new, total_match = state.dm, state.downer, state.total_match
         if use_vols:
             # volume occupancy on the chosen node: scatter the pod's slots
-            # into the [V, N] maps (invalid slots aim at the sentinel row and
-            # write False — a no-op under max)
-            vol_upd = (vol_valid & landed)[:, None] & onehot[None, :]  # [W, N]
+            # into the [V, N] maps (invalid AND count-only slots aim at the
+            # sentinel row, which must stay empty — mask them to write False,
+            # a no-op under max)
+            vol_upd = (vol_valid & ~vol_count_only & landed)[:, None] & onehot[None, :]  # [W, N]
             newv_chosen = (vol_valid & new_v[:, safe] & landed).astype(jnp.int32)  # [W]
             vol_any = state.vol_any.at[vol_ids].max(vol_upd)
             vol_ns = state.vol_ns.at[vol_ids].max(vol_upd & ~vol_ro_ok[:, None])
@@ -418,7 +423,7 @@ def _runner_for(static: BatchStatic):
         int(static.num_zones),
         weights,
         use_terms=bool(static.terms),
-        use_vols=bool(static.vol_vocab),
+        use_vols=bool(static.use_vols),
     )
 
 

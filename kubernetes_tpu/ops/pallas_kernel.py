@@ -106,12 +106,13 @@ def _pack(static: BatchStatic, init: InitialState):
 
     gids = np.zeros(p_pad, dtype=np.int32)
     gids[:p_real] = static.group_of_pod
-    # packed per-pod volume slots: vid*32 | kind*4 | ro*2 | valid
-    pod_vol = np.full((p_pad, w), (static.v_state - 1) * 32, dtype=np.int32)
+    # packed per-pod volume slots: vid*64 | kind*8 | ro*4 | count_only*2 | valid
+    pod_vol = np.full((p_pad, w), (static.v_state - 1) * 64, dtype=np.int32)
     pod_vol[:p_real] = (
-        static.pod_vol_ids * 32
-        + static.pod_vol_kind * 4
-        + static.pod_vol_ro_ok.astype(np.int32) * 2
+        static.pod_vol_ids * 64
+        + static.pod_vol_kind * 8
+        + static.pod_vol_ro_ok.astype(np.int32) * 4
+        + static.pod_vol_count_only.astype(np.int32) * 2
         + static.pod_vol_valid.astype(np.int32)
     )
 
@@ -356,9 +357,10 @@ def _pallas_runner(
                 has_kind = [jnp.int32(0) for _ in range(k)]
                 for s in range(w):
                     packed = pod_vol[i, s]
-                    vid = packed // 32
-                    kind = (packed // 4) % 8
-                    ro = (packed // 2) % 2
+                    vid = packed // 64
+                    kind = (packed // 8) % 8
+                    ro = (packed // 4) % 2
+                    co = (packed // 2) % 2  # count-only: sentinel row, no write
                     valid = packed % 2
                     row = vol_row(vid)  # [1, N]
                     any_row = row % 2
@@ -366,7 +368,7 @@ def _pallas_runner(
                     blocked = jnp.where(ro > 0, ns_row, any_row)
                     disk_bad = disk_bad | ((valid > 0) & (blocked > 0))
                     new_row = jnp.where(valid > 0, 1 - any_row, 0)  # [1, N]
-                    slot_rows.append((vid, valid, ro, kind, any_row, new_row))
+                    slot_rows.append((vid, valid, ro, co, kind, any_row, new_row))
                     for kk in range(k):
                         kin = (kind == kk) & (valid > 0)
                         count_new[kk] = count_new[kk] + jnp.where(kin, new_row, 0)
@@ -519,8 +521,10 @@ def _pallas_runner(
                 total_s[:] = total_s[:] + m_i
 
             if use_vols:
-                for (vid, valid, ro, kind, any_row, new_row) in slot_rows:
-                    upd = ((valid > 0) & landed & (oh > 0)).astype(jnp.int32)  # [1,N]
+                for (vid, valid, ro, co, kind, any_row, new_row) in slot_rows:
+                    # count-only slots aim at the sentinel row, which must
+                    # stay empty: they never write occupancy
+                    upd = ((valid > 0) & (co == 0) & landed & (oh > 0)).astype(jnp.int32)  # [1,N]
                     bits = upd * (1 + 2 * (1 - ro))
                     base = pl.multiple_of((vid // 8) * 8, 8)
                     blk = volf_s[pl.ds(base, 8), :].astype(jnp.int32)  # [8, N]
@@ -600,7 +604,7 @@ def schedule_batch_pallas(static: BatchStatic, init: InitialState):
         int(static.num_zones),
         weights,
         bool(static.terms),
-        bool(static.vol_vocab),
+        bool(static.use_vols),
     )
     chosen2d, rr = run(*scalars, *ins)
     chosen = np.asarray(chosen2d).reshape(-1)[: len(static.group_of_pod)]
