@@ -26,6 +26,7 @@ this store, rebuildable from snapshot + watch replay (SURVEY.md §5.3).
 from __future__ import annotations
 
 # (copy module no longer needed: JSON-shaped fast deepcopy below)
+import collections
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -121,8 +122,11 @@ class Store:
         self._rev = 0
         # kind -> {key -> _Item}
         self._objects: dict[str, dict[str, _Item]] = {}
-        # ordered event log (the watch-cache window)
-        self._log: list[WatchEvent] = []
+        # ordered event log (the watch-cache window).  A deque: the window
+        # trim must be O(1) — a front-slice del on a list memmoves the
+        # whole window on EVERY write once it fills, which at a 300k
+        # window costs more than the write itself.
+        self._log: collections.deque[WatchEvent] = collections.deque(maxlen=event_log_window)
         self._log_window = event_log_window
         self._watchers: list[tuple[Optional[str], "queue.Queue[Optional[WatchEvent]]"]] = []
 
@@ -330,9 +334,7 @@ class Store:
         # emit time and handed to the log and every watcher.  Consumers must
         # not mutate it (the informer parses it into fresh typed objects;
         # the mutation detector catches violations in tests).
-        self._log.append(ev)
-        if len(self._log) > self._log_window:
-            del self._log[: len(self._log) - self._log_window]
+        self._log.append(ev)  # deque maxlen trims the window in C
         for kind, q in self._watchers:
             if kind is None or kind == ev.kind:
                 q.put(ev)
