@@ -149,6 +149,85 @@ class _AffinityTerm:
 
 _VOL_KINDS = list(VOLUME_COUNT_LIMITS)  # fixed kind axis for [K, N] counts
 
+_NS_KEY = "\x00ns"  # namespace rides the label space as a reserved key
+
+
+class HostBatchState:
+    """Incremental host-side cluster state shared by every kernel segment
+    of one batch.
+
+    Without it, ``initial_state`` rebuilds its selector-match corpus and
+    volume occupancy by scanning EVERY pod on EVERY node once per
+    segment — O(existing-pods × segments), the dominant host cost at
+    150k-pod scale.  This object is built once per batch (O(existing
+    pods), usually zero) and updated per placed pod; segments then pay
+    only O(new selectors × corpus) native matching and O(vocab) fills.
+
+    The node order is the same sorted order ``build_static`` uses, so
+    node indices agree across the batch."""
+
+    def __init__(self, node_info_map: dict[str, "NodeInfo"]):
+        self.node_names = sorted(
+            n for n, i in node_info_map.items() if i.node is not None
+        )
+        self.node_index = {n: j for j, n in enumerate(self.node_names)}
+        self.eng = MatchEngine()
+        self.pod_lids: list[int] = []
+        self.pod_node_j: list[int] = []
+        self._node_j_cache: Optional[np.ndarray] = None
+        # (kind, id) -> {node_j: non-sharable instance present}
+        self.disk_locations: dict[tuple, dict[int, bool]] = {}
+        self._kind_pos = {k: i for i, k in enumerate(_VOL_KINDS)}
+        # distinct limited-kind disks per node: [K, N_real]
+        self.nk_counts = np.zeros((len(_VOL_KINDS), len(self.node_names)), dtype=np.int32)
+        for name in self.node_names:
+            j = self.node_index[name]
+            for q in node_info_map[name].pods:
+                self._ingest(q, j)
+
+    @property
+    def mounted_disks(self):
+        """Membership view over every (kind, id) mounted anywhere."""
+        return self.disk_locations
+
+    def add_pod(self, pod: api.Pod, node_name: str) -> None:
+        j = self.node_index.get(node_name)
+        if j is not None:
+            self._ingest(pod, j)
+
+    def _ingest(self, pod: api.Pod, j: int) -> None:
+        self.pod_lids.append(
+            self.eng.add_labelmap({**pod.meta.labels, _NS_KEY: pod.meta.namespace})
+        )
+        self.pod_node_j.append(j)
+        self._node_j_cache = None
+        if not pod.spec.volumes:
+            return
+        per_pod: dict[tuple, bool] = {}  # all-refs-read-only per disk
+        for vol in pod.spec.volumes:
+            if not vol.disk_id:
+                continue
+            key = (vol.disk_kind, vol.disk_id)
+            per_pod[key] = per_pod.get(key, True) and vol.read_only
+        for key, all_ro in per_pod.items():
+            locs = self.disk_locations.setdefault(key, {})
+            ns = not (key[0] in _READONLY_SHARED_KINDS and all_ro)
+            if j not in locs:
+                locs[j] = ns
+                pos = self._kind_pos.get(key[0])
+                if pos is not None:
+                    self.nk_counts[pos, j] += 1
+            elif ns:
+                locs[j] = True
+
+    def node_j_array(self) -> np.ndarray:
+        if self._node_j_cache is None:
+            self._node_j_cache = np.asarray(self.pod_node_j, dtype=np.int64)
+        return self._node_j_cache
+
+    def close(self) -> None:
+        self.eng.close()
+
 
 @dataclass
 class BatchStatic:
@@ -792,6 +871,7 @@ class Tensorizer:
         pctx: PriorityContext,
         pods: list[api.Pod],
         round_robin: int = 0,
+        host_state: Optional[HostBatchState] = None,
     ) -> InitialState:
         n_pad = static.n_pad
         G = static.static_ok.shape[0]
@@ -837,8 +917,14 @@ class Tensorizer:
             (t, at) for t, at in enumerate(static.terms) if at.term.selector is not None
         ]
         if groups_with_sels or matchable_terms:
-            eng = MatchEngine()
-            NS_KEY = "\x00ns"
+            # the engine + labelmap corpus: batch-persistent when a
+            # HostBatchState is supplied (selectors are per-segment either
+            # way); scratch-built and torn down otherwise
+            if host_state is not None:
+                eng = host_state.eng
+            else:
+                eng = MatchEngine()
+            NS_KEY = _NS_KEY
             sel_ids: dict[int, list[int]] = {}
             for g, sels in groups_with_sels.items():
                 ns_req = (NS_KEY, "Eq", [reps[g].meta.namespace])
@@ -866,14 +952,20 @@ class Tensorizer:
                     + [(r.key, r.operator, list(r.values)) for r in sel.match_expressions]
                 )
                 term_sids.append(eng.add_selector(reqs))
-            pod_lids: list[int] = []
-            pod_node_j: list[int] = []
-            for j, name in enumerate(static.node_names):
-                for q in node_info_map[name].pods:
-                    pod_lids.append(eng.add_labelmap({**q.meta.labels, NS_KEY: q.meta.namespace}))
-                    pod_node_j.append(j)
-            if pod_lids:
+            if host_state is not None:
+                pod_lids = host_state.pod_lids
+                node_j = host_state.node_j_array()
+            else:
+                pod_lids = []
+                pod_node_j: list[int] = []
+                for j, name in enumerate(static.node_names):
+                    for q in node_info_map[name].pods:
+                        pod_lids.append(
+                            eng.add_labelmap({**q.meta.labels, NS_KEY: q.meta.namespace})
+                        )
+                        pod_node_j.append(j)
                 node_j = np.asarray(pod_node_j, dtype=np.int64)
+            if pod_lids:
                 for g, ids in sel_ids.items():
                     hits = eng.match_any(ids, pod_lids)
                     np.add.at(spread_counts[g], node_j[hits], 1)
@@ -883,7 +975,8 @@ class Tensorizer:
                         hits = tm[row]
                         total_match[t] = int(hits.sum())
                         np.add.at(dom_match, static.node_domain[t, node_j[hits]], 1)
-            eng.close()
+            if host_state is None:
+                eng.close()
         dm = (dom_match[static.node_domain] * static.dom_valid).astype(np.int32)
 
         # volume occupancy from existing pods: instance presence and
@@ -892,28 +985,37 @@ class Tensorizer:
         # MaxVolumeCount dynamic state)
         V = static.v_state
         K = len(_VOL_KINDS)
-        vol_idx = {key: v for v, key in enumerate(static.vol_vocab)}
         vol_any = np.zeros((V, n_pad), dtype=bool)
         vol_ns = np.zeros((V, n_pad), dtype=bool)
         nk = np.zeros((K, n_pad), dtype=np.int32)
-        kind_pos = {k: i for i, k in enumerate(_VOL_KINDS)}
-        for j, name in enumerate(static.node_names):
-            seen: dict[str, set] = {}
-            for q in node_info_map[name].pods:
-                if not q.spec.volumes:
-                    continue
-                for vol in q.spec.volumes:
-                    if not vol.disk_id:
+        if host_state is not None:
+            # O(vocab): the disk-location dicts already aggregate the world
+            for v, key in enumerate(static.vol_vocab):
+                for j, ns_present in host_state.disk_locations.get(key, {}).items():
+                    vol_any[v, j] = True
+                    if ns_present:
+                        vol_ns[v, j] = True
+            nk[:, : host_state.nk_counts.shape[1]] = host_state.nk_counts
+        else:
+            vol_idx = {key: v for v, key in enumerate(static.vol_vocab)}
+            kind_pos = {k: i for i, k in enumerate(_VOL_KINDS)}
+            for j, name in enumerate(static.node_names):
+                seen: dict[str, set] = {}
+                for q in node_info_map[name].pods:
+                    if not q.spec.volumes:
                         continue
-                    if vol.disk_kind in kind_pos:
-                        seen.setdefault(vol.disk_kind, set()).add(vol.disk_id)
-                    v = vol_idx.get((vol.disk_kind, vol.disk_id))
-                    if v is not None:
-                        vol_any[v, j] = True
-                        if not (vol.disk_kind in _READONLY_SHARED_KINDS and vol.read_only):
-                            vol_ns[v, j] = True
-            for kind, ids in seen.items():
-                nk[kind_pos[kind], j] = len(ids)
+                    for vol in q.spec.volumes:
+                        if not vol.disk_id:
+                            continue
+                        if vol.disk_kind in kind_pos:
+                            seen.setdefault(vol.disk_kind, set()).add(vol.disk_id)
+                        v = vol_idx.get((vol.disk_kind, vol.disk_id))
+                        if v is not None:
+                            vol_any[v, j] = True
+                            if not (vol.disk_kind in _READONLY_SHARED_KINDS and vol.read_only):
+                                vol_ns[v, j] = True
+                for kind, ids in seen.items():
+                    nk[kind_pos[kind], j] = len(ids)
 
         return InitialState(
             requested=requested,

@@ -41,6 +41,7 @@ from ..scheduler.priorities import (
     TaintTolerationPriority,
 )
 from ..models.snapshot import (
+    HostBatchState,
     Tensorizer,
     count_affinity_terms,
     pod_disk_vols,
@@ -207,13 +208,15 @@ class TPUBatchBackend:
 
         assignments: list[Optional[str]] = [None] * len(pods)
 
-        # disks mounted by pods already on nodes; grows as the batch binds.
-        # Segmentation and the tensorizer use it to give identity rows only
-        # to conflict-capable disks (everything else is count-only).
-        mounted_disks: set = set()
-        for info in work_map.values():
-            for q in info.pods:
-                mounted_disks |= pod_disk_vols(q)
+        # batch-persistent host state: selector-match corpus + disk
+        # locations, built once and updated per placed pod (otherwise
+        # initial_state re-scans every existing pod per segment).  Its
+        # disk-location keys double as the mounted-disk membership that
+        # keeps singleton disks out of the occupancy vocab.  Only the
+        # kernel path needs it — the oracle-only fallback must not pay
+        # the O(existing pods) corpus build.
+        host_state = HostBatchState(work_map) if weights is not None else None
+        mounted_disks = host_state.mounted_disks if host_state is not None else set()
 
         def apply(pod: api.Pod, node_name: Optional[str], i: int) -> None:
             assignments[i] = node_name
@@ -221,7 +224,8 @@ class TPUBatchBackend:
                 info = work_map.get(node_name)
                 if info is not None:
                     info.add_pod(pod)
-                mounted_disks.update(pod_disk_vols(pod))
+                if host_state is not None:
+                    host_state.add_pod(pod, node_name)
 
         def run_oracle(pod: api.Pod, i: int) -> None:
             try:
@@ -260,7 +264,8 @@ class TPUBatchBackend:
                 run_kernel_segment(segment[mid:])
                 return
             init = self.tensorizer.initial_state(
-                static, work_map, work_pctx, seg_pods, round_robin=self.algorithm._round_robin
+                static, work_map, work_pctx, seg_pods,
+                round_robin=self.algorithm._round_robin, host_state=host_state,
             )
             if self._use_pallas(static):
                 from .pallas_kernel import schedule_batch_pallas
@@ -281,20 +286,22 @@ class TPUBatchBackend:
             self.stats["kernel_pods"] += len(segment)
             self.stats["segments"] += 1
 
-        if weights is None:
-            for i, pod in enumerate(pods):
-                run_oracle(pod, i)
-            return assignments
-
         # Phase B: every pod is kernel-expressible (inter-pod affinity and
         # volumes run on device).  One ordered pass cuts the batch into
         # budget-respecting segments up front (no trial-and-error splits);
         # the binary split inside run_kernel_segment remains only as a
         # safety net should build_static still reject a segment.
-        for kind, segment in self._segments(pods, mounted_disks=mounted_disks):
-            if kind == "oracle":
-                for i, pod in segment:
-                    run_oracle(pod, i)
-            else:
-                run_kernel_segment(segment)
+        if weights is None:
+            for i, pod in enumerate(pods):
+                run_oracle(pod, i)
+            return assignments
+        try:
+            for kind, segment in self._segments(pods, mounted_disks=mounted_disks):
+                if kind == "oracle":
+                    for i, pod in segment:
+                        run_oracle(pod, i)
+                else:
+                    run_kernel_segment(segment)
+        finally:
+            host_state.close()
         return assignments
