@@ -18,7 +18,8 @@ from __future__ import annotations
 
 from ..api.quantity import Quantity
 from ..store.store import NotFoundError
-from ..api.types import CPU, MEMORY, HOSTNAME_LABEL
+from ..api.types import (CPU, MEMORY, HOSTNAME_LABEL,
+    TAINT_NODE_NOT_READY, TAINT_NODE_UNREACHABLE)
 from . import quota as quotalib
 from .framework import (
     CREATE,
@@ -174,8 +175,8 @@ class DefaultTolerationSeconds(AdmissionPlugin):
     name = "DefaultTolerationSeconds"
     operations = (CREATE,)
 
-    NOT_READY = "node.alpha.kubernetes.io/notReady"
-    UNREACHABLE = "node.alpha.kubernetes.io/unreachable"
+    NOT_READY = TAINT_NODE_NOT_READY
+    UNREACHABLE = TAINT_NODE_UNREACHABLE
     DEFAULT_SECONDS = 300
 
     def admit(self, attrs: Attributes) -> None:

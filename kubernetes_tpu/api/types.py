@@ -39,6 +39,11 @@ NO_SCHEDULE = "NoSchedule"
 PREFER_NO_SCHEDULE = "PreferNoSchedule"
 NO_EXECUTE = "NoExecute"
 
+# the era's node-failure taint keys (taint_controller.go); applied by the
+# node lifecycle controller, tolerated by DefaultTolerationSeconds
+TAINT_NODE_NOT_READY = "node.alpha.kubernetes.io/notReady"
+TAINT_NODE_UNREACHABLE = "node.alpha.kubernetes.io/unreachable"
+
 # Node condition types
 NODE_READY = "Ready"
 NODE_MEMORY_PRESSURE = "MemoryPressure"

@@ -30,6 +30,7 @@ from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccounts import ServiceAccountController
 from .statefulset import StatefulSetController
+from .taint import NoExecuteTaintManager
 from .ttl import TTLController
 from .volume import AttachDetachController, PersistentVolumeController
 
@@ -49,6 +50,7 @@ DEFAULT_CONTROLLERS: dict[str, Callable] = {
     "podgc": PodGCController,
     "ttl": TTLController,
     "disruption": DisruptionController,
+    "taint-manager": NoExecuteTaintManager,
     "persistentvolume": PersistentVolumeController,
     "attachdetach": AttachDetachController,
     "horizontalpodautoscaler": HorizontalPodAutoscalerController,

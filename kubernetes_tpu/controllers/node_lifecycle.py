@@ -69,9 +69,11 @@ class NodeLifecycleController(Controller):
         secondary_eviction_qps: float = 0.01,
         unhealthy_zone_threshold: float = 0.55,
         large_zone_size: int = 50,
+        use_taint_based_evictions: bool = False,
         **kw,
     ):
         super().__init__(clientset, informers, **kw)
+        self.use_taint_based_evictions = use_taint_based_evictions
         self.grace_period = grace_period
         self.pod_eviction_timeout = pod_eviction_timeout
         self.eviction_qps = eviction_qps
@@ -138,20 +140,56 @@ class NodeLifecycleController(Controller):
             else:
                 limiter.set_qps(0.0)
 
-        # 3. evictions
+        # 3. evictions — either direct pod deletes after the grace window,
+        # or (taint mode) NoExecute taints applied at once: the taint
+        # manager then enforces each pod's own tolerationSeconds instead of
+        # one controller-wide timeout (taint_controller.go)
         for zone, members in zone_members.items():
             limiter = self._zone_limiters[zone]
-            if limiter.qps <= 0.0:
-                continue
             for node in members:
                 if self._is_ready(node):
                     self._not_ready_since.pop(node.meta.name, None)
+                    if self.use_taint_based_evictions:
+                        self._set_failure_taints(node, ready=True)
+                    continue
+                if limiter.qps <= 0.0:
+                    continue  # zone damped: leave state as-is
+                if self.use_taint_based_evictions:
+                    if limiter.try_accept():
+                        self._set_failure_taints(node, ready=False)
+                        summary["tainted"] = summary.get("tainted", 0) + 1
                     continue
                 since = self._not_ready_since.setdefault(node.meta.name, now)
                 if now - since < self.pod_eviction_timeout:
                     continue
                 summary["evicted_pods"] += self._evict_pods(node, limiter)
         return summary
+
+    def _set_failure_taints(self, node: api.Node, ready: bool) -> None:
+        """Reconcile the notReady/unreachable NoExecute taints to the
+        node's observed condition (reference ``zoneNoExecuteTainer``)."""
+        from .taint import TAINT_NOT_READY, TAINT_UNREACHABLE
+
+        cond = node.status.condition(api.NODE_READY)
+        status = cond.status if cond else "Unknown"
+        want_key = None
+        if not ready:
+            want_key = TAINT_UNREACHABLE if status == "Unknown" else TAINT_NOT_READY
+        ours = {TAINT_NOT_READY, TAINT_UNREACHABLE}
+        have = {t.key for t in node.spec.taints if t.key in ours}
+        if have == ({want_key} if want_key else set()):
+            return
+
+        def _mutate(cur: api.Node) -> api.Node:
+            cur.spec.taints = [t for t in cur.spec.taints if t.key not in ours]
+            if want_key:
+                cur.spec.taints.append(api.Taint(key=want_key, effect=api.NO_EXECUTE))
+            return cur
+
+        try:
+            self.clientset.nodes.guaranteed_update(node.meta.name, _mutate, "")
+        except NotFoundError:
+            pass
 
     # -- helpers -----------------------------------------------------------
     def _is_ready(self, node: api.Node) -> bool:

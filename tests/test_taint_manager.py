@@ -1,0 +1,191 @@
+"""NoExecute taint manager: timed toleration-aware evictions.
+
+Behavioral spec from the reference
+``pkg/controller/node/scheduler/taint_controller.go`` /
+``timed_workers.go`` and its tests."""
+
+import pytest
+
+from kubernetes_tpu.api import NO_EXECUTE, Taint, Toleration
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.controllers.node_lifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.taint import (
+    TAINT_NOT_READY,
+    TAINT_UNREACHABLE,
+    NoExecuteTaintManager,
+    min_toleration_seconds,
+)
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+@pytest.fixture()
+def cs():
+    return Clientset(Store())
+
+
+def taint(key=TAINT_NOT_READY):
+    return Taint(key=key, effect=NO_EXECUTE)
+
+
+def tol(key=TAINT_NOT_READY, seconds=None):
+    return Toleration(key=key, operator="Exists", effect=NO_EXECUTE,
+                      toleration_seconds=seconds)
+
+
+def mgr(cs, clock):
+    m = NoExecuteTaintManager(cs, clock=clock)
+    m.informers.start_all_manual()
+    return m
+
+
+def test_min_toleration_seconds_semantics():
+    p = make_pod("p", tolerations=[tol(seconds=300)])
+    assert min_toleration_seconds(p, [taint()]) == 300.0
+    assert min_toleration_seconds(make_pod("q"), [taint()]) is None  # no toleration
+    assert min_toleration_seconds(make_pod("r", tolerations=[tol()]), [taint()]) == float("inf")
+    # minimum across the tolerations actually used
+    p2 = make_pod("s", tolerations=[tol(seconds=300), tol(TAINT_UNREACHABLE, seconds=60)])
+    assert min_toleration_seconds(p2, [taint(), taint(TAINT_UNREACHABLE)]) == 60.0
+
+
+def test_intolerant_pod_evicted_immediately(cs):
+    clock = FakeClock()
+    cs.nodes.create(make_node("n1", taints=[taint()]))
+    cs.pods.create(make_pod("victim", node_name="n1"))
+    m = mgr(cs, clock)
+    m.tick()
+    assert [p.meta.name for p in cs.pods.list()[0]] == []
+    assert m.stats["evicted_now"] == 1
+
+
+def test_toleration_seconds_timed_eviction(cs):
+    """The 300s default: pod survives until t+300, then goes."""
+    clock = FakeClock()
+    cs.nodes.create(make_node("n1", taints=[taint()]))
+    cs.pods.create(make_pod("p", node_name="n1", tolerations=[tol(seconds=300)]))
+    m = mgr(cs, clock)
+    m.tick()
+    assert cs.pods.get("p", "default") is not None  # still here
+    clock.now = 299.0
+    m.tick()
+    assert cs.pods.get("p", "default") is not None
+    clock.now = 300.0
+    assert m.tick() == 1
+    assert [p.meta.name for p in cs.pods.list()[0]] == []
+    assert m.stats["evicted_timed"] == 1
+
+
+def test_untaint_cancels_timer(cs):
+    clock = FakeClock()
+    cs.nodes.create(make_node("n1", taints=[taint()]))
+    cs.pods.create(make_pod("p", node_name="n1", tolerations=[tol(seconds=300)]))
+    m = mgr(cs, clock)
+    m.tick()
+    assert m.pending_count() == 1
+    # node recovers: taint removed
+    cs.nodes.guaranteed_update("n1", lambda n: (n.spec.taints.clear(), n)[1])
+    clock.now = 400.0
+    assert m.tick() == 0
+    assert cs.pods.get("p", "default") is not None
+    assert m.pending_count() == 0 and m.stats["cancelled"] == 1
+
+
+def test_forever_toleration_never_evicts(cs):
+    clock = FakeClock()
+    cs.nodes.create(make_node("n1", taints=[taint()]))
+    cs.pods.create(make_pod("p", node_name="n1", tolerations=[tol()]))
+    m = mgr(cs, clock)
+    clock.now = 1e6
+    m.tick()
+    assert cs.pods.get("p", "default") is not None
+    assert m.pending_count() == 0
+
+
+def test_new_pod_on_tainted_node_gets_timer(cs):
+    clock = FakeClock()
+    cs.nodes.create(make_node("n1", taints=[taint()]))
+    m = mgr(cs, clock)
+    m.tick()
+    cs.pods.create(make_pod("late", node_name="n1", tolerations=[tol(seconds=10)]))
+    m.tick()
+    assert m.pending_count() == 1
+    clock.now = 10.0
+    assert m.tick() == 1
+
+
+def test_node_lifecycle_applies_failure_taints(cs):
+    """Taint mode: NotReady -> notReady taint; stale heartbeat (Unknown)
+    -> unreachable taint; recovery removes them (zoneNoExecuteTainer)."""
+    clock = FakeClock()
+    from kubernetes_tpu.api import NodeCondition
+
+    cs.nodes.create(make_node("n1", conditions=[
+        NodeCondition(type="Ready", status="True", heartbeat_time=0.0)
+    ]))
+    # healthy peers keep the zone out of full-disruption damping
+    for i in (2, 3):
+        cs.nodes.create(make_node(f"n{i}", conditions=[
+            NodeCondition(type="Ready", status="True", heartbeat_time=1e9)
+        ]))
+    ctl = NodeLifecycleController(
+        cs, grace_period=40.0, use_taint_based_evictions=True,
+        eviction_qps=1000.0, clock=clock,
+    )
+    ctl.informers.start_all_manual()
+    clock.now = 100.0  # heartbeat stale -> Unknown -> unreachable taint
+    ctl.monitor()
+    ctl.monitor()  # second pass taints (census sees the Unknown mark)
+    n = cs.nodes.get("n1")
+    assert [t.key for t in n.spec.taints] == [TAINT_UNREACHABLE]
+    # kubelet comes back: Ready heartbeat -> taints removed
+    def _ready(cur):
+        cur.status.conditions = [NodeCondition(type="Ready", status="True",
+                                               heartbeat_time=clock.now)]
+        return cur
+    cs.nodes.guaranteed_update("n1", _ready)
+    ctl.monitor()
+    assert cs.nodes.get("n1").spec.taints == []
+
+
+def test_end_to_end_taint_eviction_with_default_toleration(cs):
+    """Lifecycle taints the dead node; the taint manager enforces the
+    300s default toleration the admission plugin injects."""
+    clock = FakeClock()
+    from kubernetes_tpu.api import NodeCondition
+
+    cs.nodes.create(make_node("n1", conditions=[
+        NodeCondition(type="Ready", status="True", heartbeat_time=0.0)
+    ]))
+    for i in (2, 3):
+        cs.nodes.create(make_node(f"n{i}", conditions=[
+            NodeCondition(type="Ready", status="True", heartbeat_time=1e9)
+        ]))
+    cs.pods.create(make_pod("p", node_name="n1", tolerations=[
+        tol(TAINT_NOT_READY, seconds=300), tol(TAINT_UNREACHABLE, seconds=300)
+    ]))
+    lifecycle = NodeLifecycleController(
+        cs, grace_period=40.0, use_taint_based_evictions=True,
+        eviction_qps=1000.0, clock=clock,
+    )
+    lifecycle.informers.start_all_manual()
+    m = mgr(cs, clock)
+    clock.now = 100.0
+    lifecycle.monitor()
+    lifecycle.monitor()
+    m.tick()
+    assert m.pending_count() == 1
+    clock.now = 399.0
+    m.tick()
+    assert cs.pods.get("p", "default") is not None
+    clock.now = 400.0  # tainted at t=100 + 300s
+    m.tick()
+    assert [p.meta.name for p in cs.pods.list()[0]] == []
