@@ -40,7 +40,11 @@ class HollowKubelet:
         pod_start_latency: float = 0.5,
         heartbeat_interval: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
+        runtime: "FakeRuntime" = None,
+        memory_pressure_fraction: float = 0.95,
     ):
+        from .runtime import FakeRuntime, PodRuntimeManager
+
         self.clientset = clientset
         self.node_name = node_name
         self.pod_index = pod_index
@@ -53,6 +57,12 @@ class HollowKubelet:
         self._clock = clock
         self._last_heartbeat = -1e18
         self._starting: dict[str, float] = {}  # pod key -> bind-seen time
+        # probe / restart / eviction machinery (pkg/kubelet prober +
+        # eviction manager over a scriptable fake runtime)
+        self.runtime = runtime or FakeRuntime()
+        self.pod_manager = PodRuntimeManager(self.runtime, clock)
+        self.memory_pressure_fraction = memory_pressure_fraction
+        self._memory_capacity = api.Quantity(memory).value()
 
     # -- registration (kubelet_node_status.go registerWithApiserver) -------
     def register(self) -> None:
@@ -93,14 +103,19 @@ class HollowKubelet:
     # -- the sync tick -----------------------------------------------------
     def tick(self) -> dict:
         """One syncLoop iteration: heartbeat if due, admit newly-bound pods,
-        transition starting pods to Running after the start latency."""
+        transition starting pods to Running after the start latency, run
+        probes/restarts, then the eviction manager pass."""
         now = self._clock()
-        out = {"started": 0, "observed": 0}
+        out = {"started": 0, "observed": 0, "restarts": 0, "evicted": 0}
         self._heartbeat()
 
         mine = self._my_pods()
         live = {p.meta.key for p in mine}
+        running: list[api.Pod] = []
         for pod in mine:
+            if pod.status.phase == api.RUNNING:
+                running.append(pod)
+                continue
             if pod.status.phase != api.PENDING:
                 continue
             key = pod.meta.key
@@ -112,7 +127,109 @@ class HollowKubelet:
                     out["started"] += 1
                 del self._starting[key]
         self._starting = {k: t for k, t in self._starting.items() if k in live}
+
+        out["restarts"], still_running = self._sync_running(running)
+        for gone in self.pod_manager.known() - live:
+            self.pod_manager.forget(gone)
+        out["evicted"] = self._eviction_pass(still_running)
         return out
+
+    def _sync_running(self, running: list[api.Pod]) -> tuple[int, list[api.Pod]]:
+        """Prober + restart-policy pass; pushes status only on change.
+        Returns pods still running — a pod that went terminal this tick
+        must not be re-ranked by the eviction pass."""
+        restarts = 0
+        still_running: list[api.Pod] = []
+        for pod in running:
+            outcome, statuses, all_ready = self.pod_manager.sync_pod(pod)
+            prev = pod.status
+            new_restarts = sum(s.restart_count for s in statuses) - sum(
+                s.restart_count for s in prev.container_statuses
+            )
+            restarts += max(0, new_restarts)
+            phase = {
+                "running": api.RUNNING,
+                "succeeded": api.SUCCEEDED,
+                "failed": api.FAILED,
+            }[outcome]
+            if outcome == "running":
+                still_running.append(pod)
+            else:
+                self.pod_manager.forget(pod.meta.key)
+            prev_ready = any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in prev.conditions
+            )
+            changed = (
+                phase != prev.phase
+                or all_ready != prev_ready
+                or [s.to_dict() for s in statuses]
+                != [s.to_dict() for s in prev.container_statuses]
+            )
+            if not changed:
+                continue
+            update = api.Pod.from_dict(pod.to_dict())
+            update.status.phase = phase
+            update.status.container_statuses = statuses
+            conds = [c for c in update.status.conditions if c.get("type") != "Ready"]
+            conds.append({"type": "Ready", "status": "True" if all_ready else "False"})
+            update.status.conditions = conds
+            try:
+                self.clientset.pods.update_status(update)
+            except (NotFoundError, ConflictError):
+                continue
+        return restarts, still_running
+
+    def _eviction_pass(self, running: list[api.Pod]) -> int:
+        """eviction_manager.go:213 synchronize — memory signal vs the
+        threshold; rank by QoS then usage; evict until under."""
+        from .runtime import rank_for_eviction
+
+        usage = self.runtime.pod_memory_usage
+        used = sum(usage.get(p.meta.key, 0) for p in running)
+        threshold = self._memory_capacity * self.memory_pressure_fraction
+        under_pressure = used > threshold
+        self._set_pressure_condition(under_pressure)
+        if not under_pressure:
+            return 0
+        evicted = 0
+        for victim in rank_for_eviction(running, usage):
+            if used <= threshold:
+                break
+            update = api.Pod.from_dict(victim.to_dict())
+            update.status.phase = api.FAILED
+            update.status.reason = "Evicted"
+            try:
+                self.clientset.pods.update_status(update)
+            except (NotFoundError, ConflictError):
+                continue
+            used -= usage.get(victim.meta.key, 0)
+            self.pod_manager.forget(victim.meta.key)
+            evicted += 1
+        return evicted
+
+    def _set_pressure_condition(self, pressure: bool) -> None:
+        # this kubelet exclusively owns its node's pressure condition, so
+        # the last pushed value is authoritative — no read needed
+        if pressure == getattr(self, "_last_pressure", False):
+            return
+        want = "True" if pressure else "False"
+
+        def _mutate(cur: api.Node) -> api.Node:
+            c = cur.status.condition(api.NODE_MEMORY_PRESSURE)
+            if c is None:
+                if not pressure:
+                    return cur
+                c = api.NodeCondition(type=api.NODE_MEMORY_PRESSURE)
+                cur.status.conditions.append(c)
+            c.status = want
+            return cur
+
+        try:
+            self.clientset.nodes.guaranteed_update(self.node_name, _mutate, "")
+            self._last_pressure = pressure
+        except NotFoundError:
+            pass
 
     def _set_running(self, pod: api.Pod, now: float) -> bool:
         # pod may be a shared informer-cache object (PodNodeIndex path):
@@ -175,9 +292,9 @@ class HollowFleet:
 
     def tick_all(self) -> dict:
         self.informer.pump()
-        total = {"started": 0, "observed": 0}
+        total = {"started": 0, "observed": 0, "restarts": 0, "evicted": 0}
         for k in self.kubelets:
             r = k.tick()
-            total["started"] += r["started"]
-            total["observed"] += r["observed"]
+            for key in total:
+                total[key] += r[key]
         return total

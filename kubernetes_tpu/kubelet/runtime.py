@@ -1,0 +1,224 @@
+"""Fake container runtime + prober + pressure eviction for the hollow node.
+
+Capability of three reference kubelet subsystems, driven by the hollow
+kubelet's tick (no containers underneath — a scriptable fake runtime
+plays the part of dockershim, like kubemark's fake Docker client):
+
+- **Prober** (``pkg/kubelet/prober/``, 905 LoC): per-container liveness
+  and readiness workers honoring ``initialDelaySeconds`` /
+  ``periodSeconds`` / ``failureThreshold`` / ``successThreshold``.
+  Liveness failure past the threshold restarts the container
+  (restart_count += 1); readiness results drive the container's
+  ``ready`` bit and the pod's Ready condition — which the endpoint
+  controller consumes, so an unready pod leaves its Service.
+- **Restart policy** (``kuberuntime_manager.go SyncPod``): a container
+  exit restarts under Always (and OnFailure when exit_code != 0);
+  otherwise the pod goes Succeeded/Failed.
+- **Eviction manager** (``pkg/kubelet/eviction/eviction_manager.go:213
+  synchronize``): observed memory/disk signals against thresholds; when
+  over, pods are ranked — BestEffort first, then Burstable, Guaranteed
+  last (the QoS order of ``eviction/helpers.go``), higher usage first
+  within a class — and evicted (phase Failed, reason Evicted) until the
+  signal clears; the node reports Memory/DiskPressure conditions, which
+  the scheduler's CheckNodeMemoryPressure / CheckNodeDiskPressure
+  predicates then act on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api import types as api
+
+QOS_GUARANTEED = "Guaranteed"
+QOS_BURSTABLE = "Burstable"
+QOS_BEST_EFFORT = "BestEffort"
+
+_QOS_EVICTION_ORDER = {QOS_BEST_EFFORT: 0, QOS_BURSTABLE: 1, QOS_GUARANTEED: 2}
+
+
+def pod_qos_class(pod: api.Pod) -> str:
+    """Reference ``pkg/api/v1/helper/qos.GetPodQOS``."""
+    requests: dict[str, str] = {}
+    limits_all = True
+    any_request = False
+    for c in pod.spec.containers:
+        r, l = c.resources.requests, c.resources.limits
+        if r:
+            any_request = True
+        for k in ("cpu", "memory"):
+            rq, lq = r.get(k), l.get(k)
+            if lq is None or (rq is not None and str(rq) != str(lq)):
+                limits_all = False
+    if not any_request and not any(c.resources.limits for c in pod.spec.containers):
+        return QOS_BEST_EFFORT
+    if limits_all and all(
+        c.resources.requests.keys() == c.resources.limits.keys() or not c.resources.requests
+        for c in pod.spec.containers
+    ) and all(c.resources.limits for c in pod.spec.containers):
+        return QOS_GUARANTEED
+    return QOS_BURSTABLE
+
+
+@dataclass
+class _ProbeState:
+    consecutive_failures: int = 0
+    consecutive_successes: int = 0
+    last_run: float = -1e18
+    result: bool = True  # last settled verdict
+
+
+@dataclass
+class _ContainerState:
+    status: api.ContainerStatus = None
+    liveness: _ProbeState = field(default_factory=_ProbeState)
+    readiness: _ProbeState = field(default_factory=_ProbeState)
+    started_at: float = 0.0
+
+
+class FakeRuntime:
+    """Scriptable container world: tests flip probe outcomes and inject
+    exits; the prober/eviction logic reacts exactly as the real kubelet
+    would against CRI."""
+
+    def __init__(self):
+        # (pod_key, container) -> scripted outcome
+        self.probe_results: dict[tuple[str, str, str], bool] = {}
+        self.exits: dict[tuple[str, str], int] = {}  # -> exit code
+        # per-pod observed usage signals (the cadvisor stand-in)
+        self.pod_memory_usage: dict[str, int] = {}  # bytes
+
+    def probe(self, pod_key: str, container: str, kind: str) -> bool:
+        return self.probe_results.get((pod_key, container, kind), True)
+
+    def set_probe(self, pod_key: str, container: str, kind: str, ok: bool) -> None:
+        self.probe_results[(pod_key, container, kind)] = ok
+
+    def inject_exit(self, pod_key: str, container: str, exit_code: int) -> None:
+        self.exits[(pod_key, container)] = exit_code
+
+    def take_exit(self, pod_key: str, container: str) -> Optional[int]:
+        return self.exits.pop((pod_key, container), None)
+
+
+class PodRuntimeManager:
+    """Per-kubelet container/probe state machine (one per HollowKubelet)."""
+
+    def __init__(self, runtime: FakeRuntime, clock: Callable[[], float]):
+        self.runtime = runtime
+        self.clock = clock
+        self._pods: dict[str, dict[str, _ContainerState]] = {}
+
+    def ensure_running(self, pod: api.Pod) -> None:
+        key = pod.meta.key
+        if key in self._pods:
+            return
+        now = self.clock()
+        self._pods[key] = {
+            c.name: _ContainerState(
+                status=api.ContainerStatus(name=c.name, state="running", ready=True),
+                started_at=now,
+            )
+            for c in pod.spec.containers
+        }
+
+    def forget(self, pod_key: str) -> None:
+        self._pods.pop(pod_key, None)
+
+    def known(self) -> set[str]:
+        return set(self._pods)
+
+    # -- one prober + runtime pass for one pod; returns the pod-level
+    # outcome: ("running", statuses, all_ready) | ("succeeded"|"failed", ...)
+    def sync_pod(self, pod: api.Pod):
+        key = pod.meta.key
+        states = self._pods.get(key)
+        if states is None:
+            self.ensure_running(pod)
+            states = self._pods[key]
+        now = self.clock()
+        terminal: Optional[str] = None
+
+        for c in pod.spec.containers:
+            st = states.get(c.name)
+            if st is None:
+                st = states[c.name] = _ContainerState(
+                    status=api.ContainerStatus(name=c.name, state="running", ready=True),
+                    started_at=now,
+                )
+            # scripted exit (the PLEG event)
+            exit_code = self.runtime.take_exit(key, c.name)
+            if exit_code is not None:
+                restart = pod.spec.restart_policy == "Always" or (
+                    pod.spec.restart_policy == "OnFailure" and exit_code != 0
+                )
+                if restart:
+                    self._restart(st, now, reason="Error" if exit_code else "Completed")
+                else:
+                    st.status.state = "terminated"
+                    st.status.ready = False
+                    st.status.exit_code = exit_code
+                    st.status.reason = "Error" if exit_code else "Completed"
+                    terminal = "failed" if exit_code else "succeeded"
+                continue
+            if st.status.state != "running":
+                continue
+            # liveness: failureThreshold consecutive failures -> restart
+            if c.liveness_probe is not None:
+                res = self._run_probe(st, st.liveness, c.liveness_probe, key, c.name, "liveness", now)
+                if res is False and st.liveness.consecutive_failures >= c.liveness_probe.failure_threshold:
+                    self._restart(st, now, reason="Unhealthy")
+            # readiness: drives the ready bit through both thresholds
+            if c.readiness_probe is not None:
+                self._run_probe(st, st.readiness, c.readiness_probe, key, c.name, "readiness", now)
+                st.status.ready = st.readiness.result and st.status.state == "running"
+            else:
+                st.status.ready = st.status.state == "running"
+
+        statuses = [states[c.name].status for c in pod.spec.containers if c.name in states]
+        all_ready = bool(statuses) and all(s.ready for s in statuses)
+        if terminal is not None:
+            return terminal, statuses, False
+        return "running", statuses, all_ready
+
+    def _run_probe(self, cst: _ContainerState, pst: _ProbeState, probe: api.Probe,
+                   pod_key: str, cname: str, kind: str, now: float) -> Optional[bool]:
+        if now - cst.started_at < probe.initial_delay_seconds:
+            return None
+        if now - pst.last_run < probe.period_seconds:
+            return None
+        pst.last_run = now
+        ok = self.runtime.probe(pod_key, cname, kind)
+        if ok:
+            pst.consecutive_successes += 1
+            pst.consecutive_failures = 0
+            if pst.consecutive_successes >= probe.success_threshold:
+                pst.result = True
+        else:
+            pst.consecutive_failures += 1
+            pst.consecutive_successes = 0
+            if pst.consecutive_failures >= probe.failure_threshold:
+                pst.result = False
+        return ok
+
+    def _restart(self, st: _ContainerState, now: float, reason: str) -> None:
+        st.status.restart_count += 1
+        st.status.state = "running"
+        st.status.ready = True
+        st.status.reason = reason
+        st.started_at = now
+        st.liveness = _ProbeState()
+        st.readiness = _ProbeState()
+
+
+def rank_for_eviction(pods: list[api.Pod], usage: dict[str, int]) -> list[api.Pod]:
+    """QoS class first (BestEffort evicted first), then usage descending
+    (``eviction/helpers.go`` rankMemoryPressure)."""
+    return sorted(
+        pods,
+        key=lambda p: (
+            _QOS_EVICTION_ORDER.get(pod_qos_class(p), 1),
+            -usage.get(p.meta.key, 0),
+        ),
+    )

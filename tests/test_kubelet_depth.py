@@ -1,0 +1,224 @@
+"""Kubelet depth: probes → restarts/readiness, restart policy, QoS
+pressure eviction, endpoint integration.
+
+Behavioral spec from the reference ``pkg/kubelet/prober/``,
+``kuberuntime_manager.go SyncPod``, ``eviction/eviction_manager.go``."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Probe,
+    Quantity,
+    ResourceRequirements,
+    Service,
+    ServicePort,
+)
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.controllers.endpoint import EndpointController
+from kubernetes_tpu.kubelet.hollow import HollowKubelet
+from kubernetes_tpu.kubelet.runtime import (
+    QOS_BEST_EFFORT,
+    QOS_BURSTABLE,
+    QOS_GUARANTEED,
+    pod_qos_class,
+)
+from kubernetes_tpu.store import Store
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+@pytest.fixture()
+def world():
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock, memory="1Gi")
+    k.register()
+    return cs, clock, k
+
+
+def probe_pod(name, liveness=None, readiness=None, restart_policy="Always",
+              labels=None, resources=None):
+    return Pod(
+        meta=ObjectMeta(name=name, namespace="default", labels=labels or {}),
+        spec=PodSpec(
+            containers=[Container(name="c", liveness_probe=liveness,
+                                  readiness_probe=readiness,
+                                  resources=resources or ResourceRequirements())],
+            node_name="n1",
+            restart_policy=restart_policy,
+        ),
+    )
+
+
+def start(cs, k, pod):
+    cs.pods.create(pod)
+    k.tick()  # observe
+    k.tick()  # start (latency 0)
+    k.tick()  # first runtime sync publishes container statuses
+    return cs.pods.get(pod.meta.name, "default")
+
+
+def test_pod_starts_with_ready_containers(world):
+    cs, clock, k = world
+    got = start(cs, k, probe_pod("p"))
+    assert got.status.phase == "Running"
+    assert got.status.container_statuses[0].ready is True
+    ready = [c for c in got.status.conditions if c.get("type") == "Ready"]
+    assert ready and ready[0]["status"] == "True"
+
+
+def test_liveness_failures_restart_after_threshold(world):
+    cs, clock, k = world
+    p = probe_pod("p", liveness=Probe(period_seconds=1, failure_threshold=3))
+    start(cs, k, p)
+    k.runtime.set_probe("default/p", "c", "liveness", False)
+    for i in range(3):
+        clock.now += 1.0
+        k.tick()
+    got = cs.pods.get("p", "default")
+    assert got.status.container_statuses[0].restart_count == 1
+    assert got.status.phase == "Running"  # restarted, not dead
+    # after restart the probe state resets; healthy again -> no more restarts
+    k.runtime.set_probe("default/p", "c", "liveness", True)
+    for _ in range(5):
+        clock.now += 1.0
+        k.tick()
+    assert cs.pods.get("p", "default").status.container_statuses[0].restart_count == 1
+
+
+def test_readiness_flips_pod_ready_condition_and_endpoints(world):
+    """An unready pod must drop out of its Service's endpoints."""
+    cs, clock, k = world
+    cs.services.create(Service(
+        meta=ObjectMeta(name="web", namespace="default"),
+        selector={"app": "web"},
+        ports=[ServicePort(port=80, target_port=8080)],
+        cluster_ip="10.0.0.1",
+    ))
+    p = probe_pod("p", readiness=Probe(period_seconds=1, failure_threshold=1),
+                  labels={"app": "web"})
+    start(cs, k, p)
+    pod = cs.pods.get("p", "default")
+    pod.status.pod_ip = "10.8.0.1"
+    cs.pods.update_status(pod)
+
+    epc = EndpointController(cs)
+    epc.informers.start_all_manual()
+
+    def drive_eps():
+        for _ in range(5):
+            epc.informers.pump_all()
+            while epc.sync_once():
+                pass
+
+    drive_eps()
+    eps = cs.endpoints.get("web", "default")
+    assert [a.ip for s in eps.subsets for a in s.addresses] == ["10.8.0.1"]
+
+    # readiness fails -> Ready=False -> endpoint moves to notReady
+    k.runtime.set_probe("default/p", "c", "readiness", False)
+    clock.now += 1.0
+    k.tick()
+    drive_eps()
+    eps = cs.endpoints.get("web", "default")
+    assert [a.ip for s in eps.subsets for a in s.addresses] == []
+    assert [a.ip for s in eps.subsets for a in s.not_ready_addresses] == ["10.8.0.1"]
+
+    # recovers
+    k.runtime.set_probe("default/p", "c", "readiness", True)
+    clock.now += 1.0
+    k.tick()
+    drive_eps()
+    eps = cs.endpoints.get("web", "default")
+    assert [a.ip for s in eps.subsets for a in s.addresses] == ["10.8.0.1"]
+
+
+def test_restart_policy_never_terminal_phase(world):
+    cs, clock, k = world
+    start(cs, k, probe_pod("p", restart_policy="Never"))
+    k.runtime.inject_exit("default/p", "c", 1)
+    clock.now += 1.0
+    k.tick()
+    got = cs.pods.get("p", "default")
+    assert got.status.phase == "Failed"
+    assert got.status.container_statuses[0].state == "terminated"
+    assert got.status.container_statuses[0].exit_code == 1
+
+
+def test_restart_policy_on_failure(world):
+    cs, clock, k = world
+    start(cs, k, probe_pod("p", restart_policy="OnFailure"))
+    k.runtime.inject_exit("default/p", "c", 1)
+    clock.now += 1.0
+    k.tick()
+    assert cs.pods.get("p", "default").status.container_statuses[0].restart_count == 1
+    # clean exit under OnFailure -> Succeeded
+    k.runtime.inject_exit("default/p", "c", 0)
+    clock.now += 1.0
+    k.tick()
+    assert cs.pods.get("p", "default").status.phase == "Succeeded"
+
+
+def test_qos_classes():
+    be = probe_pod("a")
+    assert pod_qos_class(be) == QOS_BEST_EFFORT
+    bu = probe_pod("b", resources=ResourceRequirements(
+        requests={"cpu": Quantity("100m")}))
+    assert pod_qos_class(bu) == QOS_BURSTABLE
+    gu = probe_pod("c", resources=ResourceRequirements(
+        requests={"cpu": Quantity("1"), "memory": Quantity("1Gi")},
+        limits={"cpu": Quantity("1"), "memory": Quantity("1Gi")}))
+    assert pod_qos_class(gu) == QOS_GUARANTEED
+
+
+def test_memory_pressure_evicts_best_effort_first(world):
+    cs, clock, k = world  # 1Gi node, threshold 95%
+    gu = probe_pod("precious", resources=ResourceRequirements(
+        requests={"cpu": Quantity("1"), "memory": Quantity("256Mi")},
+        limits={"cpu": Quantity("1"), "memory": Quantity("256Mi")}))
+    be = probe_pod("disposable")
+    start(cs, k, gu)
+    start(cs, k, be)
+    m = 1 << 20
+    k.runtime.pod_memory_usage = {
+        "default/precious": 700 * m, "default/disposable": 400 * m,
+    }
+    clock.now += 1.0
+    res = k.tick()
+    assert res["evicted"] == 1
+    assert cs.pods.get("disposable", "default").status.reason == "Evicted"
+    assert cs.pods.get("disposable", "default").status.phase == "Failed"
+    assert cs.pods.get("precious", "default").status.phase == "Running"
+    # node reported MemoryPressure while over; clears after eviction
+    assert cs.nodes.get("n1").status.condition("MemoryPressure").status == "True"
+    clock.now += 1.0
+    k.tick()
+    assert cs.nodes.get("n1").status.condition("MemoryPressure").status == "False"
+
+
+def test_pod_completing_during_pressure_is_not_marked_evicted(world):
+    """A pod that went Succeeded this tick must not be re-ranked by the
+    eviction pass and overwritten to Failed/Evicted."""
+    cs, clock, k = world
+    start(cs, k, probe_pod("done", restart_policy="Never"))
+    start(cs, k, probe_pod("hog"))
+    k.runtime.inject_exit("default/done", "c", 0)
+    m = 1 << 20
+    k.runtime.pod_memory_usage = {"default/done": 600 * m, "default/hog": 600 * m}
+    clock.now += 1.0
+    k.tick()
+    # the completed pod keeps its phase AND its freed memory no longer
+    # counts toward the pressure signal, so nothing is evicted
+    assert cs.pods.get("done", "default").status.phase == "Succeeded"
+    assert cs.pods.get("hog", "default").status.phase == "Running"
+    assert cs.pods.get("hog", "default").status.reason == ""
