@@ -1,0 +1,82 @@
+"""kube-apiserver daemon (reference ``cmd/kube-apiserver/app/server.go:112``).
+
+    python -m kubernetes_tpu.apiserver --port 6443 \
+        [--token-file tokens.csv] [--authorization-mode RBAC] \
+        [--audit-log audit.jsonl] [--event-log-window 300000]
+
+``--token-file`` rows are ``token,user[,group1|group2]`` (the reference's
+static token file)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..admission import default_chain
+from ..daemon import install_signal_stop, wait_forever
+from ..store.store import Store
+from .server import APIServer
+
+
+def parse_token_file(path: str) -> dict:
+    from ..auth import UserInfo
+
+    tokens: dict[str, object] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(",")
+            user = parts[1] if len(parts) > 1 else parts[0]
+            groups = parts[2].split("|") if len(parts) > 2 and parts[2] else []
+            tokens[parts[0]] = UserInfo(name=user, groups=groups)
+    return tokens
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu.apiserver")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6443)
+    ap.add_argument("--token-file", default=None)
+    ap.add_argument("--authorization-mode", default=None,
+                    choices=[None, "AlwaysAllow", "RBAC"])
+    ap.add_argument("--audit-log", default=None)
+    ap.add_argument("--event-log-window", type=int, default=300_000)
+    ap.add_argument("--disable-admission", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.disable_admission:
+        store = Store(event_log_window=args.event_log_window)
+    else:
+        from ..admission import AdmittedStore
+
+        store = AdmittedStore(default_chain(), event_log_window=args.event_log_window)
+
+    tokens = parse_token_file(args.token_file) if args.token_file else None
+    authorizer = None
+    if args.authorization_mode == "RBAC":
+        from ..auth import RBACAuthorizer
+
+        authorizer = RBACAuthorizer(store)
+    auditor = None
+    if args.audit_log:
+        from ..auth.audit import Auditor, LogBackend
+
+        auditor = Auditor(backends=[LogBackend(args.audit_log)])
+
+    server = APIServer(store, host=args.host, port=args.port, tokens=tokens,
+                       authorizer=authorizer, auditor=auditor)
+    server.start()
+    print(f"apiserver serving on {server.url}", flush=True)
+    stop = install_signal_stop()
+    wait_forever(stop)
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,7 +122,12 @@ class SharedInformer:
             self._apply(ev)
 
     def pump(self, max_events: Optional[int] = None) -> int:
-        """Synchronously apply all (or up to max_events) pending events."""
+        """Synchronously apply all (or up to max_events) pending events.
+        A no-op when the watch thread owns the stream (mixed drivers —
+        e.g. a clock tick inside a threaded daemon — must not compete
+        for events)."""
+        if self._thread is not None:
+            return 0
         if self._watch is None:
             self._seed()
         n = 0

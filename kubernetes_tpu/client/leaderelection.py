@@ -33,7 +33,10 @@ class LeaderElector:
         identity: str,
         lease_duration: float = 15.0,
         renew_deadline: float = 10.0,
-        clock: Callable[[], float] = time.monotonic,
+        # wall clock, NOT monotonic: renewTime is compared by OTHER
+        # processes/hosts (the reference writes metav1.Time); monotonic
+        # bases are boot-relative and would split-brain across hosts
+        clock: Callable[[], float] = time.time,
     ):
         self.clientset = clientset
         self.lock_name = lock_name

@@ -1,0 +1,58 @@
+"""kube-controller-manager daemon (reference
+``cmd/kube-controller-manager/app/controllermanager.go:107 Run``).
+
+    python -m kubernetes_tpu.controllers --apiserver http://host:6443 \
+        [--leader-elect] [--controllers deployment,replicaset,...] \
+        [--node-monitor-period 5]
+
+Runs every registered control loop threaded (informer watch threads +
+per-controller workers) plus the tick-driven loops (node lifecycle
+monitor, taint manager, cronjob clock)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+
+from ..daemon import install_signal_stop, remote_clientset, run_with_leader_election
+from .manager import DEFAULT_CONTROLLERS, ControllerManager
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu.controllers")
+    ap.add_argument("--apiserver", required=True)
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--controllers", default="*",
+                    help="comma list or * (default set: %s)" % ",".join(DEFAULT_CONTROLLERS))
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--node-monitor-period", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    cs = remote_clientset(args.apiserver, args.token)
+    names = None if args.controllers == "*" else args.controllers.split(",")
+
+    def run(payload_stop: threading.Event) -> None:
+        mgr = ControllerManager(cs, enabled=names)
+        mgr.start(manual=False, workers_per_controller=args.workers)
+        logging.info("controller manager running: %s", ", ".join(mgr.controllers))
+        while not payload_stop.is_set():
+            mgr.tick()  # clock-driven loops (node monitor, taints, cron)
+            payload_stop.wait(args.node_monitor_period)
+        mgr.stop()
+
+    stop = install_signal_stop()
+    run_with_leader_election(
+        cs, "kube-controller-manager", f"kcm-{os.getpid()}", run, stop,
+        leader_elect=args.leader_elect,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

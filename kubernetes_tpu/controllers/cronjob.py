@@ -33,8 +33,9 @@ class CronJobController(Controller):
         return f"{job.meta.namespace}/{ref.name}"
 
     def tick(self) -> None:
-        """Enqueue every CronJob (the reference's 10s ``syncAll`` poll)."""
-        for cj in self.clientset.cronjobs.list(None)[0]:
+        """Enqueue every CronJob (the reference's 10s ``syncAll`` poll) —
+        from the informer cache, not a wire LIST per poll."""
+        for cj in self.informer("CronJob").list():
             self.queue.add(cj.meta.key)
 
     def sync(self, key: str) -> None:

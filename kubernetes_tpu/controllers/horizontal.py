@@ -35,7 +35,8 @@ class HorizontalPodAutoscalerController(Controller):
         self.watch("HorizontalPodAutoscaler")
 
     def tick(self) -> None:
-        for hpa in self.clientset.horizontalpodautoscalers.list(None)[0]:
+        # informer cache, not a wire LIST per resync period
+        for hpa in self.informer("HorizontalPodAutoscaler").list():
             self.queue.add(hpa.meta.key)
 
     def _target_client(self, hpa: HorizontalPodAutoscaler):

@@ -9,7 +9,10 @@ single-threaded drives."""
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional
+
+logger = logging.getLogger("kubernetes_tpu.controllers.manager")
 
 from ..client.clientset import Clientset
 from ..client.informer import InformerFactory
@@ -113,6 +116,18 @@ class ControllerManager:
             if progressed == 0 and all(len(c.queue) == 0 for c in self.controllers.values()):
                 break
         return total
+
+    def tick(self) -> None:
+        """Drive the clock-based loops (the reference runs these on
+        wait.Until timers): node-lifecycle monitor, taint-manager timers,
+        cronjob schedule checks."""
+        for c in self.controllers.values():
+            fn = getattr(c, "monitor", None) or getattr(c, "tick", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # controller loops never die
+                    logger.exception("%s tick failed", c.name)
 
     def stop(self) -> None:
         for c in self.controllers.values():

@@ -18,11 +18,15 @@ class PodGCController(Controller):
     def __init__(self, clientset, informers=None, terminated_pod_threshold: int = 12500, **kw):
         super().__init__(clientset, informers, **kw)
         self.terminated_pod_threshold = terminated_pod_threshold
+        # cache-fed scans: a GC pass must not LIST the cluster over the wire
+        self.informers.informer("Pod")
+        self.informers.informer("Node")
 
     def tick(self) -> int:
         """One GC pass; returns pods deleted."""
-        pods, _ = self.clientset.pods.list(None)
-        node_names = {n.meta.name for n in self.clientset.nodes.list()[0]}
+        self.informers.pump_all()  # no-op under threaded informers
+        pods = self.informer("Pod").list()
+        node_names = {n.meta.name for n in self.informer("Node").list()}
         deleted = 0
 
         terminated = [p for p in pods if p.status.phase in (api.SUCCEEDED, api.FAILED)]

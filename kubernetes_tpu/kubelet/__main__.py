@@ -1,0 +1,77 @@
+"""Hollow-node daemon (reference ``cmd/kubemark/hollow-node.go``): a
+hollow kubelet plus (optionally) a hollow proxy against a remote
+apiserver.
+
+    python -m kubernetes_tpu.kubelet --apiserver http://host:6443 \
+        --name node-001 [--count 50] [--proxy] [--tick 1.0]
+
+``--count N`` runs a fleet of N nodes named ``{name}-{i:05d}`` in one
+process (kubemark's N-hollow-nodes-per-host packing)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..daemon import install_signal_stop, remote_clientset, wait_forever
+from .hollow import HollowFleet, HollowKubelet
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu.kubelet")
+    ap.add_argument("--apiserver", required=True)
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--name", default="hollow")
+    ap.add_argument("--count", type=int, default=1)
+    ap.add_argument("--proxy", action="store_true")
+    ap.add_argument("--tick", type=float, default=1.0)
+    ap.add_argument("--cpu", default="8")
+    ap.add_argument("--memory", default="16Gi")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    cs = remote_clientset(args.apiserver, args.token)
+    if args.count > 1:
+        fleet = HollowFleet(cs, args.count, cpu=args.cpu, memory=args.memory)
+        # kubemark names nodes per host; keep the given prefix
+        for i, k in enumerate(fleet.kubelets):
+            k.node_name = f"{args.name}-{i:05d}"
+        fleet.register_all()
+        kubelets = fleet.kubelets
+        tick = fleet.tick_all
+    else:
+        k = HollowKubelet(cs, args.name, cpu=args.cpu, memory=args.memory)
+        k.register()
+        kubelets = [k]
+        tick = k.tick
+
+    proxies = []
+    if args.proxy:
+        from ..proxy import HollowProxyFleet
+
+        pf = HollowProxyFleet(cs, [k.node_name for k in kubelets])
+        pf.start()
+        proxies.append(pf)
+
+    logging.info("hollow node(s) running: %d kubelet(s), proxy=%s",
+                 len(kubelets), bool(proxies))
+
+    def one_tick() -> None:
+        # node loops never die: a transient apiserver error must not take
+        # down the whole N-node fleet process
+        try:
+            tick()
+            for pf in proxies:
+                pf.tick_all()
+        except Exception:
+            logging.exception("hollow tick failed (will retry)")
+
+    stop = install_signal_stop()
+    wait_forever(stop, tick=one_tick, interval=args.tick)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

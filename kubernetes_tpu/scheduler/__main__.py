@@ -1,0 +1,77 @@
+"""kube-scheduler daemon (reference ``plugin/cmd/kube-scheduler/app/
+server.go:67 Run``, leader election ``:133``).
+
+    python -m kubernetes_tpu.scheduler --apiserver http://host:6443 \
+        [--leader-elect] [--backend tpu|oracle] [--batch-interval 0.05] \
+        [--policy-config-file policy.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+
+from ..daemon import install_signal_stop, remote_clientset, run_with_leader_election
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu.scheduler")
+    ap.add_argument("--apiserver", required=True)
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--backend", choices=["tpu", "oracle"], default="tpu")
+    ap.add_argument("--batch-interval", type=float, default=0.05,
+                    help="seconds to coalesce pending pods before a TPU batch")
+    ap.add_argument("--policy-config-file", default=None)
+    ap.add_argument("--scheduler-name", default="default-scheduler")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    cs = remote_clientset(args.apiserver, args.token)
+
+    def run(payload_stop: threading.Event) -> None:
+        from .generic_scheduler import GenericScheduler
+        from .scheduler import Scheduler
+
+        algo = GenericScheduler()
+        if args.policy_config_file:
+            from .policy import load_policy_file
+
+            algo = load_policy_file(args.policy_config_file)
+        backend = None
+        if args.backend == "tpu":
+            from ..ops import TPUBatchBackend
+
+            backend = TPUBatchBackend(algorithm=algo)
+        sched = Scheduler(cs, algorithm=algo, backend=backend,
+                          scheduler_name=args.scheduler_name)
+        sched.start(manual=False)  # threaded informers + event sink
+        logging.info("scheduler running (backend=%s)", args.backend)
+        while not payload_stop.is_set():
+            if backend is not None:
+                # batch mode: coalesce, then schedule the whole queue
+                payload_stop.wait(args.batch_interval)
+                if len(sched.queue):
+                    bound, failed = sched.schedule_pending_batch()
+                    if bound or failed:
+                        logging.info("batch: %d bound, %d failed", bound, failed)
+            else:
+                if not sched.schedule_one(timeout=0.2, async_bind=True):
+                    continue
+        sched.informers.stop_all()
+        sched.broadcaster.stop()
+
+    stop = install_signal_stop()
+    run_with_leader_election(
+        cs, "kube-scheduler", f"scheduler-{os.getpid()}", run, stop,
+        leader_elect=args.leader_elect,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""e2e: real daemons over the wire — apiserver HTTP server, a
+leader-elected scheduler on a remote clientset with threaded informers,
+a threaded controller manager, and a hollow fleet, scheduling 1k pods.
+
+The de-risking test for the daemon process model (reference
+``plugin/cmd/kube-scheduler/app/server.go:67,133``,
+``cmd/kube-apiserver/app/server.go:112``)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import ObjectMeta, ReplicaSet, PodTemplateSpec, PodSpec, Container, Quantity, ResourceRequirements
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Clientset, LeaderElector
+from kubernetes_tpu.client.remote import RemoteStore
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.kubelet.hollow import HollowFleet
+from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+from kubernetes_tpu.store import Store
+
+
+N_PODS = 1000
+N_NODES = 20
+
+
+@pytest.mark.timeout(120)
+def test_daemon_stack_schedules_1k_pods_over_the_wire():
+    server = APIServer(Store(event_log_window=50_000))
+    server.start()
+    try:
+        _run(server)
+    finally:
+        server.stop()
+
+
+def _run(server):
+    # -- scheduler daemon: remote clientset, threaded informers, leader lock
+    sched_cs = Clientset(RemoteStore(server.url))
+    elector = LeaderElector(sched_cs, "kube-scheduler", "sched-a")
+    assert elector.try_acquire_or_renew()
+    # a standby cannot take the lock while it's held
+    standby = LeaderElector(sched_cs, "kube-scheduler", "sched-b")
+    assert not standby.try_acquire_or_renew()
+
+    sched = Scheduler(sched_cs, algorithm=GenericScheduler(), emit_events=False)
+    sched.start(manual=False)  # threaded informer watch loops
+    stop = threading.Event()
+
+    def sched_loop():
+        while not stop.is_set():
+            if not sched.schedule_one(timeout=0.05, async_bind=False):
+                continue
+
+    threads = [threading.Thread(target=sched_loop, daemon=True) for _ in range(1)]
+    for t in threads:
+        t.start()
+
+    # -- controller manager daemon (replicaset loop drives pod creation)
+    cm_cs = Clientset(RemoteStore(server.url))
+    mgr = ControllerManager(cm_cs, enabled=["replicaset"])
+    mgr.start(manual=False, workers_per_controller=2)
+
+    # -- hollow fleet (shares one process here; talks over the wire too)
+    fleet_cs = Clientset(RemoteStore(server.url))
+    fleet = HollowFleet(fleet_cs, N_NODES, cpu="64", memory="128Gi", pods=200,
+                        pod_start_latency=0.0)
+    fleet.register_all()
+
+    # -- workload: one ReplicaSet of 1k pods through the controller plane
+    cli = Clientset(RemoteStore(server.url))
+    rs = ReplicaSet(
+        meta=ObjectMeta(name="web", namespace="default"),
+        replicas=N_PODS,
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        template=PodTemplateSpec(
+            labels={"app": "web"},
+            spec=PodSpec(containers=[Container(
+                name="c",
+                resources=ResourceRequirements(requests={"cpu": Quantity("50m")}),
+            )]),
+        ),
+    )
+    cli.replicasets.create(rs)
+
+    deadline = time.time() + 90
+    bound = 0
+    try:
+        while time.time() < deadline:
+            fleet.tick_all()
+            pods, _ = cli.pods.list()
+            bound = sum(1 for p in pods if p.spec.node_name)
+            running = sum(1 for p in pods if p.status.phase == "Running")
+            if bound >= N_PODS and running >= N_PODS:
+                break
+            time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        mgr.stop()
+        sched.informers.stop_all()
+
+    assert bound >= N_PODS, f"only {bound}/{N_PODS} pods bound before deadline"
+    running = sum(1 for p in cli.pods.list()[0] if p.status.phase == "Running")
+    assert running >= N_PODS
+    elector.release()
