@@ -22,7 +22,7 @@ from kubernetes_tpu.controllers import (
 )
 from kubernetes_tpu.kubelet import HollowFleet
 from kubernetes_tpu.scheduler import Scheduler
-from kubernetes_tpu.store import Store
+from kubernetes_tpu.store import NotFoundError, Store
 from kubernetes_tpu.testutil import make_node, make_pod
 
 
@@ -351,3 +351,82 @@ def test_full_cluster_lifecycle():
     assert all(p.spec.node_name and p.spec.node_name != dead.node_name for p in pods)
     assert all(p.status.phase == "Running" for p in pods)
     assert {p.meta.name for p in pods}.isdisjoint(set(victims)), "victims replaced, not revived"
+
+
+def test_gc_cascades_for_any_registered_kind(cs):
+    """Job->Pod and StatefulSet->Pod cascade with no per-kind GC code
+    (the graph spans the whole type registry, graph_builder.go:317)."""
+    from kubernetes_tpu.api import Job, StatefulSet, OwnerReference
+
+    job = cs.jobs.create(Job(meta=ObjectMeta(name="j", namespace="default")))
+    sts = cs.statefulsets.create(StatefulSet(meta=ObjectMeta(name="s", namespace="default")))
+    for name, owner in (("j-pod", job), ("s-pod", sts)):
+        p = make_pod(name)
+        p.meta.owner_references = [OwnerReference(
+            kind=owner.KIND, name=owner.meta.name, uid=owner.meta.uid, controller=True)]
+        cs.pods.create(p)
+    gc = GarbageCollector(cs)
+    gc.reconcile_all()
+    assert {p.meta.name for p in cs.pods.list()[0]} == {"j-pod", "s-pod"}
+    cs.jobs.delete("j", "default")
+    cs.statefulsets.delete("s", "default")
+    gc.reconcile_all()
+    assert cs.pods.list()[0] == []
+
+
+def test_gc_patches_away_dangling_ref_when_other_owner_lives(cs):
+    from kubernetes_tpu.api import Job, OwnerReference
+
+    a = cs.jobs.create(Job(meta=ObjectMeta(name="a", namespace="default")))
+    b = cs.jobs.create(Job(meta=ObjectMeta(name="b", namespace="default")))
+    p = make_pod("shared")
+    p.meta.owner_references = [
+        OwnerReference(kind="Job", name="a", uid=a.meta.uid),
+        OwnerReference(kind="Job", name="b", uid=b.meta.uid),
+    ]
+    cs.pods.create(p)
+    gc = GarbageCollector(cs)
+    gc.reconcile_all()
+    cs.jobs.delete("a", "default")
+    gc.reconcile_all()
+    got = cs.pods.get("shared", "default")
+    assert [r.name for r in got.meta.owner_references] == ["b"]  # patched, kept
+
+
+def test_gc_orphan_propagation(cs):
+    """An owner deleted with the orphan finalizer releases its dependents
+    instead of cascading (propagationPolicy=Orphan)."""
+    from kubernetes_tpu.api import OwnerReference, ReplicaSet
+
+    rs = ReplicaSet(meta=ObjectMeta(name="keepers", namespace="default"))
+    rs.meta.finalizers = ["orphan"]
+    rs = cs.replicasets.create(rs)
+    p = make_pod("survivor")
+    p.meta.owner_references = [OwnerReference(
+        kind="ReplicaSet", name="keepers", uid=rs.meta.uid, controller=True)]
+    cs.pods.create(p)
+    gc = GarbageCollector(cs)
+    gc.reconcile_all()
+    cs.replicasets.delete("keepers", "default")  # tombstoned by finalizer
+    gc.reconcile_all()
+    # the finalizer was removed -> the delete completed
+    with pytest.raises(NotFoundError):
+        cs.replicasets.get("keepers", "default")
+    # and the dependent survives, ownerless
+    got = cs.pods.get("survivor", "default")
+    assert got.meta.owner_references == []
+
+
+def test_gc_uid_check_survives_recreate(cs):
+    from kubernetes_tpu.api import Job, OwnerReference
+
+    old = cs.jobs.create(Job(meta=ObjectMeta(name="j", namespace="default")))
+    p = make_pod("dep")
+    p.meta.owner_references = [OwnerReference(kind="Job", name="j", uid=old.meta.uid)]
+    cs.pods.create(p)
+    gc = GarbageCollector(cs)
+    gc.reconcile_all()
+    cs.jobs.delete("j", "default")
+    cs.jobs.create(Job(meta=ObjectMeta(name="j", namespace="default")))  # new uid
+    gc.reconcile_all()
+    assert cs.pods.list()[0] == []  # old-uid dependent still collected
