@@ -23,4 +23,13 @@ from .plugins import (
     ServiceAccount,
     default_chain,
 )
+from .plugins_ext import (
+    AlwaysPullImages,
+    DefaultStorageClass,
+    GenericAdmissionWebhook,
+    ImagePolicyWebhook,
+    NodeRestriction,
+    PodNodeSelector,
+    PodPreset,
+)
 from . import quota

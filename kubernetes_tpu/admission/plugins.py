@@ -20,6 +20,7 @@ from ..api.quantity import Quantity
 from ..store.store import NotFoundError
 from ..api.types import (CPU, MEMORY, HOSTNAME_LABEL,
     TAINT_NODE_NOT_READY, TAINT_NODE_UNREACHABLE)
+from . import plugins_ext as _PluginsExt
 from . import quota as quotalib
 from .framework import (
     CREATE,
@@ -330,6 +331,8 @@ def default_chain() -> AdmissionChain:
         NamespaceLifecycle(),
         LimitRanger(),
         ServiceAccount(),
+        _PluginsExt.DefaultStorageClass(),
+        _PluginsExt.PodPreset(),
         DefaultTolerationSeconds(),
         LimitPodHardAntiAffinityTopology(),
         Priority(),

@@ -1,0 +1,287 @@
+"""Extended admission plugins (toward the reference's full default set).
+
+Capability equivalents of ``plugin/pkg/admission/*``:
+
+- DefaultStorageClass        — ``storageclass/default/admission.go``
+- PodPreset                  — ``podpreset/admission.go``
+- AlwaysPullImages           — ``alwayspullimages/admission.go``
+- PodNodeSelector            — ``podnodeselector/admission.go``
+- ImagePolicyWebhook         — ``imagepolicy/admission.go``
+- GenericAdmissionWebhook    — ``webhook/admission.go`` (external
+  validating webhooks with a failure policy)
+- NodeRestriction            — ``noderestriction/admission.go``
+
+Webhook transports are injectable callables (tests pass functions; the
+HTTP form posts JSON like the scheduler extender does), because the
+webhook CONTRACT — review request in, allow/deny out, failure policy on
+error — is the capability, not the socket."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Callable, Optional
+
+from ..api.selectors import LabelSelector
+from ..store.store import NotFoundError
+from .framework import CREATE, DELETE, UPDATE, AdmissionPlugin, Attributes
+
+
+class DefaultStorageClass(AdmissionPlugin):
+    """PVCs created without a class get the cluster default
+    (``storageclass/default/admission.go``: exactly one class annotated
+    default; ambiguous defaults deny)."""
+
+    name = "DefaultStorageClass"
+    operations = (CREATE,)
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "PersistentVolumeClaim" and super().handles(attrs)
+
+    def admit(self, attrs: Attributes) -> None:
+        spec = attrs.obj.setdefault("spec", {})
+        if spec.get("storageClassName"):
+            return
+        defaults = [
+            d for d in attrs.store.list("StorageClass", None)[0] if d.get("isDefault")
+        ]
+        if not defaults:
+            return
+        if len(defaults) > 1:
+            self.deny("more than one default StorageClass")
+        spec["storageClassName"] = defaults[0]["metadata"]["name"]
+
+
+class PodPreset(AdmissionPlugin):
+    """Inject env/volumes from matching PodPresets into pods at create
+    (``podpreset/admission.go``); a merge CONFLICT (the pod already sets a
+    key the preset would set, with a different value) skips the entire
+    preset — no partial application."""
+
+    name = "PodPreset"
+    operations = (CREATE,)
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Pod" and super().handles(attrs)
+
+    def admit(self, attrs: Attributes) -> None:
+        labels = (attrs.obj.get("metadata") or {}).get("labels") or {}
+        spec = attrs.obj.setdefault("spec", {})
+        applied = []
+        for raw in attrs.store.list("PodPreset", attrs.namespace)[0]:
+            preset_spec = raw.get("spec") or {}
+            sel = LabelSelector.from_dict(preset_spec.get("selector"))
+            if not sel.matches(labels):
+                continue
+            env = preset_spec.get("env") or {}
+            conflict = any(
+                k in (c.get("env") or {}) and c["env"][k] != v
+                for c in spec.get("containers") or []
+                for k, v in env.items()
+            ) or any(
+                v.get("name") == pv.get("name") and v != pv
+                for v in spec.get("volumes") or []
+                for pv in preset_spec.get("volumes") or []
+            )
+            if conflict:
+                continue  # the whole preset is skipped, nothing applied
+            for c in spec.setdefault("containers", []):
+                merged = dict(env)
+                merged.update(c.get("env") or {})
+                if merged:
+                    c["env"] = merged
+            have = {v.get("name") for v in spec.get("volumes") or []}
+            for vol in preset_spec.get("volumes") or []:
+                if vol.get("name") not in have:
+                    spec.setdefault("volumes", []).append(dict(vol))
+            applied.append(raw["metadata"]["name"])
+        if applied:
+            meta = attrs.obj.setdefault("metadata", {})
+            anns = meta.setdefault("annotations", {})
+            for name in applied:
+                anns[f"podpreset.admission.kubernetes.io/podpreset-{name}"] = "applied"
+
+
+class AlwaysPullImages(AdmissionPlugin):
+    """Force imagePullPolicy=Always (``alwayspullimages/admission.go``:
+    multi-tenant nodes must not serve cached private images)."""
+
+    name = "AlwaysPullImages"
+    operations = (CREATE, UPDATE)
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Pod" and super().handles(attrs)
+
+    def admit(self, attrs: Attributes) -> None:
+        for c in (attrs.obj.get("spec") or {}).get("containers") or []:
+            c["imagePullPolicy"] = "Always"
+
+    def validate(self, attrs: Attributes) -> None:
+        for c in (attrs.obj.get("spec") or {}).get("containers") or []:
+            if c.get("imagePullPolicy") != "Always":
+                self.deny(f"container {c.get('name')} must pull Always")
+
+
+class PodNodeSelector(AdmissionPlugin):
+    """Merge the namespace's node-selector annotation into pods; a pod
+    selector conflicting with the namespace's is denied
+    (``podnodeselector/admission.go``)."""
+
+    name = "PodNodeSelector"
+    operations = (CREATE,)
+    ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Pod" and super().handles(attrs)
+
+    def _namespace_selector(self, attrs: Attributes) -> dict:
+        try:
+            ns = attrs.store.get("Namespace", "", attrs.namespace)
+        except NotFoundError:
+            return {}
+        raw = ((ns.get("metadata") or {}).get("annotations") or {}).get(self.ANNOTATION, "")
+        out = {}
+        for part in raw.split(","):
+            part = part.strip()
+            if part and "=" in part:
+                k, v = part.split("=", 1)
+                out[k.strip()] = v.strip()
+        return out
+
+    def admit(self, attrs: Attributes) -> None:
+        want = self._namespace_selector(attrs)
+        if not want:
+            return
+        spec = attrs.obj.setdefault("spec", {})
+        sel = spec.setdefault("nodeSelector", {})
+        for k, v in want.items():
+            if k in sel and sel[k] != v:
+                self.deny(f"pod node selector {k}={sel[k]} conflicts with namespace {k}={v}")
+            sel[k] = v
+
+
+class ImagePolicyWebhook(AdmissionPlugin):
+    """Ask an external image-policy service whether the pod's images are
+    allowed (``imagepolicy/admission.go``).  ``default_allow`` is the
+    failure policy when the backend is unreachable."""
+
+    name = "ImagePolicyWebhook"
+    operations = (CREATE,)
+
+    def __init__(self, backend: Optional[Callable[[dict], dict]] = None,
+                 url: Optional[str] = None, default_allow: bool = False,
+                 timeout: float = 5.0):
+        if backend is None and url is None:
+            # surface misconfiguration at wiring time, not as a perpetual
+            # "backend unreachable" that the failure policy silently eats
+            raise ValueError("ImagePolicyWebhook needs a backend or a url")
+        self.backend = backend
+        self.url = url
+        self.default_allow = default_allow
+        self.timeout = timeout
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Pod" and super().handles(attrs)
+
+    def _review(self, payload: dict) -> dict:
+        if self.backend is not None:
+            return self.backend(payload)
+        req = urllib.request.Request(
+            self.url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def validate(self, attrs: Attributes) -> None:
+        images = [c.get("image", "") for c in
+                  (attrs.obj.get("spec") or {}).get("containers") or []]
+        payload = {"spec": {"containers": [{"image": i} for i in images],
+                            "namespace": attrs.namespace}}
+        try:
+            result = self._review(payload)
+        except Exception:
+            if self.default_allow:
+                return
+            self.deny("image policy backend unreachable (failure policy: deny)")
+        if not (result.get("status") or {}).get("allowed", False):
+            reason = (result.get("status") or {}).get("reason", "image rejected")
+            self.deny(reason)
+
+
+class GenericAdmissionWebhook(AdmissionPlugin):
+    """External validating webhooks (``webhook/admission.go``): each rule
+    names the kinds it reviews; ``fail_open`` webhooks admit on backend
+    error, fail-closed ones deny."""
+
+    name = "GenericAdmissionWebhook"
+    operations = (CREATE, UPDATE, DELETE)
+
+    def __init__(self, webhooks: Optional[list[dict]] = None, timeout: float = 5.0):
+        # each: {name, kinds: [..] | ["*"], backend: callable | url: str,
+        #        fail_open: bool}
+        self.webhooks = webhooks or []
+        self.timeout = timeout
+
+    def _call(self, hook: dict, payload: dict) -> dict:
+        backend = hook.get("backend")
+        if backend is not None:
+            return backend(payload)
+        req = urllib.request.Request(
+            hook["url"], data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def validate(self, attrs: Attributes) -> None:
+        payload = {
+            "request": {
+                "operation": attrs.operation,
+                "kind": attrs.kind,
+                "namespace": attrs.namespace,
+                "name": attrs.name,
+                "object": attrs.obj,
+                "oldObject": attrs.old_obj,
+                "userInfo": {"username": attrs.user},
+            }
+        }
+        for hook in self.webhooks:
+            kinds = hook.get("kinds", ["*"])
+            if "*" not in kinds and attrs.kind not in kinds:
+                continue
+            try:
+                result = self._call(hook, payload)
+            except Exception:
+                if hook.get("fail_open", False):
+                    continue
+                self.deny(f"webhook {hook.get('name')} unreachable (fail closed)")
+            response = result.get("response") or {}
+            if not response.get("allowed", False):
+                msg = (response.get("status") or {}).get("message", "denied")
+                self.deny(f"webhook {hook.get('name')}: {msg}")
+
+
+class NodeRestriction(AdmissionPlugin):
+    """Kubelets (``system:node:<name>``) may only modify their own Node
+    object and pods bound to them (``noderestriction/admission.go``)."""
+
+    name = "NodeRestriction"
+    operations = (CREATE, UPDATE, DELETE)
+    PREFIX = "system:node:"
+
+    def validate(self, attrs: Attributes) -> None:
+        if not attrs.user.startswith(self.PREFIX):
+            return
+        node_name = attrs.user[len(self.PREFIX):]
+        if attrs.kind == "Node":
+            if attrs.name != node_name:
+                self.deny(f"node {node_name} may not modify node {attrs.name}")
+            return
+        if attrs.kind == "Pod":
+            ref = attrs.obj if attrs.operation != DELETE else attrs.old_obj
+            bound = ((ref or {}).get("spec") or {}).get("nodeName", "")
+            if bound != node_name:
+                self.deny(f"node {node_name} may only manage its own pods")
+            return
+        self.deny(f"node {node_name} may not write {attrs.kind} objects")

@@ -289,6 +289,42 @@ class LimitRangeItem:
 
 @register_kind
 @dataclass
+class PodPreset:
+    """Pod injection policy (reference ``pkg/apis/settings/types.go``;
+    applied by the PodPreset admission plugin to matching pods at
+    create)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    env: dict = field(default_factory=dict)
+    volumes: list = field(default_factory=list)  # wire-form volume dicts
+
+    KIND = "PodPreset"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "selector": self.selector.to_dict(),
+                "env": dict(self.env),
+                "volumes": [dict(v) for v in self.volumes],
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PodPreset":
+        spec = d.get("spec") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            selector=LabelSelector.from_dict(spec.get("selector")),
+            env=dict(spec.get("env") or {}),
+            volumes=[dict(v) for v in spec.get("volumes") or []],
+        )
+
+
+@register_kind
+@dataclass
 class LimitRange:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     limits: list[LimitRangeItem] = field(default_factory=list)

@@ -162,6 +162,8 @@ class Container:
     ports: list[ContainerPort] = field(default_factory=list)
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    env: dict[str, str] = field(default_factory=dict)  # injected by PodPreset
+    image_pull_policy: str = ""  # "" | Always | IfNotPresent | Never
 
     def to_dict(self) -> dict:
         d = {
@@ -174,6 +176,10 @@ class Container:
             d["livenessProbe"] = self.liveness_probe.to_dict()
         if self.readiness_probe:
             d["readinessProbe"] = self.readiness_probe.to_dict()
+        if self.env:
+            d["env"] = dict(self.env)
+        if self.image_pull_policy:
+            d["imagePullPolicy"] = self.image_pull_policy
         return d
 
     @classmethod
@@ -185,6 +191,8 @@ class Container:
             ports=[ContainerPort.from_dict(p) for p in d.get("ports") or []],
             liveness_probe=Probe.from_dict(d.get("livenessProbe")),
             readiness_probe=Probe.from_dict(d.get("readinessProbe")),
+            env=dict(d.get("env") or {}),
+            image_pull_policy=d.get("imagePullPolicy", ""),
         )
 
 

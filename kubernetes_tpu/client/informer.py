@@ -198,7 +198,10 @@ class InformerFactory:
                 inf.start_manual()
 
     def pump_all(self) -> int:
-        return sum(inf.pump() for inf in self._informers.values())
+        # snapshot: a handler may register a NEW informer mid-pump (the
+        # GC wiring a just-established CRD kind); the newcomer gets its
+        # events on the caller's next pump round
+        return sum(inf.pump() for inf in list(self._informers.values()))
 
     def stop_all(self) -> None:
         for inf in self._informers.values():

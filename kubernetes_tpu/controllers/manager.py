@@ -18,6 +18,7 @@ from ..client.clientset import Clientset
 from ..client.informer import InformerFactory
 from .base import Controller
 from .certificates import CertificateController
+from .crdregistrar import CRDRegistrar
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
@@ -54,6 +55,7 @@ DEFAULT_CONTROLLERS: dict[str, Callable] = {
     "ttl": TTLController,
     "disruption": DisruptionController,
     "taint-manager": NoExecuteTaintManager,
+    "crd-registrar": CRDRegistrar,
     "persistentvolume": PersistentVolumeController,
     "attachdetach": AttachDetachController,
     "horizontalpodautoscaler": HorizontalPodAutoscalerController,

@@ -4,8 +4,10 @@ Capability of the reference's default predicate set
 (``plugin/pkg/scheduler/algorithm/predicates/predicates.go``; registration
 ``algorithmprovider/defaults/defaults.go:118-186``).  This module is the
 sequential CPU *oracle*: the behavioral specification that the TPU
-feasibility-mask kernels (``kubernetes_tpu/ops/filters.py``) must reproduce
-bit-for-bit on the canonical fixed-point units.
+feasibility masks (built in ``kubernetes_tpu/models/snapshot.py`` and
+evaluated by ``kubernetes_tpu/ops/batch_kernel.py`` /
+``ops/pallas_kernel.py``) must reproduce bit-for-bit on the canonical
+fixed-point units.
 
 Each predicate: ``fn(pod, meta, node_info, ctx) -> (ok, reasons)`` where
 ``meta`` is per-pod precomputation shared across all nodes (reference

@@ -1,0 +1,253 @@
+"""Extended admission plugins + runtime kind registration (CRDs).
+
+Behavioral specs from the reference ``plugin/pkg/admission/*`` and
+``apiextensions-apiserver``."""
+
+import pytest
+
+from kubernetes_tpu.admission import (
+    AdmissionChain,
+    AdmissionDenied,
+    AdmittedStore,
+    AlwaysPullImages,
+    GenericAdmissionWebhook,
+    ImagePolicyWebhook,
+    NodeRestriction,
+    PodNodeSelector,
+    default_chain,
+)
+from kubernetes_tpu.api import (
+    CustomResourceDefinition,
+    Namespace,
+    ObjectMeta,
+    PersistentVolumeClaim,
+    PodPresetSpec,
+    Quantity,
+    StorageClass,
+)
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.controllers.crdregistrar import CRDRegistrar
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_pod
+
+
+@pytest.fixture()
+def cs():
+    return Clientset(AdmittedStore(default_chain()))
+
+
+def test_default_storage_class_applied_to_classless_claim(cs):
+    cs.storageclasses.create(StorageClass(
+        meta=ObjectMeta(name="standard"), provisioner="p", is_default=True))
+    cs.storageclasses.create(StorageClass(meta=ObjectMeta(name="slow"), provisioner="p"))
+    pvc = cs.persistentvolumeclaims.create(PersistentVolumeClaim(
+        meta=ObjectMeta(name="c", namespace="default"), request_storage=Quantity("1Gi")))
+    assert pvc.storage_class == "standard"
+    # explicit class untouched
+    pvc2 = cs.persistentvolumeclaims.create(PersistentVolumeClaim(
+        meta=ObjectMeta(name="c2", namespace="default"),
+        request_storage=Quantity("1Gi"), storage_class="slow"))
+    assert pvc2.storage_class == "slow"
+
+
+def test_two_default_storage_classes_deny(cs):
+    for n in ("a", "b"):
+        cs.storageclasses.create(StorageClass(
+            meta=ObjectMeta(name=n), provisioner="p", is_default=True))
+    with pytest.raises(AdmissionDenied):
+        cs.persistentvolumeclaims.create(PersistentVolumeClaim(
+            meta=ObjectMeta(name="c", namespace="default"),
+            request_storage=Quantity("1Gi")))
+
+
+def test_pod_preset_injects_env_and_volumes(cs):
+    cs.podpresets.create(PodPresetSpec(
+        meta=ObjectMeta(name="inject", namespace="default"),
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        env={"DB_HOST": "db.internal"},
+        volumes=[{"name": "cache", "diskId": "", "diskKind": ""}],
+    ))
+    pod = cs.pods.create(make_pod("p", labels={"app": "web"}))
+    assert pod.spec.containers[0].env == {"DB_HOST": "db.internal"}
+    assert any(v.name == "cache" for v in pod.spec.volumes)
+    assert "podpreset.admission.kubernetes.io/podpreset-inject" in pod.meta.annotations
+    # non-matching pod untouched
+    other = cs.pods.create(make_pod("q", labels={"app": "api"}))
+    assert other.spec.containers[0].env == {}
+
+
+def test_always_pull_images():
+    chain = AdmissionChain([AlwaysPullImages()])
+    cs = Clientset(AdmittedStore(chain))
+    pod = cs.pods.create(make_pod("p"))
+    assert all(c.image_pull_policy == "Always" for c in pod.spec.containers)
+
+
+def test_pod_node_selector_merges_and_conflicts():
+    chain = AdmissionChain([PodNodeSelector()])
+    cs = Clientset(AdmittedStore(chain))
+    cs.namespaces.create(Namespace(meta=ObjectMeta(
+        name="tenant", annotations={
+            PodNodeSelector.ANNOTATION: "pool=gold, zone=us-east"})))
+    pod = cs.pods.create(make_pod("p", namespace="tenant"))
+    assert pod.spec.node_selector == {"pool": "gold", "zone": "us-east"}
+    bad = make_pod("q", namespace="tenant", node_selector={"pool": "silver"})
+    with pytest.raises(AdmissionDenied):
+        cs.pods.create(bad)
+
+
+def test_image_policy_webhook_allow_deny_and_failure_policy():
+    def deny_evil(payload):
+        images = [c["image"] for c in payload["spec"]["containers"]]
+        bad = any("evil" in i for i in images)
+        return {"status": {"allowed": not bad, "reason": "evil image"}}
+
+    chain = AdmissionChain([ImagePolicyWebhook(backend=deny_evil)])
+    cs = Clientset(AdmittedStore(chain))
+    cs.pods.create(make_pod("ok"))
+    evil = make_pod("bad")
+    evil.spec.containers[0].image = "registry/evil:latest"
+    with pytest.raises(AdmissionDenied):
+        cs.pods.create(evil)
+
+    def broken(payload):
+        raise RuntimeError("down")
+
+    closed = Clientset(AdmittedStore(AdmissionChain(
+        [ImagePolicyWebhook(backend=broken, default_allow=False)])))
+    with pytest.raises(AdmissionDenied):
+        closed.pods.create(make_pod("x"))
+    open_ = Clientset(AdmittedStore(AdmissionChain(
+        [ImagePolicyWebhook(backend=broken, default_allow=True)])))
+    open_.pods.create(make_pod("y"))  # fail-open admits
+
+
+def test_generic_admission_webhook_scoping_and_fail_policy():
+    calls = []
+
+    def record_and_deny(payload):
+        calls.append(payload["request"]["kind"])
+        return {"response": {"allowed": False, "status": {"message": "nope"}}}
+
+    chain = AdmissionChain([GenericAdmissionWebhook(webhooks=[
+        {"name": "podcop", "kinds": ["Pod"], "backend": record_and_deny},
+    ])])
+    cs = Clientset(AdmittedStore(chain))
+    cs.namespaces.create(Namespace(meta=ObjectMeta(name="ns1")))  # not scoped -> no call
+    with pytest.raises(AdmissionDenied):
+        cs.pods.create(make_pod("p"))
+    assert calls == ["Pod"]
+
+
+def test_node_restriction():
+    chain = AdmissionChain([NodeRestriction()])
+    store = AdmittedStore(chain)
+    cs = Clientset(store)
+    # kubelet identity may write its own pod status but not others'
+    own = make_pod("mine", node_name="n1").to_dict()
+    other = make_pod("theirs", node_name="n2").to_dict()
+    from kubernetes_tpu.admission import Attributes, CREATE
+
+    chain.run(Attributes(operation=CREATE, kind="Pod", namespace="default",
+                         name="mine", obj=own, store=store, user="system:node:n1"))
+    with pytest.raises(AdmissionDenied):
+        chain.run(Attributes(operation=CREATE, kind="Pod", namespace="default",
+                             name="theirs", obj=other, store=store,
+                             user="system:node:n1"))
+    with pytest.raises(AdmissionDenied):
+        chain.run(Attributes(operation=CREATE, kind="Node", namespace="",
+                             name="n2", obj={}, store=store, user="system:node:n1"))
+
+
+def test_crd_registers_runtime_kind_end_to_end(cs):
+    """Create a CRD -> registrar establishes it -> custom objects are
+    addressable through the typed client AND the wire apiserver, and the
+    GC collects their dependents."""
+    reg = CRDRegistrar(cs)
+    cs.customresourcedefinitions.create(CustomResourceDefinition(
+        meta=ObjectMeta(name="widgets.example.com"),
+        kind_name="Widget", plural="widgets"))
+    reg.reconcile_all()
+    assert cs.customresourcedefinitions.get("widgets.example.com").established
+
+    from kubernetes_tpu.api.crd import make_dynamic_kind
+
+    Widget = __import__("kubernetes_tpu.api.types", fromlist=["KINDS"]).KINDS["Widget"]
+    w = Widget.from_dict({"kind": "Widget",
+                          "metadata": {"name": "w1", "namespace": "default"},
+                          "spec": {"size": 3}})
+    created = cs.client_for("Widget").create(w)
+    assert created.raw["spec"]["size"] == 3
+    got = cs.client_for("Widget").get("w1", "default")
+    assert got.meta.name == "w1"
+
+    # wire addressability via the lazy resource lookup
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client.remote import RemoteStore
+
+    srv = APIServer(cs.store)
+    srv.start()
+    try:
+        remote = Clientset(RemoteStore(srv.url))
+        objs, _ = remote.client_for("Widget").list()
+        assert [o.meta.name for o in objs] == ["w1"]
+    finally:
+        srv.stop()
+
+    # GC: a pod owned by a Widget cascades when the Widget goes
+    from kubernetes_tpu.api import OwnerReference
+    from kubernetes_tpu.controllers import GarbageCollector
+
+    p = make_pod("wdep")
+    p.meta.owner_references = [OwnerReference(
+        kind="Widget", name="w1", uid=created.meta.uid)]
+    cs.pods.create(p)
+    gc = GarbageCollector(cs)
+    gc.reconcile_all()
+    cs.client_for("Widget").delete("w1", "default")
+    gc.reconcile_all()
+    assert all(q.meta.name != "wdep" for q in cs.pods.list()[0])
+
+    # deleting the CRD unregisters the kind
+    cs.customresourcedefinitions.delete("widgets.example.com")
+    reg.reconcile_all()
+    from kubernetes_tpu.api.types import KINDS
+
+    assert "Widget" not in KINDS
+
+
+def test_pod_preset_conflict_skips_whole_preset(cs):
+    """A pod whose env conflicts with the preset gets NOTHING from it —
+    no partial application, no applied annotation."""
+    cs.podpresets.create(PodPresetSpec(
+        meta=ObjectMeta(name="inject", namespace="default"),
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        env={"FOO": "preset"},
+        volumes=[{"name": "cache"}],
+    ))
+    p = make_pod("p", labels={"app": "web"})
+    p.spec.containers[0].env = {"FOO": "pod"}
+    created = cs.pods.create(p)
+    assert created.spec.containers[0].env == {"FOO": "pod"}
+    assert not any(v.name == "cache" for v in created.spec.volumes)
+    assert not any("podpreset" in k for k in created.meta.annotations)
+
+
+def test_duplicate_crd_does_not_unregister_claimants_kind(cs):
+    reg = CRDRegistrar(cs)
+    cs.customresourcedefinitions.create(CustomResourceDefinition(
+        meta=ObjectMeta(name="widgets.a.com"), kind_name="Widget", plural="widgets"))
+    reg.reconcile_all()
+    cs.customresourcedefinitions.create(CustomResourceDefinition(
+        meta=ObjectMeta(name="widgets.b.com"), kind_name="Widget", plural="widgets"))
+    reg.reconcile_all()
+    assert not cs.customresourcedefinitions.get("widgets.b.com").established
+    cs.customresourcedefinitions.delete("widgets.b.com")
+    reg.reconcile_all()
+    from kubernetes_tpu.api.types import KINDS
+
+    assert "Widget" in KINDS  # the claimant's kind survives
+    cs.customresourcedefinitions.delete("widgets.a.com")
+    reg.reconcile_all()
+    assert "Widget" not in KINDS
