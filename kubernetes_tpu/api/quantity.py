@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import re
 from fractions import Fraction
+from functools import lru_cache
 from functools import total_ordering
 
 _BINARY_SUFFIXES = {
@@ -159,10 +160,7 @@ def _coerce(v) -> Fraction:
     raise TypeError(f"cannot compare Quantity with {type(v)!r}")
 
 
-from functools import lru_cache
-
-
-@lru_cache(maxsize=4096)
+@lru_cache(maxsize=8192)
 def _parse(s: str) -> Fraction:
     """Memoized: clusters reuse a handful of quantity strings ("100m",
     "128Mi", …) across hundreds of thousands of objects, and Fractions are

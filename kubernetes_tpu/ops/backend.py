@@ -78,7 +78,13 @@ class TPUBatchBackend:
         self,
         algorithm: Optional[GenericScheduler] = None,
         tensorizer: Optional[Tensorizer] = None,
-        max_segment_pods: int = 4096,  # power of two = one scan-length bucket
+        # Segment cap: a power of two so every full segment lands in one
+        # scan-length bucket.  Large segments amortize the per-segment host
+        # work (tensorize, corpus matching, dispatch) across more pods —
+        # 4096 -> 65536 took the north preset from 44x to 125x; the other
+        # budgets (signatures/terms/conflict-vols) still cut when exceeded,
+        # and the Pallas scan runs to the REAL pod count, not the pad.
+        max_segment_pods: int = 65536,
         kernel_impl: str = "auto",  # auto | pallas | xla
     ):
         self.algorithm = algorithm or GenericScheduler()

@@ -63,14 +63,40 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def quantity_to_slot_units(slot: int, q: Quantity) -> int:
-    """Canonicalize one Quantity into its slot's integer unit."""
+def _away_from_zero_div(num: int, den: int) -> int:
+    """Quantity.value()/milli_value() rounding: positives round away
+    from zero; negatives take divmod's floor, which is ALSO away from
+    zero — any reimplementation (e.g. in a kernel) must match both."""
+    q, r = divmod(num, den)
+    if r != 0 and num > 0:
+        q += 1
+    return q
+
+
+def _slot_units_cached(slot: int, f) -> int:
     if slot == CPU_MILLI:
-        return q.milli_value()
+        return _away_from_zero_div(f.numerator * 1000, f.denominator)
     if slot in (MEM_MIB, STORAGE_MIB):
-        f = q.fraction
         return _ceil_div(f.numerator, f.denominator * MIB)
-    return q.value()
+    return _away_from_zero_div(f.numerator, f.denominator)
+
+
+_slot_units_memo: dict = {}
+
+
+def quantity_to_slot_units(slot: int, q: Quantity) -> int:
+    """Canonicalize one Quantity into its slot's integer unit.  Memoized:
+    resource strings come from a tiny vocabulary ("100m", "128Mi", ...)
+    but this runs for every container of every pod admitted to every
+    tensor build — Fraction arithmetic is the oracle's hottest scalar op."""
+    f = q.fraction
+    key = (slot, f.numerator, f.denominator)
+    got = _slot_units_memo.get(key)
+    if got is None:
+        if len(_slot_units_memo) > 65536:
+            _slot_units_memo.clear()
+        got = _slot_units_memo[key] = _slot_units_cached(slot, f)
+    return got
 
 
 @dataclass
