@@ -26,6 +26,7 @@ from .predicates import (
     DEFAULT_PREDICATES,
     PredicateContext,
     compute_metadata,
+    fast_fit_nodes,
     pod_fits_on_node,
 )
 from .priorities import PriorityContext, default_priorities
@@ -74,14 +75,20 @@ class GenericScheduler:
         parallelizes with 16 workers (P1); the oracle stays sequential —
         the node axis is exactly what the TPU shards instead."""
         meta = compute_metadata(pod, ctx)
-        feasible: list[str] = []
-        failures: dict[str, list[str]] = {}
-        for name in node_names:
-            ok, reasons = pod_fits_on_node(pod, meta, node_info_map[name], ctx, self.predicates)
-            if ok:
-                feasible.append(name)
-            else:
-                failures[name] = reasons
+        if self.predicates == DEFAULT_PREDICATES:
+            # fused inline pass — identical feasibility, first-fail reasons
+            feasible, failures = fast_fit_nodes(pod, meta, node_names, node_info_map, ctx)
+        else:
+            feasible = []
+            failures = {}
+            for name in node_names:
+                ok, reasons = pod_fits_on_node(
+                    pod, meta, node_info_map[name], ctx, self.predicates
+                )
+                if ok:
+                    feasible.append(name)
+                else:
+                    failures[name] = reasons
         for ext in self.extenders:
             if not feasible:
                 break

@@ -36,6 +36,19 @@ from .units import (
 )
 
 
+def _zone_key_of(node) -> str:
+    """reference ``utilnode.GetZoneKey`` (region+zone label pair), cached on
+    the NodeInfo because scoring reads it for every node on every pod."""
+    if node is None:
+        return ""
+    labels = node.meta.labels
+    region = labels.get(api.REGION_LABEL, "")
+    zone = labels.get(api.ZONE_LABEL, "")
+    if not region and not zone:
+        return ""
+    return f"{region}:{zone}"
+
+
 def pod_has_affinity(pod: api.Pod) -> bool:
     a = pod.spec.affinity
     return a is not None and bool(
@@ -59,16 +72,19 @@ class NodeInfo:
         self.allocatable_pods = node_allocatable_pods(node) if node else 0
         self.used_ports: set[tuple[str, int]] = set()
         self.generation = 0
+        self.zone_key = _zone_key_of(node)  # cached region:zone label pair
 
     # -- node object -------------------------------------------------------
     def set_node(self, node: api.Node) -> None:
         self.node = node
         self.allocatable = node_allocatable_vec(node)
         self.allocatable_pods = node_allocatable_pods(node)
+        self.zone_key = _zone_key_of(node)
         self.generation += 1
 
     def remove_node(self) -> None:
         self.node = None
+        self.zone_key = ""
         self.generation += 1
 
     # -- pod aggregation ---------------------------------------------------
@@ -104,6 +120,7 @@ class NodeInfo:
     def clone(self) -> "NodeInfo":
         c = NodeInfo()
         c.node = self.node
+        c.zone_key = self.zone_key
         c.pods = list(self.pods)
         c.pods_with_affinity = list(self.pods_with_affinity)
         c.requested = self.requested.copy()

@@ -68,6 +68,21 @@ class PredicateMetadata:
     is_best_effort: bool = False
     host_ports: list[tuple[str, int]] = field(default_factory=list)
     matching_anti_affinity_terms: list[MatchingAntiAffinityTerm] = field(default_factory=list)
+    # The pod's OWN required (anti)affinity terms, collapsed to topology
+    # VALUE SETS per term (computed once per pod; the per-node check then
+    # costs O(1) set lookups instead of an all-pods scan — the value-set
+    # form of predicates.go:1181's per-node scan, bit-identical because
+    # _same_topology is exactly "both nodes carry the key with equal
+    # values").  None = pod carries no such terms.
+    own_affinity_values: "list[tuple[str, set, bool, bool]] | None" = None
+    # [(topology_key, matching_values, matching_pod_exists, self_match)]
+    own_anti_affinity_values: "list[tuple[str, set]] | None" = None
+    # [(topology_key, forbidden_values)]
+    # Symmetry set collapsed the same way: key -> owner-node values where
+    # co-location is forbidden; sym_always_fails = a symmetry term with no
+    # topology key (forbids every node, as the term list form does)
+    sym_forbidden: "dict[str, set] | None" = None
+    sym_always_fails: bool = False
 
 
 class PredicateContext:
@@ -146,6 +161,47 @@ def compute_metadata(pod: api.Pod, ctx: PredicateContext) -> PredicateMetadata:
                 meta.matching_anti_affinity_terms.append(
                     MatchingAntiAffinityTerm(term=term, owner_node_labels=node_labels)
                 )
+    if meta.matching_anti_affinity_terms:
+        meta.sym_forbidden = {}
+        for mt in meta.matching_anti_affinity_terms:
+            key = mt.term.topology_key
+            if not key:
+                meta.sym_always_fails = True
+                continue
+            if key in mt.owner_node_labels:
+                meta.sym_forbidden.setdefault(key, set()).add(mt.owner_node_labels[key])
+
+    # The pod's own required terms, collapsed to per-term topology value
+    # sets in ONE pass over the cluster (instead of one pass per node)
+    aff = pod.spec.affinity
+    if aff is not None and (aff.pod_affinity_required or aff.pod_anti_affinity_required):
+        all_pods = ctx.all_pods()
+        if aff.pod_affinity_required:
+            meta.own_affinity_values = []
+            for term in aff.pod_affinity_required:
+                values: set = set()
+                exists = False
+                for existing, existing_info in all_pods:
+                    if not _pod_matches_term(existing, pod, term):
+                        continue
+                    exists = True
+                    labels = existing_info.node.meta.labels if existing_info.node else {}
+                    if term.topology_key in labels:
+                        values.add(labels[term.topology_key])
+                meta.own_affinity_values.append(
+                    (term.topology_key, values, exists, _pod_matches_term(pod, pod, term))
+                )
+        if aff.pod_anti_affinity_required:
+            meta.own_anti_affinity_values = []
+            for term in aff.pod_anti_affinity_required:
+                values = set()
+                for existing, existing_info in all_pods:
+                    if not _pod_matches_term(existing, pod, term):
+                        continue
+                    labels = existing_info.node.meta.labels if existing_info.node else {}
+                    if term.topology_key in labels:
+                        values.add(labels[term.topology_key])
+                meta.own_anti_affinity_values.append((term.topology_key, values))
     return meta
 
 
@@ -383,26 +439,61 @@ def no_volume_node_conflict(pod, meta, info: NodeInfo, ctx: PredicateContext) ->
 
 
 def match_inter_pod_affinity(pod, meta: PredicateMetadata, info: NodeInfo, ctx: PredicateContext) -> tuple[bool, list[str]]:
+    if meta is None:
+        # probe callers without precomputation get the real thing — the
+        # scan branches below must never run against missing symmetry data
+        meta = compute_metadata(pod, ctx)
     if info.node is None:
         return False, [AFFINITY_NOT_MATCH]
     node_labels = info.node.meta.labels
 
     # 1. Symmetry: existing pods' required anti-affinity must not be broken
-    #    (satisfiesExistingPodsAntiAffinity, predicates.go:1146).
-    for mt in meta.matching_anti_affinity_terms:
-        if not mt.term.topology_key:
+    #    (satisfiesExistingPodsAntiAffinity, predicates.go:1146) — value-set
+    #    form when precomputed, term-list scan otherwise
+    if meta is not None and meta.sym_forbidden is not None:
+        if meta.sym_always_fails:
             return False, [AFFINITY_NOT_MATCH]
-        if _same_topology(node_labels, mt.owner_node_labels, mt.term.topology_key):
-            return False, [AFFINITY_NOT_MATCH]
+        for key, values in meta.sym_forbidden.items():
+            if key in node_labels and node_labels[key] in values:
+                return False, [AFFINITY_NOT_MATCH]
+    else:
+        for mt in meta.matching_anti_affinity_terms:
+            if not mt.term.topology_key:
+                return False, [AFFINITY_NOT_MATCH]
+            if _same_topology(node_labels, mt.owner_node_labels, mt.term.topology_key):
+                return False, [AFFINITY_NOT_MATCH]
 
     aff = pod.spec.affinity
     if aff is None or (not aff.pod_affinity_required and not aff.pod_anti_affinity_required):
         return True, []
 
-    all_pods = None  # lazily fetched
+    # 2+3. The pod's own required terms (satisfiesPodsAffinityAntiAffinity,
+    # predicates.go:1181) over the per-pod precomputed value sets: a term is
+    # satisfied iff this node's topology value is in the term's matching
+    # set (affinity) / out of it (anti-affinity); the first-pod rule
+    # (predicates.go:1196-1216) rides the precomputed exists/self flags.
+    if meta is not None and (
+        meta.own_affinity_values is not None or meta.own_anti_affinity_values is not None
+    ):
+        for key, values, exists, self_match in meta.own_affinity_values or ():
+            if not key:
+                return False, [AFFINITY_NOT_MATCH]
+            if node_labels.get(key) in values and key in node_labels:
+                continue
+            if exists:
+                return False, [AFFINITY_NOT_MATCH]
+            if not self_match:
+                return False, [AFFINITY_NOT_MATCH]
+        for key, values in meta.own_anti_affinity_values or ():
+            if not key:
+                return False, [AFFINITY_NOT_MATCH]
+            if key in node_labels and node_labels.get(key) in values:
+                return False, [AFFINITY_NOT_MATCH]
+        return True, []
 
-    # 2. The pod's own required affinity terms
-    #    (satisfiesPodsAffinityAntiAffinity, predicates.go:1181).
+    # direct per-node scan (reached only with a hand-built meta lacking
+    # the value sets, e.g. external predicate callers)
+    all_pods = None  # lazily fetched
     for term in aff.pod_affinity_required:
         if not term.topology_key:
             return False, [AFFINITY_NOT_MATCH]
@@ -426,7 +517,6 @@ def match_inter_pod_affinity(pod, meta: PredicateMetadata, info: NodeInfo, ctx: 
             if not _pod_matches_term(pod, pod, term):
                 return False, [AFFINITY_NOT_MATCH]
 
-    # 3. The pod's own required anti-affinity terms.
     for term in aff.pod_anti_affinity_required:
         if not term.topology_key:
             return False, [AFFINITY_NOT_MATCH]
@@ -479,3 +569,132 @@ def pod_fits_on_node(
         if not ok:
             reasons.extend(r)
     return (not reasons), reasons
+
+
+def fast_fit_nodes(
+    pod: api.Pod,
+    meta: PredicateMetadata,
+    node_names: list,
+    node_info_map: dict,
+    ctx: PredicateContext,
+) -> tuple[list[str], dict[str, list[str]]]:
+    """The DEFAULT predicate set fused into one inline pass per node.
+
+    SURVEY §7.1/§2.12: hot paths must not be interpreted-Python *dispatch*
+    loops — 11 predicate function calls per node per pod is exactly that.
+    This staged form produces IDENTICAL feasibility (every stage is the
+    same arithmetic as its predicate function, in the same order); the
+    only divergence is that an infeasible node reports its FIRST failing
+    stage's reason rather than every failing predicate's — reasons feed
+    only the failure-event message.  Custom predicate configs keep the
+    full per-predicate loop.
+
+    Pod-invariant work is hoisted: toleration checks memoize on the
+    node's taint tuple, stage flags are plain attribute reads, and the
+    volume/port/selector stages are skipped entirely for pods that carry
+    none (the common case)."""
+    feasible: list[str] = []
+    failures: dict[str, list[str]] = {}
+
+    req = meta.pod_request.units
+    req_cpu, req_mem, req_sto, req_gpu = (
+        req[CPU_MILLI], req[MEM_MIB], req[STORAGE_MIB], req[GPU_COUNT],
+    )
+    best_effort = meta.is_best_effort
+    host_ports = meta.host_ports
+    want_host = pod.spec.node_name
+    node_selector = pod.spec.node_selector
+    aff = pod.spec.affinity
+    node_aff = aff.node_affinity_required if aff is not None else None
+    has_disk_vols = any(v.disk_id for v in pod.spec.volumes)
+    has_pvc_vols = any(v.pvc_name for v in pod.spec.volumes)
+    tolerations = pod.spec.tolerations
+    tol_memo: dict[tuple, bool] = {}
+    has_own_aff = (
+        meta.sym_forbidden is not None
+        or meta.own_affinity_values is not None
+        or meta.own_anti_affinity_values is not None
+    )
+
+    for name in node_names:
+        info = node_info_map[name]
+        node = info.node
+        why: Optional[str] = None
+        if node is None:
+            why = NODE_NOT_READY
+        elif node.spec.unschedulable:
+            why = NODE_UNSCHEDULABLE
+        else:
+            ready = node.status.condition(api.NODE_READY)
+            if ready is not None and ready.status != "True":
+                why = NODE_NOT_READY
+        if why is None and info.disk_pressure:
+            why = DISK_PRESSURE
+        if why is None and best_effort and info.memory_pressure:
+            why = MEMORY_PRESSURE
+        if why is None:
+            taints = node.spec.taints
+            if taints:
+                tkey = tuple(
+                    (t.key, t.value, t.effect) for t in taints
+                    if t.effect in (api.NO_SCHEDULE, api.NO_EXECUTE)
+                )
+                if tkey:
+                    ok = tol_memo.get(tkey)
+                    if ok is None:
+                        ok = all(
+                            any(tol.tolerates(t) for tol in tolerations)
+                            for t in taints
+                            if t.effect in (api.NO_SCHEDULE, api.NO_EXECUTE)
+                        )
+                        tol_memo[tkey] = ok
+                    if not ok:
+                        why = TAINT_NOT_TOLERATED
+        if why is None:
+            # PodFitsResources (:556) + pod count
+            alloc = info.allocatable.units
+            used = info.requested.units
+            if len(info.pods) + 1 > info.allocatable_pods:
+                why = INSUFFICIENT_PODS
+            elif req_cpu > 0 and used[CPU_MILLI] + req_cpu > alloc[CPU_MILLI]:
+                why = INSUFFICIENT_CPU
+            elif req_mem > 0 and used[MEM_MIB] + req_mem > alloc[MEM_MIB]:
+                why = INSUFFICIENT_MEMORY
+            elif req_sto > 0 and used[STORAGE_MIB] + req_sto > alloc[STORAGE_MIB]:
+                why = INSUFFICIENT_STORAGE
+            elif req_gpu > 0 and used[GPU_COUNT] + req_gpu > alloc[GPU_COUNT]:
+                why = INSUFFICIENT_GPU
+        if why is None and want_host and want_host != node.meta.name:
+            why = NODE_NOT_MATCH_HOST
+        if why is None and host_ports:
+            for port in host_ports:
+                if port in info.used_ports:
+                    why = PORT_CONFLICT
+                    break
+        if why is None and (node_selector or node_aff is not None):
+            labels = node.meta.labels
+            if node_selector and not matches_simple_selector(node_selector, labels):
+                why = SELECTOR_MISMATCH
+            elif node_aff is not None and not node_aff.matches(labels):
+                why = SELECTOR_MISMATCH
+        if why is None and has_disk_vols:
+            ok, r = no_disk_conflict(pod, meta, info, ctx)
+            if ok:
+                ok, r = max_volume_count(pod, meta, info, ctx)
+            if not ok:
+                why = r[0]
+        if why is None and has_pvc_vols:
+            ok, r = no_volume_zone_conflict(pod, meta, info, ctx)
+            if ok:
+                ok, r = no_volume_node_conflict(pod, meta, info, ctx)
+            if not ok:
+                why = r[0]
+        if why is None and (has_own_aff or meta.matching_anti_affinity_terms):
+            ok, r = match_inter_pod_affinity(pod, meta, info, ctx)
+            if not ok:
+                why = r[0]
+        if why is None:
+            feasible.append(name)
+        else:
+            failures[name] = [why]
+    return feasible, failures

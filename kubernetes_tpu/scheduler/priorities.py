@@ -68,16 +68,14 @@ class PriorityContext:
         self.pvs = pvs or {}
 
 
+# one zone-key implementation for oracle AND tensorizer (bit-parity):
+from .nodeinfo import _zone_key_of as _zone_key_of_node
+
+
 def _zone_key(node: Optional[api.Node]) -> str:
-    """reference ``utilnode.GetZoneKey``: region+zone label pair."""
-    if node is None:
-        return ""
-    labels = node.meta.labels
-    region = labels.get(api.REGION_LABEL, "")
-    zone = labels.get(api.ZONE_LABEL, "")
-    if not region and not zone:
-        return ""
-    return f"{region}:{zone}"
+    """reference ``utilnode.GetZoneKey``; scoring loops read the cached
+    ``NodeInfo.zone_key`` (same function) instead."""
+    return _zone_key_of_node(node)
 
 
 # ---------------------------------------------------------------------------
@@ -111,14 +109,12 @@ class LeastRequestedPriority:
 
     def compute_all(self, pod: api.Pod, infos: list[NodeInfo], ctx: PriorityContext) -> list[int]:
         req = pod_nonzero_request_vec(pod)
+        rc, rm = req.units[CPU_MILLI], req.units[MEM_MIB]
         out = []
         for info in infos:
-            cpu = _least_requested_score(
-                info.nonzero_requested[CPU_MILLI] + req[CPU_MILLI], info.allocatable[CPU_MILLI]
-            )
-            mem = _least_requested_score(
-                info.nonzero_requested[MEM_MIB] + req[MEM_MIB], info.allocatable[MEM_MIB]
-            )
+            nz, al = info.nonzero_requested.units, info.allocatable.units
+            cpu = _least_requested_score(nz[CPU_MILLI] + rc, al[CPU_MILLI])
+            mem = _least_requested_score(nz[MEM_MIB] + rm, al[MEM_MIB])
             out.append((cpu + mem) // 2)
         return out
 
@@ -131,14 +127,12 @@ class MostRequestedPriority:
 
     def compute_all(self, pod, infos, ctx) -> list[int]:
         req = pod_nonzero_request_vec(pod)
+        rc, rm = req.units[CPU_MILLI], req.units[MEM_MIB]
         out = []
         for info in infos:
-            cpu = _most_requested_score(
-                info.nonzero_requested[CPU_MILLI] + req[CPU_MILLI], info.allocatable[CPU_MILLI]
-            )
-            mem = _most_requested_score(
-                info.nonzero_requested[MEM_MIB] + req[MEM_MIB], info.allocatable[MEM_MIB]
-            )
+            nz, al = info.nonzero_requested.units, info.allocatable.units
+            cpu = _most_requested_score(nz[CPU_MILLI] + rc, al[CPU_MILLI])
+            mem = _most_requested_score(nz[MEM_MIB] + rm, al[MEM_MIB])
             out.append((cpu + mem) // 2)
         return out
 
@@ -151,12 +145,14 @@ class BalancedResourceAllocation:
 
     def compute_all(self, pod, infos, ctx) -> list[int]:
         req = pod_nonzero_request_vec(pod)
+        rc, rm = req.units[CPU_MILLI], req.units[MEM_MIB]
         out = []
         for info in infos:
-            cpu_req = info.nonzero_requested[CPU_MILLI] + req[CPU_MILLI]
-            mem_req = info.nonzero_requested[MEM_MIB] + req[MEM_MIB]
-            cpu_cap = info.allocatable[CPU_MILLI]
-            mem_cap = info.allocatable[MEM_MIB]
+            nz, al = info.nonzero_requested.units, info.allocatable.units
+            cpu_req = nz[CPU_MILLI] + rc
+            mem_req = nz[MEM_MIB] + rm
+            cpu_cap = al[CPU_MILLI]
+            mem_cap = al[MEM_MIB]
             if cpu_cap == 0 or mem_cap == 0 or cpu_req >= cpu_cap or mem_req >= mem_cap:
                 out.append(0)
                 continue
@@ -212,7 +208,7 @@ class SelectorSpreadPriority:
                     if q.meta.namespace == pod.meta.namespace and self._matches_any(sels, q):
                         cnt += 1
             counts.append(cnt)
-            zk = _zone_key(info.node)
+            zk = info.zone_key
             if zk:
                 zone_counts[zk] = zone_counts.get(zk, 0) + cnt
         max_n = max(counts, default=0)
@@ -227,7 +223,7 @@ class SelectorSpreadPriority:
             )
             total_fp = node_fp
             if have_zones:
-                zk = _zone_key(info.node)
+                zk = info.zone_key
                 if zk:
                     zone_fp = (
                         ((max_z - zone_counts[zk]) * MAX_PRIORITY * FIXED_POINT_ONE) // max_z

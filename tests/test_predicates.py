@@ -353,3 +353,65 @@ def test_affinity_namespace_scoping():
     pod2 = make_pod("web2", affinity=aff2)
     ok, _ = fits(pod2, "na", m)
     assert ok
+
+
+def test_fast_fit_nodes_matches_per_predicate_loop():
+    """The fused default-set pass must stay feasibility-identical to the
+    11-predicate loop — this pin catches drift when a predicate changes
+    without its fused mirror."""
+    import random
+
+    from kubernetes_tpu.api import (Affinity, LabelSelector, PodAffinityTerm,
+                                    Taint, Toleration, Volume)
+    from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+    from kubernetes_tpu.scheduler.predicates import (
+        DEFAULT_PREDICATES, PredicateContext, compute_metadata,
+        fast_fit_nodes, pod_fits_on_node)
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    rng = random.Random(11)
+    zones = ["a", "b", "c"]
+    node_info_map = {}
+    for i in range(40):
+        node = make_node(
+            f"n{i:02d}", cpu="4", memory="8Gi",
+            labels={"zone": zones[i % 3], "disk": "ssd" if i % 4 == 0 else "hdd",
+                    "failure-domain.beta.kubernetes.io/zone": zones[i % 3]},
+            taints=[Taint(key="dedicated", value="x", effect="NoSchedule")] if i % 5 == 0 else [],
+            unschedulable=(i == 7),
+        )
+        info = NodeInfo(node)
+        for j in range(rng.randrange(3)):
+            existing = make_pod(f"e{i}-{j}", cpu="500m", labels={"app": rng.choice(["web", "db"])})
+            if rng.random() < 0.3:
+                existing.spec.affinity = Affinity(pod_anti_affinity_required=[
+                    PodAffinityTerm(selector=LabelSelector.from_match_labels({"app": "web"}),
+                                    topology_key="failure-domain.beta.kubernetes.io/zone")])
+            if rng.random() < 0.3:
+                existing.spec.volumes = [Volume(name="v", disk_id=f"d{rng.randrange(6)}",
+                                                disk_kind="gce-pd")]
+            info.add_pod(existing)
+        node_info_map[node.meta.name] = info
+    names = sorted(node_info_map)
+
+    for t in range(60):
+        pod = make_pod(f"p{t}", cpu=rng.choice(["100m", "2", "5"]),
+                       labels={"app": rng.choice(["web", "db"])})
+        if rng.random() < 0.3:
+            pod.spec.node_selector = {"disk": "ssd"}
+        if rng.random() < 0.3:
+            pod.spec.affinity = Affinity(pod_anti_affinity_required=[
+                PodAffinityTerm(selector=LabelSelector.from_match_labels({"app": pod.meta.labels["app"]}),
+                                topology_key="failure-domain.beta.kubernetes.io/zone")])
+        if rng.random() < 0.3:
+            pod.spec.volumes = [Volume(name="v", disk_id=f"d{rng.randrange(6)}", disk_kind="gce-pd")]
+        if rng.random() < 0.2:
+            pod.spec.tolerations = [Toleration(key="dedicated", operator="Exists")]
+        ctx = PredicateContext(node_info_map)
+        meta = compute_metadata(pod, ctx)
+        fast_feasible, _ = fast_fit_nodes(pod, meta, names, node_info_map, ctx)
+        slow_feasible = [
+            n for n in names
+            if pod_fits_on_node(pod, meta, node_info_map[n], ctx, DEFAULT_PREDICATES)[0]
+        ]
+        assert fast_feasible == slow_feasible, f"trial {t}"
