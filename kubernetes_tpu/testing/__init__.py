@@ -1,0 +1,4 @@
+"""Test infrastructure: chaos injection + SLO enforcement (SURVEY.md §4.6)."""
+
+from .chaos import ChaosMonkey, NodePartition, PodKiller, SchedulerRestart
+from .slo import SLOChecker, SLOViolation

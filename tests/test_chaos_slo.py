@@ -1,0 +1,216 @@
+"""Chaos injection + SLO enforcement e2e.
+
+The capability of the reference's chaosmonkey/network-partition e2e and
+the metrics-threshold gatekeeping (SURVEY.md §4.6, coverage row 52)."""
+
+import pytest
+
+from kubernetes_tpu.api import ObjectMeta, ReplicaSet, PodTemplateSpec, PodSpec, Container, Quantity, ResourceRequirements
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.kubelet.hollow import HollowFleet
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testing import (
+    ChaosMonkey,
+    NodePartition,
+    PodKiller,
+    SchedulerRestart,
+    SLOChecker,
+    SLOViolation,
+)
+from kubernetes_tpu.utils.metrics import Counter, Histogram
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def advance(self, dt):
+        self.now += dt
+
+    def __call__(self):
+        return self.now
+
+
+def make_rs(n, cpu="100m"):
+    return ReplicaSet(
+        meta=ObjectMeta(name="web", namespace="default"),
+        replicas=n,
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        template=PodTemplateSpec(
+            labels={"app": "web"},
+            spec=PodSpec(containers=[Container(
+                name="c", resources=ResourceRequirements(requests={"cpu": Quantity(cpu)}),
+            )]),
+        ),
+    )
+
+
+def build_world(n_nodes=9, clock=None):
+    clock = clock or FakeClock()
+    cs = Clientset(Store())
+    fleet = HollowFleet(cs, n_nodes, clock=clock, pod_start_latency=0.0,
+                        cpu="4", memory="8Gi")
+    fleet.register_all()
+    mgr = ControllerManager(
+        cs, enabled=["replicaset", "node-lifecycle"], clock=clock,
+        grace_period=40, pod_eviction_timeout=60, eviction_qps=100,
+    )
+    mgr.start()
+    sched = Scheduler(cs, clock=clock)
+    sched.start()
+    return cs, clock, fleet, mgr, sched
+
+
+def test_partition_mid_rollout_recovers_without_eviction_storm():
+    """A minority of nodes partitions while a ReplicaSet rolls out; the
+    rollout completes on survivors, and recovery re-heartbeats without a
+    mass eviction (the zone-damping + chaos protocol together)."""
+    cs, clock, fleet, mgr, sched = build_world(9)
+    cs.replicasets.create(make_rs(40))
+    partitioned = {f"hollow-0000{i}" for i in (0, 1)}  # 2 of 9: minority
+
+    def tick(t):
+        mgr.reconcile_all()
+        sched.pump()
+        sched.run_pending()
+        fleet.tick_all()
+        mgr.tick()  # node-lifecycle monitor
+        clock.advance(5.0)
+
+    def done():
+        pods, _ = cs.pods.list()
+        return sum(1 for p in pods if p.status.phase == "Running") >= 40
+
+    cm = ChaosMonkey(
+        tick, [NodePartition(fleet, partitioned)],
+        inject_at=2, recover_at=30, done=done, max_ticks=80,
+    )
+    ticks = cm.run()
+    assert cm.injected and cm.recovered
+    pods, _ = cs.pods.list()
+    running = sum(1 for p in pods if p.status.phase == "Running")
+    assert running >= 40, f"only {running} running after {ticks} ticks"
+    # recovery: the partitioned nodes are Ready again
+    for name in partitioned:
+        node = cs.nodes.get(name)
+        assert node.status.condition("Ready").status == "True"
+
+
+def test_scheduler_restart_resumes_from_store():
+    """Kill the scheduler mid-workload and rebuild it from nothing but
+    the store: every pod still lands exactly once (assume/bind CAS) —
+    the checkpoint/resume property (SURVEY.md §5.3)."""
+    cs, clock, fleet, mgr, sched = build_world(6)
+    holder = {"scheduler": sched}
+    cs.replicasets.create(make_rs(30))
+
+    def tick(t):
+        mgr.reconcile_all()
+        s = holder["scheduler"]
+        if s is not None:
+            s.pump()
+            s.run_pending()
+        fleet.tick_all()
+        clock.advance(2.0)
+
+    def done():
+        pods, _ = cs.pods.list()
+        return (
+            len(pods) >= 30
+            and all(p.spec.node_name for p in pods)
+            and sum(1 for p in pods if p.status.phase == "Running") >= 30
+        )
+
+    cm = ChaosMonkey(
+        tick,
+        [SchedulerRestart(holder, lambda: Scheduler(cs, clock=clock))],
+        inject_at=3, recover_at=6, done=done, max_ticks=60,
+    )
+    cm.run()
+    pods, _ = cs.pods.list()
+    assert len(pods) == 30  # no duplicates, no losses
+    assert all(p.spec.node_name for p in pods)
+
+
+def test_pod_killer_churn_is_healed_by_replicaset():
+    cs, clock, fleet, mgr, sched = build_world(6)
+    cs.replicasets.create(make_rs(20))
+    killer = PodKiller(cs, rate=2, seed=3)
+
+    def tick(t):
+        mgr.reconcile_all()
+        sched.pump()
+        sched.run_pending()
+        fleet.tick_all()
+        clock.advance(2.0)
+
+    def done():
+        pods, _ = cs.pods.list()
+        return sum(1 for p in pods if p.status.phase == "Running") >= 20
+
+    cm = ChaosMonkey(tick, [killer], inject_at=3, recover_at=12, done=done, max_ticks=80)
+    cm.run()
+    assert killer.killed > 0
+    pods, _ = cs.pods.list()
+    assert sum(1 for p in pods if p.status.phase == "Running") >= 20
+
+
+def test_slo_checker_enforces_reference_thresholds():
+    slo = SLOChecker()
+    slo.check_throughput(250.0)  # above warn line: clean
+    slo.assert_all()
+
+    slo = SLOChecker()
+    slo.check_throughput(55.0)  # warn band (30..100)
+    slo.assert_all()  # warns, does not fail
+    assert slo.warnings
+
+    slo = SLOChecker()
+    slo.check_throughput(12.0)  # below the 30 pods/s floor
+    h = Histogram("lat", buckets=[10, 100, 1000])
+    for v in [5, 20, 900, 900, 900]:
+        h.observe(v)
+    slo.check_latency_quantile("algo latency", h, 0.99, max_value=100)
+    c = Counter("failures")
+    c.inc(7)
+    slo.check_counter_max("failures", c, 3)
+    with pytest.raises(SLOViolation) as ei:
+        slo.assert_all()
+    msg = str(ei.value)
+    assert "throughput" in msg and "p99" in msg and "failures" in msg
+
+
+def test_scheduler_slis_meet_slo_in_density_run():
+    """The scheduler_perf density gate: schedule 200 pods, enforce the
+    reference thresholds on the real SLI histograms."""
+    import time as _time
+
+    cs, clock, fleet, mgr, sched = build_world(6)
+    cs.replicasets.create(make_rs(200, cpu="10m"))
+    start = _time.perf_counter()
+    for _ in range(30):
+        mgr.reconcile_all()
+        sched.pump()
+        n = sched.run_pending()
+        fleet.tick_all()
+        clock.advance(1.0)
+        pods, _ = cs.pods.list()
+        if len(pods) >= 200 and all(p.spec.node_name for p in pods):
+            break
+    elapsed = _time.perf_counter() - start
+    pods, _ = cs.pods.list()
+    bound = sum(1 for p in pods if p.spec.node_name)
+    assert bound >= 200
+
+    slo = SLOChecker()
+    slo.check_throughput(bound / elapsed)
+    # e2e p99 under 1s (reference pod-scheduling SLI; microseconds)
+    slo.check_latency_quantile(
+        "e2e scheduling latency", sched.metrics.e2e_scheduling_latency, 0.99,
+        max_value=1_000_000,
+    )
+    slo.check_counter_max("schedule failures", sched.metrics.schedule_failures, 0)
+    slo.assert_all()
