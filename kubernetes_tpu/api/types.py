@@ -44,6 +44,10 @@ NO_EXECUTE = "NoExecute"
 TAINT_NODE_NOT_READY = "node.alpha.kubernetes.io/notReady"
 TAINT_NODE_UNREACHABLE = "node.alpha.kubernetes.io/unreachable"
 
+# stamped on ReplicaSets by the deployment controller; read by kubectl
+# rollout history/undo (reference deployment/util annotation constants)
+DEPLOYMENT_REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
+
 # Node condition types
 NODE_READY = "Ready"
 NODE_MEMORY_PRESSURE = "MemoryPressure"

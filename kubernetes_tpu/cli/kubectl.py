@@ -33,6 +33,36 @@ from ..client.remote import RemoteStore
 from ..store.store import AlreadyExistsError, NotFoundError
 
 LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+REVISION_ANNOTATION = api.DEPLOYMENT_REVISION_ANNOTATION
+
+
+def _jsonpath(doc, expr: str) -> list:
+    """The jsonpath subset ``get -o jsonpath=`` actually gets used for
+    (reference ``pkg/util/jsonpath``): ``{.a.b}``, ``{.items[2].x}``, and
+    ``{.items[*].x}`` fan-out.  Multiple ``{...}`` groups concatenate."""
+    import re
+
+    out: list = []
+    exprs = re.findall(r"\{([^}]*)\}", expr) or [expr]
+    for e in exprs:
+        nodes = [doc]
+        for part in [p for p in e.strip().lstrip(".").split(".") if p]:
+            m = re.fullmatch(r"([^\[\]]*)(?:\[(\*|-?\d+)\])?", part)
+            if m is None:
+                raise ValueError(f"bad jsonpath segment {part!r}")
+            field_name, idx = m.group(1), m.group(2)
+            next_nodes = []
+            for n in nodes:
+                v = n[field_name] if field_name else n
+                if idx is None:
+                    next_nodes.append(v)
+                elif idx == "*":
+                    next_nodes.extend(v)
+                else:
+                    next_nodes.append(v[int(idx)])
+            nodes = next_nodes
+        out.extend(nodes)
+    return out
 
 # kind -> plural resource name, from the one type registry (RESTMapper
 # analogue) — new kinds (incl. CRDs) become kubectl-addressable on import.
@@ -113,6 +143,19 @@ class Kubectl:
         if output == "yaml":
             docs = [o.to_dict() for o in objs]
             self.out.write(yaml.safe_dump(docs[0] if name else {"items": docs}))
+            return 0
+        if output and not output.startswith("jsonpath="):
+            self.out.write(f"error: unsupported output format {output!r}\n")
+            return 1
+        if output.startswith("jsonpath="):
+            docs = [o.to_dict() for o in objs]
+            doc = docs[0] if name else {"items": docs}
+            try:
+                values = _jsonpath(doc, output[len("jsonpath="):])
+            except (KeyError, IndexError, TypeError, ValueError) as e:
+                self.out.write(f"error: jsonpath: {e}\n")
+                return 1
+            self.out.write(" ".join(str(v) for v in values) + "\n")
             return 0
         rows = [self._headers(kind)]
         for o in objs:
@@ -250,6 +293,105 @@ class Kubectl:
         self.out.write(f"{resource}/{name} deleted\n")
         return 0
 
+    # -- rollout (cmd/rollout, rollback.go) --------------------------------
+    def _dep_and_rses(self, name: str, namespace: Optional[str]):
+        dep = self.cs.deployments.get(name, namespace)
+        rses = []
+        for rs in self.cs.replicasets.list(namespace or "default")[0]:
+            ref = rs.meta.controller_ref()
+            if ref is not None and ref.kind == "Deployment" and ref.uid == dep.meta.uid:
+                rses.append(rs)
+        return dep, rses
+
+    def rollout_status(self, name: str, namespace: Optional[str] = None) -> int:
+        """``kubectl rollout status deployment NAME``: 0 when the rollout
+        is complete, 1 while in progress (the reference polls; one shot
+        here — loops live in the caller)."""
+        try:
+            dep, _ = self._dep_and_rses(name, namespace)
+        except NotFoundError:
+            self.out.write(f'Error: deployment "{name}" not found\n')
+            return 1
+        # completion also requires the CURRENT template's RS to be fully
+        # rolled out — aggregate counters alone go stale the instant the
+        # spec changes (reference guards with observedGeneration +
+        # updatedReplicas-of-current-template)
+        from ..controllers.deployment import template_hash
+
+        want_hash = template_hash(dep.template)
+        cur_rs = next(
+            (rs for rs in self._dep_and_rses(name, namespace)[1]
+             if rs.meta.labels.get("pod-template-hash") == want_hash),
+            None,
+        )
+        if (
+            cur_rs is not None
+            and cur_rs.status_ready_replicas >= dep.replicas
+            and dep.status_updated_replicas >= dep.replicas
+            and dep.status_ready_replicas >= dep.replicas
+            and dep.status_replicas == dep.replicas
+        ):
+            self.out.write(f'deployment "{name}" successfully rolled out\n')
+            return 0
+        self.out.write(
+            f"Waiting for rollout: {dep.status_updated_replicas} of "
+            f"{dep.replicas} updated, {dep.status_ready_replicas} ready\n"
+        )
+        return 1
+
+    def rollout_history(self, name: str, namespace: Optional[str] = None) -> int:
+        try:
+            dep, rses = self._dep_and_rses(name, namespace)
+        except NotFoundError:
+            self.out.write(f'Error: deployment "{name}" not found\n')
+            return 1
+        self.out.write(f"deployment/{name}\nREVISION  REPLICASET\n")
+        for rs in sorted(
+            rses, key=lambda r: int(r.meta.annotations.get(REVISION_ANNOTATION, "0"))
+        ):
+            rev = rs.meta.annotations.get(REVISION_ANNOTATION, "0")
+            self.out.write(f"{rev:<9} {rs.meta.name}\n")
+        return 0
+
+    def rollout_undo(self, name: str, namespace: Optional[str] = None,
+                     to_revision: int = 0) -> int:
+        """``rollback.go``: re-apply the target revision's template (the
+        previous one by default); the controller's hash matching then
+        treats that RS as new again and bumps its revision."""
+        try:
+            dep, rses = self._dep_and_rses(name, namespace)
+        except NotFoundError:
+            self.out.write(f'Error: deployment "{name}" not found\n')
+            return 1
+        by_rev = {
+            int(rs.meta.annotations.get(REVISION_ANNOTATION, "0")): rs for rs in rses
+        }
+        if not by_rev:
+            self.out.write("error: no rollout history\n")
+            return 1
+        if to_revision:
+            target = by_rev.get(to_revision)
+            if target is None:
+                self.out.write(f"error: revision {to_revision} not found\n")
+                return 1
+        else:
+            revs = sorted(by_rev)
+            if len(revs) < 2:
+                self.out.write("error: no previous revision\n")
+                return 1
+            target = by_rev[revs[-2]]
+
+        template = api.PodTemplateSpec.from_dict(target.template.to_dict())
+        template.labels.pop("pod-template-hash", None)
+
+        def _rollback(cur):
+            cur.template = template
+            return cur
+
+        self.cs.deployments.guaranteed_update(name, _rollback, namespace)
+        self.out.write(f"deployment/{name} rolled back\n")
+        return 0
+
     # -- scale / cordon / drain -------------------------------------------
     def scale(self, resource: str, name: str, replicas: int, namespace: Optional[str] = None) -> int:
         resource, kind = _resolve(resource)
@@ -324,7 +466,7 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     common.add_argument("--server", default=argparse.SUPPRESS)
     common.add_argument("--token", default=argparse.SUPPRESS)
     common.add_argument("-n", "--namespace", default=argparse.SUPPRESS)
-    common.add_argument("-o", "--output", default=argparse.SUPPRESS, choices=["", "json", "yaml"])
+    common.add_argument("-o", "--output", default=argparse.SUPPRESS)  # ""|json|yaml|jsonpath=...
 
     parser = argparse.ArgumentParser(prog="kubectl-tpu", parents=[common])
     sub = parser.add_subparsers(dest="verb", required=True)
@@ -354,6 +496,11 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("name")
     p = sub.add_parser("top", parents=[common])
     p.add_argument("what", choices=["nodes"])
+    p = sub.add_parser("rollout", parents=[common])
+    p.add_argument("action", choices=["status", "history", "undo"])
+    p.add_argument("resource")  # "deployment" or "deployment/NAME"
+    p.add_argument("name", nargs="?")
+    p.add_argument("--to-revision", type=int, default=0)
 
     args = parser.parse_args(argv)
     server = getattr(args, "server", "http://127.0.0.1:8080")
@@ -382,6 +529,19 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
         return k.drain(args.name)
     if args.verb == "top":
         return k.top_nodes()
+    if args.verb == "rollout":
+        res = args.resource
+        name = args.name
+        if name is None and "/" in res:
+            res, name = res.split("/", 1)
+        if _resolve(res)[1] != "Deployment" or not name:
+            k.out.write("error: rollout supports deployment/NAME\n")
+            return 1
+        if args.action == "status":
+            return k.rollout_status(name, namespace)
+        if args.action == "history":
+            return k.rollout_history(name, namespace)
+        return k.rollout_undo(name, namespace, args.to_revision)
     return 2
 
 

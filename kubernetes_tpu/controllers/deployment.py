@@ -30,6 +30,7 @@ def template_hash(template: api.PodTemplateSpec) -> str:
 
 class DeploymentController(Controller):
     name = "deployment"
+    REVISION_ANNOTATION = api.DEPLOYMENT_REVISION_ANNOTATION
 
     def __init__(self, clientset, informers=None, **kw):
         super().__init__(clientset, informers, **kw)
@@ -63,8 +64,16 @@ class DeploymentController(Controller):
                 return rs
         return None
 
-    def _create_new_rs(self, dep: api.Deployment, replicas: int) -> api.ReplicaSet:
+    def _create_new_rs(self, dep: api.Deployment, replicas: int,
+                       rses: list[api.ReplicaSet]) -> api.ReplicaSet:
         h = template_hash(dep.template)
+        next_rev = 1 + max(
+            (
+                int(rs.meta.annotations.get(self.REVISION_ANNOTATION, "0"))
+                for rs in rses
+            ),
+            default=0,
+        )
         labels = dict(dep.template.labels)
         labels["pod-template-hash"] = h
         template = api.PodTemplateSpec(labels=labels, spec=api.PodSpec.from_dict(dep.template.spec.to_dict()))
@@ -75,6 +84,7 @@ class DeploymentController(Controller):
                 name=f"{dep.meta.name}-{h}",
                 namespace=dep.meta.namespace,
                 labels=labels,
+                annotations={self.REVISION_ANNOTATION: str(next_rev)},
                 owner_references=[
                     OwnerReference(kind="Deployment", name=dep.meta.name, uid=dep.meta.uid, controller=True)
                 ],
@@ -87,6 +97,30 @@ class DeploymentController(Controller):
             return self.clientset.replicasets.create(rs)
         except AlreadyExistsError:
             return self.clientset.replicasets.get(rs.meta.name, rs.meta.namespace)
+
+    def _ensure_revision(self, new_rs, rses: list[api.ReplicaSet]) -> None:
+        """The reference's revision bookkeeping (``deployment/sync.go``
+        getNewReplicaSet): the RS matching the current template carries the
+        HIGHEST revision; re-applying an old template (rollback-by-reapply)
+        bumps that RS's revision rather than minting a new RS — rollout
+        history/undo read these annotations."""
+        revisions = [
+            int(rs.meta.annotations.get(self.REVISION_ANNOTATION, "0")) for rs in rses
+        ]
+        max_rev = max(revisions, default=0)
+        if new_rs is None:
+            return  # _create_new_rs stamps max+1
+        cur = int(new_rs.meta.annotations.get(self.REVISION_ANNOTATION, "0"))
+        if cur == max_rev and cur != 0:
+            return
+
+        def _stamp(r: api.ReplicaSet) -> api.ReplicaSet:
+            r.meta.annotations[self.REVISION_ANNOTATION] = str(max_rev + 1)
+            return r
+
+        self.clientset.replicasets.guaranteed_update(
+            new_rs.meta.name, _stamp, new_rs.meta.namespace
+        )
 
     def _scale_rs(self, rs: api.ReplicaSet, replicas: int) -> None:
         if rs.replicas == replicas:
@@ -107,6 +141,7 @@ class DeploymentController(Controller):
             return
         rses = self._owned_rses(dep)
         new_rs = self._new_rs(dep, rses)
+        self._ensure_revision(new_rs, rses)
         old_rses = [rs for rs in rses if new_rs is None or rs.meta.uid != new_rs.meta.uid]
         old_total = sum(rs.replicas for rs in old_rses)
 
@@ -116,13 +151,13 @@ class DeploymentController(Controller):
             old_active = sum(rs.status_replicas for rs in old_rses)
             if old_active == 0:
                 if new_rs is None:
-                    new_rs = self._create_new_rs(dep, dep.replicas)
+                    new_rs = self._create_new_rs(dep, dep.replicas, rses)
                 self._scale_rs(new_rs, dep.replicas)
         else:  # RollingUpdate
             if new_rs is None:
                 # surge head-room for the first step of the rollout
                 initial = max(min(dep.replicas, dep.replicas + dep.max_surge - old_total), 0)
-                new_rs = self._create_new_rs(dep, initial)
+                new_rs = self._create_new_rs(dep, initial, rses)
             else:
                 # scale new up within maxSurge
                 max_total = dep.replicas + dep.max_surge

@@ -132,3 +132,118 @@ def test_cli_over_http(tmp_path):
         assert rc == 0 and "n1" in out.getvalue()
     finally:
         server.stop()
+
+
+def _drive_deploy(cs, rounds=8):
+    from kubernetes_tpu.controllers.manager import ControllerManager
+
+    mgr = ControllerManager(cs, enabled=["deployment", "replicaset"])
+    mgr.start()
+    for _ in range(rounds):
+        mgr.reconcile_all()
+    return mgr
+
+
+def test_rollout_history_undo_and_status(cs):
+    """create v1 -> update to v2 -> rollout history shows both ->
+    undo returns to v1's template (rollback-by-reapply, rollback.go)."""
+    import yaml as _yaml
+
+    from kubernetes_tpu.api import Deployment, ObjectMeta, PodTemplateSpec, PodSpec, Container
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    dep = Deployment(
+        meta=ObjectMeta(name="web", namespace="default"),
+        replicas=2,
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        template=PodTemplateSpec(labels={"app": "web"},
+                                 spec=PodSpec(containers=[Container(name="c", image="img:v1")])),
+    )
+    cs.deployments.create(dep)
+    _drive_deploy(cs)
+
+    def _to_v2(cur):
+        cur.template.spec.containers[0].image = "img:v2"
+        return cur
+
+    cs.deployments.guaranteed_update("web", _to_v2, "default")
+    _drive_deploy(cs)
+
+    rc, out = run(cs, "rollout", "history", "deployment/web")
+    assert rc == 0
+    lines = [l for l in out.splitlines() if l and l[0].isdigit()]
+    assert len(lines) == 2 and lines[0].startswith("1") and lines[1].startswith("2")
+
+    rc, out = run(cs, "rollout", "undo", "deployment/web")
+    assert rc == 0
+    _drive_deploy(cs)
+    assert cs.deployments.get("web", "default").template.spec.containers[0].image == "img:v1"
+    # the re-applied template's RS carries the highest revision now
+    rc, out = run(cs, "rollout", "history", "deployment/web")
+    revs = [int(l.split()[0]) for l in out.splitlines() if l and l[0].isdigit()]
+    assert max(revs) == 3
+
+
+def test_rollout_status_roundtrip(cs):
+    from kubernetes_tpu.api import Deployment, ObjectMeta, PodTemplateSpec, PodSpec, Container
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    cs.deployments.create(Deployment(
+        meta=ObjectMeta(name="api", namespace="default"), replicas=1,
+        selector=LabelSelector.from_match_labels({"app": "api"}),
+        template=PodTemplateSpec(labels={"app": "api"},
+                                 spec=PodSpec(containers=[Container(name="c")])),
+    ))
+    rc, out = run(cs, "rollout", "status", "deployment/api")
+    assert rc == 1 and "Waiting" in out  # nothing reconciled yet
+
+
+def test_get_output_jsonpath(cs):
+    cs.nodes.create(make_node("n1", cpu="2"))
+    cs.nodes.create(make_node("n2", cpu="4"))
+    rc, out = run(cs, "get", "nodes", "-o", "jsonpath={.items[*].metadata.name}")
+    assert rc == 0 and out.strip() == "n1 n2"
+    rc, out = run(cs, "get", "nodes", "n2", "-o", "jsonpath={.metadata.name}")
+    assert rc == 0 and out.strip() == "n2"
+    rc, out = run(cs, "get", "nodes", "-o", "jsonpath={.items[1].status.capacity.cpu}")
+    assert rc == 0 and out.strip() == "4"
+
+
+def test_rollout_status_not_fooled_by_stale_counters(cs):
+    """After a spec update, stale aggregate counters must not report
+    success until the NEW template's RS is rolled out."""
+    from kubernetes_tpu.api import Deployment, ObjectMeta, PodTemplateSpec, PodSpec, Container
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    cs.deployments.create(Deployment(
+        meta=ObjectMeta(name="web", namespace="default"), replicas=2,
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        template=PodTemplateSpec(labels={"app": "web"},
+                                 spec=PodSpec(containers=[Container(name="c", image="v1")])),
+    ))
+    _drive_deploy(cs)
+    # fake full health for v1
+    def _healthy(cur):
+        cur.status_replicas = cur.status_updated_replicas = cur.status_ready_replicas = 2
+        return cur
+    cs.deployments.guaranteed_update("web", _healthy, "default")
+    for rs in cs.replicasets.list("default")[0]:
+        def _rs_healthy(cur):
+            cur.status_replicas = cur.status_ready_replicas = 2
+            return cur
+        cs.replicasets.guaranteed_update(rs.meta.name, _rs_healthy, "default")
+    rc, out = run(cs, "rollout", "status", "deployment/web")
+    assert rc == 0  # genuinely rolled out
+    # spec changes; counters are stale until the controller reconciles
+    def _to_v2(cur):
+        cur.template.spec.containers[0].image = "v2"
+        return cur
+    cs.deployments.guaranteed_update("web", _to_v2, "default")
+    rc, out = run(cs, "rollout", "status", "deployment/web")
+    assert rc == 1 and "Waiting" in out
+
+
+def test_get_rejects_unknown_output_format(cs):
+    cs.nodes.create(make_node("n1"))
+    rc, out = run(cs, "get", "nodes", "-o", "josn")
+    assert rc == 1 and "unsupported output" in out
