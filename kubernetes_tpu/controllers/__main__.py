@@ -30,7 +30,12 @@ def main(argv=None) -> int:
                     help="comma list or * (default set: %s)" % ",".join(DEFAULT_CONTROLLERS))
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--node-monitor-period", type=float, default=5.0)
+    ap.add_argument("--feature-gates", default="")
     args = ap.parse_args(argv)
+    from ..utils.features import DEFAULT_FEATURE_GATES
+
+    if args.feature_gates:
+        DEFAULT_FEATURE_GATES.set_from_string(args.feature_gates)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
@@ -38,7 +43,10 @@ def main(argv=None) -> int:
     names = None if args.controllers == "*" else args.controllers.split(",")
 
     def run(payload_stop: threading.Event) -> None:
-        mgr = ControllerManager(cs, enabled=names)
+        kw = {}
+        if DEFAULT_FEATURE_GATES.enabled("TaintBasedEvictions"):
+            kw["use_taint_based_evictions"] = True
+        mgr = ControllerManager(cs, enabled=names, **kw)
         mgr.start(manual=False, workers_per_controller=args.workers)
         logging.info("controller manager running: %s", ", ".join(mgr.controllers))
         while not payload_stop.is_set():

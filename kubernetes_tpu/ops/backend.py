@@ -96,8 +96,13 @@ class TPUBatchBackend:
 
     def _use_pallas(self, static) -> bool:
         """Fused Pallas kernel on real TPU; XLA scan everywhere else (CPU
-        tests, unsupported shapes) and after any runtime failure."""
+        tests, unsupported shapes), after any runtime failure, or when the
+        PallasKernels feature gate is off."""
         if self.kernel_impl == "xla" or self._pallas_failed:
+            return False
+        from ..utils.features import DEFAULT_FEATURE_GATES
+
+        if not DEFAULT_FEATURE_GATES.enabled("PallasKernels"):
             return False
         from .pallas_kernel import supports_pallas
 

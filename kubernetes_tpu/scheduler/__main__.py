@@ -22,12 +22,34 @@ def main(argv=None) -> int:
     ap.add_argument("--apiserver", required=True)
     ap.add_argument("--token", default=None)
     ap.add_argument("--leader-elect", action="store_true")
-    ap.add_argument("--backend", choices=["tpu", "oracle"], default="tpu")
-    ap.add_argument("--batch-interval", type=float, default=0.05,
+    # SUPPRESS so explicit flags can be told apart from defaults when a
+    # --config file is layered underneath (flag > file > default)
+    ap.add_argument("--backend", choices=["tpu", "oracle"], default=argparse.SUPPRESS)
+    ap.add_argument("--batch-interval", type=float, default=argparse.SUPPRESS,
                     help="seconds to coalesce pending pods before a TPU batch")
-    ap.add_argument("--policy-config-file", default=None)
-    ap.add_argument("--scheduler-name", default="default-scheduler")
+    ap.add_argument("--policy-config-file", default=argparse.SUPPRESS)
+    ap.add_argument("--scheduler-name", default=argparse.SUPPRESS)
+    ap.add_argument("--feature-gates", default="")
+    ap.add_argument("--config", default=None,
+                    help="SchedulerConfiguration YAML (componentconfig)")
     args = ap.parse_args(argv)
+    from ..utils.features import SchedulerConfiguration, load_component_config
+
+    cfg = (load_component_config(SchedulerConfiguration, args.config)
+           if args.config else SchedulerConfiguration())
+    # flag > config file > dataclass default
+    for attr in ("scheduler_name", "backend", "batch_interval", "policy_config_file"):
+        if not hasattr(args, attr):
+            setattr(args, attr, getattr(cfg, attr) or (None if attr == "policy_config_file" else getattr(cfg, attr)))
+    args.leader_elect = args.leader_elect or cfg.leader_elect
+    if args.config and cfg.feature_gates:
+        from ..utils.features import DEFAULT_FEATURE_GATES
+
+        DEFAULT_FEATURE_GATES.set_from_map(cfg.feature_gates)
+    if args.feature_gates:
+        from ..utils.features import DEFAULT_FEATURE_GATES
+
+        DEFAULT_FEATURE_GATES.set_from_string(args.feature_gates)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 

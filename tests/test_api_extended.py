@@ -259,3 +259,50 @@ def test_clientset_has_all_kind_clients():
     # cluster-scoped kinds key by bare name
     pc = cs.priorityclasses.create(PriorityClass(meta=ObjectMeta(name="high"), value=10))
     assert cs.priorityclasses.get("high").value == 10
+
+
+def test_feature_gates_and_componentconfig(tmp_path):
+    """pkg/features + componentconfig capability: defaults, flag wire
+    format, unknown rejection, strict config decoding."""
+    import pytest
+
+    from kubernetes_tpu.utils.features import (
+        FeatureGates,
+        SchedulerConfiguration,
+        load_component_config,
+    )
+
+    g = FeatureGates()
+    assert g.enabled("PodPriority") is True
+    assert g.enabled("TaintBasedEvictions") is False
+    g.set_from_string("TaintBasedEvictions=true, PallasKernels=false")
+    assert g.enabled("TaintBasedEvictions") is True
+    assert g.enabled("PallasKernels") is False
+    with pytest.raises(KeyError):
+        g.enabled("NoSuchGate")
+    with pytest.raises(ValueError):
+        g.set_from_string("PodPriority=yes")
+    with g.override("PodPriority", False):
+        assert not g.enabled("PodPriority")
+    assert g.enabled("PodPriority")
+
+    cfg_file = tmp_path / "sched.yaml"
+    cfg_file.write_text("backend: oracle\nbatch_interval: 0.2\n")
+    cfg = load_component_config(SchedulerConfiguration, str(cfg_file))
+    assert cfg.backend == "oracle" and cfg.batch_interval == 0.2
+    cfg_file.write_text("backnd: oracle\n")
+    with pytest.raises(ValueError):
+        load_component_config(SchedulerConfiguration, str(cfg_file))
+
+
+def test_pallas_gate_disables_pallas_path():
+    from kubernetes_tpu.models.snapshot import Tensorizer
+    from kubernetes_tpu.ops.backend import TPUBatchBackend
+    from kubernetes_tpu.utils.features import DEFAULT_FEATURE_GATES
+
+    class FakeStatic:
+        num_zones = 1
+
+    b = TPUBatchBackend(kernel_impl="pallas")  # would force pallas
+    with DEFAULT_FEATURE_GATES.override("PallasKernels", False):
+        assert b._use_pallas(FakeStatic()) is False
