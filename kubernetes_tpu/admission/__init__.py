@@ -31,5 +31,6 @@ from .plugins_ext import (
     NodeRestriction,
     PodNodeSelector,
     PodPreset,
+    ServiceIPAllocator,
 )
 from . import quota

@@ -331,6 +331,7 @@ def default_chain() -> AdmissionChain:
         NamespaceLifecycle(),
         LimitRanger(),
         ServiceAccount(),
+        _PluginsExt.ServiceIPAllocator(),
         _PluginsExt.DefaultStorageClass(),
         _PluginsExt.PodPreset(),
         DefaultTolerationSeconds(),

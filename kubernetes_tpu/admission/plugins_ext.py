@@ -262,6 +262,74 @@ class GenericAdmissionWebhook(AdmissionPlugin):
                 self.deny(f"webhook {hook.get('name')}: {msg}")
 
 
+class ServiceIPAllocator(AdmissionPlugin):
+    """ClusterIP + NodePort allocation at service create (the capability
+    of the reference's service REST registry allocators,
+    ``pkg/registry/core/service`` — placed on the write path the way all
+    of this framework's registry behavior is)."""
+
+    name = "ServiceIPAllocator"
+    operations = (CREATE,)
+
+    def __init__(self, service_cidr: str = "10.0.0.0/16",
+                 node_port_range: tuple[int, int] = (30000, 32767)):
+        import ipaddress
+
+        self.network = ipaddress.ip_network(service_cidr)
+        self.node_port_range = node_port_range
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Service" and super().handles(attrs)
+
+    def admit(self, attrs: Attributes) -> None:
+        import ipaddress
+
+        spec = attrs.obj.setdefault("spec", {})
+        existing, _ = attrs.store.list("Service", None)
+        used_ips = {s.get("spec", {}).get("clusterIP", "") for s in existing}
+        used_ports = {
+            p.get("nodePort", 0)
+            for s in existing
+            for p in s.get("spec", {}).get("ports", [])
+        }
+        ip = spec.get("clusterIP", "")
+        if ip == "":
+            for candidate in self.network.hosts():
+                c = str(candidate)
+                if c not in used_ips:
+                    spec["clusterIP"] = c
+                    break
+            else:
+                self.deny("service CIDR exhausted")
+        elif ip != "None":
+            try:
+                addr = ipaddress.ip_address(ip)
+            except ValueError:
+                self.deny(f"invalid clusterIP {ip!r}")
+            if addr not in self.network:
+                self.deny(f"clusterIP {ip} not in service CIDR {self.network}")
+            if ip in used_ips:
+                self.deny(f"clusterIP {ip} already allocated")
+        if spec.get("type") in ("NodePort", "LoadBalancer"):
+            lo, hi = self.node_port_range
+            for port in spec.get("ports", []):
+                np = int(port.get("nodePort", 0) or 0)
+                if np == 0:
+                    for candidate in range(lo, hi + 1):
+                        if candidate not in used_ports:
+                            port["nodePort"] = candidate
+                            used_ports.add(candidate)
+                            break
+                    else:
+                        self.deny("node port range exhausted")
+                elif np in used_ports:
+                    self.deny(f"node port {np} already allocated")
+                elif not (lo <= np <= hi):
+                    self.deny(f"node port {np} outside range {lo}-{hi}")
+                else:
+                    used_ports.add(np)
+
+
 class NodeRestriction(AdmissionPlugin):
     """Kubelets (``system:node:<name>``) may only modify their own Node
     object and pods bound to them (``noderestriction/admission.go``)."""

@@ -636,12 +636,14 @@ class NodeSpec:
     taints: list[Taint] = field(default_factory=list)
     unschedulable: bool = False
     provider_id: str = ""
+    pod_cidr: str = ""  # allocated by the node IPAM controller
 
     def to_dict(self) -> dict:
         return {
             "taints": [t.to_dict() for t in self.taints],
             "unschedulable": self.unschedulable,
             "providerID": self.provider_id,
+            "podCIDR": self.pod_cidr,
         }
 
     @classmethod
@@ -651,6 +653,7 @@ class NodeSpec:
             taints=[Taint.from_dict(t) for t in d.get("taints") or []],
             unschedulable=bool(d.get("unschedulable", False)),
             provider_id=d.get("providerID", ""),
+            pod_cidr=d.get("podCIDR", ""),
         )
 
 

@@ -9,6 +9,7 @@ from .audit import (
 )
 from .audit import PolicyRule as AuditPolicyRule
 from .authn import (
+    BootstrapTokenAuthenticator,
     ANONYMOUS,
     Authenticator,
     RequestHeaderAuthenticator,

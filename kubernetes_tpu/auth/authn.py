@@ -61,6 +61,49 @@ class TokenFileAuthenticator(Authenticator):
         return self.tokens.get(auth[7:])
 
 
+class BootstrapTokenAuthenticator(Authenticator):
+    """Bootstrap tokens "<id>.<secret>" validated against live
+    ``bootstrap-token-<id>`` Secrets in kube-system (reference
+    ``plugin/pkg/auth/authenticator/token/bootstrap``): unexpired tokens
+    authenticate as ``system:bootstrap:<id>`` in
+    ``system:bootstrappers`` — the kubeadm join credential."""
+
+    def __init__(self, store, clock=None):
+        import time
+
+        self.store = store
+        self.clock = clock or time.time
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer ") or "." not in auth[7:]:
+            return None
+        token_id, _, token_secret = auth[7:].partition(".")
+        from ..store.store import NotFoundError
+
+        try:
+            raw = self.store.get("Secret", "kube-system", f"bootstrap-token-{token_id}")
+        except NotFoundError:
+            return None
+        import hmac as _hmac
+
+        from ..controllers.ipam import parse_token_expiration
+
+        data = raw.get("data") or {}
+        if not _hmac.compare_digest(
+            str(data.get("token-secret", "")), token_secret
+        ):
+            return None
+        if parse_token_expiration(data.get("expiration")) <= self.clock():
+            return None
+        # the reference splits token usages: a signing-only token must NOT
+        # authenticate — require the authentication usage explicitly
+        if data.get("usage-bootstrap-authentication") not in ("true", True):
+            return None
+        return UserInfo(name=f"system:bootstrap:{token_id}",
+                        groups=["system:bootstrappers"])
+
+
 class RequestHeaderAuthenticator(Authenticator):
     """Identity asserted via X-Remote-User / X-Remote-Group headers — the
     front-proxy / client-cert stand-in (reference

@@ -26,6 +26,8 @@ from .disruption import DisruptionController
 from .endpoint import EndpointController
 from .garbagecollector import GarbageCollector
 from .horizontal import HorizontalPodAutoscalerController
+from .ipam import (BootstrapSignerController, NodeIpamController,
+    TokenCleanerController)
 from .job import JobController
 from .namespace import NamespaceController
 from .node_lifecycle import NodeLifecycleController
@@ -61,6 +63,9 @@ DEFAULT_CONTROLLERS: dict[str, Callable] = {
     "horizontalpodautoscaler": HorizontalPodAutoscalerController,
     "serviceaccount": ServiceAccountController,
     "certificates": CertificateController,
+    "node-ipam": NodeIpamController,
+    "bootstrapsigner": BootstrapSignerController,
+    "tokencleaner": TokenCleanerController,
 }
 
 
