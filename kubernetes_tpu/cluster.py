@@ -1,0 +1,221 @@
+"""Cluster lifecycle: init / join / up / down (the kubeadm +
+local-up-cluster capability, ``cmd/kubeadm`` + ``hack/``).
+
+    python -m kubernetes_tpu.cluster up   --nodes 10        # whole cluster
+    python -m kubernetes_tpu.cluster init --port 6443       # control plane
+    python -m kubernetes_tpu.cluster join --apiserver URL \
+        --token <id>.<secret> --name node-7                 # one hollow node
+    python -m kubernetes_tpu.cluster down
+
+``init`` mirrors kubeadm's phases at this control plane's depth: start
+the apiserver, create the system namespaces, mint a bootstrap token
+Secret, publish the signed ``kube-public/cluster-info`` discovery
+document, then start the scheduler and controller manager (leader
+elected). ``join`` performs the token-verified discovery handshake
+(fetch cluster-info WITHOUT credentials, verify its HMAC signature with
+the shared token — the reference's JWS check) before starting a kubelet.
+Process state lives in ``.kubernetes-tpu-cluster.json`` for ``down``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets as pysecrets
+import subprocess
+import sys
+import time
+import urllib.request
+
+STATE_FILE = ".kubernetes-tpu-cluster.json"
+
+
+def _spawn(mod: str, *args: str) -> int:
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", mod, *args],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    return proc.pid
+
+
+def _wait_healthy(url: str, timeout: float = 15.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"{url}/healthz", timeout=1) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            time.sleep(0.2)
+    raise SystemExit(f"apiserver at {url} did not become healthy")
+
+
+def _clientset(url: str):
+    from .client import Clientset
+    from .client.remote import RemoteStore
+
+    return Clientset(RemoteStore(url))
+
+
+def cmd_init(args) -> dict:
+    pids = {}
+    pids["apiserver"] = _spawn(
+        "kubernetes_tpu.apiserver", "--host", "127.0.0.1", "--port", str(args.port)
+    )
+    # persist immediately: if health-wait fails, `down` can still reap it
+    _save({"pids": dict(pids)})
+    url = f"http://127.0.0.1:{args.port}"
+    _wait_healthy(url)
+    cs = _clientset(url)
+
+    # kubeadm phase: system namespaces + bootstrap token + cluster-info
+    from .api import Namespace, ObjectMeta
+    from .api.cluster import Secret
+    from .controllers.ipam import BootstrapSignerController
+    from .store.store import AlreadyExistsError
+
+    for ns in ("kube-system", "kube-public"):
+        try:
+            cs.namespaces.create(Namespace(meta=ObjectMeta(name=ns)))
+        except AlreadyExistsError:
+            pass
+    token_id = pysecrets.token_hex(3)
+    token_secret = pysecrets.token_hex(8)
+    cs.secrets.create(Secret(
+        meta=ObjectMeta(name=f"bootstrap-token-{token_id}", namespace="kube-system"),
+        type="bootstrap.kubernetes.io/token",
+        data={"token-id": token_id, "token-secret": token_secret,
+              "expiration": str(time.time() + args.token_ttl),
+              "usage-bootstrap-authentication": "true"},
+    ))
+    signer = BootstrapSignerController(cs, cluster_info_payload=f"server: {url}")
+    signer.informers.start_all_manual()
+    signer.informers.pump_all()
+    while signer.sync_once():
+        pass
+
+    pids["scheduler"] = _spawn(
+        "kubernetes_tpu.scheduler", "--apiserver", url,
+        "--backend", args.backend, "--leader-elect",
+    )
+    pids["controller-manager"] = _spawn(
+        "kubernetes_tpu.controllers", "--apiserver", url, "--leader-elect",
+    )
+    token = f"{token_id}.{token_secret}"
+    print(f"control plane up at {url}")
+    print(f"join token: {token}")
+    print(f"  python -m kubernetes_tpu.cluster join --apiserver {url} "
+          f"--token {token} --name node-1")
+    return {"url": url, "pids": pids, "token": token}
+
+
+def verify_cluster_info(url: str, token: str) -> str:
+    """The join-side discovery handshake: fetch cluster-info anonymously,
+    verify the signature for OUR token id with OUR token secret."""
+    from .controllers.ipam import sign_cluster_info
+
+    token_id, _, token_secret = token.partition(".")
+    with urllib.request.urlopen(
+        f"{url}/api/v1/namespaces/kube-public/configmaps/cluster-info", timeout=5
+    ) as r:
+        info = json.loads(r.read())
+    data = info.get("data") or {}
+    payload = data.get("kubeconfig", "")
+    sig = data.get(f"jws-kubeconfig-{token_id}", "")
+    want = sign_cluster_info(payload, token_secret)
+    if not sig or sig != want:
+        raise SystemExit("cluster-info signature verification FAILED "
+                         "(wrong token or tampered discovery document)")
+    return payload
+
+
+def cmd_join(args) -> dict:
+    payload = verify_cluster_info(args.apiserver, args.token)
+    print(f"discovery verified: {payload!r}")
+    pid = _spawn(
+        "kubernetes_tpu.kubelet", "--apiserver", args.apiserver,
+        "--name", args.name, "--proxy",
+    )
+    print(f"node {args.name} joining (pid {pid})")
+    return {"pids": {f"kubelet-{args.name}": pid}}
+
+
+def _save(state: dict) -> None:
+    old = {}
+    if os.path.exists(STATE_FILE):
+        with open(STATE_FILE) as f:
+            old = json.load(f)
+    old.setdefault("pids", {}).update(state.get("pids", {}))
+    for k, v in state.items():
+        if k != "pids":
+            old[k] = v
+    with open(STATE_FILE, "w") as f:
+        json.dump(old, f, indent=2)
+
+
+def cmd_down(_args) -> None:
+    import signal
+
+    if not os.path.exists(STATE_FILE):
+        print("no cluster state found")
+        return
+    with open(STATE_FILE) as f:
+        state = json.load(f)
+    for name, pid in state.get("pids", {}).items():
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped {name} (pid {pid})")
+        except ProcessLookupError:
+            pass
+    os.remove(STATE_FILE)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu.cluster")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("init")
+    p.add_argument("--port", type=int, default=6443)
+    p.add_argument("--backend", choices=["tpu", "oracle"], default="tpu")
+    p.add_argument("--token-ttl", type=float, default=24 * 3600)
+    p = sub.add_parser("join")
+    p.add_argument("--apiserver", required=True)
+    p.add_argument("--token", required=True)
+    p.add_argument("--name", required=True)
+    p = sub.add_parser("up")
+    p.add_argument("--port", type=int, default=6443)
+    p.add_argument("--backend", choices=["tpu", "oracle"], default="oracle")
+    p.add_argument("--nodes", type=int, default=5)
+    p.add_argument("--token-ttl", type=float, default=24 * 3600)
+    sub.add_parser("down")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "init":
+        _save(cmd_init(args))
+        return 0
+    if args.cmd == "join":
+        _save(cmd_join(args))
+        return 0
+    if args.cmd == "up":
+        state = cmd_init(args)
+        url, token = state["url"], state["token"]
+        for i in range(args.nodes):
+            verify_cluster_info(url, token)
+            state["pids"][f"kubelet-{i}"] = _spawn(
+                "kubernetes_tpu.kubelet", "--apiserver", url,
+                "--name", f"node-{i:03d}", "--proxy",
+            )
+        _save(state)
+        print(f"{args.nodes} nodes joining")
+        return 0
+    if args.cmd == "down":
+        cmd_down(args)
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
