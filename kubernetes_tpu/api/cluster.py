@@ -599,6 +599,47 @@ class StorageClass:
 
 @_register_cluster_scoped
 @dataclass
+class APIService:
+    """Aggregation registration (reference ``kube-aggregator``
+    ``apiregistration.k8s.io/APIService``): requests under
+    ``/apis/<group>/...`` proxy to the named backend server.  The
+    reference resolves a Service reference; ``url`` carries the resolved
+    backend directly (the proxy handshake, availability condition, and
+    route installation are the capability)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    group: str = ""
+    url: str = ""  # backend base URL, e.g. http://127.0.0.1:9443
+    available: bool = False  # status condition, set by the aggregator probe
+
+    KIND = "APIService"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {"group": self.group, "url": self.url},
+            "status": {"available": self.available},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "APIService":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""
+        spec = d.get("spec") or {}
+        return cls(
+            meta=meta,
+            group=spec.get("group", ""),
+            url=spec.get("url", ""),
+            available=bool((d.get("status") or {}).get("available")),
+        )
+
+
+@_register_cluster_scoped
+@dataclass
 class PriorityClass:
     """Named pod priority (reference ``pkg/apis/scheduling/types.go``;
     resolved into ``pod.spec.priority`` by the Priority admission plugin)."""

@@ -146,6 +146,24 @@ def _make_handler(server: APIServer):
             parts = [p for p in url.path.split("/") if p]
             verb = {"POST": "create", "PUT": "update", "DELETE": "delete"}.get(method, "get")
             resource, ns, name = "", "", ""
+            if parts and parts[0] == "apis" and len(parts) >= 2:
+                # aggregated APIs: authorize/audit on "<group>/<resource>"
+                # so RBAC rules can scope aggregated access per group
+                group = parts[1]
+                rest = parts[3:] if len(parts) >= 3 else []  # skip version
+                if rest and rest[0] == "namespaces" and len(rest) >= 3:
+                    ns = rest[1]
+                    resource = f"{group}/{rest[2]}"
+                    name = rest[3] if len(rest) >= 4 else ""
+                else:
+                    resource = f"{group}/{rest[0]}" if rest else group
+                    name = rest[1] if len(rest) >= 2 else ""
+                if method == "GET":
+                    if q.get("watch", ["false"])[0] == "true":
+                        verb = "watch"
+                    elif not name:
+                        verb = "list"
+                return verb, resource, ns, name
             if len(parts) >= 3 and parts[0] == "api" and parts[1] == "v1":
                 rest = parts[2:]
                 if len(rest) == 1:
@@ -259,6 +277,121 @@ def _make_handler(server: APIServer):
         def do_DELETE(self):
             self._route("DELETE")
 
+        # -- chunked framing shared by watch serving and the proxy ---------
+        def _write_chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        def _end_chunks(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+
+        def _lookup_apiservice(self, group: str):
+            """By convention name==group, else fall back to spec.group (the
+            reference names objects '<version>.<group>')."""
+            from ..store.store import NotFoundError as _NF
+
+            try:
+                return server.store.get("APIService", "", group)
+            except _NF:
+                pass
+            for svc in server.store.list("APIService", "")[0]:
+                if (svc.get("spec") or {}).get("group") == group:
+                    return svc
+            return None
+
+        def _mark_available(self, svc: dict, available: bool) -> None:
+            """Best-effort availability condition (the reference's
+            aggregator availability controller, folded into the proxy's
+            own observations)."""
+            name = (svc.get("metadata") or {}).get("name", "")
+            if bool((svc.get("status") or {}).get("available")) == available:
+                return
+            try:
+                def _set(d: dict) -> dict:
+                    d.setdefault("status", {})["available"] = available
+                    return d
+
+                server.store.guaranteed_update("APIService", "", name, _set)
+            except Exception:
+                pass
+
+        def _proxy_aggregated(self, method: str, group: str, url) -> None:
+            """The kube-aggregator seam (``staging/src/k8s.io/
+            kube-aggregator`` proxy handler): ``/apis/<group>/...`` routes
+            to the APIService-registered backend.
+
+            Identity crosses as the front-proxy headers X-Remote-User /
+            X-Remote-Group — the client's own Authorization credential is
+            NEVER forwarded (forwarding it would hand bearer tokens to
+            whoever registered the APIService; the reference's aggregator
+            re-asserts identity the same way)."""
+            import urllib.error
+            import urllib.request as _rq
+
+            svc = self._lookup_apiservice(group)
+            if svc is None:
+                return self._error(404, "NotFound", f"no APIService for group {group!r}")
+            base = (svc.get("spec") or {}).get("url", "")
+            if not base:
+                return self._error(503, "ServiceUnavailable", f"APIService {group} has no backend")
+            q = parse_qs(url.query)
+            is_watch = q.get("watch", ["false"])[0] == "true"
+            target = base.rstrip("/") + url.path + (f"?{url.query}" if url.query else "")
+            body = None
+            length = int(self.headers.get("Content-Length", 0))
+            if length:
+                body = self.rfile.read(length)
+            req = _rq.Request(target, data=body, method=method)
+            for h in ("Content-Type", "Accept"):
+                if self.headers.get(h):
+                    req.add_header(h, self.headers[h])
+            user = getattr(self, "_user", None)
+            if user is not None and getattr(user, "name", ""):
+                req.add_header("X-Remote-User", user.name)
+                if user.groups:
+                    req.add_header("X-Remote-Group", ",".join(user.groups))
+            try:
+                # watches hold the socket open; plain requests fail fast
+                resp = _rq.urlopen(req, timeout=300 if is_watch else 30)
+            except urllib.error.HTTPError as e:
+                data = e.read()
+                self._last_code = e.code
+                self.send_response(e.code)
+                self.send_header("Content-Type", e.headers.get("Content-Type", "application/json"))
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            except Exception as e:
+                self._mark_available(svc, False)
+                return self._error(502, "BadGateway", f"APIService {group} backend error: {e}")
+            self._mark_available(svc, True)
+            with resp:
+                self._last_code = resp.status
+                self.send_response(resp.status)
+                chunked = resp.headers.get("Transfer-Encoding", "") == "chunked"
+                ctype = resp.headers.get("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
+                # once the response starts, failures may only close the
+                # stream — a second status line would corrupt the body
+                try:
+                    if chunked:
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        while True:
+                            chunk = resp.read1(65536) if hasattr(resp, "read1") else resp.read(65536)
+                            if not chunk:
+                                break
+                            self._write_chunk(chunk)
+                        self._end_chunks()
+                    else:
+                        data = resp.read()
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self.close_connection = True
+
         def _dispatch(self, method: str) -> None:
             url = urlparse(self.path)
             q = parse_qs(url.query)
@@ -286,6 +419,8 @@ def _make_handler(server: APIServer):
                 )
                 return self._send(200, {"errors": errors})
 
+            if parts and parts[0] == "apis" and len(parts) >= 2:
+                return self._proxy_aggregated(method, parts[1], url)
             if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
                 return self._error(404, "NotFound", f"no route for {url.path}")
             parts = parts[2:]
@@ -377,9 +512,8 @@ def _make_handler(server: APIServer):
                         ).encode()
                         + b"\n"
                     )
-                    self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
-                    self.wfile.flush()
-                self.wfile.write(b"0\r\n\r\n")
+                    self._write_chunk(line)
+                self._end_chunks()
             except (BrokenPipeError, ConnectionResetError):
                 pass
             finally:
