@@ -245,7 +245,7 @@ def run_parity(backend_res: dict, n_nodes: int, n_pods: int, workload: str, seed
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--preset", choices=PRESETS, default="mixed")
+    parser.add_argument("--preset", choices=PRESETS, default="north")
     parser.add_argument("--nodes", type=int, default=None)
     parser.add_argument("--pods", type=int, default=None)
     parser.add_argument("--workload", choices=["plain", "mixed"], default=None)
@@ -312,12 +312,19 @@ def main() -> None:
         f"segments={stats.get('segments', 0)} events={'on' if args.events else 'off'})",
         file=sys.stderr,
     )
-    # baseline: the reference harness's expected throughput (100 pods/s)
+    # baseline: the reference harness's expected throughput (100 pods/s).
+    # The preset/scale ride along so recorded results across rounds are
+    # comparable on their own terms (r1 default was 'basic'; the default
+    # is now the north-star scale itself).
     line = {
         "metric": "pods-scheduled/sec",
         "value": round(result["pods_per_sec"], 1),
         "unit": "pods/s",
         "vs_baseline": round(result["pods_per_sec"] / 100.0, 2),
+        "preset": args.preset,
+        "nodes": n_nodes,
+        "pods": result["bound"] + result["failed"],
+        "workload": workload,
     }
     if parity is not None:
         line["parity_checked"] = parity["checked"]
