@@ -37,19 +37,86 @@ _OP_BY_NAME = {
 
 
 def _build() -> Optional[str]:
-    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC_PATH):
-        return _SO_PATH
+    return _compile_cached(
+        _SRC_PATH, _SO_PATH,
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC_PATH],
+    )
+
+
+def _compile_cached(src: str, so: str, cmd: list[str]) -> Optional[str]:
+    """Compile ``src`` to ``so`` if stale; atomic rename so concurrent
+    processes never observe a half-written library.  Shared by every
+    native component."""
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    tmp = f"{so}.{os.getpid()}.tmp"
     try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC_PATH, "-o", _SO_PATH],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return _SO_PATH
+        subprocess.run(cmd + ["-o", tmp], check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        return so
     except Exception as e:  # noqa: BLE001 - any failure -> Python fallback
-        logger.warning("native labelmatch build failed (%s); using Python fallback", e)
+        logger.warning("native build of %s failed (%s); using Python fallback", src, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
+
+
+# -- fastcopy: native deep copy of JSON-shaped data ------------------------
+_FC_SRC = os.path.join(os.path.dirname(_SRC_PATH), "fastcopy.c")
+_fc_fn = None
+_fc_failed = False
+_fc_mu = threading.Lock()
+
+
+def _fc_so_path() -> str:
+    # keyed on the interpreter ABI: this library calls CPython APIs, so a
+    # cached build from another Python version must never be loaded
+    import sysconfig
+
+    tag = sysconfig.get_config_var("SOABI") or "py"
+    return os.path.join(os.path.dirname(_SRC_PATH), f"libfastcopy-{tag}.so")
+
+
+def get_fastcopy():
+    """The native deepcopy callable (PyObject -> PyObject), or None.
+    Built with the Python C API and loaded via ctypes.PyDLL (GIL held);
+    undefined CPython symbols resolve against the running interpreter."""
+    global _fc_fn, _fc_failed
+    with _fc_mu:
+        if _fc_fn is not None or _fc_failed:
+            return _fc_fn
+        try:
+            import sysconfig
+
+            include = sysconfig.get_paths()["include"]
+            so = _compile_cached(
+                _FC_SRC, _fc_so_path(),
+                ["gcc", "-O2", "-shared", "-fPIC", f"-I{include}", _FC_SRC],
+            )
+            if so is None:
+                raise RuntimeError("compile failed")
+            lib = ctypes.PyDLL(so)
+            lib.fc_deepcopy.restype = ctypes.py_object
+            lib.fc_deepcopy.argtypes = [ctypes.py_object]
+            fn = lib.fc_deepcopy
+            # self-check before trusting it on the store's hot path (an
+            # explicit raise: asserts vanish under PYTHONOPTIMIZE)
+            probe = {"a": [1, {"b": "c"}], "d": None}
+            got = fn(probe)
+            if not (
+                got == probe
+                and got is not probe
+                and got["a"] is not probe["a"]
+                and got["a"][1] is not probe["a"][1]
+            ):
+                raise RuntimeError("fastcopy self-check failed")
+            _fc_fn = fn
+        except Exception as e:  # noqa: BLE001 - any failure -> Python fallback
+            logger.warning("native fastcopy unavailable (%s); using Python fallback", e)
+            _fc_failed = True
+        return _fc_fn
 
 
 def get_lib():

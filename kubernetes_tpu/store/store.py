@@ -35,16 +35,31 @@ from typing import Callable, Iterator, Optional
 from ..api.meta import new_uid
 
 
-def _fast_deepcopy(obj):
+def _py_fast_deepcopy(obj):
     """Deep copy for JSON-shaped data (dict/list/scalars only) — the store's
     wire form by construction.  ~3x faster than copy.deepcopy, which burns
     time on memo bookkeeping and type dispatch the shape can't need."""
     t = type(obj)
     if t is dict:
-        return {k: _fast_deepcopy(v) for k, v in obj.items()}
+        return {k: _py_fast_deepcopy(v) for k, v in obj.items()}
     if t is list:
-        return [_fast_deepcopy(v) for v in obj]
+        return [_py_fast_deepcopy(v) for v in obj]
     return obj  # str/int/float/bool/None are immutable
+
+
+def _fast_deepcopy(obj):
+    """First call resolves the copier — the native C walk
+    (csrc/fastcopy.c, another ~3x) when it builds, else the Python walk —
+    and rebinds this name, so importing the store never triggers a
+    compile and later calls pay zero dispatch overhead."""
+    global _fast_deepcopy
+    try:
+        from ..native import get_fastcopy
+
+        _fast_deepcopy = get_fastcopy() or _py_fast_deepcopy
+    except Exception:  # noqa: BLE001 - the store must never lose its copier
+        _fast_deepcopy = _py_fast_deepcopy
+    return _fast_deepcopy(obj)
 
 
 def object_key(namespace: str, name: str) -> str:

@@ -106,3 +106,41 @@ def test_python_fallback_parity(monkeypatch):
     sid = eng.add_selector([("app", "In", ["web", "api"])])
     lids = [eng.add_labelmap({"app": "web"}), eng.add_labelmap({"app": "db"})]
     assert eng.match_matrix([sid], lids).tolist() == [[True, False]]
+
+
+def test_native_fastcopy_semantics():
+    """The C deepcopy must mirror the Python walk exactly: fresh
+    containers at every level, scalars shared, store isolation intact."""
+    from kubernetes_tpu.native import get_fastcopy
+    from kubernetes_tpu.store.store import _py_fast_deepcopy
+
+    fn = get_fastcopy()
+    if fn is None:
+        import pytest
+
+        pytest.skip("native fastcopy unavailable")
+    src = {"m": {"labels": {"a": "b"}, "fin": ["x", {"y": [1, 2.5, None, True]}]},
+           "empty": {}, "el": []}
+    for copier in (fn, _py_fast_deepcopy):
+        got = copier(src)
+        assert got == src
+        assert got is not src
+        assert got["m"] is not src["m"]
+        assert got["m"]["fin"] is not src["m"]["fin"]
+        assert got["m"]["fin"][1] is not src["m"]["fin"][1]
+        got["m"]["labels"]["a"] = "mutated"
+        assert src["m"]["labels"]["a"] == "b"  # isolation
+
+
+def test_store_isolation_with_active_copier():
+    """Whichever copier the store picked: watchers and readers must be
+    isolated from writer mutations."""
+    from kubernetes_tpu.store import Store
+
+    s = Store()
+    obj = {"kind": "Pod", "metadata": {"name": "p", "namespace": "default",
+                                       "labels": {"k": "v"}}}
+    stored = s.create("Pod", obj)
+    stored["metadata"]["labels"]["k"] = "hacked"
+    again = s.get("Pod", "default", "p")
+    assert again["metadata"]["labels"]["k"] == "v"
