@@ -666,6 +666,10 @@ class NodeStatus:
     # PV names attached to this node, written by the attach/detach
     # controller (reference ``node.status.volumesAttached``)
     volumes_attached: list[str] = field(default_factory=list)
+    # the node's read-API endpoint (reference daemonEndpoints.kubeletEndpoint
+    # + addresses, collapsed to one URL) — the apiserver proxies pod
+    # subresources (logs) here
+    kubelet_url: str = ""
 
     def condition(self, ctype: str) -> Optional[NodeCondition]:
         for c in self.conditions:
@@ -680,6 +684,7 @@ class NodeStatus:
             "conditions": [c.to_dict() for c in self.conditions],
             "images": copy.deepcopy(self.images),
             "volumesAttached": list(self.volumes_attached),
+            "kubeletURL": self.kubelet_url,
         }
 
     @classmethod
@@ -691,6 +696,7 @@ class NodeStatus:
             conditions=[NodeCondition.from_dict(c) for c in d.get("conditions") or []],
             images=copy.deepcopy(d.get("images") or []),
             volumes_attached=list(d.get("volumesAttached") or []),
+            kubelet_url=d.get("kubeletURL", ""),
         )
 
 

@@ -277,6 +277,52 @@ def _make_handler(server: APIServer):
         def do_DELETE(self):
             self._route("DELETE")
 
+        def _proxy_pod_log(self, ns: str, name: str, q) -> None:
+            """pod/log subresource: resolve the pod's node, proxy to that
+            node's kubelet read API (reference ``registry/core/pod/rest``
+            LogREST -> kubelet :10250 /containerLogs)."""
+            import urllib.request as _rq
+
+            try:
+                pod = server.store.get("Pod", ns, name)
+            except NotFoundError:
+                return self._error(404, "NotFound", f"pod {ns}/{name}")
+            node_name = (pod.get("spec") or {}).get("nodeName", "")
+            if not node_name:
+                return self._error(400, "BadRequest", "pod is not scheduled yet")
+            try:
+                node = server.store.get("Node", "", node_name)
+            except NotFoundError:
+                return self._error(502, "BadGateway", f"node {node_name} not found")
+            kubelet_url = (node.get("status") or {}).get("kubeletURL", "")
+            if not kubelet_url:
+                return self._error(502, "BadGateway",
+                                   f"node {node_name} exposes no kubelet endpoint")
+            containers = (pod.get("spec") or {}).get("containers") or []
+            known = [c.get("name", "") for c in containers]
+            container = q.get("container", [None])[0] or (known[0] if known else "")
+            if container not in known:
+                # also blocks path traversal into other kubelet endpoints
+                return self._error(400, "BadRequest",
+                                   f"container {container!r} not in pod {ns}/{name}")
+            target = f"{kubelet_url}/containerLogs/{ns}/{name}/{container}"
+            if "tailLines" in q:
+                tail = q["tailLines"][0]
+                if not tail.isdigit():
+                    return self._error(400, "BadRequest", "tailLines must be an integer")
+                target += f"?tailLines={tail}"
+            try:
+                with _rq.urlopen(target, timeout=10) as resp:
+                    data = resp.read()
+            except Exception as e:
+                return self._error(502, "BadGateway", f"kubelet log fetch failed: {e}")
+            self._last_code = 200
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         # -- chunked framing shared by watch serving and the proxy ---------
         def _write_chunk(self, data: bytes) -> None:
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
@@ -457,6 +503,8 @@ def _make_handler(server: APIServer):
                         if errors[0] is not None:
                             return self._error(409, "Conflict", errors[0])
                         return self._send(201, {"status": "bound"})
+                    if parts[4] == "log" and kind == "Pod" and method == "GET":
+                        return self._proxy_pod_log(ns, name, q)
                     if parts[4] == "eviction" and kind == "Pod" and method == "POST":
                         from ..client.clientset import Clientset, EvictionDisallowed
 

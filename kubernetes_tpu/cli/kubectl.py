@@ -392,6 +392,64 @@ class Kubectl:
         self.out.write(f"deployment/{name} rolled back\n")
         return 0
 
+    def logs(self, name: str, namespace: Optional[str] = None,
+             container: str = "", tail: int = 0) -> int:
+        """``kubectl logs`` via the pod/log subresource (apiserver proxies
+        to the owning node's kubelet read API)."""
+        ns = namespace or "default"
+        base = getattr(self.cs.store, "base_url", None)
+        if base is None:
+            # in-proc clientset: reach the kubelet URL directly
+            import urllib.request
+
+            try:
+                pod = self.cs.pods.get(name, ns)
+            except NotFoundError:
+                self.out.write(f'Error: pod "{name}" not found\n')
+                return 1
+            if not pod.spec.node_name:
+                self.out.write("error: pod is not scheduled yet\n")
+                return 1
+            try:
+                node = self.cs.nodes.get(pod.spec.node_name)
+            except NotFoundError:
+                self.out.write(f'error: node "{pod.spec.node_name}" not found\n')
+                return 1
+            if not node.status.kubelet_url:
+                self.out.write("error: node exposes no kubelet endpoint\n")
+                return 1
+            c = container or (pod.spec.containers[0].name if pod.spec.containers else "")
+            url = f"{node.status.kubelet_url}/containerLogs/{ns}/{name}/{c}"
+            if tail:
+                url += f"?tailLines={tail}"
+        else:
+            url = f"{base}/api/v1/namespaces/{ns}/pods/{name}/log"
+            sep = "?"
+            if container:
+                url += f"{sep}container={container}"
+                sep = "&"
+            if tail:
+                url += f"{sep}tailLines={tail}"
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(url)
+        token = getattr(self.cs.store, "token", None)
+        if base is not None and token:
+            # the other verbs authenticate via RemoteStore; this direct
+            # fetch must carry the same credential
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                self.out.write(r.read().decode())
+            return 0
+        except urllib.error.HTTPError as e:
+            self.out.write(f"error: {e.read().decode()}\n")
+            return 1
+        except Exception as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+
     # -- scale / cordon / drain -------------------------------------------
     def scale(self, resource: str, name: str, replicas: int, namespace: Optional[str] = None) -> int:
         resource, kind = _resolve(resource)
@@ -496,6 +554,10 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("name")
     p = sub.add_parser("top", parents=[common])
     p.add_argument("what", choices=["nodes"])
+    p = sub.add_parser("logs", parents=[common])
+    p.add_argument("name")
+    p.add_argument("-c", "--container", default="")
+    p.add_argument("--tail", type=int, default=0)
     p = sub.add_parser("rollout", parents=[common])
     p.add_argument("action", choices=["status", "history", "undo"])
     p.add_argument("resource")  # "deployment" or "deployment/NAME"
@@ -529,6 +591,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
         return k.drain(args.name)
     if args.verb == "top":
         return k.top_nodes()
+    if args.verb == "logs":
+        return k.logs(args.name, namespace, args.container, args.tail)
     if args.verb == "rollout":
         res = args.resource
         name = args.name

@@ -28,13 +28,16 @@ def main(argv=None) -> int:
     ap.add_argument("--tick", type=float, default=1.0)
     ap.add_argument("--cpu", default="8")
     ap.add_argument("--memory", default="16Gi")
+    ap.add_argument("--serve-logs", action="store_true",
+                    help="expose the kubelet read API (logs/pods/healthz)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     cs = remote_clientset(args.apiserver, args.token)
     if args.count > 1:
-        fleet = HollowFleet(cs, args.count, cpu=args.cpu, memory=args.memory)
+        fleet = HollowFleet(cs, args.count, cpu=args.cpu, memory=args.memory,
+                            serve=args.serve_logs)
         # kubemark names nodes per host; keep the given prefix
         for i, k in enumerate(fleet.kubelets):
             k.node_name = f"{args.name}-{i:05d}"
@@ -42,7 +45,8 @@ def main(argv=None) -> int:
         kubelets = fleet.kubelets
         tick = fleet.tick_all
     else:
-        k = HollowKubelet(cs, args.name, cpu=args.cpu, memory=args.memory)
+        k = HollowKubelet(cs, args.name, cpu=args.cpu, memory=args.memory,
+                          serve=args.serve_logs)
         k.register()
         kubelets = [k]
         tick = k.tick

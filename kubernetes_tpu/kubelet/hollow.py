@@ -42,6 +42,7 @@ class HollowKubelet:
         clock: Callable[[], float] = time.monotonic,
         runtime: "FakeRuntime" = None,
         memory_pressure_fraction: float = 0.95,
+        serve: bool = False,
     ):
         from .runtime import FakeRuntime, PodRuntimeManager
 
@@ -63,11 +64,19 @@ class HollowKubelet:
         self.pod_manager = PodRuntimeManager(self.runtime, clock)
         self.memory_pressure_fraction = memory_pressure_fraction
         self._memory_capacity = api.Quantity(memory).value()
+        # the node's read API (pkg/kubelet/server): logs/pods/healthz
+        self.server = None
+        if serve:
+            from .server import KubeletServer
+
+            self.server = KubeletServer(self)
+            self.server.start()
 
     # -- registration (kubelet_node_status.go registerWithApiserver) -------
     def register(self) -> None:
         labels = dict(self.labels)
         labels.setdefault(api.HOSTNAME_LABEL, self.node_name)
+        kubelet_url = self.server.url if self.server is not None else ""
         node = api.Node(
             meta=ObjectMeta(name=self.node_name, namespace="", labels=labels),
             status=api.NodeStatus(
@@ -86,6 +95,7 @@ class HollowKubelet:
                         type=api.NODE_READY, status="True", heartbeat_time=self._clock()
                     )
                 ],
+                kubelet_url=kubelet_url,
             ),
         )
         try:
@@ -257,6 +267,10 @@ class HollowKubelet:
             c.status = "True"
             c.heartbeat_time = now
             c.heartbeat_revision = cur.meta.resource_version
+            # a restarted kubelet binds a fresh port: the endpoint must
+            # follow the heartbeat, not only initial registration
+            if self.server is not None:
+                cur.status.kubelet_url = self.server.url
             return cur
 
         try:

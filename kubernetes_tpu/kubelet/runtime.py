@@ -88,6 +88,19 @@ class FakeRuntime:
         self.exits: dict[tuple[str, str], int] = {}  # -> exit code
         # per-pod observed usage signals (the cadvisor stand-in)
         self.pod_memory_usage: dict[str, int] = {}  # bytes
+        # (pod_key, container) -> log lines (the container stdout stand-in)
+        self._logs: dict[tuple[str, str], list[str]] = {}
+
+    def append_log(self, pod_key: str, container: str, line: str) -> None:
+        self._logs.setdefault((pod_key, container), []).append(line)
+
+    def read_logs(self, pod_key: str, container: str):
+        """Lines, or None if the container never existed here."""
+        return self._logs.get((pod_key, container))
+
+    def drop_logs(self, pod_key: str) -> None:
+        for k in [k for k in self._logs if k[0] == pod_key]:
+            del self._logs[k]
 
     def probe(self, pod_key: str, container: str, kind: str) -> bool:
         return self.probe_results.get((pod_key, container, kind), True)
@@ -122,9 +135,14 @@ class PodRuntimeManager:
             )
             for c in pod.spec.containers
         }
+        for c in pod.spec.containers:
+            self.runtime.append_log(key, c.name, f"container {c.name} started")
 
     def forget(self, pod_key: str) -> None:
         self._pods.pop(pod_key, None)
+        # a recreated pod under the same key must not inherit old logs,
+        # and a churning fleet must not grow buffers without bound
+        self.runtime.drop_logs(pod_key)
 
     def known(self) -> set[str]:
         return set(self._pods)
@@ -154,7 +172,7 @@ class PodRuntimeManager:
                     pod.spec.restart_policy == "OnFailure" and exit_code != 0
                 )
                 if restart:
-                    self._restart(st, now, reason="Error" if exit_code else "Completed")
+                    self._restart(st, now, reason="Error" if exit_code else "Completed", pod_key=key, cname=c.name)
                 else:
                     st.status.state = "terminated"
                     st.status.ready = False
@@ -168,7 +186,7 @@ class PodRuntimeManager:
             if c.liveness_probe is not None:
                 res = self._run_probe(st, st.liveness, c.liveness_probe, key, c.name, "liveness", now)
                 if res is False and st.liveness.consecutive_failures >= c.liveness_probe.failure_threshold:
-                    self._restart(st, now, reason="Unhealthy")
+                    self._restart(st, now, reason="Unhealthy", pod_key=key, cname=c.name)
             # readiness: drives the ready bit through both thresholds
             if c.readiness_probe is not None:
                 self._run_probe(st, st.readiness, c.readiness_probe, key, c.name, "readiness", now)
@@ -202,7 +220,8 @@ class PodRuntimeManager:
                 pst.result = False
         return ok
 
-    def _restart(self, st: _ContainerState, now: float, reason: str) -> None:
+    def _restart(self, st: _ContainerState, now: float, reason: str,
+                 pod_key: str, cname: str) -> None:
         st.status.restart_count += 1
         st.status.state = "running"
         st.status.ready = True
@@ -210,6 +229,10 @@ class PodRuntimeManager:
         st.started_at = now
         st.liveness = _ProbeState()
         st.readiness = _ProbeState()
+        self.runtime.append_log(
+            pod_key, cname,
+            f"container {cname} restarted ({reason}), restart #{st.status.restart_count}",
+        )
 
 
 def rank_for_eviction(pods: list[api.Pod], usage: dict[str, int]) -> list[api.Pod]:

@@ -1,0 +1,84 @@
+"""Kubelet read API: the node's HTTP server (logs, healthz, pods).
+
+Capability of ``pkg/kubelet/server`` (3,911 LoC) at this framework's
+depth: the :10250 read surface the apiserver proxies pod subresources
+to —
+
+  GET /healthz
+  GET /pods                                   (the node's pod list)
+  GET /containerLogs/{ns}/{pod}/{container}[?tailLines=N]
+
+Log content comes from the fake runtime's per-container buffers, which
+the hollow kubelet writes lifecycle lines into (started/restarted/
+probe failures) and tests/workloads can append to."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class KubeletServer:
+    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0):
+        self.kubelet = kubelet
+        handler = _make_handler(kubelet)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.httpd.server_address[0]}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def _make_handler(kubelet):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def _send(self, code: int, data: bytes, ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            if url.path == "/healthz":
+                return self._send(200, b"ok", "text/plain")
+            if url.path == "/pods":
+                pods = [p.to_dict() for p in kubelet._my_pods()]
+                return self._send(200, json.dumps({"items": pods}).encode())
+            if len(parts) == 4 and parts[0] == "containerLogs":
+                _, ns, pod, container = parts
+                q = parse_qs(url.query)
+                lines = kubelet.runtime.read_logs(f"{ns}/{pod}", container)
+                if lines is None:
+                    return self._send(404, b"container not found", "text/plain")
+                tail = q.get("tailLines", [None])[0]
+                if tail is not None:
+                    if not tail.isdigit():
+                        return self._send(400, b"tailLines must be an integer",
+                                          "text/plain")
+                    lines = lines[-int(tail):]
+                return self._send(200, ("\n".join(lines) + "\n" if lines else "").encode(),
+                                  "text/plain")
+            return self._send(404, b"not found", "text/plain")
+
+    return Handler

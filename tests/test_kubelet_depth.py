@@ -222,3 +222,89 @@ def test_pod_completing_during_pressure_is_not_marked_evicted(world):
     assert cs.pods.get("done", "default").status.phase == "Succeeded"
     assert cs.pods.get("hog", "default").status.phase == "Running"
     assert cs.pods.get("hog", "default").status.reason == ""
+
+
+def test_pod_logs_through_kubelet_server_and_apiserver():
+    """kubectl logs path: hollow kubelet serves container logs over HTTP;
+    the apiserver's pod/log subresource proxies to it."""
+    import io
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cli.kubectl import main as kubectl
+    from kubernetes_tpu.client.remote import RemoteStore
+
+    store = Store()
+    cs = Clientset(store)
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock, serve=True)
+    k.register()
+    try:
+        assert cs.nodes.get("n1").status.kubelet_url  # registered endpoint
+        start(cs, k, probe_pod("p"))
+        k.runtime.append_log("default/p", "c", "hello from the app")
+
+        # direct kubelet read API
+        with urllib.request.urlopen(
+            f"{k.server.url}/containerLogs/default/p/c"
+        ) as r:
+            body = r.read().decode()
+        assert "container c started" in body and "hello from the app" in body
+
+        # through the apiserver subresource + kubectl logs
+        srv = APIServer(store)
+        srv.start()
+        try:
+            remote = Clientset(RemoteStore(srv.url))
+            buf = io.StringIO()
+            rc = kubectl(["logs", "p"], clientset=remote, out=buf)
+            assert rc == 0, buf.getvalue()
+            assert "hello from the app" in buf.getvalue()
+            # tail
+            buf = io.StringIO()
+            rc = kubectl(["logs", "p", "--tail", "1"], clientset=remote, out=buf)
+            assert rc == 0
+            assert buf.getvalue().strip() == "hello from the app"
+        finally:
+            srv.stop()
+    finally:
+        if k.server:
+            k.server.stop()
+
+
+def test_log_path_traversal_and_stale_buffers_blocked():
+    """container param must resolve against the pod spec (no traversal
+    into other kubelet endpoints); deleted pods drop their buffers."""
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+
+    store = Store()
+    cs = Clientset(store)
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock, serve=True)
+    k.register()
+    srv = APIServer(store)
+    srv.start()
+    try:
+        start(cs, k, probe_pod("p"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{srv.url}/api/v1/namespaces/default/pods/p/log?container=../../pods")
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{srv.url}/api/v1/namespaces/default/pods/p/log?tailLines=abc")
+        assert ei.value.code == 400
+        # delete + recreate: fresh logs, no inherited lines
+        cs.pods.delete("p", "default")
+        k.tick()
+        start(cs, k, probe_pod("p"))
+        with urllib.request.urlopen(
+            f"{srv.url}/api/v1/namespaces/default/pods/p/log") as r:
+            body = r.read().decode()
+        assert body.count("container c started") == 1
+    finally:
+        srv.stop()
+        k.server.stop()
