@@ -483,7 +483,9 @@ def _make_handler(server: APIServer):
                     items, rev = server.store.list(kind, ns)
                     return self._send(200, {"items": items, "resourceVersion": rev})
                 if method == "POST":
-                    body = self._body()
+                    from ..api.scheme import convert_to_internal
+
+                    body = convert_to_internal(self._body())
                     if kind in CLUSTER_SCOPED:
                         body.setdefault("metadata", {})["namespace"] = ""
                     return self._send(201, server.store.create(kind, body))
@@ -517,7 +519,9 @@ def _make_handler(server: APIServer):
                 if method == "GET":
                     return self._send(200, server.store.get(kind, ns, name))
                 if method == "PUT":
-                    obj = self._body()
+                    from ..api.scheme import convert_to_internal
+
+                    obj = convert_to_internal(self._body())
                     cas = q.get("cas", ["true"])[0] == "true"
                     expect = None if cas else 0
                     out = server.store.update(kind, obj, expect_rev=expect or None)

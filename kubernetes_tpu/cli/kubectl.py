@@ -227,8 +227,13 @@ class Kubectl:
 
     # -- create / apply / delete ------------------------------------------
     def _load_manifests(self, path: str) -> list[dict]:
+        from ..api.scheme import convert_to_internal
+
         text = sys.stdin.read() if path == "-" else open(path).read()
-        return [d for d in yaml.safe_load_all(text) if d]
+        # versioned wire documents (apps/v1beta1, extensions/v1beta1,
+        # batch/v2alpha1, ...) decode through the scheme — reference-era
+        # YAML applies unchanged
+        return [convert_to_internal(d) for d in yaml.safe_load_all(text) if d]
 
     def create(self, filename: str) -> int:
         rc = 0
