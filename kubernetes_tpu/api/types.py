@@ -670,6 +670,10 @@ class NodeStatus:
     # + addresses, collapsed to one URL) — the apiserver proxies pod
     # subresources (logs) here
     kubelet_url: str = ""
+    # PVs the kubelet currently has MOUNTED into pods (reference
+    # ``node.status.volumesInUse``): the attach/detach controller must not
+    # detach these until the kubelet unmounts
+    volumes_in_use: list[str] = field(default_factory=list)
 
     def condition(self, ctype: str) -> Optional[NodeCondition]:
         for c in self.conditions:
@@ -685,6 +689,7 @@ class NodeStatus:
             "images": copy.deepcopy(self.images),
             "volumesAttached": list(self.volumes_attached),
             "kubeletURL": self.kubelet_url,
+            "volumesInUse": list(self.volumes_in_use),
         }
 
     @classmethod
@@ -697,6 +702,7 @@ class NodeStatus:
             images=copy.deepcopy(d.get("images") or []),
             volumes_attached=list(d.get("volumesAttached") or []),
             kubelet_url=d.get("kubeletURL", ""),
+            volumes_in_use=list(d.get("volumesInUse") or []),
         )
 
 

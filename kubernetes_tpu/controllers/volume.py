@@ -268,7 +268,14 @@ class AttachDetachController(Controller):
             node = self.clientset.nodes.get(key)
         except NotFoundError:
             return
-        want = self._desired_for(key)
+        desired = self._desired_for(key)
+        # unmount-before-detach (the reference reconciler consults
+        # node.status.volumesInUse): a volume the kubelet still has
+        # mounted stays attached even when no pod wants it anymore
+        in_use = set(node.status.volumes_in_use)
+        keep = [v for v in node.status.volumes_attached
+                if v in in_use and v not in desired]
+        want = sorted(set(desired) | set(keep))
         if sorted(node.status.volumes_attached) == want:
             return
 

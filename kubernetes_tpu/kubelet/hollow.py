@@ -43,6 +43,7 @@ class HollowKubelet:
         runtime: "FakeRuntime" = None,
         memory_pressure_fraction: float = 0.95,
         serve: bool = False,
+        mount_latency: float = 0.0,
     ):
         from .runtime import FakeRuntime, PodRuntimeManager
 
@@ -62,6 +63,10 @@ class HollowKubelet:
         # eviction manager over a scriptable fake runtime)
         self.runtime = runtime or FakeRuntime()
         self.pod_manager = PodRuntimeManager(self.runtime, clock)
+        from .volumemanager import VolumeManager
+
+        self.volume_manager = VolumeManager(clock, mount_latency=mount_latency)
+        self._last_in_use: list[str] = []
         self.memory_pressure_fraction = memory_pressure_fraction
         self._memory_capacity = api.Quantity(memory).value()
         # the node's read API (pkg/kubelet/server): logs/pods/healthz
@@ -121,6 +126,15 @@ class HollowKubelet:
 
         mine = self._my_pods()
         live = {p.meta.key for p in mine}
+        # volume manager pass (reconciler.go:165): pods with PVC-backed
+        # volumes may only start once attach + mount complete
+        pvc_to_pv = self._pvc_to_pv(mine)
+        if pvc_to_pv is not None or self.volume_manager.has_state():
+            # the second arm: departed pods must still UNMOUNT (and clear
+            # volumesInUse) even when no remaining pod needs volumes
+            attached = self._attached_volumes()
+            self.volume_manager.sync(mine, attached, pvc_to_pv or {})
+            self._report_volumes_in_use()
         running: list[api.Pod] = []
         for pod in mine:
             if pod.status.phase == api.RUNNING:
@@ -133,6 +147,10 @@ class HollowKubelet:
                 self._starting[key] = now
                 out["observed"] += 1
             elif now - self._starting[key] >= self.pod_start_latency:
+                if pvc_to_pv is not None and not self.volume_manager.pod_volumes_ready(
+                    pod, pvc_to_pv
+                ):
+                    continue  # WaitForAttachAndMount: stay Pending
                 if self._set_running(pod, now):
                     out["started"] += 1
                 del self._starting[key]
@@ -218,6 +236,39 @@ class HollowKubelet:
             evicted += 1
         return evicted
 
+    def _pvc_to_pv(self, mine: list[api.Pod]):
+        """ns/claim -> bound PV name, or None when no pod needs volumes
+        (skips the PVC list entirely — the common case)."""
+        if not any(v.pvc_name for p in mine for v in p.spec.volumes):
+            return None
+        out = {}
+        for pvc in self.clientset.persistentvolumeclaims.list(None)[0]:
+            if pvc.volume_name:
+                out[pvc.meta.key] = pvc.volume_name
+        return out
+
+    def _attached_volumes(self) -> set:
+        try:
+            node = self.clientset.nodes.get(self.node_name)
+        except NotFoundError:
+            return set()
+        return set(node.status.volumes_attached)
+
+    def _report_volumes_in_use(self) -> None:
+        in_use = self.volume_manager.volumes_in_use()
+        if in_use == self._last_in_use:
+            return
+
+        def _mutate(cur: api.Node) -> api.Node:
+            cur.status.volumes_in_use = list(in_use)
+            return cur
+
+        try:
+            self.clientset.nodes.guaranteed_update(self.node_name, _mutate, "")
+            self._last_in_use = in_use
+        except NotFoundError:
+            pass
+
     def _set_pressure_condition(self, pressure: bool) -> None:
         # this kubelet exclusively owns its node's pressure condition, so
         # the last pushed value is authoritative — no read needed
@@ -271,6 +322,9 @@ class HollowKubelet:
             # follow the heartbeat, not only initial registration
             if self.server is not None:
                 cur.status.kubelet_url = self.server.url
+            # and volumesInUse is always THIS process's truth — a restart
+            # clears stale mounts so the AD controller can detach
+            cur.status.volumes_in_use = self.volume_manager.volumes_in_use()
             return cur
 
         try:

@@ -211,3 +211,109 @@ def test_attach_follows_late_claim_binding(cs):
     drive(PersistentVolumeController(cs))
     drive(ad)  # PVC bind event requeues n1
     assert cs.nodes.get("n1").status.volumes_attached == ["pv1"]
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_pod_waits_for_attach_and_mount():
+    """WaitForAttachAndMount: a PVC-backed pod stays Pending until the
+    attach/detach controller attaches AND the kubelet mounts."""
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock)
+    k.register()
+    cs.persistentvolumes.create(make_pv("pv1", "10Gi"))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    pvctl = PersistentVolumeController(cs)
+    drive(pvctl)
+    cs.pods.create(make_pod("user", cpu="100m", node_name="n1",
+                            volumes=[Volume(name="v", pvc_name="claim")]))
+    for _ in range(4):
+        clock.now += 1.0
+        k.tick()
+    # not attached yet -> must still be Pending despite zero start latency
+    assert cs.pods.get("user", "default").status.phase == "Pending"
+
+    ad = AttachDetachController(cs)
+    drive(ad)
+    assert cs.nodes.get("n1").status.volumes_attached == ["pv1"]
+    for _ in range(3):
+        clock.now += 1.0
+        k.tick()
+    assert cs.pods.get("user", "default").status.phase == "Running"
+    assert cs.nodes.get("n1").status.volumes_in_use == ["pv1"]
+
+
+def test_detach_waits_for_unmount():
+    """The unmount-before-detach protocol: a deleted pod's volume stays
+    attached while the kubelet still reports it in volumesInUse."""
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock)
+    k.register()
+    cs.persistentvolumes.create(make_pv("pv1", "10Gi"))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    drive(PersistentVolumeController(cs))
+    cs.pods.create(make_pod("user", cpu="100m", node_name="n1",
+                            volumes=[Volume(name="v", pvc_name="claim")]))
+    ad = AttachDetachController(cs)
+    drive(ad)
+    for _ in range(3):
+        clock.now += 1.0
+        k.tick()
+    assert cs.pods.get("user", "default").status.phase == "Running"
+
+    cs.pods.delete("user", "default")
+    # AD reconciles BEFORE the kubelet unmounts: volume must stay attached
+    drive(ad)
+    assert cs.nodes.get("n1").status.volumes_attached == ["pv1"]
+    # kubelet observes the pod gone -> unmounts -> clears volumesInUse
+    clock.now += 1.0
+    k.tick()
+    assert cs.nodes.get("n1").status.volumes_in_use == []
+    drive(ad)  # now the detach proceeds
+    assert cs.nodes.get("n1").status.volumes_attached == []
+
+
+def test_terminal_pod_volumes_unmount_and_detach():
+    """A completed Job pod's volume must unmount (and then detach) even
+    while the terminal pod object still exists."""
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock)
+    k.register()
+    cs.persistentvolumes.create(make_pv("pv1", "10Gi"))
+    cs.persistentvolumeclaims.create(make_pvc("claim", "5Gi"))
+    drive(PersistentVolumeController(cs))
+    pod = make_pod("job-pod", cpu="100m", node_name="n1",
+                   volumes=[Volume(name="v", pvc_name="claim")])
+    pod.spec.restart_policy = "Never"
+    cs.pods.create(pod)
+    ad = AttachDetachController(cs)
+    drive(ad)
+    for _ in range(3):
+        clock.now += 1.0
+        k.tick()
+    assert cs.pods.get("job-pod", "default").status.phase == "Running"
+    # container exits cleanly -> pod Succeeded (object remains)
+    k.runtime.inject_exit("default/job-pod", "c0", 0)
+    clock.now += 1.0
+    k.tick()
+    clock.now += 1.0
+    k.tick()
+    assert cs.pods.get("job-pod", "default").status.phase == "Succeeded"
+    assert cs.nodes.get("n1").status.volumes_in_use == []
+    drive(ad)
+    assert cs.nodes.get("n1").status.volumes_attached == []
