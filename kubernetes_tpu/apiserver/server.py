@@ -183,6 +183,10 @@ def _make_handler(server: APIServer):
                     name = rest[3]
                     if len(rest) == 5 and rest[4] == "binding":
                         verb = "bind"
+                    elif len(rest) == 5 and rest[4] == "exec":
+                        # its own verb: create-pods rights must not imply
+                        # command execution (pods/exec subresource)
+                        verb = "exec"
                     elif len(rest) == 5 and rest[4] == "eviction":
                         # distinct verb so create-pods rights do not imply
                         # eviction (reference treats pods/eviction as its
@@ -277,34 +281,49 @@ def _make_handler(server: APIServer):
         def do_DELETE(self):
             self._route("DELETE")
 
+        def _resolve_pod_kubelet(self, ns: str, name: str, q):
+            """Shared pod-subresource resolution: pod -> node -> kubelet
+            endpoint + validated container.  Returns (kubelet_url,
+            container, node_name) or None after writing the error."""
+            try:
+                pod = server.store.get("Pod", ns, name)
+            except NotFoundError:
+                self._error(404, "NotFound", f"pod {ns}/{name}")
+                return None
+            node_name = (pod.get("spec") or {}).get("nodeName", "")
+            if not node_name:
+                self._error(400, "BadRequest", "pod is not scheduled yet")
+                return None
+            try:
+                node = server.store.get("Node", "", node_name)
+            except NotFoundError:
+                self._error(502, "BadGateway", f"node {node_name} not found")
+                return None
+            kubelet_url = (node.get("status") or {}).get("kubeletURL", "")
+            if not kubelet_url:
+                self._error(502, "BadGateway",
+                            f"node {node_name} exposes no kubelet endpoint")
+                return None
+            containers = (pod.get("spec") or {}).get("containers") or []
+            known = [c.get("name", "") for c in containers]
+            container = q.get("container", [None])[0] or (known[0] if known else "")
+            if container not in known:
+                # also blocks path traversal into other kubelet endpoints
+                self._error(400, "BadRequest",
+                            f"container {container!r} not in pod {ns}/{name}")
+                return None
+            return kubelet_url, container, node_name
+
         def _proxy_pod_log(self, ns: str, name: str, q) -> None:
             """pod/log subresource: resolve the pod's node, proxy to that
             node's kubelet read API (reference ``registry/core/pod/rest``
             LogREST -> kubelet :10250 /containerLogs)."""
             import urllib.request as _rq
 
-            try:
-                pod = server.store.get("Pod", ns, name)
-            except NotFoundError:
-                return self._error(404, "NotFound", f"pod {ns}/{name}")
-            node_name = (pod.get("spec") or {}).get("nodeName", "")
-            if not node_name:
-                return self._error(400, "BadRequest", "pod is not scheduled yet")
-            try:
-                node = server.store.get("Node", "", node_name)
-            except NotFoundError:
-                return self._error(502, "BadGateway", f"node {node_name} not found")
-            kubelet_url = (node.get("status") or {}).get("kubeletURL", "")
-            if not kubelet_url:
-                return self._error(502, "BadGateway",
-                                   f"node {node_name} exposes no kubelet endpoint")
-            containers = (pod.get("spec") or {}).get("containers") or []
-            known = [c.get("name", "") for c in containers]
-            container = q.get("container", [None])[0] or (known[0] if known else "")
-            if container not in known:
-                # also blocks path traversal into other kubelet endpoints
-                return self._error(400, "BadRequest",
-                                   f"container {container!r} not in pod {ns}/{name}")
+            resolved = self._resolve_pod_kubelet(ns, name, q)
+            if resolved is None:
+                return
+            kubelet_url, container, _ = resolved
             target = f"{kubelet_url}/containerLogs/{ns}/{name}/{container}"
             if "tailLines" in q:
                 tail = q["tailLines"][0]
@@ -319,6 +338,44 @@ def _make_handler(server: APIServer):
             self._last_code = 200
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _proxy_pod_exec(self, ns: str, name: str, q) -> None:
+            """pods/exec subresource: resolve node, forward the command to
+            the kubelet's exec endpoint (the SPDY exec path's capability
+            over JSON), authenticated with the cluster-key exec token."""
+            import urllib.error
+            import urllib.request as _rq
+
+            from ..auth.authn import kubelet_exec_token
+
+            resolved = self._resolve_pod_kubelet(ns, name, q)
+            if resolved is None:
+                return
+            kubelet_url, container, node_name = resolved
+            command = self._body().get("command")
+            if not isinstance(command, list) or not command:
+                return self._error(400, "BadRequest", "command (list) required")
+            body = json.dumps({"command": command}).encode()
+            req = _rq.Request(
+                f"{kubelet_url}/exec/{ns}/{name}/{container}", data=body,
+                headers={"Content-Type": "application/json",
+                         "Authorization": f"Bearer {kubelet_exec_token(node_name)}"},
+                method="POST",
+            )
+            try:
+                with _rq.urlopen(req, timeout=30) as resp:
+                    data = resp.read()
+            except urllib.error.HTTPError as e:
+                # the kubelet's own verdict passes through (e.g. 400/404)
+                return self._error(e.code, "KubeletError", e.read().decode()[:200])
+            except Exception as e:
+                return self._error(502, "BadGateway", f"kubelet exec failed: {e}")
+            self._last_code = 200
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -507,6 +564,8 @@ def _make_handler(server: APIServer):
                         return self._send(201, {"status": "bound"})
                     if parts[4] == "log" and kind == "Pod" and method == "GET":
                         return self._proxy_pod_log(ns, name, q)
+                    if parts[4] == "exec" and kind == "Pod" and method == "POST":
+                        return self._proxy_pod_exec(ns, name, q)
                     if parts[4] == "eviction" and kind == "Pod" and method == "POST":
                         from ..client.clientset import Clientset, EvictionDisallowed
 

@@ -169,6 +169,15 @@ def _unb64(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
+def kubelet_exec_token(node_name: str, key: bytes = b"cluster-signing-key") -> str:
+    """The control plane's credential for a node's exec endpoint: HMAC of
+    the node name under the cluster signing key.  Only components holding
+    the key (apiserver, kubectl pointed at the in-proc store) can mint it
+    — reading node.status alone is not enough to run commands (the
+    reference's kubelet delegated-authz contract, minimally)."""
+    return hmac.new(key, f"kubelet-exec:{node_name}".encode(), hashlib.sha256).hexdigest()
+
+
 class ServiceAccountTokenMinter:
     """Mints and verifies service-account bearer tokens (reference
     ``pkg/serviceaccount`` TokenGenerator; the controller writes them into

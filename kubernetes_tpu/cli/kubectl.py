@@ -397,6 +397,28 @@ class Kubectl:
         self.out.write(f"deployment/{name} rolled back\n")
         return 0
 
+    def _kubelet_target(self, name: str, ns: str, container: str):
+        """In-proc path resolution: pod -> node -> kubelet URL + container.
+        Returns (url_base, container) or None after printing the error."""
+        try:
+            pod = self.cs.pods.get(name, ns)
+        except NotFoundError:
+            self.out.write(f'Error: pod "{name}" not found\n')
+            return None
+        if not pod.spec.node_name:
+            self.out.write("error: pod is not scheduled yet\n")
+            return None
+        try:
+            node = self.cs.nodes.get(pod.spec.node_name)
+        except NotFoundError:
+            self.out.write(f'error: node "{pod.spec.node_name}" not found\n')
+            return None
+        if not node.status.kubelet_url:
+            self.out.write("error: node exposes no kubelet endpoint\n")
+            return None
+        c = container or (pod.spec.containers[0].name if pod.spec.containers else "")
+        return node.status.kubelet_url, c, pod.spec.node_name
+
     def logs(self, name: str, namespace: Optional[str] = None,
              container: str = "", tail: int = 0) -> int:
         """``kubectl logs`` via the pod/log subresource (apiserver proxies
@@ -405,26 +427,11 @@ class Kubectl:
         base = getattr(self.cs.store, "base_url", None)
         if base is None:
             # in-proc clientset: reach the kubelet URL directly
-            import urllib.request
-
-            try:
-                pod = self.cs.pods.get(name, ns)
-            except NotFoundError:
-                self.out.write(f'Error: pod "{name}" not found\n')
+            resolved = self._kubelet_target(name, ns, container)
+            if resolved is None:
                 return 1
-            if not pod.spec.node_name:
-                self.out.write("error: pod is not scheduled yet\n")
-                return 1
-            try:
-                node = self.cs.nodes.get(pod.spec.node_name)
-            except NotFoundError:
-                self.out.write(f'error: node "{pod.spec.node_name}" not found\n')
-                return 1
-            if not node.status.kubelet_url:
-                self.out.write("error: node exposes no kubelet endpoint\n")
-                return 1
-            c = container or (pod.spec.containers[0].name if pod.spec.containers else "")
-            url = f"{node.status.kubelet_url}/containerLogs/{ns}/{name}/{c}"
+            kubelet_url, c, _ = resolved
+            url = f"{kubelet_url}/containerLogs/{ns}/{name}/{c}"
             if tail:
                 url += f"?tailLines={tail}"
         else:
@@ -454,6 +461,52 @@ class Kubectl:
         except Exception as e:
             self.out.write(f"error: {e}\n")
             return 1
+
+    def exec(self, name: str, command: list[str], namespace: Optional[str] = None,
+             container: str = "") -> int:
+        """``kubectl exec POD -- cmd...`` via the pods/exec subresource."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        ns = namespace or "default"
+        base = getattr(self.cs.store, "base_url", None)
+        exec_node = None
+        if base is None:
+            resolved = self._kubelet_target(name, ns, container)
+            if resolved is None:
+                return 1
+            kubelet_url, c, exec_node = resolved
+            url = f"{kubelet_url}/exec/{ns}/{name}/{c}"
+        else:
+            url = f"{base}/api/v1/namespaces/{ns}/pods/{name}/exec"
+            if container:
+                url += f"?container={container}"
+        req = urllib.request.Request(
+            url, data=_json.dumps({"command": command}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        if base is not None:
+            token = getattr(self.cs.store, "token", None)
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+        else:
+            # direct kubelet path: mint the cluster-key exec credential
+            from ..auth.authn import kubelet_exec_token
+
+            req.add_header("Authorization", f"Bearer {kubelet_exec_token(exec_node)}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            self.out.write(f"error: {e.read().decode()}\n")
+            return 1
+        except Exception as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        if out.get("stdout"):
+            self.out.write(out["stdout"] + ("\n" if not out["stdout"].endswith("\n") else ""))
+        return int(out.get("exitCode", 0))
 
     # -- scale / cordon / drain -------------------------------------------
     def scale(self, resource: str, name: str, replicas: int, namespace: Optional[str] = None) -> int:
@@ -563,6 +616,11 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("name")
     p.add_argument("-c", "--container", default="")
     p.add_argument("--tail", type=int, default=0)
+    p = sub.add_parser("exec", parents=[common])
+    p.add_argument("name")
+    p.add_argument("-c", "--container", default="")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="-- cmd args...")
     p = sub.add_parser("rollout", parents=[common])
     p.add_argument("action", choices=["status", "history", "undo"])
     p.add_argument("resource")  # "deployment" or "deployment/NAME"
@@ -598,6 +656,14 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
         return k.top_nodes()
     if args.verb == "logs":
         return k.logs(args.name, namespace, args.container, args.tail)
+    if args.verb == "exec":
+        cmd = list(args.command)
+        if cmd and cmd[0] == "--":
+            cmd = cmd[1:]  # only the FIRST separator belongs to kubectl
+        if not cmd:
+            k.out.write("error: command required after --\n")
+            return 1
+        return k.exec(args.name, cmd, namespace, args.container)
     if args.verb == "rollout":
         res = args.resource
         name = args.name

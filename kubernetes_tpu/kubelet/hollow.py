@@ -73,8 +73,9 @@ class HollowKubelet:
         self.server = None
         if serve:
             from .server import KubeletServer
+            from ..auth.authn import kubelet_exec_token
 
-            self.server = KubeletServer(self)
+            self.server = KubeletServer(self, exec_token=kubelet_exec_token(node_name))
             self.server.start()
 
     # -- registration (kubelet_node_status.go registerWithApiserver) -------

@@ -90,6 +90,8 @@ class FakeRuntime:
         self.pod_memory_usage: dict[str, int] = {}  # bytes
         # (pod_key, container) -> log lines (the container stdout stand-in)
         self._logs: dict[tuple[str, str], list[str]] = {}
+        # (pod_key, container) -> exec handler (the CRI ExecSync stand-in)
+        self._exec_handlers: dict = {}
 
     def append_log(self, pod_key: str, container: str, line: str) -> None:
         self._logs.setdefault((pod_key, container), []).append(line)
@@ -101,6 +103,18 @@ class FakeRuntime:
     def drop_logs(self, pod_key: str) -> None:
         for k in [k for k in self._logs if k[0] == pod_key]:
             del self._logs[k]
+
+    def set_exec_handler(self, pod_key: str, container: str, fn) -> None:
+        """fn(command: list[str]) -> (stdout: str, exit_code: int)."""
+        self._exec_handlers[(pod_key, container)] = fn
+
+    def exec(self, pod_key: str, container: str, command: list[str]):
+        """Run a command "in" the container (the CRI ExecSync stand-in).
+        Default behavior echoes the command; scripted handlers override."""
+        fn = self._exec_handlers.get((pod_key, container))
+        if fn is not None:
+            return fn(command)
+        return (" ".join(command), 0)
 
     def probe(self, pod_key: str, container: str, kind: str) -> bool:
         return self.probe_results.get((pod_key, container, kind), True)

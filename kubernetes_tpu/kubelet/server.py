@@ -7,6 +7,10 @@ to —
   GET /healthz
   GET /pods                                   (the node's pod list)
   GET /containerLogs/{ns}/{pod}/{container}[?tailLines=N]
+  POST /exec/{ns}/{pod}/{container}       {"command": [...]}
+
+Exec is the CRI ExecSync capability: the reference streams over SPDY;
+the command-in/stdout+exit-out contract rides JSON here.
 
 Log content comes from the fake runtime's per-container buffers, which
 the hollow kubelet writes lifecycle lines into (started/restarted/
@@ -22,9 +26,15 @@ from urllib.parse import parse_qs, urlparse
 
 
 class KubeletServer:
-    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0,
+                 exec_token: str = ""):
         self.kubelet = kubelet
-        handler = _make_handler(kubelet)
+        # exec is a WRITE capability: when a token is set, exec requests
+        # must present it (the reference kubelet delegates authn/authz to
+        # the apiserver; the shared-secret bearer is that contract's
+        # minimal form — the read-only endpoints stay open like :10255)
+        self.exec_token = exec_token
+        handler = _make_handler(kubelet, self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
@@ -43,7 +53,7 @@ class KubeletServer:
             self._thread.join(timeout=5)
 
 
-def _make_handler(kubelet):
+def _make_handler(kubelet, server_ref=None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -79,6 +89,35 @@ def _make_handler(kubelet):
                     lines = lines[-int(tail):]
                 return self._send(200, ("\n".join(lines) + "\n" if lines else "").encode(),
                                   "text/plain")
+            return self._send(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            if len(parts) == 4 and parts[0] == "exec":
+                token = server_ref.exec_token
+                if token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth != f"Bearer {token}":
+                        return self._send(401, b"unauthorized", "text/plain")
+                _, ns, pod, container = parts
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length)) if length else {}
+                except ValueError:
+                    return self._send(400, b"bad json", "text/plain")
+                command = body.get("command") or []
+                if not isinstance(command, list) or not command:
+                    return self._send(400, b"command required", "text/plain")
+                key = f"{ns}/{pod}"
+                target = next((p2 for p2 in kubelet._my_pods() if p2.meta.key == key), None)
+                if target is None:
+                    return self._send(404, b"pod not on this node", "text/plain")
+                if container not in [c.name for c in target.spec.containers]:
+                    return self._send(404, b"container not found", "text/plain")
+                stdout, code = kubelet.runtime.exec(key, container, [str(c) for c in command])
+                out = json.dumps({"stdout": stdout, "exitCode": int(code)}).encode()
+                return self._send(200, out)
             return self._send(404, b"not found", "text/plain")
 
     return Handler

@@ -308,3 +308,74 @@ def test_log_path_traversal_and_stale_buffers_blocked():
     finally:
         srv.stop()
         k.server.stop()
+
+
+def test_kubectl_exec_through_apiserver_and_kubelet():
+    """pods/exec: apiserver resolves the node and forwards the command to
+    the kubelet; scripted handlers model in-container processes."""
+    import io
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cli.kubectl import main as kubectl
+    from kubernetes_tpu.client.remote import RemoteStore
+
+    store = Store()
+    cs = Clientset(store)
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock, serve=True)
+    k.register()
+    srv = APIServer(store)
+    srv.start()
+    try:
+        start(cs, k, probe_pod("p"))
+        remote = Clientset(RemoteStore(srv.url))
+        # default handler echoes
+        buf = io.StringIO()
+        rc = kubectl(["exec", "p", "--", "cat", "/etc/hostname"],
+                     clientset=remote, out=buf)
+        assert rc == 0 and buf.getvalue().strip() == "cat /etc/hostname"
+        # scripted handler with nonzero exit
+        k.runtime.set_exec_handler(
+            "default/p", "c",
+            lambda cmd: ("no such file", 2) if cmd[0] == "ls" else ("ok", 0))
+        buf = io.StringIO()
+        rc = kubectl(["exec", "p", "--", "ls", "/nope"], clientset=remote, out=buf)
+        assert rc == 2 and "no such file" in buf.getvalue()
+        # unknown container rejected at the apiserver
+        buf = io.StringIO()
+        rc = kubectl(["exec", "-c", "../../pods", "p", "--", "id"],
+                     clientset=remote, out=buf)
+        assert rc == 1 and "not in pod" in buf.getvalue()
+    finally:
+        srv.stop()
+        k.server.stop()
+
+
+def test_kubelet_exec_endpoint_requires_the_cluster_credential():
+    """Direct exec against the kubelet without the cluster-key token must
+    401 — reading kubeletURL off the node is not enough to run commands."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock, serve=True)
+    k.register()
+    try:
+        start(cs, k, probe_pod("p"))
+        req = urllib.request.Request(
+            f"{k.server.url}/exec/default/p/c",
+            data=_json.dumps({"command": ["id"]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 401
+        # with the minted credential it works
+        from kubernetes_tpu.auth.authn import kubelet_exec_token
+
+        req.add_header("Authorization", f"Bearer {kubelet_exec_token('n1')}")
+        with urllib.request.urlopen(req) as r:
+            assert _json.loads(r.read())["exitCode"] == 0
+    finally:
+        k.server.stop()
