@@ -140,42 +140,31 @@ class ResourceVec:
 
 def pod_request_vec(pod: api.Pod) -> ResourceVec:
     """Raw summed container requests in canonical units (predicate side;
-    reference ``predicates.GetResourceRequest``).  Cached on the pod
-    object: the batch path converts the same Pod instance several times
-    (working-map apply, scheduler-cache assume, metadata) and pod specs
-    are immutable once admitted.  Callers receive a COPY — ResourceVec
-    is mutated in place by NodeInfo aggregation."""
-    cached = getattr(pod, "_req_vec_cache", None)
-    if cached is None:
-        cached = ResourceVec()
-        for c in pod.spec.containers:
-            cached.add(ResourceVec.from_resource_list(c.resources.requests))
-        try:
-            pod._req_vec_cache = cached
-        except AttributeError:
-            pass  # __slots__ or frozen: just skip caching
-    return cached.copy()
+    reference ``predicates.GetResourceRequest``).
+
+    Deliberately NOT cached on the pod object: an A/B at the north preset
+    measured per-pod vector caching at -20% throughput — pinning two
+    extra objects per pod (~1.2M at 150k pods) makes every cyclic-GC pass
+    slower, which outweighs the ~4us/call rebuild it saves.  The slot
+    conversion underneath is already memoized."""
+    v = ResourceVec()
+    for c in pod.spec.containers:
+        v.add(ResourceVec.from_resource_list(c.resources.requests))
+    return v
 
 
 def pod_nonzero_request_vec(pod: api.Pod) -> ResourceVec:
     """Summed container requests with per-container cpu/mem defaults for
-    empty requests (priority side; reference ``priorities/util/non_zero.go``).
-    Cached like :func:`pod_request_vec`; callers receive a copy."""
-    cached = getattr(pod, "_nz_vec_cache", None)
-    if cached is None:
-        cached = ResourceVec()
-        for c in pod.spec.containers:
-            cv = ResourceVec.from_resource_list(c.resources.requests)
-            if cv.units[CPU_MILLI] == 0:
-                cv.units[CPU_MILLI] = DEFAULT_MILLI_CPU_REQUEST
-            if cv.units[MEM_MIB] == 0:
-                cv.units[MEM_MIB] = DEFAULT_MEM_MIB_REQUEST
-            cached.add(cv)
-        try:
-            pod._nz_vec_cache = cached
-        except AttributeError:
-            pass
-    return cached.copy()
+    empty requests (priority side; reference ``priorities/util/non_zero.go``)."""
+    v = ResourceVec()
+    for c in pod.spec.containers:
+        cv = ResourceVec.from_resource_list(c.resources.requests)
+        if cv.units[CPU_MILLI] == 0:
+            cv.units[CPU_MILLI] = DEFAULT_MILLI_CPU_REQUEST
+        if cv.units[MEM_MIB] == 0:
+            cv.units[MEM_MIB] = DEFAULT_MEM_MIB_REQUEST
+        v.add(cv)
+    return v
 
 
 def node_allocatable_vec(node: api.Node) -> ResourceVec:
