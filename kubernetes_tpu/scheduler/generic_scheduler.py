@@ -76,8 +76,14 @@ class GenericScheduler:
         the node axis is exactly what the TPU shards instead."""
         meta = compute_metadata(pod, ctx)
         if self.predicates == DEFAULT_PREDICATES:
+            from ..models.snapshot import pod_signature_key
+
             # fused inline pass — identical feasibility, first-fail reasons
-            feasible, failures = fast_fit_nodes(pod, meta, node_names, node_info_map, ctx)
+            # the sig key engages the per-NodeInfo equivalence cache
+            feasible, failures = fast_fit_nodes(
+                pod, meta, node_names, node_info_map, ctx,
+                sig_key=pod_signature_key(pod),
+            )
         else:
             feasible = []
             failures = {}

@@ -571,12 +571,38 @@ def pod_fits_on_node(
     return (not reasons), reasons
 
 
+_ECACHE_MISS = object()
+
+
+def _post_cache_stages(pod, meta, info, ctx, has_disk_vols, has_pvc_vols,
+                       has_own_aff) -> Optional[str]:
+    """The cross-node stages (never cached): volumes + inter-pod affinity."""
+    if has_disk_vols:
+        ok, r = no_disk_conflict(pod, meta, info, ctx)
+        if ok:
+            ok, r = max_volume_count(pod, meta, info, ctx)
+        if not ok:
+            return r[0]
+    if has_pvc_vols:
+        ok, r = no_volume_zone_conflict(pod, meta, info, ctx)
+        if ok:
+            ok, r = no_volume_node_conflict(pod, meta, info, ctx)
+        if not ok:
+            return r[0]
+    if has_own_aff or meta.matching_anti_affinity_terms:
+        ok, r = match_inter_pod_affinity(pod, meta, info, ctx)
+        if not ok:
+            return r[0]
+    return None
+
+
 def fast_fit_nodes(
     pod: api.Pod,
     meta: PredicateMetadata,
     node_names: list,
     node_info_map: dict,
     ctx: PredicateContext,
+    sig_key: Optional[str] = None,
 ) -> tuple[list[str], dict[str, list[str]]]:
     """The DEFAULT predicate set fused into one inline pass per node.
 
@@ -592,7 +618,22 @@ def fast_fit_nodes(
     Pod-invariant work is hoisted: toleration checks memoize on the
     node's taint tuple, stage flags are plain attribute reads, and the
     volume/port/selector stages are skipped entirely for pods that carry
-    none (the common case)."""
+    none (the common case).
+
+    With ``sig_key``, the equivalence-cache analogue engages (reference
+    ``core/equivalence_cache.go:55``): each NodeInfo carries its OWN
+    ``(generation, {signature: verdict})`` memo of the NODE-LOCAL
+    predicate prefix — conditions, taints, resources, host/ports/
+    selector — whose inputs are fully covered by the signature and the
+    node's generation counter (add/remove_pod and set_node bump it; the
+    dict is replaced whenever the generation moves, the reference's
+    per-node invalidation).  Living ON the NodeInfo makes the cache
+    lineage-correct by construction: the backend's speculative clones
+    and a deleted-then-recreated node are different objects with
+    different caches.  The cross-node stages (volumes, inter-pod
+    affinity) are re-evaluated every time, exactly the split the
+    reference enforces by invalidating those predicates on any cluster
+    pod event."""
     feasible: list[str] = []
     failures: dict[str, list[str]] = {}
 
@@ -615,11 +656,36 @@ def fast_fit_nodes(
         or meta.own_affinity_values is not None
         or meta.own_anti_affinity_values is not None
     )
+    # the cross-node tail is skipped wholesale for plain pods — one spare
+    # function call per node per pod is measurable at cluster scale
+    needs_tail = (
+        has_disk_vols or has_pvc_vols or has_own_aff
+        or bool(meta.matching_anti_affinity_terms)
+    )
 
     for name in node_names:
         info = node_info_map[name]
         node = info.node
-        why: Optional[str] = None
+        node_cache = None
+        if sig_key is not None:
+            node_cache = getattr(info, "_pred_cache", None)
+            if node_cache is None or node_cache[0] != info.generation:
+                node_cache = (info.generation, {})
+                info._pred_cache = node_cache
+            hit = node_cache[1].get(sig_key, _ECACHE_MISS)
+            if hit is not _ECACHE_MISS:
+                why = hit
+                if why is None and needs_tail:
+                    why = _post_cache_stages(
+                        pod, meta, info, ctx, has_disk_vols, has_pvc_vols,
+                        has_own_aff,
+                    )
+                if why is None:
+                    feasible.append(name)
+                else:
+                    failures[name] = [why]
+                continue
+        why = None
         if node is None:
             why = NODE_NOT_READY
         elif node.spec.unschedulable:
@@ -677,22 +743,14 @@ def fast_fit_nodes(
                 why = SELECTOR_MISMATCH
             elif node_aff is not None and not node_aff.matches(labels):
                 why = SELECTOR_MISMATCH
-        if why is None and has_disk_vols:
-            ok, r = no_disk_conflict(pod, meta, info, ctx)
-            if ok:
-                ok, r = max_volume_count(pod, meta, info, ctx)
-            if not ok:
-                why = r[0]
-        if why is None and has_pvc_vols:
-            ok, r = no_volume_zone_conflict(pod, meta, info, ctx)
-            if ok:
-                ok, r = no_volume_node_conflict(pod, meta, info, ctx)
-            if not ok:
-                why = r[0]
-        if why is None and (has_own_aff or meta.matching_anti_affinity_terms):
-            ok, r = match_inter_pod_affinity(pod, meta, info, ctx)
-            if not ok:
-                why = r[0]
+        if node_cache is not None:
+            # memoize the node-local prefix verdict (why or clean)
+            node_cache[1][sig_key] = why
+        if why is None and needs_tail:
+            # ONE implementation of the cross-node tail for hit and miss
+            why = _post_cache_stages(
+                pod, meta, info, ctx, has_disk_vols, has_pvc_vols, has_own_aff
+            )
         if why is None:
             feasible.append(name)
         else:

@@ -415,3 +415,58 @@ def test_fast_fit_nodes_matches_per_predicate_loop():
             if pod_fits_on_node(pod, meta, node_info_map[n], ctx, DEFAULT_PREDICATES)[0]
         ]
         assert fast_feasible == slow_feasible, f"trial {t}"
+
+
+def test_equivalence_cache_verdicts_match_cold_run():
+    """Warm (cached) evaluation must equal cold evaluation, survive node
+    mutation (generation bump), and stay lineage-correct across clones."""
+    import random
+
+    from kubernetes_tpu.api import Taint
+    from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+    from kubernetes_tpu.scheduler.predicates import (
+        PredicateContext, compute_metadata, fast_fit_nodes)
+    from kubernetes_tpu.models.snapshot import pod_signature_key
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    rng = random.Random(5)
+    node_info_map = {}
+    for i in range(20):
+        node = make_node(f"n{i:02d}", cpu="2",
+                         taints=[Taint(key="d", value="x", effect="NoSchedule")] if i % 4 == 0 else [])
+        node_info_map[node.meta.name] = NodeInfo(node)
+    names = sorted(node_info_map)
+
+    def run(pod, use_sig):
+        ctx = PredicateContext(node_info_map)
+        meta = compute_metadata(pod, ctx)
+        return fast_fit_nodes(pod, meta, names, node_info_map, ctx,
+                              sig_key=pod_signature_key(pod) if use_sig else None)
+
+    for t in range(30):
+        pod = make_pod(f"p{t}", cpu=rng.choice(["100m", "1", "3"]))
+        cold = run(pod, use_sig=False)
+        warm1 = run(pod, use_sig=True)   # populates
+        warm2 = run(pod, use_sig=True)   # hits
+        assert cold == warm1 == warm2, f"trial {t}"
+
+    # generation bump invalidates: fill a node, same-sig pod now fails there
+    pod = make_pod("big", cpu="1500m")
+    assert "n01" in run(pod, use_sig=True)[0]
+    filler = make_pod("filler", cpu="1")
+    node_info_map["n01"].add_pod(filler)  # bumps generation
+    feasible, failures = run(pod, use_sig=True)
+    assert "n01" not in feasible and "Insufficient cpu" in failures["n01"][0]
+
+    # lineage: a clone's speculative add must not poison the original
+    clone = node_info_map["n02"].clone()
+    clone.add_pod(make_pod("spec", cpu="2"))
+    clone_map = dict(node_info_map)
+    clone_map["n02"] = clone
+    ctx = PredicateContext(clone_map)
+    meta = compute_metadata(pod, ctx)
+    f_clone, _ = fast_fit_nodes(pod, meta, names, clone_map, ctx,
+                                sig_key=pod_signature_key(pod))
+    assert "n02" not in f_clone  # clone full
+    f_orig, _ = run(pod, use_sig=True)
+    assert "n02" in f_orig  # original unaffected by the clone's cache
