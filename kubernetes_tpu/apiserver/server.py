@@ -211,6 +211,11 @@ def _make_handler(server: APIServer):
                     self._user.name if self._user else "",
                     verb, resource, ns, name,
                 )
+            if urlparse(self.path).path in ("/api", "/api/v1", "/apis"):
+                # discovery is granted to every AUTHENTICATED identity
+                # (the reference's system:discovery binding) — clients must
+                # enumerate resources before any RBAC rule can name them
+                return True
             if server.authorizer is not None:
                 from ..auth import ALLOW, ANONYMOUS, AuthzAttributes
 
@@ -280,6 +285,35 @@ def _make_handler(server: APIServer):
 
         def do_DELETE(self):
             self._route("DELETE")
+
+        def _serve_discovery(self, path: str) -> None:
+            """Discovery endpoints (reference ``endpoints/discovery``):
+            /api lists versions, /api/v1 the live resource list (built
+            from the one type registry, so CRD kinds appear the moment
+            they establish), /apis the aggregated groups."""
+            from ..api.types import CLUSTER_SCOPED_KINDS, KIND_PLURALS
+
+            if path == "/api":
+                return self._send(200, {"kind": "APIVersions", "versions": ["v1"]})
+            if path == "/api/v1":
+                resources = [
+                    {"name": plural, "kind": kind,
+                     "namespaced": kind not in CLUSTER_SCOPED_KINDS}
+                    for kind, plural in sorted(KIND_PLURALS.items())
+                ]
+                return self._send(200, {"kind": "APIResourceList",
+                                        "groupVersion": "v1",
+                                        "resources": resources})
+            by_group: dict = {}
+            for svc in server.store.list("APIService", "")[0]:
+                spec = svc.get("spec") or {}
+                g = spec.get("group", "")
+                if not g:
+                    continue
+                avail = bool((svc.get("status") or {}).get("available"))
+                by_group[g] = by_group.get(g, False) or avail
+            groups = [{"name": g, "available": a} for g, a in sorted(by_group.items())]
+            return self._send(200, {"kind": "APIGroupList", "groups": groups})
 
         def _resolve_pod_kubelet(self, ns: str, name: str, q):
             """Shared pod-subresource resolution: pod -> node -> kubelet
@@ -511,6 +545,10 @@ def _make_handler(server: APIServer):
                 self.end_headers()
                 self.wfile.write(text)
                 return
+            if url.path in ("/api", "/api/v1", "/apis"):
+                if method != "GET":
+                    return self._error(405, "MethodNotAllowed", method)
+                return self._serve_discovery(url.path)
             if url.path == "/version":
                 from .. import __version__
 

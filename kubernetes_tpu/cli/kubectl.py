@@ -298,6 +298,44 @@ class Kubectl:
         self.out.write(f"{resource}/{name} deleted\n")
         return 0
 
+    def top_pods(self, namespace: Optional[str] = None) -> int:
+        """``kubectl top pods``: per-pod memory from each node's kubelet
+        stats endpoint (the heapster/metricsutil path at this depth)."""
+        import json as _json
+        import urllib.request
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        rows = [("NAME", "NODE", "MEMORY")]
+        ns = namespace or "default"
+        nodes = [n for n in self.cs.nodes.list()[0] if n.status.kubelet_url]
+
+        def fetch(node):
+            try:
+                with urllib.request.urlopen(
+                    f"{node.status.kubelet_url}/stats/summary", timeout=5
+                ) as r:
+                    return node, _json.loads(r.read()), None
+            except Exception as e:  # noqa: BLE001 - reported per node below
+                return node, None, e
+
+        unreachable = []
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            for node, summary, err in pool.map(fetch, nodes):
+                if err is not None:
+                    unreachable.append((node.meta.name, err))
+                    continue
+                for entry in summary.get("pods", []):
+                    ref = entry.get("podRef") or {}
+                    if ref.get("namespace") != ns:
+                        continue
+                    mib = (entry.get("memory") or {}).get("usageBytes", 0) // (1 << 20)
+                    rows.append((ref.get("name", ""), node.meta.name, f"{mib}Mi"))
+        self._print(*rows)
+        for name, err in unreachable:
+            self.out.write(f"warning: could not fetch stats from node {name}: {err}\n")
+        return 0 if len(rows) > 1 or not unreachable else 1
+
     # -- rollout (cmd/rollout, rollback.go) --------------------------------
     def _dep_and_rses(self, name: str, namespace: Optional[str]):
         dep = self.cs.deployments.get(name, namespace)
@@ -611,7 +649,7 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p = sub.add_parser("drain", parents=[common])
     p.add_argument("name")
     p = sub.add_parser("top", parents=[common])
-    p.add_argument("what", choices=["nodes"])
+    p.add_argument("what", choices=["nodes", "pods"])
     p = sub.add_parser("logs", parents=[common])
     p.add_argument("name")
     p.add_argument("-c", "--container", default="")
@@ -653,6 +691,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     if args.verb == "drain":
         return k.drain(args.name)
     if args.verb == "top":
+        if args.what == "pods":
+            return k.top_pods(namespace)
         return k.top_nodes()
     if args.verb == "logs":
         return k.logs(args.name, namespace, args.container, args.tail)

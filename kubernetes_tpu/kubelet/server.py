@@ -6,6 +6,7 @@ to —
 
   GET /healthz
   GET /pods                                   (the node's pod list)
+  GET /stats/summary                          (cadvisor-style usage)
   GET /containerLogs/{ns}/{pod}/{container}[?tailLines=N]
   POST /exec/{ns}/{pod}/{container}       {"command": [...]}
 
@@ -72,6 +73,14 @@ def _make_handler(kubelet, server_ref=None):
             parts = [p for p in url.path.split("/") if p]
             if url.path == "/healthz":
                 return self._send(200, b"ok", "text/plain")
+            if url.path == "/stats/summary":
+                usage = kubelet.runtime.pod_memory_usage
+                pods = [
+                    {"podRef": {"namespace": p.meta.namespace, "name": p.meta.name},
+                     "memory": {"usageBytes": usage.get(p.meta.key, 0)}}
+                    for p in kubelet._my_pods()
+                ]
+                return self._send(200, json.dumps({"pods": pods}).encode())
             if url.path == "/pods":
                 pods = [p.to_dict() for p in kubelet._my_pods()]
                 return self._send(200, json.dumps({"items": pods}).encode())

@@ -379,3 +379,39 @@ def test_kubelet_exec_endpoint_requires_the_cluster_credential():
             assert _json.loads(r.read())["exitCode"] == 0
     finally:
         k.server.stop()
+
+
+def test_discovery_and_top_pods():
+    import io
+    import json as _json
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cli.kubectl import main as kubectl
+
+    store = Store()
+    cs = Clientset(store)
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock, serve=True)
+    k.register()
+    srv = APIServer(store)
+    srv.start()
+    try:
+        # discovery lists core resources and registered groups
+        with urllib.request.urlopen(f"{srv.url}/api/v1") as r:
+            resources = _json.loads(r.read())["resources"]
+        names = {x["name"] for x in resources}
+        assert {"pods", "nodes", "deployments"} <= names
+        pods_entry = next(x for x in resources if x["name"] == "pods")
+        nodes_entry = next(x for x in resources if x["name"] == "nodes")
+        assert pods_entry["namespaced"] and not nodes_entry["namespaced"]
+
+        # top pods via kubelet stats
+        start(cs, k, probe_pod("p"))
+        k.runtime.pod_memory_usage["default/p"] = 64 << 20
+        buf = io.StringIO()
+        rc = kubectl(["top", "pods"], clientset=cs, out=buf)
+        assert rc == 0 and "64Mi" in buf.getvalue(), buf.getvalue()
+    finally:
+        srv.stop()
+        k.server.stop()
