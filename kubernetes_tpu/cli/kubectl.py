@@ -33,6 +33,44 @@ from ..client.remote import RemoteStore
 from ..store.store import AlreadyExistsError, NotFoundError
 
 LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+
+def _parse_selector(spec: str):
+    """kubectl's equality selector forms: "k=v", "k==v", "k!=v", comma
+    separated.  Returns [(key, op, value)] or None on a malformed (or
+    effectively empty) selector — an empty selector must NOT silently
+    mean match-all, because delete -l rides on it."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, v = part.split("!=", 1)
+            op = "!="
+        elif "==" in part:
+            k, v = part.split("==", 1)
+            op = "="
+        elif "=" in part:
+            k, v = part.split("=", 1)
+            op = "="
+        else:
+            return None
+        k, v = k.strip(), v.strip()
+        if not k:
+            return None
+        out.append((k, op, v))
+    return out or None
+
+
+def _labels_match(obj, want: list) -> bool:
+    labels = obj.meta.labels
+    for k, op, v in want:
+        if op == "=" and labels.get(k) != v:
+            return False
+        if op == "!=" and labels.get(k) == v:
+            return False
+    return True
 REVISION_ANNOTATION = api.DEPLOYMENT_REVISION_ANNOTATION
 
 
@@ -122,13 +160,16 @@ class Kubectl:
 
     # -- get ---------------------------------------------------------------
     def get(self, resource: str, name: Optional[str] = None, namespace: Optional[str] = None,
-            output: str = "") -> int:
+            output: str = "", selector: str = "") -> int:
         resource, kind = _resolve(resource)
         if kind is None:
             self.out.write(f"error: unknown resource {resource!r}\n")
             return 1
         client = self.cs.client_for(kind)
         if name:
+            if selector:
+                self.out.write("error: a name cannot be combined with -l\n")
+                return 1
             try:
                 objs = [client.get(name, namespace)]
             except NotFoundError:
@@ -136,6 +177,12 @@ class Kubectl:
                 return 1
         else:
             objs, _ = client.list(namespace)
+            if selector:
+                want = _parse_selector(selector)
+                if want is None:
+                    self.out.write(f"error: bad selector {selector!r}\n")
+                    return 1
+                objs = [o for o in objs if _labels_match(o, want)]
         if output == "json":
             docs = [o.to_dict() for o in objs]
             self.out.write(json.dumps(docs[0] if name else {"items": docs}, indent=2) + "\n")
@@ -288,7 +335,37 @@ class Kubectl:
             self.out.write(f"{KIND_TO_RESOURCE[kind]}/{name} configured\n")
         return 0
 
-    def delete(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
+    def delete(self, resource: str, name: Optional[str], namespace: Optional[str] = None,
+               selector: str = "") -> int:
+        if name and selector:
+            self.out.write("error: a name cannot be combined with -l\n")
+            return 1
+        if selector and not name:
+            resource2, kind = _resolve(resource)
+            if kind is None:
+                self.out.write(f"error: unknown resource {resource!r}\n")
+                return 1
+            want = _parse_selector(selector)
+            if want is None:
+                self.out.write(f"error: bad selector {selector!r}\n")
+                return 1
+            client = self.cs.client_for(kind)
+            # scope like every other verb: the default namespace, never
+            # all-namespaces implicitly (delete is irreversible)
+            ns_scope = namespace if namespace is not None else client.default_namespace
+            victims = [o for o in client.list(ns_scope)[0] if _labels_match(o, want)]
+            for o in victims:
+                try:
+                    client.delete(o.meta.name, o.meta.namespace)
+                    self.out.write(f"{resource2}/{o.meta.name} deleted\n")
+                except NotFoundError:
+                    pass
+            if not victims:
+                self.out.write("No resources found\n")
+            return 0
+        return self._delete_one(resource, name, namespace)
+
+    def _delete_one(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
         resource, kind = _resolve(resource)
         try:
             self.cs.client_for(kind).delete(name, namespace)
@@ -628,6 +705,7 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p = sub.add_parser("get", parents=[common])
     p.add_argument("resource")
     p.add_argument("name", nargs="?")
+    p.add_argument("-l", "--selector", default="")
     p = sub.add_parser("describe", parents=[common])
     p.add_argument("resource")
     p.add_argument("name")
@@ -637,7 +715,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("-f", "--filename", required=True)
     p = sub.add_parser("delete", parents=[common])
     p.add_argument("resource")
-    p.add_argument("name")
+    p.add_argument("name", nargs="?")
+    p.add_argument("-l", "--selector", default="")
     p = sub.add_parser("scale", parents=[common])
     p.add_argument("resource")
     p.add_argument("name")
@@ -673,7 +752,7 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     cs = clientset or Clientset(RemoteStore(server, token=token))
     k = Kubectl(cs, out=out)
     if args.verb == "get":
-        return k.get(args.resource, args.name, namespace, output)
+        return k.get(args.resource, args.name, namespace, output, args.selector)
     if args.verb == "describe":
         return k.describe(args.resource, args.name, namespace)
     if args.verb == "create":
@@ -681,7 +760,10 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     if args.verb == "apply":
         return k.apply(args.filename)
     if args.verb == "delete":
-        return k.delete(args.resource, args.name, namespace)
+        if not args.name and not args.selector:
+            k.out.write("error: a name or -l selector is required\n")
+            return 1
+        return k.delete(args.resource, args.name, namespace, args.selector)
     if args.verb == "scale":
         return k.scale(args.resource, args.name, args.replicas, namespace)
     if args.verb == "cordon":

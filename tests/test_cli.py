@@ -247,3 +247,39 @@ def test_get_rejects_unknown_output_format(cs):
     cs.nodes.create(make_node("n1"))
     rc, out = run(cs, "get", "nodes", "-o", "josn")
     assert rc == 1 and "unsupported output" in out
+
+
+def test_get_and_delete_by_label_selector(cs):
+    for name, app in (("a1", "web"), ("a2", "web"), ("b1", "db")):
+        cs.pods.create(make_pod(name, labels={"app": app}))
+    rc, out = run(cs, "get", "pods", "-l", "app=web")
+    assert rc == 0 and "a1" in out and "a2" in out and "b1" not in out
+    rc, out = run(cs, "delete", "pods", "-l", "app=web")
+    assert rc == 0 and out.count("deleted") == 2
+    assert {p.meta.name for p in cs.pods.list()[0]} == {"b1"}
+    rc, out = run(cs, "get", "pods", "-l", "bad-selector")
+    assert rc == 1 and "bad selector" in out
+
+
+def test_selector_safety_rails(cs):
+    from kubernetes_tpu.api import Namespace, ObjectMeta
+
+    cs.namespaces.create(Namespace(meta=ObjectMeta(name="other")))
+    cs.pods.create(make_pod("d1", labels={"app": "web"}))
+    cs.pods.create(make_pod("o1", labels={"app": "web"}, namespace="other"))
+    # empty-ish selector errors instead of matching everything
+    rc, out = run(cs, "delete", "pods", "-l", ",")
+    assert rc == 1 and "bad selector" in out
+    # name + selector rejected
+    rc, out = run(cs, "delete", "pods", "d1", "-l", "app=web")
+    assert rc == 1 and "cannot be combined" in out
+    # delete -l scopes to the default namespace, not the whole cluster
+    rc, out = run(cs, "delete", "pods", "-l", "app=web")
+    assert rc == 0
+    remaining = {(p.meta.namespace, p.meta.name) for p in cs.pods.list()[0]}
+    assert ("other", "o1") in remaining and ("default", "d1") not in remaining
+    # != operator
+    cs.pods.create(make_pod("d2", labels={"app": "db"}))
+    cs.pods.create(make_pod("d3", labels={"app": "web"}))
+    rc, out = run(cs, "get", "pods", "-l", "app!=db")
+    assert rc == 0 and "d3" in out and "d2" not in out
