@@ -24,6 +24,10 @@ from .plugins import (
     default_chain,
 )
 from .plugins_ext import (
+    AlwaysAdmit,
+    AlwaysDeny,
+    NamespaceAutoProvision,
+    SecurityContextDeny,
     AlwaysPullImages,
     DefaultStorageClass,
     GenericAdmissionWebhook,

@@ -353,3 +353,63 @@ class NodeRestriction(AdmissionPlugin):
                 self.deny(f"node {node_name} may only manage its own pods")
             return
         self.deny(f"node {node_name} may not write {attrs.kind} objects")
+
+
+class NamespaceAutoProvision(AdmissionPlugin):
+    """Create the namespace on first use instead of rejecting
+    (``autoprovision/admission.go`` — the permissive sibling of
+    NamespaceLifecycle's exists-check)."""
+
+    name = "NamespaceAutoProvision"
+    operations = (CREATE,)
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace or attrs.kind == "Namespace":
+            return
+        try:
+            attrs.store.get("Namespace", "", attrs.namespace)
+        except NotFoundError:
+            from ..api.cluster import Namespace
+            from ..api.meta import ObjectMeta
+            from ..store.store import AlreadyExistsError
+
+            try:
+                attrs.store.create(
+                    "Namespace",
+                    Namespace(meta=ObjectMeta(name=attrs.namespace)).to_dict(),
+                )
+            except AlreadyExistsError:
+                pass  # racing creates are fine; anything else surfaces
+
+
+class SecurityContextDeny(AdmissionPlugin):
+    """Reject privileged containers (``securitycontextdeny/admission.go``
+    at the depth this pod model carries security context)."""
+
+    name = "SecurityContextDeny"
+    operations = (CREATE, UPDATE)
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Pod" and super().handles(attrs)
+
+    def validate(self, attrs: Attributes) -> None:
+        for c in (attrs.obj.get("spec") or {}).get("containers") or []:
+            if (c.get("securityContext") or {}).get("privileged"):
+                self.deny(f"container {c.get('name')} requests privileged mode")
+
+
+class AlwaysAdmit(AdmissionPlugin):
+    """``admit/admission.go`` — the no-op plugin (testing/default glue)."""
+
+    name = "AlwaysAdmit"
+    operations = (CREATE, UPDATE, DELETE)
+
+
+class AlwaysDeny(AdmissionPlugin):
+    """``deny/admission.go`` — rejects everything (lockdown/testing)."""
+
+    name = "AlwaysDeny"
+    operations = (CREATE, UPDATE, DELETE)
+
+    def validate(self, attrs: Attributes) -> None:
+        self.deny("AlwaysDeny rejects all requests")

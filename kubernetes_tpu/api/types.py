@@ -168,6 +168,7 @@ class Container:
     readiness_probe: Optional[Probe] = None
     env: dict[str, str] = field(default_factory=dict)  # injected by PodPreset
     image_pull_policy: str = ""  # "" | Always | IfNotPresent | Never
+    privileged: bool = False  # securityContext.privileged essential
 
     def to_dict(self) -> dict:
         d = {
@@ -184,6 +185,8 @@ class Container:
             d["env"] = dict(self.env)
         if self.image_pull_policy:
             d["imagePullPolicy"] = self.image_pull_policy
+        if self.privileged:
+            d["securityContext"] = {"privileged": True}
         return d
 
     @classmethod
@@ -197,6 +200,7 @@ class Container:
             readiness_probe=Probe.from_dict(d.get("readinessProbe")),
             env=dict(d.get("env") or {}),
             image_pull_policy=d.get("imagePullPolicy", ""),
+            privileged=bool((d.get("securityContext") or {}).get("privileged")),
         )
 
 

@@ -251,3 +251,25 @@ def test_duplicate_crd_does_not_unregister_claimants_kind(cs):
     cs.customresourcedefinitions.delete("widgets.a.com")
     reg.reconcile_all()
     assert "Widget" not in KINDS
+
+
+def test_namespace_autoprovision_security_context_always_deny():
+    from kubernetes_tpu.admission import (
+        AlwaysDeny,
+        NamespaceAutoProvision,
+        SecurityContextDeny,
+    )
+
+    cs2 = Clientset(AdmittedStore(AdmissionChain(
+        [NamespaceAutoProvision(), SecurityContextDeny()])))
+    cs2.pods.create(make_pod("p", namespace="brand-new"))
+    assert cs2.namespaces.get("brand-new").phase == "Active"
+
+    bad = make_pod("root", namespace="brand-new")
+    bad.spec.containers[0].privileged = True
+    with pytest.raises(AdmissionDenied):
+        cs2.pods.create(bad)
+
+    locked = Clientset(AdmittedStore(AdmissionChain([AlwaysDeny()])))
+    with pytest.raises(AdmissionDenied):
+        locked.pods.create(make_pod("x"))
