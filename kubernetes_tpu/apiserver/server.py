@@ -47,6 +47,10 @@ from ..utils.metrics import Counter, Histogram, Registry
 
 logger = logging.getLogger("kubernetes_tpu.apiserver")
 
+# SelfSubjectAccessReview route (reference authorization.k8s.io group,
+# served by the generic apiserver; evaluated against the live authorizer)
+SSAR_PATH = "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews"
+
 # resource path segment -> kind, derived from the one type registry so
 # every registered kind (incl. late-registered CRDs) is wire-addressable.
 from ..api.types import CLUSTER_SCOPED_KINDS as CLUSTER_SCOPED  # noqa: E402
@@ -211,10 +215,12 @@ def _make_handler(server: APIServer):
                     self._user.name if self._user else "",
                     verb, resource, ns, name,
                 )
-            if urlparse(self.path).path in ("/api", "/api/v1", "/apis"):
-                # discovery is granted to every AUTHENTICATED identity
-                # (the reference's system:discovery binding) — clients must
-                # enumerate resources before any RBAC rule can name them
+            if urlparse(self.path).path in ("/api", "/api/v1", "/apis", SSAR_PATH):
+                # discovery and self-subject access review are granted to
+                # every AUTHENTICATED identity (the reference's
+                # system:discovery / system:basic-user bindings) — clients
+                # must enumerate resources and ask "can I?" before any RBAC
+                # rule can name them
                 return True
             if server.authorizer is not None:
                 from ..auth import ALLOW, ANONYMOUS, AuthzAttributes
@@ -285,6 +291,29 @@ def _make_handler(server: APIServer):
 
         def do_DELETE(self):
             self._route("DELETE")
+
+        def _serve_ssar(self) -> None:
+            """SelfSubjectAccessReview: "can the CALLING user do X?"
+            evaluated against the live authorizer (reference
+            ``pkg/registry/authorization/selfsubjectaccessreview``).  The
+            caller's authenticated identity is authoritative — the spec
+            carries only the action, never the user."""
+            attrs = (self._body().get("spec") or {}).get("resourceAttributes") or {}
+            if server.authorizer is None:
+                return self._send(201, {"status": {"allowed": True,
+                                                   "reason": "no authorizer configured"}})
+            from ..auth import ALLOW, ANONYMOUS, AuthzAttributes
+
+            user = self._user if self._user is not None else ANONYMOUS
+            decision, reason = server.authorizer.authorize(AuthzAttributes(
+                user=user,
+                verb=attrs.get("verb", ""),
+                resource=attrs.get("resource", ""),
+                namespace=attrs.get("namespace", ""),
+                name=attrs.get("name", ""),
+            ))
+            return self._send(201, {"status": {"allowed": decision == ALLOW,
+                                               "reason": reason}})
 
         def _serve_discovery(self, path: str) -> None:
             """Discovery endpoints (reference ``endpoints/discovery``):
@@ -560,6 +589,8 @@ def _make_handler(server: APIServer):
                 )
                 return self._send(200, {"errors": errors})
 
+            if url.path == SSAR_PATH and method == "POST":
+                return self._serve_ssar()
             if parts and parts[0] == "apis" and len(parts) >= 2:
                 return self._proxy_aggregated(method, parts[1], url)
             if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":

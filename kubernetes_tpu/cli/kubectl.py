@@ -35,6 +35,12 @@ from ..store.store import AlreadyExistsError, NotFoundError
 LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
 
 
+class _AbortMutation(Exception):
+    """Raised inside a guaranteed_update mutate to cancel the write: a CLI
+    verb that refuses an operation must not commit a no-op revision (a
+    spurious MODIFIED event would wake every watcher)."""
+
+
 def _parse_selector(spec: str):
     """kubectl's equality selector forms: "k=v", "k==v", "k!=v", comma
     separated.  Returns [(key, op, value)] or None on a malformed (or
@@ -690,6 +696,566 @@ class Kubectl:
         self._print(*rows)
         return 0
 
+    # -- label / annotate (cmd/label.go, cmd/annotate.go) ------------------
+    def _set_map(self, resource: str, name: str, pairs: list[str], which: str,
+                 namespace: Optional[str], overwrite: bool) -> int:
+        """Shared engine for label/annotate: "k=v" sets, "k-" removes;
+        setting an existing key without --overwrite is an error (the
+        reference refuses to clobber silently)."""
+        resource, kind = _resolve(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return 1
+        sets, removes = {}, []
+        for p in pairs:
+            if p.endswith("-") and "=" not in p:
+                removes.append(p[:-1])
+            elif "=" in p:
+                k, v = p.split("=", 1)
+                sets[k] = v
+            else:
+                self.out.write(f"error: expected KEY=VALUE or KEY-, got {p!r}\n")
+                return 1
+        err = []
+
+        def _mutate(obj):
+            m = getattr(obj.meta, which)
+            if not overwrite:
+                clobbered = [k for k, v in sets.items() if k in m and m[k] != v]
+                if clobbered:
+                    err.append(clobbered)
+                    raise _AbortMutation
+            m.update(sets)
+            for k in removes:
+                m.pop(k, None)
+            return obj
+
+        try:
+            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+        except _AbortMutation:
+            self.out.write(
+                f"error: {err[0][0]!r} already has a value; use --overwrite\n")
+            return 1
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} {'labeled' if which == 'labels' else 'annotated'}\n")
+        return 0
+
+    def label(self, resource: str, name: str, pairs: list[str],
+              namespace: Optional[str] = None, overwrite: bool = False) -> int:
+        return self._set_map(resource, name, pairs, "labels", namespace, overwrite)
+
+    def annotate(self, resource: str, name: str, pairs: list[str],
+                 namespace: Optional[str] = None, overwrite: bool = False) -> int:
+        return self._set_map(resource, name, pairs, "annotations", namespace, overwrite)
+
+    # -- patch (cmd/patch.go) ----------------------------------------------
+    def patch(self, resource: str, name: str, patch: str,
+              namespace: Optional[str] = None, patch_type: str = "merge") -> int:
+        """``kubectl patch``: merge (RFC 7386 recursive merge, null
+        deletes) or json (RFC 6902 add/replace/remove) against the
+        object's wire form, re-decoded through the type registry."""
+        resource, kind = _resolve(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return 1
+        try:
+            doc = json.loads(patch)
+        except json.JSONDecodeError as e:
+            self.out.write(f"error: bad patch: {e}\n")
+            return 1
+
+        def _merge(base, overlay, strategic=False):
+            if (strategic and isinstance(base, list) and isinstance(overlay, list)
+                    and all(isinstance(x, dict) and "name" in x for x in base + overlay)):
+                # strategic list merge keyed on "name" (the reference's
+                # patchMergeKey for containers/ports/env/volumes): named
+                # entries merge in place, new ones append, siblings survive
+                out_list = list(base)
+                index = {x["name"]: i for i, x in enumerate(out_list)}
+                for item in overlay:
+                    i = index.get(item["name"])
+                    if i is None:
+                        out_list.append(item)
+                    else:
+                        out_list[i] = _merge(out_list[i], item, strategic)
+                return out_list
+            if not isinstance(base, dict) or not isinstance(overlay, dict):
+                return overlay
+            out = dict(base)
+            for k, v in overlay.items():
+                if v is None:
+                    out.pop(k, None)
+                else:
+                    out[k] = _merge(out.get(k), v, strategic)
+            return out
+
+        def _json_patch(base, ops):
+            for op in ops:
+                path = [p for p in op.get("path", "").split("/") if p]
+                target = base
+                for seg in path[:-1]:
+                    target = target[int(seg)] if isinstance(target, list) else target[seg]
+                leaf = path[-1] if path else ""
+                action = op.get("op")
+                if isinstance(target, list):
+                    idx = len(target) if leaf == "-" else int(leaf)
+                    if action == "add":
+                        target.insert(idx, op.get("value"))
+                    elif action == "replace":
+                        target[idx] = op.get("value")
+                    elif action == "remove":
+                        del target[idx]
+                    else:
+                        raise ValueError(f"unsupported op {action!r}")
+                else:
+                    if action in ("add", "replace"):
+                        target[leaf] = op.get("value")
+                    elif action == "remove":
+                        del target[leaf]
+                    else:
+                        raise ValueError(f"unsupported op {action!r}")
+            return base
+
+        errors = []
+
+        def _mutate(obj):
+            wire = obj.to_dict()
+            try:
+                if patch_type == "json":
+                    patched = _json_patch(wire, doc)
+                else:
+                    patched = _merge(wire, doc, strategic=patch_type == "strategic")
+            except (KeyError, IndexError, ValueError, TypeError) as e:
+                errors.append(str(e))
+                raise _AbortMutation from e
+            new = type(obj).from_dict(patched)
+            new.meta.uid = obj.meta.uid  # identity is cluster-owned
+            new.meta.resource_version = obj.meta.resource_version
+            return new
+
+        try:
+            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+        except _AbortMutation:
+            self.out.write(f"error: cannot apply patch: {errors[0]}\n")
+            return 1
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} patched\n")
+        return 0
+
+    # -- taint (cmd/taint.go) ----------------------------------------------
+    def taint(self, name: str, specs: list[str]) -> int:
+        """``kubectl taint nodes NAME key=value:Effect`` / ``key:Effect-``
+        (removal).  Same key+effect replaces (with the reference's
+        "overwrite" message)."""
+        adds, removes = [], []
+        for spec in specs:
+            if spec.endswith("-"):
+                body = spec[:-1]
+                kv, _, effect = body.partition(":")
+                key = kv.split("=", 1)[0]
+                removes.append((key, effect))
+                continue
+            body, _, effect = spec.partition(":")
+            if not effect:
+                self.out.write(f"error: taint {spec!r} must specify an effect\n")
+                return 1
+            key, _, value = body.partition("=")
+            adds.append(api.Taint(key=key, value=value, effect=effect))
+        msgs = []
+        missing = []
+
+        def _mutate(node):
+            msgs.clear()
+            missing.clear()
+            taints = list(node.spec.taints)
+            for t in adds:
+                before = len(taints)
+                taints = [x for x in taints if not (x.key == t.key and x.effect == t.effect)]
+                msgs.append("modified" if len(taints) != before else "tainted")
+                taints.append(t)
+            for key, effect in removes:
+                kept = [x for x in taints
+                        if not (x.key == key and (not effect or x.effect == effect))]
+                if len(kept) == len(taints):
+                    missing.append(f"{key}:{effect}" if effect else key)
+                else:
+                    msgs.append("untainted")
+                taints = kept
+            if missing:
+                raise _AbortMutation
+            node.spec.taints = taints
+            return node
+
+        try:
+            self.cs.nodes.guaranteed_update(name, _mutate, "")
+        except _AbortMutation:
+            self.out.write(f"error: taint {missing[0]!r} not found\n")
+            return 1
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: node "{name}" not found\n')
+            return 1
+        self.out.write(f"node/{name} {msgs[-1] if msgs else 'unchanged'}\n")
+        return 0
+
+    # -- expose / run / autoscale (imperative generators) ------------------
+    def expose(self, resource: str, name: str, port: int, target_port: int = 0,
+               svc_type: str = "ClusterIP", svc_name: str = "",
+               namespace: Optional[str] = None) -> int:
+        """``kubectl expose``: generate a Service selecting the workload's
+        pods (reference ``cmd/expose.go`` + service generators)."""
+        resource, kind = _resolve(resource)
+        if kind not in ("Deployment", "ReplicaSet", "Service", "Pod"):
+            self.out.write(f"error: cannot expose {resource}\n")
+            return 1
+        try:
+            obj = self.cs.client_for(kind).get(name, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        if kind == "Deployment" or kind == "ReplicaSet":
+            selector = dict(obj.selector.match_labels)
+        elif kind == "Service":
+            selector = dict(obj.selector)
+        else:  # Pod
+            selector = dict(obj.meta.labels)
+        if not selector:
+            self.out.write("error: couldn't find a selector to expose\n")
+            return 1
+        svc = api.Service(
+            meta=api.ObjectMeta(name=svc_name or name,
+                                namespace=namespace or obj.meta.namespace or "default"),
+            selector=selector,
+            ports=[api.ServicePort(port=port, target_port=target_port or port)],
+            type=svc_type,
+        )
+        try:
+            self.cs.services.create(svc)
+        except AlreadyExistsError:
+            self.out.write(f'Error: service "{svc.meta.name}" already exists\n')
+            return 1
+        self.out.write(f"service/{svc.meta.name} exposed\n")
+        return 0
+
+    def run(self, name: str, image: str, replicas: int = 1, restart: str = "Always",
+            namespace: Optional[str] = None, labels: Optional[str] = None) -> int:
+        """``kubectl run`` (reference ``cmd/run.go`` generator ladder):
+        restart=Always → Deployment, OnFailure → Job, Never → bare Pod."""
+        lbls = dict(p.split("=", 1) for p in (labels or "").split(",") if "=" in p)
+        lbls.setdefault("run", name)
+        ns = namespace or "default"
+        container = api.Container(name=name, image=image)
+        try:
+            if restart == "Always":
+                dep = api.Deployment(
+                    meta=api.ObjectMeta(name=name, namespace=ns, labels=dict(lbls)),
+                    replicas=replicas,
+                    selector=api.LabelSelector.from_match_labels(dict(lbls)),
+                    template=api.PodTemplateSpec(
+                        labels=dict(lbls), spec=api.PodSpec(containers=[container])),
+                )
+                self.cs.deployments.create(dep)
+                self.out.write(f"deployment/{name} created\n")
+            elif restart == "OnFailure":
+                from ..api.apps import Job
+
+                job = Job(
+                    meta=api.ObjectMeta(name=name, namespace=ns, labels=dict(lbls)),
+                    selector=api.LabelSelector.from_match_labels(dict(lbls)),
+                    template=api.PodTemplateSpec(
+                        labels=dict(lbls),
+                        spec=api.PodSpec(containers=[container], restart_policy="OnFailure")),
+                )
+                self.cs.client_for("Job").create(job)
+                self.out.write(f"job/{name} created\n")
+            elif restart == "Never":
+                pod = api.Pod(
+                    meta=api.ObjectMeta(name=name, namespace=ns, labels=dict(lbls)),
+                    spec=api.PodSpec(containers=[container], restart_policy="Never"),
+                )
+                self.cs.pods.create(pod)
+                self.out.write(f"pod/{name} created\n")
+            else:
+                self.out.write(f"error: invalid --restart {restart!r}\n")
+                return 1
+        except AlreadyExistsError:
+            self.out.write(f'Error: "{name}" already exists\n')
+            return 1
+        return 0
+
+    def autoscale(self, resource: str, name: str, min_replicas: int, max_replicas: int,
+                  cpu_percent: int = 80, namespace: Optional[str] = None) -> int:
+        """``kubectl autoscale``: generate an HPA targeting the workload."""
+        resource, kind = _resolve(resource)
+        if kind not in ("Deployment", "ReplicaSet"):
+            self.out.write(f"error: cannot autoscale {resource}\n")
+            return 1
+        try:
+            obj = self.cs.client_for(kind).get(name, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        from ..api.cluster import HorizontalPodAutoscaler
+
+        hpa = HorizontalPodAutoscaler(
+            meta=api.ObjectMeta(name=name,
+                                namespace=namespace or obj.meta.namespace or "default"),
+            target_kind=kind, target_name=name,
+            min_replicas=min_replicas, max_replicas=max_replicas,
+            target_cpu_utilization=cpu_percent,
+        )
+        try:
+            self.cs.client_for("HorizontalPodAutoscaler").create(hpa)
+        except AlreadyExistsError:
+            self.out.write(f'Error: hpa "{name}" already exists\n')
+            return 1
+        self.out.write(f"horizontalpodautoscaler/{name} autoscaled\n")
+        return 0
+
+    # -- set image / set resources (cmd/set/) ------------------------------
+    def set_image(self, resource: str, name: str, pairs: list[str],
+                  namespace: Optional[str] = None) -> int:
+        """``kubectl set image deployment/NAME container=image ...`` —
+        the rolling-update trigger (template change → new RS hash)."""
+        resource, kind = _resolve(resource)
+        if kind not in ("Deployment", "ReplicaSet", "DaemonSet", "StatefulSet", "Pod"):
+            self.out.write(f"error: cannot set image on {resource}\n")
+            return 1
+        want = {}
+        for p in pairs:
+            if "=" not in p:
+                self.out.write(f"error: expected CONTAINER=IMAGE, got {p!r}\n")
+                return 1
+            c, img = p.split("=", 1)
+            want[c] = img
+        missing = []
+
+        def _mutate(obj):
+            missing.clear()
+            containers = (obj.spec.containers if kind == "Pod"
+                          else obj.template.spec.containers)
+            by_name = {c.name: c for c in containers}
+            for c, img in want.items():
+                if c == "*":
+                    for cont in containers:
+                        cont.image = img
+                elif c in by_name:
+                    by_name[c].image = img
+                else:
+                    missing.append(c)
+            if missing:
+                raise _AbortMutation
+            return obj
+
+        try:
+            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+        except _AbortMutation:
+            self.out.write(f"error: unable to find container {missing[0]!r}\n")
+            return 1
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} image updated\n")
+        return 0
+
+    def set_resources(self, resource: str, name: str, requests: str = "",
+                      limits: str = "", namespace: Optional[str] = None) -> int:
+        """``kubectl set resources`` — update every container's
+        requests/limits from "cpu=100m,memory=128Mi" strings."""
+        from ..api.quantity import Quantity
+
+        resource, kind = _resolve(resource)
+        if kind not in ("Deployment", "ReplicaSet", "DaemonSet", "StatefulSet"):
+            self.out.write(f"error: cannot set resources on {resource}\n")
+            return 1
+
+        def _parse(s: str) -> dict:
+            return {k: Quantity(v)
+                    for k, v in (p.split("=", 1) for p in s.split(",") if "=" in p)}
+
+        try:
+            req, lim = _parse(requests), _parse(limits)
+        except ValueError as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+
+        def _mutate(obj):
+            for c in obj.template.spec.containers:
+                c.resources.requests.update(req)
+                c.resources.limits.update(lim)
+            return obj
+
+        try:
+            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} resource requirements updated\n")
+        return 0
+
+    # -- auth can-i (cmd/auth/cani.go) -------------------------------------
+    def auth_can_i(self, verb: str, resource: str, name: str = "",
+                   namespace: Optional[str] = None) -> int:
+        """POSTs a SelfSubjectAccessReview; the server evaluates its live
+        authorizer for the calling identity.  Exit 0 yes / 1 no."""
+        plural, _ = _resolve(resource)
+        base = getattr(self.cs.store, "base_url", None)
+        if base is None:
+            # in-proc clientset bypasses the filter chain entirely: every
+            # verb IS allowed, so say so rather than guess at policy
+            self.out.write("yes\n")
+            return 0
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps({"spec": {"resourceAttributes": {
+            "verb": verb, "resource": plural, "name": name,
+            "namespace": namespace or "default",
+        }}}).encode()
+        req = urllib.request.Request(
+            f"{base}/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+            data=body, headers={"Content-Type": "application/json"}, method="POST")
+        token = getattr(self.cs.store, "token", None)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                status = json.loads(r.read()).get("status") or {}
+        except urllib.error.HTTPError as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        except Exception as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        self.out.write("yes\n" if status.get("allowed") else "no\n")
+        return 0 if status.get("allowed") else 1
+
+    # -- discovery verbs ---------------------------------------------------
+    def api_versions(self) -> int:
+        base = getattr(self.cs.store, "base_url", None)
+        versions = ["v1"]
+        if base is not None:
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(f"{base}/api", timeout=10) as r:
+                    versions = json.loads(r.read()).get("versions", ["v1"])
+                with urllib.request.urlopen(f"{base}/apis", timeout=10) as r:
+                    for g in json.loads(r.read()).get("groups", []):
+                        versions.append(g["name"])
+            except Exception as e:
+                self.out.write(f"error: could not reach server: {e}\n")
+                return 1
+        for v in versions:
+            self.out.write(v + "\n")
+        return 0
+
+    def api_resources(self) -> int:
+        """Table of every servable resource, from live discovery (remote)
+        or the type registry (in-proc) — CRDs included either way."""
+        base = getattr(self.cs.store, "base_url", None)
+        rows = [("NAME", "SHORTNAMES", "KIND", "NAMESPACED")]
+        short_by_plural: dict[str, list] = {}
+        for s, plural in _SHORT_NAMES.items():
+            short_by_plural.setdefault(plural, []).append(s)
+        if base is not None:
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(f"{base}/api/v1", timeout=10) as r:
+                    resources = json.loads(r.read()).get("resources", [])
+            except Exception as e:
+                self.out.write(f"error: could not reach server: {e}\n")
+                return 1
+        else:
+            resources = [
+                {"name": plural, "kind": kind,
+                 "namespaced": kind not in api.CLUSTER_SCOPED_KINDS}
+                for kind, plural in sorted(api.KIND_PLURALS.items())
+            ]
+        for res in sorted(resources, key=lambda r: r["name"]):
+            rows.append((res["name"], ",".join(short_by_plural.get(res["name"], [])),
+                         res["kind"], res["namespaced"]))
+        self._print(*rows)
+        return 0
+
+    def version(self) -> int:
+        from .. import __version__
+
+        self.out.write(f"Client Version: {__version__}\n")
+        base = getattr(self.cs.store, "base_url", None)
+        if base is not None:
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(f"{base}/version", timeout=10) as r:
+                    self.out.write(f"Server Version: {json.loads(r.read())['version']}\n")
+            except Exception as e:
+                self.out.write(f"error: could not reach server: {e}\n")
+                return 1
+        return 0
+
+    def cluster_info(self) -> int:
+        base = getattr(self.cs.store, "base_url", None)
+        if base is None:
+            self.out.write("Kubernetes master is running in-process\n")
+            return 0
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                ok = json.loads(r.read()).get("status") == "ok"
+        except Exception:
+            ok = False
+        self.out.write(f"Kubernetes master is running at {base} "
+                       f"({'healthy' if ok else 'UNREACHABLE'})\n")
+        return 0 if ok else 1
+
+    # -- wait (cmd/wait.go) ------------------------------------------------
+    def wait_for(self, resource: str, name: str, condition: str,
+                 namespace: Optional[str] = None, timeout: float = 30.0) -> int:
+        """``kubectl wait RES/NAME --for=condition=X|delete`` — polls the
+        API (the reference watches; same observable behavior)."""
+        import time as _time
+
+        resource, kind = _resolve(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return 1
+        client = self.cs.client_for(kind)
+        want_delete = condition == "delete"
+        want_cond = condition.split("=", 1)[1] if condition.startswith("condition=") else ""
+        if not want_delete and not want_cond:
+            self.out.write(f"error: unsupported --for {condition!r}\n")
+            return 1
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                obj = client.get(name, namespace)
+            except (NotFoundError, KeyError):
+                if want_delete:
+                    self.out.write(f"{resource}/{name} condition met\n")
+                    return 0
+                obj = None
+            if obj is not None and want_cond:
+                conds = getattr(getattr(obj, "status", None), "conditions", [])
+                for c in conds:
+                    if isinstance(c, dict):
+                        ctype, cstat = c.get("type", ""), c.get("status", "")
+                    else:
+                        ctype = getattr(c, "type", "")
+                        cstat = getattr(c, "status", "")
+                    if ctype == want_cond and cstat == "True":
+                        self.out.write(f"{resource}/{name} condition met\n")
+                        return 0
+            if _time.monotonic() >= deadline:
+                self.out.write(f"error: timed out waiting for {condition} on {resource}/{name}\n")
+                return 1
+            _time.sleep(0.05)
+
 
 def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None, out=None) -> int:
     # SUPPRESS so a subparser never clobbers a value parsed before the verb
@@ -743,6 +1309,63 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("resource")  # "deployment" or "deployment/NAME"
     p.add_argument("name", nargs="?")
     p.add_argument("--to-revision", type=int, default=0)
+    for verb in ("label", "annotate"):
+        p = sub.add_parser(verb, parents=[common])
+        p.add_argument("resource")
+        p.add_argument("name")
+        p.add_argument("pairs", nargs="+", help="KEY=VALUE or KEY- to remove")
+        p.add_argument("--overwrite", action="store_true")
+    p = sub.add_parser("patch", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("-p", "--patch", required=True)
+    p.add_argument("--type", dest="patch_type", choices=["merge", "strategic", "json"],
+                   default="merge")
+    p = sub.add_parser("taint", parents=[common])
+    p.add_argument("resource", help="must be nodes")
+    p.add_argument("name")
+    p.add_argument("specs", nargs="+", help="key=value:Effect or key:Effect-")
+    p = sub.add_parser("expose", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--target-port", type=int, default=0)
+    p.add_argument("--type", dest="svc_type", default="ClusterIP")
+    p.add_argument("--name", dest="svc_name", default="")
+    p = sub.add_parser("run", parents=[common])
+    p.add_argument("name")
+    p.add_argument("--image", required=True)
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--restart", choices=["Always", "OnFailure", "Never"], default="Always")
+    p.add_argument("--labels", default="")
+    p = sub.add_parser("autoscale", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("--min", dest="min_replicas", type=int, default=1)
+    p.add_argument("--max", dest="max_replicas", type=int, required=True)
+    p.add_argument("--cpu-percent", type=int, default=80)
+    p = sub.add_parser("set", parents=[common])
+    p.add_argument("what", choices=["image", "resources"])
+    p.add_argument("resource")  # "deployment" or "deployment/NAME"
+    p.add_argument("name", nargs="?")
+    p.add_argument("pairs", nargs="*", help="container=image pairs (set image)")
+    p.add_argument("--requests", default="")
+    p.add_argument("--limits", default="")
+    p = sub.add_parser("auth", parents=[common])
+    p.add_argument("action", choices=["can-i"])
+    p.add_argument("auth_verb")
+    p.add_argument("auth_resource")
+    p.add_argument("auth_name", nargs="?", default="")
+    sub.add_parser("api-versions", parents=[common])
+    sub.add_parser("api-resources", parents=[common])
+    sub.add_parser("version", parents=[common])
+    sub.add_parser("cluster-info", parents=[common])
+    p = sub.add_parser("wait", parents=[common])
+    p.add_argument("resource")  # "pod/NAME" or "pod NAME"
+    p.add_argument("name", nargs="?")
+    p.add_argument("--for", dest="condition", required=True,
+                   help="condition=TYPE or delete")
+    p.add_argument("--timeout", type=float, default=30.0)
 
     args = parser.parse_args(argv)
     server = getattr(args, "server", "http://127.0.0.1:8080")
@@ -799,6 +1422,59 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
         if args.action == "history":
             return k.rollout_history(name, namespace)
         return k.rollout_undo(name, namespace, args.to_revision)
+    if args.verb in ("label", "annotate"):
+        fn = k.label if args.verb == "label" else k.annotate
+        return fn(args.resource, args.name, args.pairs, namespace, args.overwrite)
+    if args.verb == "patch":
+        return k.patch(args.resource, args.name, args.patch, namespace, args.patch_type)
+    if args.verb == "taint":
+        if _resolve(args.resource)[1] != "Node":
+            k.out.write("error: taint supports nodes only\n")
+            return 1
+        return k.taint(args.name, args.specs)
+    if args.verb == "expose":
+        return k.expose(args.resource, args.name, args.port, args.target_port,
+                        args.svc_type, args.svc_name, namespace)
+    if args.verb == "run":
+        return k.run(args.name, args.image, args.replicas, args.restart,
+                     namespace, args.labels)
+    if args.verb == "autoscale":
+        return k.autoscale(args.resource, args.name, args.min_replicas,
+                           args.max_replicas, args.cpu_percent, namespace)
+    if args.verb == "set":
+        res, name = args.resource, args.name
+        pairs = list(args.pairs)
+        if name is None and "/" in res:
+            res, name = res.split("/", 1)
+        elif name is not None and "=" in name:
+            # "set image deployment/web c=img": name slot holds a pair
+            pairs.insert(0, name)
+            if "/" in res:
+                res, name = res.split("/", 1)
+        if not name:
+            k.out.write("error: set requires RESOURCE/NAME\n")
+            return 1
+        if args.what == "image":
+            return k.set_image(res, name, pairs, namespace)
+        return k.set_resources(res, name, args.requests, args.limits, namespace)
+    if args.verb == "auth":
+        return k.auth_can_i(args.auth_verb, args.auth_resource, args.auth_name, namespace)
+    if args.verb == "api-versions":
+        return k.api_versions()
+    if args.verb == "api-resources":
+        return k.api_resources()
+    if args.verb == "version":
+        return k.version()
+    if args.verb == "cluster-info":
+        return k.cluster_info()
+    if args.verb == "wait":
+        res, name = args.resource, args.name
+        if name is None and "/" in res:
+            res, name = res.split("/", 1)
+        if not name:
+            k.out.write("error: wait requires RESOURCE/NAME\n")
+            return 1
+        return k.wait_for(res, name, args.condition, namespace, args.timeout)
     return 2
 
 

@@ -283,3 +283,221 @@ def test_selector_safety_rails(cs):
     cs.pods.create(make_pod("d3", labels={"app": "web"}))
     rc, out = run(cs, "get", "pods", "-l", "app!=db")
     assert rc == 0 and "d3" in out and "d2" not in out
+
+
+# -- round-2 verb breadth (label/annotate/patch/taint/expose/run/...) ------
+
+
+def test_label_and_annotate(cs):
+    cs.pods.create(make_pod("p1", labels={"app": "web"}))
+    rc, out = run(cs, "label", "pod", "p1", "tier=frontend")
+    assert rc == 0 and "labeled" in out
+    assert cs.pods.get("p1").meta.labels["tier"] == "frontend"
+    # refuse to clobber without --overwrite
+    rc, out = run(cs, "label", "pod", "p1", "tier=backend")
+    assert rc == 1 and "overwrite" in out
+    rc, out = run(cs, "label", "pod", "p1", "tier=backend", "--overwrite")
+    assert rc == 0
+    assert cs.pods.get("p1").meta.labels["tier"] == "backend"
+    # key- removes
+    rc, out = run(cs, "label", "pod", "p1", "tier-")
+    assert rc == 0
+    assert "tier" not in cs.pods.get("p1").meta.labels
+    rc, out = run(cs, "annotate", "pod", "p1", "note=hello")
+    assert rc == 0 and "annotated" in out
+    assert cs.pods.get("p1").meta.annotations["note"] == "hello"
+
+
+def test_patch_merge_and_json(cs):
+    from kubernetes_tpu.api import ObjectMeta, ConfigMap
+
+    cs.client_for("ConfigMap").create(
+        ConfigMap(meta=ObjectMeta(name="cfg"), data={"a": "1"}))
+    rc, out = run(cs, "patch", "configmap", "cfg", "-p", '{"data": {"b": "2"}}')
+    assert rc == 0 and "patched" in out
+    assert cs.client_for("ConfigMap").get("cfg").data == {"a": "1", "b": "2"}
+    # null deletes in merge patch
+    rc, out = run(cs, "patch", "configmap", "cfg", "-p", '{"data": {"a": null}}')
+    assert rc == 0
+    assert cs.client_for("ConfigMap").get("cfg").data == {"b": "2"}
+    # JSON patch replace
+    rc, out = run(cs, "patch", "configmap", "cfg", "--type", "json", "-p",
+                  '[{"op": "replace", "path": "/data/b", "value": "9"}]')
+    assert rc == 0
+    assert cs.client_for("ConfigMap").get("cfg").data == {"b": "9"}
+    # malformed patch errors
+    rc, out = run(cs, "patch", "configmap", "cfg", "-p", "{nope")
+    assert rc == 1 and "bad patch" in out
+
+
+def test_taint_add_modify_remove(cs):
+    cs.nodes.create(make_node("n1"))
+    rc, out = run(cs, "taint", "nodes", "n1", "dedicated=gpu:NoSchedule")
+    assert rc == 0 and "tainted" in out
+    [t] = cs.nodes.get("n1").spec.taints
+    assert (t.key, t.value, t.effect) == ("dedicated", "gpu", "NoSchedule")
+    # same key+effect replaces
+    rc, out = run(cs, "taint", "nodes", "n1", "dedicated=tpu:NoSchedule")
+    assert rc == 0 and "modified" in out
+    [t] = cs.nodes.get("n1").spec.taints
+    assert t.value == "tpu"
+    # removal by key:Effect-
+    rc, out = run(cs, "taint", "nodes", "n1", "dedicated:NoSchedule-")
+    assert rc == 0 and "untainted" in out
+    assert cs.nodes.get("n1").spec.taints == []
+    # an effect is mandatory on add
+    rc, out = run(cs, "taint", "nodes", "n1", "dedicated=gpu")
+    assert rc == 1 and "effect" in out
+
+
+def test_run_expose_autoscale(cs):
+    rc, out = run(cs, "run", "web", "--image", "nginx:1.13", "--replicas", "3")
+    assert rc == 0 and "deployment/web created" in out
+    dep = cs.deployments.get("web")
+    assert dep.replicas == 3
+    assert dep.template.spec.containers[0].image == "nginx:1.13"
+
+    rc, out = run(cs, "expose", "deployment", "web", "--port", "80")
+    assert rc == 0 and "service/web exposed" in out
+    svc = cs.services.get("web")
+    assert svc.selector == {"run": "web"} and svc.ports[0].port == 80
+
+    rc, out = run(cs, "autoscale", "deployment", "web", "--max", "10", "--min", "2")
+    assert rc == 0 and "autoscaled" in out
+    hpa = cs.client_for("HorizontalPodAutoscaler").get("web")
+    assert (hpa.min_replicas, hpa.max_replicas) == (2, 10)
+
+    # restart ladder: Never → bare pod, OnFailure → job
+    rc, out = run(cs, "run", "one-off", "--image", "busybox", "--restart", "Never")
+    assert rc == 0 and "pod/one-off created" in out
+    assert cs.pods.get("one-off").spec.restart_policy == "Never"
+    rc, out = run(cs, "run", "batch1", "--image", "busybox", "--restart", "OnFailure")
+    assert rc == 0 and "job/batch1 created" in out
+
+
+def test_set_image_and_resources(cs):
+    run(cs, "run", "web", "--image", "nginx:1.13")
+    rc, out = run(cs, "set", "image", "deployment/web", "web=nginx:1.14")
+    assert rc == 0 and "image updated" in out
+    assert cs.deployments.get("web").template.spec.containers[0].image == "nginx:1.14"
+    # unknown container errors
+    rc, out = run(cs, "set", "image", "deployment/web", "nope=img")
+    assert rc == 1 and "unable to find container" in out
+    rc, out = run(cs, "set", "resources", "deployment/web",
+                  "--requests", "cpu=250m,memory=64Mi", "--limits", "cpu=1")
+    assert rc == 0
+    c = cs.deployments.get("web").template.spec.containers[0]
+    assert str(c.resources.requests["cpu"]) == "250m"
+    assert str(c.resources.limits["cpu"]) == "1"
+
+
+def test_discovery_verbs_and_wait(cs):
+    rc, out = run(cs, "api-versions")
+    assert rc == 0 and "v1" in out
+    rc, out = run(cs, "api-resources")
+    assert rc == 0 and "pods" in out and "deployments" in out and "po" in out
+    rc, out = run(cs, "version")
+    assert rc == 0 and "Client Version" in out
+    rc, out = run(cs, "cluster-info")
+    assert rc == 0 and "in-process" in out
+
+    # wait --for=delete on an absent object returns immediately
+    rc, out = run(cs, "wait", "pod/ghost", "--for", "delete", "--timeout", "1")
+    assert rc == 0 and "condition met" in out
+    # wait --for=condition on a node that has it
+    cs.nodes.create(make_node("n1"))  # make_node gives Ready=True
+    rc, out = run(cs, "wait", "node/n1", "--for", "condition=Ready", "--timeout", "2")
+    assert rc == 0 and "condition met" in out
+    # timeout path
+    cs.pods.create(make_pod("stuck"))
+    rc, out = run(cs, "wait", "pod/stuck", "--for", "condition=Ready",
+                  "--timeout", "0.2")
+    assert rc == 1 and "timed out" in out
+
+
+def test_auth_can_i_over_http():
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.auth.authn import TokenFileAuthenticator, UnionAuthenticator
+    from kubernetes_tpu.auth.authz import RBACAuthorizer
+    from kubernetes_tpu.api.rbac import ClusterRole, ClusterRoleBinding, PolicyRule, Subject
+    from kubernetes_tpu.api import ObjectMeta
+
+    store = Store()
+    store.create("ClusterRole", ClusterRole(
+        meta=ObjectMeta(name="pod-reader"),
+        rules=[PolicyRule(verbs=["get", "list"], resources=["pods"])]).to_dict())
+    store.create("ClusterRoleBinding", ClusterRoleBinding(
+        meta=ObjectMeta(name="read-pods"), role_name="pod-reader",
+        subjects=[Subject(kind="User", name="alice")]).to_dict())
+    authn = UnionAuthenticator(TokenFileAuthenticator({"tok-alice": "alice"}))
+    authz = RBACAuthorizer(store)
+    server = APIServer(store, authenticator=authn, authorizer=authz)
+    server.start()
+    try:
+        out = io.StringIO()
+        rc = kubectl_main(["--server", server.url, "--token", "tok-alice",
+                           "auth", "can-i", "list", "pods"], out=out)
+        assert rc == 0 and "yes" in out.getvalue()
+        out = io.StringIO()
+        rc = kubectl_main(["--server", server.url, "--token", "tok-alice",
+                           "auth", "can-i", "delete", "pods"], out=out)
+        assert rc == 1 and "no" in out.getvalue()
+    finally:
+        server.stop()
+
+
+def test_patch_strategic_merges_containers_by_name(cs):
+    """--type strategic must merge named list entries, not replace the
+    list (reference strategic-merge patchMergeKey=name on containers)."""
+    from kubernetes_tpu.api import Container, Deployment, LabelSelector, ObjectMeta
+    from kubernetes_tpu.api import PodSpec, PodTemplateSpec
+
+    cs.deployments.create(Deployment(
+        meta=ObjectMeta(name="web"),
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        template=PodTemplateSpec(labels={"app": "web"}, spec=PodSpec(containers=[
+            Container(name="app", image="app:v1"),
+            Container(name="sidecar", image="side:v1"),
+        ])),
+    ))
+    rc, out = run(cs, "patch", "deployment", "web", "--type", "strategic", "-p",
+                  '{"spec": {"template": {"spec": {"containers": '
+                  '[{"name": "app", "image": "app:v2"}]}}}}')
+    assert rc == 0
+    containers = {c.name: c.image for c in
+                  cs.deployments.get("web").template.spec.containers}
+    assert containers == {"app": "app:v2", "sidecar": "side:v1"}
+
+
+def test_refused_cli_writes_do_not_commit_a_revision(cs):
+    """A verb that errors must not bump resourceVersion (no spurious
+    MODIFIED events for watchers)."""
+    cs.pods.create(make_pod("p1", labels={"tier": "fe"}))
+    cs.nodes.create(make_node("n1"))
+    rev = cs.pods.get("p1").meta.resource_version
+    rc, _ = run(cs, "label", "pod", "p1", "tier=be")  # refused: no --overwrite
+    assert rc == 1
+    assert cs.pods.get("p1").meta.resource_version == rev
+    rc, _ = run(cs, "patch", "pod", "p1", "--type", "json", "-p",
+                '[{"op": "remove", "path": "/metadata/ghost"}]')
+    assert rc == 1
+    assert cs.pods.get("p1").meta.resource_version == rev
+    nrev = cs.nodes.get("n1").meta.resource_version
+    rc, out = run(cs, "taint", "nodes", "n1", "ghost:NoSchedule-")
+    assert rc == 1 and "not found" in out and "node/n1" not in out
+    assert cs.nodes.get("n1").meta.resource_version == nrev
+    # set image with unknown container: refused, unwritten
+    run(cs, "run", "web", "--image", "nginx:1.13")
+    drev = cs.deployments.get("web").meta.resource_version
+    rc, _ = run(cs, "set", "image", "deployment/web", "nope=img")
+    assert rc == 1
+    assert cs.deployments.get("web").meta.resource_version == drev
+
+
+def test_discovery_verbs_unreachable_server():
+    out = io.StringIO()
+    rc = kubectl_main(["--server", "http://127.0.0.1:1", "api-versions"], out=out)
+    assert rc == 1 and "could not reach server" in out.getvalue()
+    out = io.StringIO()
+    rc = kubectl_main(["--server", "http://127.0.0.1:1", "api-resources"], out=out)
+    assert rc == 1 and "could not reach server" in out.getvalue()
