@@ -1,3 +1,3 @@
 """HTTP API server: the store over REST + watch streams (SURVEY.md L3/L4)."""
 
-from .server import APIServer
+from .server import APIServer, TLSConfig

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import logging
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -50,6 +51,32 @@ logger = logging.getLogger("kubernetes_tpu.apiserver")
 # SelfSubjectAccessReview route (reference authorization.k8s.io group,
 # served by the generic apiserver; evaluated against the live authorizer)
 SSAR_PATH = "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews"
+
+
+class TLSConfig:
+    """Serving-side TLS for the wire server (reference
+    ``--tls-cert-file``/``--tls-private-key-file``/``--client-ca-file``).
+    With ``client_ca`` set, the handshake REQUESTS (not requires) a client
+    certificate and verifies it against the CA; a verified peer cert
+    becomes the request identity via
+    ``X509CertificateAuthenticator.from_peercert`` — token-bearing clients
+    still authenticate normally without one."""
+
+    def __init__(self, certfile: str, keyfile: str,
+                 client_ca: Optional[str] = None):
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.client_ca = client_ca
+
+    def context(self):
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.certfile, self.keyfile)
+        if self.client_ca:
+            ctx.load_verify_locations(self.client_ca)
+            ctx.verify_mode = ssl.CERT_OPTIONAL
+        return ctx
 
 # resource path segment -> kind, derived from the one type registry so
 # every registered kind (incl. late-registered CRDs) is wire-addressable.
@@ -76,8 +103,10 @@ class APIServer:
         authenticator=None,
         authorizer=None,
         auditor=None,
+        tls: Optional["TLSConfig"] = None,
     ):
         self.store = store
+        self.tls = tls
         self.tokens = tokens
         self.authenticator = authenticator
         if authenticator is None and tokens is not None:
@@ -96,13 +125,45 @@ class APIServer:
             Histogram("apiserver_request_latencies_microseconds")
         )
         handler = _make_handler(self)
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        if tls is not None:
+            # The handshake must run in the per-connection worker thread,
+            # never the accept loop: a client that connects and trickles
+            # (or withholds) its ClientHello would otherwise block accept()
+            # and deny service to everyone.
+            ctx = tls.context()
+
+            class _TLSServer(ThreadingHTTPServer):
+                def get_request(self):
+                    sock, addr = self.socket.accept()
+                    return ctx.wrap_socket(
+                        sock, server_side=True, do_handshake_on_connect=False
+                    ), addr
+
+                def finish_request(self, request, client_address):
+                    request.settimeout(10.0)
+                    request.do_handshake()
+                    request.settimeout(None)
+                    super().finish_request(request, client_address)
+
+                def handle_error(self, request, client_address):
+                    import ssl as _ssl
+
+                    exc = sys.exc_info()[1]
+                    if isinstance(exc, (_ssl.SSLError, TimeoutError,
+                                        ConnectionError, OSError)):
+                        return  # dropped/garbage handshakes are routine
+                    super().handle_error(request, client_address)
+
+            self.httpd = _TLSServer((host, port), handler)
+        else:
+            self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://{self.httpd.server_address[0]}:{self.port}"
+        scheme = "https" if self.tls is not None else "http"
+        return f"{scheme}://{self.httpd.server_address[0]}:{self.port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -203,7 +264,16 @@ def _make_handler(server: APIServer):
             Returns False (response already sent) on 401/403."""
             self._user = None
             if server.authenticator is not None:
-                user = server.authenticator.authenticate(self.headers)
+                user = None
+                if server.tls is not None and server.tls.client_ca:
+                    # the reference's x509 path: the TLS handshake already
+                    # verified the chain; map the peer subject to identity
+                    from ..auth.authn import X509CertificateAuthenticator
+
+                    peercert = getattr(self.connection, "getpeercert", lambda: None)()
+                    user = X509CertificateAuthenticator.from_peercert(peercert)
+                if user is None:
+                    user = server.authenticator.authenticate(self.headers)
                 if user is None:
                     self._error(401, "Unauthorized", "invalid or missing credentials")
                     return False

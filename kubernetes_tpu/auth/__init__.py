@@ -12,12 +12,15 @@ from .authn import (
     BootstrapTokenAuthenticator,
     ANONYMOUS,
     Authenticator,
+    OIDCAuthenticator,
     RequestHeaderAuthenticator,
     ServiceAccountTokenAuthenticator,
     ServiceAccountTokenMinter,
     TokenFileAuthenticator,
     UnionAuthenticator,
     UserInfo,
+    WebhookTokenAuthenticator,
+    X509CertificateAuthenticator,
 )
 from .authz import (
     ALLOW,

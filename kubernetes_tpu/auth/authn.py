@@ -139,6 +139,247 @@ class ServiceAccountTokenAuthenticator(Authenticator):
         )
 
 
+class X509CertificateAuthenticator(Authenticator):
+    """Client-certificate identity (reference
+    ``apiserver/pkg/authentication/request/x509``): subject CN is the
+    user, O entries are the groups.
+
+    Two ingestion paths, both ending in the same subject mapping:
+
+    - **TLS handshake** (the reference's own path): the wire server
+      verifies the chain against the client CA during the handshake and
+      hands the peer-cert subject to :meth:`from_peercert`.
+    - **PEM header** (front-proxy style, for plain-HTTP deployments): the
+      proxy forwards the client cert in ``X-Client-Certificate``
+      (base64 PEM); :meth:`authenticate` verifies the CA signature and
+      validity window before trusting the subject.  Because a certificate
+      alone proves nothing about who SENT it (certs are public artifacts),
+      this path additionally requires the proxy to authenticate itself
+      with ``proxy_secret`` in ``X-Proxy-Authorization`` — the analogue of
+      the reference requiring the front proxy's own client cert
+      (``--requestheader-client-ca-file``).  Without a configured
+      ``proxy_secret`` the header path is disabled entirely.
+    """
+
+    HEADER = "X-Client-Certificate"
+    PROXY_HEADER = "X-Proxy-Authorization"
+
+    def __init__(self, ca_pem: Optional[bytes] = None,
+                 proxy_secret: Optional[str] = None, clock=None):
+        import time
+
+        self.ca_pem = ca_pem
+        self.proxy_secret = proxy_secret
+        self.clock = clock or time.time
+
+    @staticmethod
+    def from_peercert(peercert: Optional[dict]) -> Optional[UserInfo]:
+        """Map an ``ssl.SSLSocket.getpeercert()`` dict (chain already
+        verified by the handshake) to a UserInfo."""
+        if not peercert:
+            return None
+        name, groups = "", []
+        for rdn in peercert.get("subject", ()):
+            for key, value in rdn:
+                if key == "commonName":
+                    name = value
+                elif key == "organizationName":
+                    groups.append(value)
+        return UserInfo(name=name, groups=groups) if name else None
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        raw = headers.get(self.HEADER, "")
+        if not raw or self.ca_pem is None or not self.proxy_secret:
+            return None
+        if not hmac.compare_digest(
+            headers.get(self.PROXY_HEADER, ""), self.proxy_secret
+        ):
+            return None
+        try:
+            pem = _unb64(raw)
+        except Exception:
+            return None
+        return self._verify_pem(pem)
+
+    def _verify_pem(self, pem: bytes) -> Optional[UserInfo]:
+        try:
+            from cryptography import x509 as cx509
+            from cryptography.x509.oid import NameOID
+
+            cert = cx509.load_pem_x509_certificate(pem)
+            ca = cx509.load_pem_x509_certificate(self.ca_pem)
+            cert.verify_directly_issued_by(ca)
+        except Exception:
+            return None
+        import datetime
+
+        now = datetime.datetime.fromtimestamp(self.clock(), tz=datetime.timezone.utc)
+        if not (cert.not_valid_before_utc <= now <= cert.not_valid_after_utc):
+            return None
+        cn = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+        orgs = cert.subject.get_attributes_for_oid(NameOID.ORGANIZATION_NAME)
+        if not cn:
+            return None
+        return UserInfo(name=cn[0].value, groups=[o.value for o in orgs])
+
+
+class WebhookTokenAuthenticator(Authenticator):
+    """Delegates bearer tokens to an external TokenReview service
+    (reference ``plugin/pkg/auth/authenticator/token/webhook``): POST a
+    TokenReview, trust the returned user on ``status.authenticated``.
+    Verdicts are cached with a TTL (the reference's 2-minute cache) so a
+    flood of requests doesn't hammer the webhook."""
+
+    CACHE_MAX = 4096
+
+    def __init__(self, url: str, timeout: float = 5.0, cache_ttl: float = 120.0,
+                 clock=None):
+        import time
+
+        self.url = url
+        self.timeout = timeout
+        self.cache_ttl = cache_ttl
+        self.clock = clock or time.time
+        self._cache: dict[str, tuple[float, Optional[UserInfo]]] = {}
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return None
+        token = auth[7:]
+        hit = self._cache.get(token)
+        if hit is not None and self.clock() - hit[0] < self.cache_ttl:
+            return hit[1]
+        user = self._review(token)
+        now = self.clock()
+        if len(self._cache) >= self.CACHE_MAX:
+            # evict expired entries; if still full (an unauthenticated
+            # flood of distinct tokens), drop the oldest — the cache must
+            # not be a memory-exhaustion vector
+            self._cache = {t: v for t, v in self._cache.items()
+                           if now - v[0] < self.cache_ttl}
+            while len(self._cache) >= self.CACHE_MAX:
+                self._cache.pop(next(iter(self._cache)))
+        self._cache[token] = (now, user)
+        return user
+
+    def _review(self, token: str) -> Optional[UserInfo]:
+        import urllib.request
+
+        body = json.dumps({"kind": "TokenReview",
+                           "spec": {"token": token}}).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                status = json.loads(r.read()).get("status") or {}
+        except Exception:
+            # an unreachable webhook must fail closed for ITS tokens but
+            # stay out of the way of other authenticators in the union
+            return None
+        if not status.get("authenticated"):
+            return None
+        user = status.get("user") or {}
+        if not user.get("username"):
+            return None
+        return UserInfo(name=user["username"], groups=list(user.get("groups") or []))
+
+
+class OIDCAuthenticator(Authenticator):
+    """OIDC-style JWT validation (reference
+    ``plugin/pkg/auth/authenticator/token/oidc``): verify signature,
+    issuer, audience and expiry, then map the username/groups claims.
+    Verification keys are supplied out-of-band (the reference fetches
+    JWKS from the issuer; this deployment has no egress, so the key is
+    config): HS256 with a shared secret, or RS256 with an RSA public key
+    when the ``cryptography`` backend is present."""
+
+    def __init__(self, issuer: str, audience: str, key,
+                 username_claim: str = "sub", groups_claim: str = "groups",
+                 username_prefix: str = "", alg: Optional[str] = None,
+                 clock=None):
+        import time
+
+        self.issuer = issuer
+        self.audience = audience
+        self.key = key
+        self.username_claim = username_claim
+        self.groups_claim = groups_claim
+        self.username_prefix = username_prefix
+        # The accepted algorithm is FIXED at configuration time — never
+        # taken from the token header, or an attacker could downgrade an
+        # RS256 deployment to HS256 and use the (public!) RSA key PEM as
+        # the HMAC secret to forge identities.
+        if alg is None:
+            key_bytes = key if isinstance(key, (bytes, str)) else None
+            if key_bytes is not None:
+                kb = key_bytes if isinstance(key_bytes, bytes) else key_bytes.encode()
+                alg = "RS256" if kb.lstrip().startswith(b"-----BEGIN") else "HS256"
+            else:
+                alg = "RS256"  # loaded public-key object
+        self.alg = alg
+        self.clock = clock or time.time
+
+    def authenticate(self, headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer ") or auth.count(".") != 2:
+            return None
+        token = auth[7:]
+        try:
+            h64, p64, s64 = token.split(".")
+            header = json.loads(_unb64(h64))
+            claims = json.loads(_unb64(p64))
+            sig = _unb64(s64)
+            if not isinstance(header, dict) or not isinstance(claims, dict):
+                return None
+            # issuer gate FIRST: a token from another issuer is "not my
+            # credential type" and must fall through in a union
+            if claims.get("iss") != self.issuer:
+                return None
+            if header.get("alg") != self.alg:
+                return None
+            if not self._verify_sig(self.alg, f"{h64}.{p64}".encode(), sig):
+                return None
+            aud = claims.get("aud")
+            if self.audience not in (aud if isinstance(aud, list) else [aud]):
+                return None
+            if "exp" in claims and float(claims["exp"]) <= self.clock():
+                return None
+            name = claims.get(self.username_claim, "")
+            if not name:
+                return None
+            groups = claims.get(self.groups_claim) or []
+            if isinstance(groups, str):
+                groups = [groups]
+            return UserInfo(name=self.username_prefix + str(name),
+                            groups=[str(g) for g in groups])
+        except Exception:
+            # malformed claims must read as a bad credential (401), never
+            # crash the request thread
+            return None
+
+    def _verify_sig(self, alg: str, signed: bytes, sig: bytes) -> bool:
+        if alg == "HS256" and isinstance(self.key, (bytes, str)):
+            key = self.key if isinstance(self.key, bytes) else self.key.encode()
+            return hmac.compare_digest(
+                sig, hmac.new(key, signed, hashlib.sha256).digest())
+        if alg == "RS256":
+            try:
+                from cryptography.hazmat.primitives import hashes, serialization
+                from cryptography.hazmat.primitives.asymmetric import padding
+
+                key = self.key
+                if isinstance(key, (bytes, str)):
+                    pem = key if isinstance(key, bytes) else key.encode()
+                    key = serialization.load_pem_public_key(pem)
+                key.verify(sig, signed, padding.PKCS1v15(), hashes.SHA256())
+                return True
+            except Exception:
+                return False
+        return False
+
+
 class UnionAuthenticator(Authenticator):
     """First authenticator that recognizes the credential wins (reference
     ``authentication/request/union``)."""

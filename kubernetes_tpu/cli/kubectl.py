@@ -41,6 +41,29 @@ class _AbortMutation(Exception):
     spurious MODIFIED event would wake every watcher)."""
 
 
+class _NoopMutation(Exception):
+    """The mutation produced an identical object — report success but skip
+    the write (no revision bump, no content-free MODIFIED event)."""
+
+
+def _update_if_changed(client, name, mutate, namespace):
+    """guaranteed_update that aborts when the object comes out unchanged.
+    Returns True if a write happened, False on a no-op."""
+
+    def _mutate(obj):
+        before = obj.to_dict()
+        new = mutate(obj)
+        if new.to_dict() == before:
+            raise _NoopMutation
+        return new
+
+    try:
+        client.guaranteed_update(name, _mutate, namespace)
+        return True
+    except _NoopMutation:
+        return False
+
+
 def _parse_selector(spec: str):
     """kubectl's equality selector forms: "k=v", "k==v", "k!=v", comma
     separated.  Returns [(key, op, value)] or None on a malformed (or
@@ -544,37 +567,33 @@ class Kubectl:
              container: str = "", tail: int = 0) -> int:
         """``kubectl logs`` via the pod/log subresource (apiserver proxies
         to the owning node's kubelet read API)."""
-        ns = namespace or "default"
-        base = getattr(self.cs.store, "base_url", None)
-        if base is None:
-            # in-proc clientset: reach the kubelet URL directly
-            resolved = self._kubelet_target(name, ns, container)
-            if resolved is None:
-                return 1
-            kubelet_url, c, _ = resolved
-            url = f"{kubelet_url}/containerLogs/{ns}/{name}/{c}"
-            if tail:
-                url += f"?tailLines={tail}"
-        else:
-            url = f"{base}/api/v1/namespaces/{ns}/pods/{name}/log"
-            sep = "?"
-            if container:
-                url += f"{sep}container={container}"
-                sep = "&"
-            if tail:
-                url += f"{sep}tailLines={tail}"
         import urllib.error
         import urllib.request
 
-        req = urllib.request.Request(url)
-        token = getattr(self.cs.store, "token", None)
-        if base is not None and token:
-            # the other verbs authenticate via RemoteStore; this direct
-            # fetch must carry the same credential
-            req.add_header("Authorization", f"Bearer {token}")
+        ns = namespace or "default"
+        base = getattr(self.cs.store, "base_url", None)
         try:
-            with urllib.request.urlopen(req, timeout=10) as r:
-                self.out.write(r.read().decode())
+            if base is None:
+                # in-proc clientset: reach the kubelet URL directly
+                resolved = self._kubelet_target(name, ns, container)
+                if resolved is None:
+                    return 1
+                kubelet_url, c, _ = resolved
+                url = f"{kubelet_url}/containerLogs/{ns}/{name}/{c}"
+                if tail:
+                    url += f"?tailLines={tail}"
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    self.out.write(r.read().decode())
+            else:
+                path = f"/api/v1/namespaces/{ns}/pods/{name}/log"
+                sep = "?"
+                if container:
+                    path += f"{sep}container={container}"
+                    sep = "&"
+                if tail:
+                    path += f"{sep}tailLines={tail}"
+                # through the store: same credential AND same TLS context
+                self.out.write(self.cs.store.raw("GET", path).decode())
             return 0
         except urllib.error.HTTPError as e:
             self.out.write(f"error: {e.read().decode()}\n")
@@ -592,33 +611,30 @@ class Kubectl:
 
         ns = namespace or "default"
         base = getattr(self.cs.store, "base_url", None)
-        exec_node = None
-        if base is None:
-            resolved = self._kubelet_target(name, ns, container)
-            if resolved is None:
-                return 1
-            kubelet_url, c, exec_node = resolved
-            url = f"{kubelet_url}/exec/{ns}/{name}/{c}"
-        else:
-            url = f"{base}/api/v1/namespaces/{ns}/pods/{name}/exec"
-            if container:
-                url += f"?container={container}"
-        req = urllib.request.Request(
-            url, data=_json.dumps({"command": command}).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        if base is not None:
-            token = getattr(self.cs.store, "token", None)
-            if token:
-                req.add_header("Authorization", f"Bearer {token}")
-        else:
-            # direct kubelet path: mint the cluster-key exec credential
-            from ..auth.authn import kubelet_exec_token
-
-            req.add_header("Authorization", f"Bearer {kubelet_exec_token(exec_node)}")
         try:
-            with urllib.request.urlopen(req, timeout=30) as r:
-                out = _json.loads(r.read())
+            if base is None:
+                resolved = self._kubelet_target(name, ns, container)
+                if resolved is None:
+                    return 1
+                kubelet_url, c, exec_node = resolved
+                # direct kubelet path: mint the cluster-key exec credential
+                from ..auth.authn import kubelet_exec_token
+
+                req = urllib.request.Request(
+                    f"{kubelet_url}/exec/{ns}/{name}/{c}",
+                    data=_json.dumps({"command": command}).encode(),
+                    headers={"Content-Type": "application/json",
+                             "Authorization": f"Bearer {kubelet_exec_token(exec_node)}"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    out = _json.loads(r.read())
+            else:
+                path = f"/api/v1/namespaces/{ns}/pods/{name}/exec"
+                if container:
+                    path += f"?container={container}"
+                out = _json.loads(self.cs.store.raw(
+                    "POST", path, body={"command": command}, timeout=30))
         except urllib.error.HTTPError as e:
             self.out.write(f"error: {e.read().decode()}\n")
             return 1
@@ -731,7 +747,7 @@ class Kubectl:
             return obj
 
         try:
-            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
         except _AbortMutation:
             self.out.write(
                 f"error: {err[0][0]!r} already has a value; use --overwrite\n")
@@ -836,14 +852,15 @@ class Kubectl:
             return new
 
         try:
-            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+            wrote = _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
         except _AbortMutation:
             self.out.write(f"error: cannot apply patch: {errors[0]}\n")
             return 1
         except (NotFoundError, KeyError):
             self.out.write(f'Error: {resource} "{name}" not found\n')
             return 1
-        self.out.write(f"{resource}/{name} patched\n")
+        self.out.write(f"{resource}/{name} patched"
+                       f"{'' if wrote else ' (no change)'}\n")
         return 0
 
     # -- taint (cmd/taint.go) ----------------------------------------------
@@ -891,14 +908,14 @@ class Kubectl:
             return node
 
         try:
-            self.cs.nodes.guaranteed_update(name, _mutate, "")
+            wrote = _update_if_changed(self.cs.nodes, name, _mutate, "")
         except _AbortMutation:
             self.out.write(f"error: taint {missing[0]!r} not found\n")
             return 1
         except (NotFoundError, KeyError):
             self.out.write(f'Error: node "{name}" not found\n')
             return 1
-        self.out.write(f"node/{name} {msgs[-1] if msgs else 'unchanged'}\n")
+        self.out.write(f"node/{name} {msgs[-1] if wrote and msgs else 'unchanged'}\n")
         return 0
 
     # -- expose / run / autoscale (imperative generators) ------------------
@@ -1051,7 +1068,7 @@ class Kubectl:
             return obj
 
         try:
-            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
         except _AbortMutation:
             self.out.write(f"error: unable to find container {missing[0]!r}\n")
             return 1
@@ -1089,7 +1106,7 @@ class Kubectl:
             return obj
 
         try:
-            self.cs.client_for(kind).guaranteed_update(name, _mutate, namespace)
+            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
         except (NotFoundError, KeyError):
             self.out.write(f'Error: {resource} "{name}" not found\n')
             return 1
@@ -1102,31 +1119,20 @@ class Kubectl:
         """POSTs a SelfSubjectAccessReview; the server evaluates its live
         authorizer for the calling identity.  Exit 0 yes / 1 no."""
         plural, _ = _resolve(resource)
-        base = getattr(self.cs.store, "base_url", None)
-        if base is None:
+        if getattr(self.cs.store, "base_url", None) is None:
             # in-proc clientset bypasses the filter chain entirely: every
             # verb IS allowed, so say so rather than guess at policy
             self.out.write("yes\n")
             return 0
-        import urllib.error
-        import urllib.request
-
-        body = json.dumps({"spec": {"resourceAttributes": {
+        body = {"spec": {"resourceAttributes": {
             "verb": verb, "resource": plural, "name": name,
             "namespace": namespace or "default",
-        }}}).encode()
-        req = urllib.request.Request(
-            f"{base}/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
-            data=body, headers={"Content-Type": "application/json"}, method="POST")
-        token = getattr(self.cs.store, "token", None)
-        if token:
-            req.add_header("Authorization", f"Bearer {token}")
+        }}}
         try:
-            with urllib.request.urlopen(req, timeout=10) as r:
-                status = json.loads(r.read()).get("status") or {}
-        except urllib.error.HTTPError as e:
-            self.out.write(f"error: {e}\n")
-            return 1
+            resp = self.cs.store.raw(
+                "POST", "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+                body=body)
+            status = json.loads(resp).get("status") or {}
         except Exception as e:
             self.out.write(f"error: {e}\n")
             return 1
@@ -1135,17 +1141,12 @@ class Kubectl:
 
     # -- discovery verbs ---------------------------------------------------
     def api_versions(self) -> int:
-        base = getattr(self.cs.store, "base_url", None)
         versions = ["v1"]
-        if base is not None:
-            import urllib.request
-
+        if getattr(self.cs.store, "base_url", None) is not None:
             try:
-                with urllib.request.urlopen(f"{base}/api", timeout=10) as r:
-                    versions = json.loads(r.read()).get("versions", ["v1"])
-                with urllib.request.urlopen(f"{base}/apis", timeout=10) as r:
-                    for g in json.loads(r.read()).get("groups", []):
-                        versions.append(g["name"])
+                versions = json.loads(self.cs.store.raw("GET", "/api")).get("versions", ["v1"])
+                for g in json.loads(self.cs.store.raw("GET", "/apis")).get("groups", []):
+                    versions.append(g["name"])
             except Exception as e:
                 self.out.write(f"error: could not reach server: {e}\n")
                 return 1
@@ -1162,11 +1163,8 @@ class Kubectl:
         for s, plural in _SHORT_NAMES.items():
             short_by_plural.setdefault(plural, []).append(s)
         if base is not None:
-            import urllib.request
-
             try:
-                with urllib.request.urlopen(f"{base}/api/v1", timeout=10) as r:
-                    resources = json.loads(r.read()).get("resources", [])
+                resources = json.loads(self.cs.store.raw("GET", "/api/v1")).get("resources", [])
             except Exception as e:
                 self.out.write(f"error: could not reach server: {e}\n")
                 return 1
@@ -1186,13 +1184,10 @@ class Kubectl:
         from .. import __version__
 
         self.out.write(f"Client Version: {__version__}\n")
-        base = getattr(self.cs.store, "base_url", None)
-        if base is not None:
-            import urllib.request
-
+        if getattr(self.cs.store, "base_url", None) is not None:
             try:
-                with urllib.request.urlopen(f"{base}/version", timeout=10) as r:
-                    self.out.write(f"Server Version: {json.loads(r.read())['version']}\n")
+                data = json.loads(self.cs.store.raw("GET", "/version"))
+                self.out.write(f"Server Version: {data['version']}\n")
             except Exception as e:
                 self.out.write(f"error: could not reach server: {e}\n")
                 return 1
@@ -1203,11 +1198,8 @@ class Kubectl:
         if base is None:
             self.out.write("Kubernetes master is running in-process\n")
             return 0
-        import urllib.request
-
         try:
-            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
-                ok = json.loads(r.read()).get("status") == "ok"
+            ok = json.loads(self.cs.store.raw("GET", "/healthz")).get("status") == "ok"
         except Exception:
             ok = False
         self.out.write(f"Kubernetes master is running at {base} "

@@ -106,17 +106,39 @@ class RemoteWatch:
 class RemoteStore:
     """Store-interface adapter over the REST API."""
 
-    def __init__(self, base_url: str, token: Optional[str] = None, timeout: float = 10.0):
+    def __init__(self, base_url: str, token: Optional[str] = None, timeout: float = 10.0,
+                 ca_file: Optional[str] = None, client_cert: Optional[str] = None,
+                 client_key: Optional[str] = None):
+        """``ca_file`` pins the server CA for https:// servers;
+        ``client_cert``/``client_key`` present an x509 client identity
+        (reference kubeconfig certificate-authority / client-certificate)."""
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self._ssl_ctx = None
+        if base_url.startswith("https://"):
+            import ipaddress
+            import ssl
+            from urllib.parse import urlparse as _urlparse
+
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
+            try:
+                ipaddress.ip_address(_urlparse(base_url).hostname or "")
+                # IP-addressed test clusters: certs rarely carry IP SANs;
+                # chain verification against the pinned CA still applies.
+                # DNS-named servers keep full hostname verification.
+                self._ssl_ctx.check_hostname = False
+            except ValueError:
+                pass
+            if client_cert:
+                self._ssl_ctx.load_cert_chain(client_cert, client_key)
 
     # -- http --------------------------------------------------------------
     def _open(self, url: str):
         req = urllib.request.Request(url)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        return urllib.request.urlopen(req, timeout=self.timeout)
+        return urllib.request.urlopen(req, timeout=self.timeout, context=self._ssl_ctx)
 
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
@@ -129,12 +151,30 @@ class RemoteStore:
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl_ctx) as resp:
                 out = json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             out = json.loads(e.read().decode())
         _raise_for_status(out)
         return out
+
+    def raw(self, method: str, path: str, body: Optional[dict] = None,
+            timeout: Optional[float] = None) -> bytes:
+        """Raw request carrying the store's credential and TLS context —
+        the path for non-resource endpoints (discovery, /version,
+        /healthz, subresource streams) so callers never hand-roll a
+        urlopen that would drop the token or the pinned CA."""
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {})
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(
+            req, timeout=timeout or self.timeout, context=self._ssl_ctx
+        ) as resp:
+            return resp.read()
 
     @staticmethod
     def _ns_path(namespace: str) -> str:

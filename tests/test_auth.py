@@ -330,3 +330,289 @@ def test_present_but_invalid_bearer_is_401_even_with_anonymous():
     assert lax.authenticate({}) is ANONYMOUS
     assert lax.authenticate({"Authorization": "Bearer WRONG"}) is None
     assert lax.authenticate({"Authorization": "Basic dXNlcjpwdw=="}) is None
+
+
+# -- round-2 authenticator breadth (x509 / webhook / OIDC / TLS) -----------
+
+
+def _openssl(*args):
+    import subprocess
+
+    subprocess.run(["openssl", *args], check=True, capture_output=True)
+
+
+def _make_pki(dirpath):
+    """CA + server cert + client cert (CN=alice, O=devs) via openssl."""
+    ca_key, ca_crt = f"{dirpath}/ca.key", f"{dirpath}/ca.crt"
+    _openssl("req", "-x509", "-newkey", "rsa:2048", "-nodes", "-keyout", ca_key,
+             "-out", ca_crt, "-subj", "/CN=test-ca", "-days", "1")
+    for name, subj in (("server", "/CN=127.0.0.1"), ("client", "/CN=alice/O=devs")):
+        key, csr, crt = (f"{dirpath}/{name}.key", f"{dirpath}/{name}.csr",
+                         f"{dirpath}/{name}.crt")
+        _openssl("req", "-newkey", "rsa:2048", "-nodes", "-keyout", key,
+                 "-out", csr, "-subj", subj)
+        _openssl("x509", "-req", "-in", csr, "-CA", ca_crt, "-CAkey", ca_key,
+                 "-CAcreateserial", "-out", crt, "-days", "1")
+    return ca_crt, f"{dirpath}/server.crt", f"{dirpath}/server.key", \
+        f"{dirpath}/client.crt", f"{dirpath}/client.key"
+
+
+def test_x509_over_real_tls(tmp_path):
+    """TLS handshake verifies the client chain; the peer cert subject
+    (CN=alice, O=devs) becomes the request identity and flows through
+    RBAC."""
+    from kubernetes_tpu.api.rbac import ClusterRole, ClusterRoleBinding, PolicyRule, Subject
+    from kubernetes_tpu.apiserver import APIServer, TLSConfig
+    from kubernetes_tpu.auth import RBACAuthorizer, TokenFileAuthenticator, UnionAuthenticator
+    from kubernetes_tpu.client.remote import RemoteStore
+
+    ca, server_crt, server_key, client_crt, client_key = _make_pki(tmp_path)
+    store = Store()
+    store.create("ClusterRole", ClusterRole(
+        meta=ObjectMeta(name="reader"),
+        rules=[PolicyRule(verbs=["get", "list"], resources=["nodes"])]).to_dict())
+    store.create("ClusterRoleBinding", ClusterRoleBinding(
+        meta=ObjectMeta(name="devs-read"), role_name="reader",
+        subjects=[Subject(kind="Group", name="devs")]).to_dict())
+    server = APIServer(
+        store,
+        authenticator=UnionAuthenticator(TokenFileAuthenticator({}),
+                                         allow_anonymous=False),
+        authorizer=RBACAuthorizer(store),
+        tls=TLSConfig(server_crt, server_key, client_ca=ca),
+    )
+    server.start()
+    try:
+        assert server.url.startswith("https://")
+        rs = RemoteStore(server.url, ca_file=ca,
+                         client_cert=client_crt, client_key=client_key)
+        items, _ = rs.list("Node", None)  # allowed via group O=devs
+        assert items == []
+        with pytest.raises(Exception):  # no delete rights for alice
+            rs.delete("Node", "", "ghost")
+        # no client cert + no token = 401
+        anon = RemoteStore(server.url, ca_file=ca)
+        with pytest.raises(Exception):
+            anon.list("Node", None)
+    finally:
+        server.stop()
+
+
+def test_x509_pem_header_path(tmp_path):
+    """Front-proxy form: base64 PEM in X-Client-Certificate, verified
+    against the CA in-process."""
+    import base64
+
+    from kubernetes_tpu.auth import X509CertificateAuthenticator
+
+    ca, _, _, client_crt, _ = _make_pki(tmp_path)
+    authn = X509CertificateAuthenticator(ca_pem=open(ca, "rb").read(),
+                                         proxy_secret="proxy-pw")
+    pem64 = base64.urlsafe_b64encode(open(client_crt, "rb").read()).decode()
+    hdrs = {"X-Client-Certificate": pem64, "X-Proxy-Authorization": "proxy-pw"}
+    user = authn.authenticate(hdrs)
+    assert user is not None and user.name == "alice" and user.groups == ["devs"]
+    # a (public!) certificate alone proves nothing: without the proxy's
+    # own credential the header path must be rejected
+    assert authn.authenticate({"X-Client-Certificate": pem64}) is None
+    assert authn.authenticate({"X-Client-Certificate": pem64,
+                               "X-Proxy-Authorization": "wrong"}) is None
+    # and with no proxy_secret configured the path is disabled entirely
+    no_proxy = X509CertificateAuthenticator(ca_pem=open(ca, "rb").read())
+    assert no_proxy.authenticate(hdrs) is None
+    # a cert from a DIFFERENT CA must be rejected
+    other = tmp_path / "other"
+    other.mkdir()
+    _, _, _, rogue_crt, _ = _make_pki(other)
+    rogue64 = base64.urlsafe_b64encode(open(rogue_crt, "rb").read()).decode()
+    assert authn.authenticate({"X-Client-Certificate": rogue64,
+                               "X-Proxy-Authorization": "proxy-pw"}) is None
+    # an expired cert must be rejected even with a valid chain
+    future = X509CertificateAuthenticator(
+        ca_pem=open(ca, "rb").read(), proxy_secret="proxy-pw",
+        clock=lambda: 4102444800.0)  # year 2100
+    assert future.authenticate(hdrs) is None
+    # garbage header
+    assert authn.authenticate({"X-Client-Certificate": "!!!",
+                               "X-Proxy-Authorization": "proxy-pw"}) is None
+
+
+def test_webhook_token_authenticator_and_cache():
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from kubernetes_tpu.auth import WebhookTokenAuthenticator
+
+    calls = []
+
+    class Hook(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = _json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+            token = body["spec"]["token"]
+            calls.append(token)
+            if token == "good":
+                out = {"status": {"authenticated": True,
+                                  "user": {"username": "webhook-user",
+                                           "groups": ["g1"]}}}
+            else:
+                out = {"status": {"authenticated": False}}
+            data = _json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = HTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_port}/"
+        authn = WebhookTokenAuthenticator(url)
+        user = authn.authenticate({"Authorization": "Bearer good"})
+        assert user is not None and user.name == "webhook-user" and user.groups == ["g1"]
+        assert authn.authenticate({"Authorization": "Bearer bad"}) is None
+        # verdicts (positive AND negative) are cached: no extra webhook calls
+        authn.authenticate({"Authorization": "Bearer good"})
+        authn.authenticate({"Authorization": "Bearer bad"})
+        assert calls == ["good", "bad"]
+        # not-bearer requests never reach the webhook
+        assert authn.authenticate({}) is None
+    finally:
+        httpd.shutdown()
+    # unreachable webhook fails closed
+    dead = WebhookTokenAuthenticator("http://127.0.0.1:1/", timeout=0.2)
+    assert dead.authenticate({"Authorization": "Bearer good"}) is None
+
+
+def _hs256_jwt(claims, key=b"oidc-secret"):
+    import base64
+    import hashlib
+    import hmac as _hmac
+    import json as _json
+
+    def b64(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    h = b64(_json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    p = b64(_json.dumps(claims).encode())
+    s = b64(_hmac.new(key, f"{h}.{p}".encode(), hashlib.sha256).digest())
+    return f"{h}.{p}.{s}"
+
+
+def test_oidc_authenticator_hs256():
+    from kubernetes_tpu.auth import OIDCAuthenticator
+
+    authn = OIDCAuthenticator(
+        issuer="https://issuer.example", audience="kube", key=b"oidc-secret",
+        username_claim="email", groups_claim="groups",
+        username_prefix="oidc:", clock=lambda: 1000.0)
+    good = _hs256_jwt({"iss": "https://issuer.example", "aud": "kube",
+                       "email": "bob@example.com", "groups": ["dev", "ops"],
+                       "exp": 2000})
+    user = authn.authenticate({"Authorization": f"Bearer {good}"})
+    assert user is not None
+    assert user.name == "oidc:bob@example.com" and user.groups == ["dev", "ops"]
+    # other issuer: not my credential -> None (falls through in a union)
+    other = _hs256_jwt({"iss": "https://other", "aud": "kube", "email": "x"})
+    assert authn.authenticate({"Authorization": f"Bearer {other}"}) is None
+    # wrong audience
+    bad_aud = _hs256_jwt({"iss": "https://issuer.example", "aud": "nope",
+                          "email": "x", "exp": 2000})
+    assert authn.authenticate({"Authorization": f"Bearer {bad_aud}"}) is None
+    # expired
+    expired = _hs256_jwt({"iss": "https://issuer.example", "aud": "kube",
+                          "email": "x", "exp": 500})
+    assert authn.authenticate({"Authorization": f"Bearer {expired}"}) is None
+    # tampered signature
+    assert authn.authenticate(
+        {"Authorization": f"Bearer {good[:-4]}AAAA"}) is None
+
+
+def test_oidc_authenticator_rs256():
+    import base64
+    import json as _json
+
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    from kubernetes_tpu.auth import OIDCAuthenticator
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub_pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo)
+
+    def b64(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    h = b64(_json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
+    p = b64(_json.dumps({"iss": "iss", "aud": "kube", "sub": "carol",
+                         "exp": 2000}).encode())
+    sig = key.sign(f"{h}.{p}".encode(), padding.PKCS1v15(), hashes.SHA256())
+    token = f"{h}.{p}.{b64(sig)}"
+    authn = OIDCAuthenticator(issuer="iss", audience="kube", key=pub_pem,
+                              clock=lambda: 1000.0)
+    user = authn.authenticate({"Authorization": f"Bearer {token}"})
+    assert user is not None and user.name == "carol"
+    # signature from a different RSA key fails
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    sig2 = other.sign(f"{h}.{p}".encode(), padding.PKCS1v15(), hashes.SHA256())
+    assert authn.authenticate(
+        {"Authorization": f"Bearer {h}.{p}.{b64(sig2)}"}) is None
+
+
+def test_oidc_rejects_algorithm_confusion():
+    """A token claiming alg=HS256 signed with the RSA PUBLIC key as HMAC
+    secret must be rejected on an RS256 deployment (the classic JWT
+    downgrade attack)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    from kubernetes_tpu.auth import OIDCAuthenticator
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub_pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo)
+    authn = OIDCAuthenticator(issuer="iss", audience="kube", key=pub_pem,
+                              clock=lambda: 1000.0)
+    assert authn.alg == "RS256"  # inferred from the PEM
+    forged = _hs256_jwt({"iss": "iss", "aud": "kube", "sub": "attacker",
+                         "exp": 2000}, key=pub_pem)
+    assert authn.authenticate({"Authorization": f"Bearer {forged}"}) is None
+
+
+def test_oidc_malformed_claims_do_not_crash():
+    import base64
+
+    from kubernetes_tpu.auth import OIDCAuthenticator
+
+    authn = OIDCAuthenticator(issuer="iss", audience="kube", key=b"k",
+                              clock=lambda: 1000.0)
+
+    def b64(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    # payload is a JSON array, not an object
+    arr = f"{b64(b'{}')}.{b64(b'[]')}.{b64(b'sig')}"
+    assert authn.authenticate({"Authorization": f"Bearer {arr}"}) is None
+    # exp is a non-numeric string
+    import json as _json
+
+    weird = _hs256_jwt({"iss": "iss", "aud": "kube", "sub": "x", "exp": "abc"},
+                       key=b"k")
+    assert authn.authenticate({"Authorization": f"Bearer {weird}"}) is None
+    # header is not an object
+    badh = f"{b64(b'[]')}.{b64(_json.dumps({'iss': 'iss'}).encode())}.{b64(b's')}"
+    assert authn.authenticate({"Authorization": f"Bearer {badh}"}) is None
+
+
+def test_webhook_cache_is_bounded():
+    from kubernetes_tpu.auth import WebhookTokenAuthenticator
+
+    authn = WebhookTokenAuthenticator("http://127.0.0.1:1/", timeout=0.05)
+    authn.CACHE_MAX = 10
+    for i in range(50):
+        authn.authenticate({"Authorization": f"Bearer junk-{i}"})
+    assert len(authn._cache) <= 10
