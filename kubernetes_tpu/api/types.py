@@ -678,6 +678,9 @@ class NodeStatus:
     # ``node.status.volumesInUse``): the attach/detach controller must not
     # detach these until the kubelet unmounts
     volumes_in_use: list[str] = field(default_factory=list)
+    # [{"type": "InternalIP"|"ExternalIP"|"Hostname", "address": ...}] —
+    # written by the cloud node controller (reference node.status.addresses)
+    addresses: list[dict] = field(default_factory=list)
 
     def condition(self, ctype: str) -> Optional[NodeCondition]:
         for c in self.conditions:
@@ -694,6 +697,7 @@ class NodeStatus:
             "volumesAttached": list(self.volumes_attached),
             "kubeletURL": self.kubelet_url,
             "volumesInUse": list(self.volumes_in_use),
+            "addresses": copy.deepcopy(self.addresses),
         }
 
     @classmethod
@@ -707,6 +711,7 @@ class NodeStatus:
             volumes_attached=list(d.get("volumesAttached") or []),
             kubelet_url=d.get("kubeletURL", ""),
             volumes_in_use=list(d.get("volumesInUse") or []),
+            addresses=copy.deepcopy(d.get("addresses") or []),
         )
 
 
@@ -810,6 +815,9 @@ class Service:
     cluster_ip: str = ""  # "" = allocate; "None" = headless
     type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer
     session_affinity: str = "None"  # None | ClientIP
+    # ingress IPs written by the cloud service controller for
+    # type=LoadBalancer (reference ``status.loadBalancer.ingress``)
+    status_load_balancer: list[str] = field(default_factory=list)
 
     KIND = "Service"
 
@@ -824,11 +832,17 @@ class Service:
                 "type": self.type,
                 "sessionAffinity": self.session_affinity,
             },
+            "status": {
+                "loadBalancer": {
+                    "ingress": [{"ip": ip} for ip in self.status_load_balancer]
+                }
+            },
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "Service":
         spec = d.get("spec") or {}
+        lb = ((d.get("status") or {}).get("loadBalancer") or {})
         return cls(
             meta=ObjectMeta.from_dict(d.get("metadata") or {}),
             selector=dict(spec.get("selector") or {}),
@@ -836,6 +850,9 @@ class Service:
             cluster_ip=spec.get("clusterIP", ""),
             type=spec.get("type", "ClusterIP"),
             session_affinity=spec.get("sessionAffinity", "None"),
+            status_load_balancer=[
+                i.get("ip", "") for i in lb.get("ingress") or [] if i.get("ip")
+            ],
         )
 
 

@@ -250,7 +250,12 @@ class WebhookTokenAuthenticator(Authenticator):
         hit = self._cache.get(token)
         if hit is not None and self.clock() - hit[0] < self.cache_ttl:
             return hit[1]
-        user = self._review(token)
+        try:
+            user = self._review(token)
+        except OSError:
+            # transport failure is NOT a verdict: don't poison the cache —
+            # the token gets re-reviewed as soon as the webhook recovers
+            return None
         now = self.clock()
         if len(self._cache) >= self.CACHE_MAX:
             # evict expired entries; if still full (an unauthenticated
@@ -264,6 +269,7 @@ class WebhookTokenAuthenticator(Authenticator):
         return user
 
     def _review(self, token: str) -> Optional[UserInfo]:
+        import urllib.error
         import urllib.request
 
         body = json.dumps({"kind": "TokenReview",
@@ -274,10 +280,14 @@ class WebhookTokenAuthenticator(Authenticator):
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 status = json.loads(r.read()).get("status") or {}
-        except Exception:
-            # an unreachable webhook must fail closed for ITS tokens but
-            # stay out of the way of other authenticators in the union
+        except urllib.error.HTTPError:
+            # the webhook answered with an error status: that IS a verdict
+            # (fail closed, cacheable)
             return None
+        except Exception as e:
+            # unreachable/timeout: fail closed for this request but let the
+            # caller skip the cache write
+            raise OSError(str(e)) from e
         if not status.get("authenticated"):
             return None
         user = status.get("user") or {}
@@ -344,7 +354,10 @@ class OIDCAuthenticator(Authenticator):
             aud = claims.get("aud")
             if self.audience not in (aud if isinstance(aud, list) else [aud]):
                 return None
-            if "exp" in claims and float(claims["exp"]) <= self.clock():
+            # exp is MANDATORY (OIDC Core requires it in ID tokens): a
+            # token without one would be valid forever and can never be
+            # invalidated
+            if "exp" not in claims or float(claims["exp"]) <= self.clock():
                 return None
             name = claims.get(self.username_claim, "")
             if not name:

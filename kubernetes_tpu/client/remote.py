@@ -122,14 +122,17 @@ class RemoteStore:
             from urllib.parse import urlparse as _urlparse
 
             self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
-            try:
-                ipaddress.ip_address(_urlparse(base_url).hostname or "")
-                # IP-addressed test clusters: certs rarely carry IP SANs;
-                # chain verification against the pinned CA still applies.
-                # DNS-named servers keep full hostname verification.
-                self._ssl_ctx.check_hostname = False
-            except ValueError:
-                pass
+            if ca_file:
+                try:
+                    ipaddress.ip_address(_urlparse(base_url).hostname or "")
+                    # IP-addressed clusters with a PINNED CA: certs rarely
+                    # carry IP SANs; chain verification against the pinned
+                    # CA still applies.  Without a pinned CA, hostname
+                    # verification stays on — any public cert would
+                    # otherwise pass.  DNS-named servers always verify.
+                    self._ssl_ctx.check_hostname = False
+                except ValueError:
+                    pass
             if client_cert:
                 self._ssl_ctx.load_cert_chain(client_cert, client_key)
 

@@ -1,0 +1,57 @@
+"""cloud-controller-manager daemon (reference
+``cmd/cloud-controller-manager/controller-manager.go``).
+
+    python -m kubernetes_tpu.cloud --apiserver http://host:6443 \
+        [--cloud-provider fake] [--leader-elect] [--controllers ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+
+from ..daemon import install_signal_stop, remote_clientset, run_with_leader_election
+from .manager import CLOUD_CONTROLLERS, CloudControllerManager
+from .provider import FakeCloud
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu.cloud")
+    ap.add_argument("--apiserver", required=True)
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--cloud-provider", default="fake", choices=["fake"])
+    ap.add_argument("--controllers", default="*",
+                    help="comma list or * (default: %s)" % ",".join(CLOUD_CONTROLLERS))
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--monitor-period", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    cs = remote_clientset(args.apiserver, args.token)
+    cloud = FakeCloud()
+    names = None if args.controllers == "*" else args.controllers.split(",")
+
+    def run(payload_stop: threading.Event) -> None:
+        mgr = CloudControllerManager(cs, cloud, enabled=names)
+        mgr.start(manual=False, workers_per_controller=args.workers)
+        logging.info("cloud controller manager running: %s", ", ".join(mgr.controllers))
+        while not payload_stop.is_set():
+            mgr.tick()
+            payload_stop.wait(args.monitor_period)
+        mgr.stop()
+
+    stop = install_signal_stop()
+    run_with_leader_election(
+        cs, "cloud-controller-manager", f"ccm-{os.getpid()}", run, stop,
+        leader_elect=args.leader_elect,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

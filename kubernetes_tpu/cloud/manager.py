@@ -1,0 +1,29 @@
+"""cloud-controller-manager (reference ``cmd/cloud-controller-manager``):
+the cloud-coupled loops split out of the core controller manager so the
+core control plane has zero IaaS dependencies."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..client.clientset import Clientset
+from ..controllers.manager import ControllerManager
+from .controllers import CloudNodeController, RouteController, ServiceLBController
+from .provider import CloudProvider
+
+CLOUD_CONTROLLERS: dict[str, Callable] = {
+    "cloud-node": CloudNodeController,
+    "service-lb": ServiceLBController,
+    "route": RouteController,
+}
+
+
+class CloudControllerManager(ControllerManager):
+    """Same informer-sharing manager, cloud registry + provider wiring."""
+
+    registry = CLOUD_CONTROLLERS
+
+    def __init__(self, clientset: Clientset, cloud: CloudProvider,
+                 enabled: Optional[list[str]] = None, clock=None, **kw):
+        super().__init__(clientset, enabled=enabled, clock=clock,
+                         cloud=cloud, **kw)

@@ -70,15 +70,19 @@ DEFAULT_CONTROLLERS: dict[str, Callable] = {
 
 
 class ControllerManager:
+    registry: dict[str, Callable] = DEFAULT_CONTROLLERS
+
     def __init__(
         self,
         clientset: Clientset,
         enabled: Optional[list[str]] = None,
         clock=None,
+        registry: Optional[dict[str, Callable]] = None,
         **controller_kw,
     ):
         import inspect
 
+        registry = registry or type(self).registry
         self.clientset = clientset
         self.informers = InformerFactory(clientset)
         self.controllers: dict[str, Controller] = {}
@@ -86,8 +90,8 @@ class ControllerManager:
         if clock is not None:
             kw["clock"] = clock
         consumed: set[str] = {"clock"}
-        for name in enabled or list(DEFAULT_CONTROLLERS):
-            ctor = DEFAULT_CONTROLLERS[name]
+        for name in enabled or list(registry):
+            ctor = registry[name]
             accepted = set(inspect.signature(ctor.__init__).parameters)
             # pass each controller only the options it declares ("clock" is
             # universal via the Controller base)

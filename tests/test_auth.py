@@ -613,6 +613,29 @@ def test_webhook_cache_is_bounded():
 
     authn = WebhookTokenAuthenticator("http://127.0.0.1:1/", timeout=0.05)
     authn.CACHE_MAX = 10
+    authn._review = lambda token: None  # a real (negative) verdict
     for i in range(50):
         authn.authenticate({"Authorization": f"Bearer junk-{i}"})
     assert len(authn._cache) <= 10
+
+
+def test_webhook_transport_errors_are_not_cached():
+    """An unreachable webhook fails closed for the request but must not
+    poison the verdict cache: the token re-reviews once it recovers."""
+    from kubernetes_tpu.auth import UserInfo, WebhookTokenAuthenticator
+
+    authn = WebhookTokenAuthenticator("http://127.0.0.1:1/", timeout=0.05)
+    assert authn.authenticate({"Authorization": "Bearer tok"}) is None
+    assert authn._cache == {}  # no verdict recorded
+    authn._review = lambda token: UserInfo(name="late-but-valid")
+    user = authn.authenticate({"Authorization": "Bearer tok"})
+    assert user is not None and user.name == "late-but-valid"
+
+
+def test_oidc_requires_exp_claim():
+    from kubernetes_tpu.auth import OIDCAuthenticator
+
+    authn = OIDCAuthenticator(issuer="iss", audience="kube", key=b"k",
+                              clock=lambda: 1000.0)
+    immortal = _hs256_jwt({"iss": "iss", "aud": "kube", "sub": "x"}, key=b"k")
+    assert authn.authenticate({"Authorization": f"Bearer {immortal}"}) is None

@@ -1,0 +1,162 @@
+"""Cloud provider layer: service LB, routes, cloud node controller —
+patterned on the reference's servicecontroller/routecontroller tests
+(which also run against the fake cloud)."""
+
+import pytest
+
+from kubernetes_tpu.api import ObjectMeta, Service, ServicePort
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.cloud import (
+    CloudControllerManager,
+    FakeCloud,
+    Instance,
+)
+from kubernetes_tpu.cloud.controllers import _lb_name
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node
+
+
+@pytest.fixture
+def world():
+    cs = Clientset(Store())
+    cloud = FakeCloud()
+    for i in range(3):
+        name = f"node-{i}"
+        cloud.add_instance(Instance(
+            name=name, internal_ip=f"10.0.0.{i+1}", external_ip=f"34.1.1.{i+1}",
+            zone="us-x1-a", region="us-x1"))
+        node = make_node(name)
+        node.spec.pod_cidr = f"10.24.{i}.0/24"
+        cs.nodes.create(node)
+    mgr = CloudControllerManager(cs, cloud)
+    mgr.start(manual=True)
+    return cs, cloud, mgr
+
+
+def drive(mgr, rounds=6):
+    for _ in range(rounds):
+        mgr.reconcile_all()
+
+
+def test_service_lb_provision_and_teardown(world):
+    cs, cloud, mgr = world
+    cs.services.create(Service(
+        meta=ObjectMeta(name="web"), selector={"app": "web"},
+        ports=[ServicePort(port=80)], type="LoadBalancer"))
+    drive(mgr)
+    svc = cs.services.get("web")
+    assert svc.status_load_balancer, "ingress IP not published"
+    ip = svc.status_load_balancer[0]
+    lb = cloud.get_load_balancer(_lb_name("default", "web"))
+    assert lb is not None and lb.ingress_ip == ip and lb.ports == [80]
+    assert lb.nodes == ["node-0", "node-1", "node-2"]
+    # the ingress IP survives reconciles without churn (idempotent ensure)
+    drive(mgr)
+    assert cs.services.get("web").status_load_balancer == [ip]
+    # deletion tears the LB down
+    cs.services.delete("web")
+    drive(mgr)
+    assert cloud.get_load_balancer(_lb_name("default", "web")) is None
+
+
+def test_service_lb_type_change_releases(world):
+    cs, cloud, mgr = world
+    cs.services.create(Service(
+        meta=ObjectMeta(name="api"), selector={"app": "api"},
+        ports=[ServicePort(port=443)], type="LoadBalancer"))
+    drive(mgr)
+    assert cs.services.get("api").status_load_balancer
+
+    def _to_cluster_ip(svc):
+        svc.type = "ClusterIP"
+        return svc
+
+    cs.services.guaranteed_update("api", _to_cluster_ip)
+    drive(mgr)
+    assert cloud.get_load_balancer(_lb_name("default", "api")) is None
+    assert cs.services.get("api").status_load_balancer == []
+
+
+def test_service_lb_retargets_on_node_unready(world):
+    cs, cloud, mgr = world
+    cs.services.create(Service(
+        meta=ObjectMeta(name="web"), selector={"app": "web"},
+        ports=[ServicePort(port=80)], type="LoadBalancer"))
+    drive(mgr)
+
+    def _unready(node):
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                c.status = "False"
+        return node
+
+    cs.nodes.guaranteed_update("node-1", _unready, "")
+    drive(mgr)
+    lb = cloud.get_load_balancer(_lb_name("default", "web"))
+    assert lb.nodes == ["node-0", "node-2"]
+    # cordoned nodes leave the target set too
+    def _cordon(node):
+        node.spec.unschedulable = True
+        return node
+
+    cs.nodes.guaranteed_update("node-0", _cordon, "")
+    drive(mgr)
+    assert cloud.get_load_balancer(_lb_name("default", "web")).nodes == ["node-2"]
+
+
+def test_route_controller_full_state(world):
+    cs, cloud, mgr = world
+    drive(mgr)
+    routes = {r.target_node: r.dest_cidr for r in cloud.list_routes()}
+    assert routes == {"node-0": "10.24.0.0/24", "node-1": "10.24.1.0/24",
+                      "node-2": "10.24.2.0/24"}
+    # node deletion removes its route
+    cs.nodes.delete("node-2")
+    drive(mgr)
+    routes = {r.target_node for r in cloud.list_routes()}
+    assert routes == {"node-0", "node-1"}
+    # CIDR change replaces the route
+    def _recidr(node):
+        node.spec.pod_cidr = "10.99.0.0/24"
+        return node
+
+    cs.nodes.guaranteed_update("node-0", _recidr, "")
+    drive(mgr)
+    routes = {r.target_node: r.dest_cidr for r in cloud.list_routes()}
+    assert routes["node-0"] == "10.99.0.0/24"
+
+
+def test_cloud_node_controller_stamps_and_reaps(world):
+    cs, cloud, mgr = world
+    drive(mgr)
+    node = cs.nodes.get("node-0")
+    kinds = {a["type"]: a["address"] for a in node.status.addresses}
+    assert kinds["InternalIP"] == "10.0.0.1" and kinds["ExternalIP"] == "34.1.1.1"
+    assert node.meta.labels["failure-domain.beta.kubernetes.io/zone"] == "us-x1-a"
+    assert node.meta.labels["failure-domain.beta.kubernetes.io/region"] == "us-x1"
+    assert node.spec.provider_id.startswith("fake://")
+    # instance disappears from the cloud -> node object reaped by monitor
+    cloud.remove_instance("node-1")
+    mgr.informers.pump_all()
+    deleted = mgr.controllers["cloud-node"].monitor()
+    assert deleted == 1
+    with pytest.raises(Exception):
+        cs.nodes.get("node-1")
+    # nodes without a providerID (not cloud-managed) are never reaped
+    unmanaged = make_node("bare-metal")
+    cs.nodes.create(unmanaged)
+    mgr.informers.pump_all()
+    assert mgr.controllers["cloud-node"].monitor() == 0
+    assert cs.nodes.get("bare-metal") is not None
+
+
+def test_zone_labels_feed_scheduler_spreading(world):
+    """The cloud-stamped zone label is the same key the scheduler's
+    SelectorSpread zone weighting reads — end-to-end the cloud layer
+    feeds scheduling topology."""
+    cs, cloud, mgr = world
+    drive(mgr)
+    from kubernetes_tpu.scheduler.nodeinfo import _zone_key_of
+
+    node = cs.nodes.get("node-0")
+    assert _zone_key_of(node) == "us-x1:us-x1-a"
