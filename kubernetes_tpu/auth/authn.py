@@ -280,10 +280,13 @@ class WebhookTokenAuthenticator(Authenticator):
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 status = json.loads(r.read()).get("status") or {}
-        except urllib.error.HTTPError:
-            # the webhook answered with an error status: that IS a verdict
-            # (fail closed, cacheable)
-            return None
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                # a 4xx is a deliberate answer: fail closed, cacheable
+                return None
+            # a 5xx is the webhook failing, not deciding — treat like an
+            # unreachable server so the verdict cache is not poisoned
+            raise OSError(f"webhook 5xx: {e.code}") from e
         except Exception as e:
             # unreachable/timeout: fail closed for this request but let the
             # caller skip the cache write

@@ -2,3 +2,5 @@
 
 from .proxier import EndpointInfo, Proxier, Rule, ServicePortName
 from .hollow import HollowProxy, HollowProxyFleet
+from .healthcheck import ProxierHealthServer, ServiceHealthServer
+from .userspace import UserspaceProxier

@@ -1,0 +1,122 @@
+"""Proxy health checking (reference ``pkg/proxy/healthcheck/``): two
+distinct surfaces —
+
+- :class:`ProxierHealthServer` — the NODE's proxier healthz
+  (``healthcheck.go healthzServer``): 200 while rule syncs are recent,
+  503 once the proxier stalls past the grace period.  Load balancers use
+  this to stop sending new flows to a node whose dataplane is stale.
+- :class:`ServiceHealthServer` — per-service endpoint counts for
+  externalTrafficPolicy=Local services (``healthcheck.go server``): an LB
+  health-probes a node's per-service port and only targets nodes with
+  LOCAL ready endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class ProxierHealthServer:
+    def __init__(self, grace_seconds: float = 60.0, clock=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.grace = grace_seconds
+        self.clock = clock or time.monotonic
+        self._last_sync = self.clock()
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != "/healthz":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                healthy, age = outer.status()
+                body = json.dumps({"lastUpdated": round(age, 3),
+                                   "healthy": healthy}).encode()
+                self.send_response(200 if healthy else 503)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def touch(self) -> None:
+        """Called by the proxier after every successful rule sync."""
+        with self._lock:
+            self._last_sync = self.clock()
+
+    def status(self) -> tuple[bool, float]:
+        with self._lock:
+            age = self.clock() - self._last_sync
+        return age <= self.grace, age
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self.httpd.shutdown()
+        self.httpd.server_close()  # release the bound socket either way
+
+
+class ServiceHealthServer:
+    """Per-service local-endpoint counts, one shared HTTP server (the
+    reference binds one port per service; a path per service keys the
+    same contract without exhausting test ports)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                key = self.path.strip("/")
+                with outer._lock:
+                    count = outer._counts.get(key)
+                if count is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps({"service": key,
+                                   "localEndpoints": count}).encode()
+                # 0 local endpoints -> 503: the LB must not target this
+                # node for a Local-policy service it has no backends on
+                self.send_response(200 if count > 0 else 503)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def sync_services(self, counts: dict[str, int]) -> None:
+        """Full-state update of tracked services (``SyncServices`` +
+        ``SyncEndpoints``): services absent from ``counts`` stop being
+        served (404)."""
+        with self._lock:
+            self._counts = dict(counts)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self.httpd.shutdown()
+        self.httpd.server_close()  # release the bound socket either way

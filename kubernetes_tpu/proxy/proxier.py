@@ -183,6 +183,10 @@ class Proxier:
                     out[spn] = out.get(spn, ()) + infos
         return out
 
+    # optional ProxierHealthServer (healthcheck.py): touched after every
+    # successful sync so the node healthz reflects dataplane freshness
+    health_server = None
+
     def sync(self) -> dict[tuple, Rule]:
         """Rebuild the whole rule table (one iptables-restore batch).
         A no-delta resync is a heartbeat: it refreshes health/affinity
@@ -192,6 +196,8 @@ class Proxier:
                 self._expire_affinity()
                 self.syncs += 1
                 self.last_sync = self.clock()
+                if self.health_server is not None:
+                    self.health_server.touch()
                 return self.rules
             self._fold_changes()
             self.service_map = self._build_service_map()
@@ -228,6 +234,8 @@ class Proxier:
             self._expire_affinity()
             self.syncs += 1
             self.last_sync = self.clock()
+            if self.health_server is not None:
+                self.health_server.touch()
             return rules
 
     def _expire_affinity(self) -> None:

@@ -639,3 +639,45 @@ def test_oidc_requires_exp_claim():
                               clock=lambda: 1000.0)
     immortal = _hs256_jwt({"iss": "iss", "aud": "kube", "sub": "x"}, key=b"k")
     assert authn.authenticate({"Authorization": f"Bearer {immortal}"}) is None
+
+
+def test_webhook_5xx_is_not_cached_as_verdict():
+    """A 5xx from the webhook is the webhook failing, not deciding: it
+    must fail closed for the request without poisoning the cache."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from kubernetes_tpu.auth import WebhookTokenAuthenticator
+
+    mode = {"broken": True}
+
+    class Hook(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers["Content-Length"]))
+            if mode["broken"]:
+                self.send_response(503)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            data = _json.dumps({"status": {"authenticated": True,
+                                           "user": {"username": "u1"}}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = HTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        authn = WebhookTokenAuthenticator(f"http://127.0.0.1:{httpd.server_port}/")
+        assert authn.authenticate({"Authorization": "Bearer tok"}) is None
+        assert authn._cache == {}  # 5xx not recorded as a verdict
+        mode["broken"] = False
+        user = authn.authenticate({"Authorization": "Bearer tok"})
+        assert user is not None and user.name == "u1"
+    finally:
+        httpd.shutdown()

@@ -211,3 +211,227 @@ def test_expired_affinity_entries_are_pruned_on_sync():
     clock.now += 10801.0
     p.sync()
     assert len(p._affinity) == 0
+
+
+# -- round-2: userspace mode + health checking -----------------------------
+
+
+def _echo_server(reply: bytes):
+    """Real TCP backend that answers every connection with `reply`."""
+    import socket
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(16)
+
+    def loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                conn.recv(1024)
+                conn.sendall(reply)
+                conn.close()
+            except OSError:
+                pass
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv, srv.getsockname()[1]
+
+
+def _call(port: int) -> bytes:
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(b"ping")
+        return s.recv(1024)
+
+
+def test_userspace_proxier_round_robin():
+    """Real sockets end-to-end: connections through the proxy port hit
+    the backends in round-robin order (LoadBalancerRR)."""
+    from kubernetes_tpu.proxy import UserspaceProxier
+
+    a_srv, a_port = _echo_server(b"A")
+    b_srv, b_port = _echo_server(b"B")
+    proxy = UserspaceProxier()
+    try:
+        pp = proxy.set_service("default/web:http",
+                               [("127.0.0.1", a_port), ("127.0.0.1", b_port)])
+        replies = [_call(pp) for _ in range(4)]
+        assert replies == [b"A", b"B", b"A", b"B"]
+        assert proxy.stats("default/web:http")["conns"] == 4
+    finally:
+        proxy.stop()
+        a_srv.close()
+        b_srv.close()
+
+
+def test_userspace_proxier_client_ip_affinity_and_update():
+    from kubernetes_tpu.proxy import UserspaceProxier
+
+    a_srv, a_port = _echo_server(b"A")
+    b_srv, b_port = _echo_server(b"B")
+    proxy = UserspaceProxier()
+    try:
+        pp = proxy.set_service("default/db:tcp",
+                               [("127.0.0.1", a_port), ("127.0.0.1", b_port)],
+                               affinity="ClientIP")
+        # same client ip (127.0.0.1) -> same backend every time
+        replies = {_call(pp) for _ in range(4)}
+        assert len(replies) == 1
+        # backend set change clears sticky state and re-balances
+        proxy.set_service("default/db:tcp", [("127.0.0.1", b_port)],
+                          affinity="ClientIP")
+        assert _call(pp) == b"B"
+        # removing the service closes the listener and drops the entry
+        # (a raw reconnect probe would be flaky: connecting to a just-freed
+        # ephemeral port from localhost can TCP-self-connect)
+        proxy.remove_service("default/db:tcp")
+        assert proxy.proxy_port("default/db:tcp") is None
+        assert proxy._services == {}
+    finally:
+        proxy.stop()
+        a_srv.close()
+        b_srv.close()
+
+
+def test_userspace_no_backends_rejects():
+    import socket
+
+    from kubernetes_tpu.proxy import UserspaceProxier
+
+    proxy = UserspaceProxier()
+    try:
+        pp = proxy.set_service("default/empty:http", [])
+        with socket.create_connection(("127.0.0.1", pp), timeout=5) as s:
+            # connection is accepted then immediately closed (REJECT analogue)
+            assert s.recv(64) == b""
+    finally:
+        proxy.stop()
+
+
+def test_proxier_healthz_staleness():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.proxy import ProxierHealthServer
+
+    now = [0.0]
+    hs = ProxierHealthServer(grace_seconds=60, clock=lambda: now[0])
+    hs.start()
+    try:
+        hs.touch()
+        with urllib.request.urlopen(f"http://127.0.0.1:{hs.port}/healthz") as r:
+            assert r.status == 200 and json.loads(r.read())["healthy"] is True
+        # proxier stalls past the grace period -> 503
+        now[0] += 61
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{hs.port}/healthz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # a sync recovers it
+        hs.touch()
+        with urllib.request.urlopen(f"http://127.0.0.1:{hs.port}/healthz") as r:
+            assert r.status == 200
+    finally:
+        hs.stop()
+
+
+def test_proxier_sync_touches_health_server():
+    from kubernetes_tpu.proxy import Proxier, ProxierHealthServer
+
+    now = [0.0]
+    p = Proxier(node_name="n1", clock=lambda: now[0])
+    hs = ProxierHealthServer(grace_seconds=60, clock=lambda: now[0])
+    p.health_server = hs
+    p.sync()
+    now[0] += 100
+    assert hs.status()[0] is False
+    p.sync()  # heartbeat resync refreshes health
+    assert hs.status()[0] is True
+
+
+def test_service_health_server_local_endpoints():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.proxy import ServiceHealthServer
+
+    shs = ServiceHealthServer()
+    shs.start()
+    try:
+        shs.sync_services({"default/web": 2, "default/db": 0})
+        with urllib.request.urlopen(f"http://127.0.0.1:{shs.port}/default/web") as r:
+            assert r.status == 200 and json.loads(r.read())["localEndpoints"] == 2
+        # zero local endpoints -> 503 (LB must skip this node)
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{shs.port}/default/db")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # unknown service -> 404
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{shs.port}/default/ghost")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        shs.stop()
+
+
+def test_userspace_half_close_delivers_reply():
+    """A client that shuts its write side (FIN-delimited request) must
+    still receive the backend's reply — EOF propagates as half-close,
+    not a teardown of both sockets."""
+    import socket
+    import threading
+
+    from kubernetes_tpu.proxy import UserspaceProxier
+
+    # backend that replies only AFTER seeing client EOF
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            buf = b""
+            while True:
+                d = conn.recv(1024)
+                if not d:
+                    break
+                buf += d
+            conn.sendall(b"got:" + buf)
+            conn.close()
+
+    threading.Thread(target=loop, daemon=True).start()
+    proxy = UserspaceProxier()
+    try:
+        pp = proxy.set_service("default/fin:tcp",
+                               [("127.0.0.1", srv.getsockname()[1])])
+        with socket.create_connection(("127.0.0.1", pp), timeout=5) as s:
+            s.sendall(b"req")
+            s.shutdown(socket.SHUT_WR)  # half-close: request complete
+            out = b""
+            while True:
+                d = s.recv(1024)
+                if not d:
+                    break
+                out += d
+        assert out == b"got:req"
+    finally:
+        proxy.stop()
+        srv.close()
