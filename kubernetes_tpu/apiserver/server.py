@@ -83,6 +83,12 @@ class TLSConfig:
 from ..api.types import CLUSTER_SCOPED_KINDS as CLUSTER_SCOPED  # noqa: E402
 from ..api.types import kind_for_plural as _kind_for  # noqa: E402
 
+# link the federation API group into the wire surface (the reference's
+# federation-apiserver compiles its types in the same way) — importing
+# registers the Cluster kind; federation/__init__ is import-light (lazy
+# controller loading) so this does NOT pull in the controller tree
+from ..federation import types as _federation_types  # noqa: E402,F401
+
 
 class APIServer:
     """HTTP front end over the store.

@@ -20,13 +20,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
-class ProxierHealthServer:
-    def __init__(self, grace_seconds: float = 60.0, clock=None,
-                 host: str = "127.0.0.1", port: int = 0):
-        self.grace = grace_seconds
-        self.clock = clock or time.monotonic
-        self._last_sync = self.clock()
-        self._lock = threading.Lock()
+class _HealthHTTPServer:
+    """Shared server lifecycle; subclasses implement
+    ``handle(path) -> (code, body_dict) | None`` (None = 404)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -34,14 +32,15 @@ class ProxierHealthServer:
                 pass
 
             def do_GET(self):
-                if self.path != "/healthz":
+                result = outer.handle(self.path)
+                if result is None:
                     self.send_response(404)
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                healthy, age = outer.status()
-                body = json.dumps({"lastUpdated": round(age, 3),
-                                   "healthy": healthy}).encode()
-                self.send_response(200 if healthy else 503)
+                code, payload = result
+                body = json.dumps(payload).encode()
+                self.send_response(code)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -49,6 +48,28 @@ class ProxierHealthServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
+
+    def handle(self, path: str):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self.httpd.shutdown()
+        self.httpd.server_close()  # release the bound socket either way
+
+
+class ProxierHealthServer(_HealthHTTPServer):
+    def __init__(self, grace_seconds: float = 60.0, clock=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.grace = grace_seconds
+        self.clock = clock or time.monotonic
+        self._last_sync = self.clock()
+        self._lock = threading.Lock()
+        super().__init__(host, port)
 
     def touch(self) -> None:
         """Called by the proxier after every successful rule sync."""
@@ -60,17 +81,15 @@ class ProxierHealthServer:
             age = self.clock() - self._last_sync
         return age <= self.grace, age
 
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
-
-    def stop(self) -> None:
-        if self._thread is not None:
-            self.httpd.shutdown()
-        self.httpd.server_close()  # release the bound socket either way
+    def handle(self, path: str):
+        if path != "/healthz":
+            return None
+        healthy, age = self.status()
+        return (200 if healthy else 503,
+                {"lastUpdated": round(age, 3), "healthy": healthy})
 
 
-class ServiceHealthServer:
+class ServiceHealthServer(_HealthHTTPServer):
     """Per-service local-endpoint counts, one shared HTTP server (the
     reference binds one port per service; a path per service keys the
     same contract without exhausting test ports)."""
@@ -78,32 +97,7 @@ class ServiceHealthServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._counts: dict[str, int] = {}
         self._lock = threading.Lock()
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_GET(self):
-                key = self.path.strip("/")
-                with outer._lock:
-                    count = outer._counts.get(key)
-                if count is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = json.dumps({"service": key,
-                                   "localEndpoints": count}).encode()
-                # 0 local endpoints -> 503: the LB must not target this
-                # node for a Local-policy service it has no backends on
-                self.send_response(200 if count > 0 else 503)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self.httpd.server_port
-        self._thread: Optional[threading.Thread] = None
+        super().__init__(host, port)
 
     def sync_services(self, counts: dict[str, int]) -> None:
         """Full-state update of tracked services (``SyncServices`` +
@@ -112,11 +106,13 @@ class ServiceHealthServer:
         with self._lock:
             self._counts = dict(counts)
 
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
-
-    def stop(self) -> None:
-        if self._thread is not None:
-            self.httpd.shutdown()
-        self.httpd.server_close()  # release the bound socket either way
+    def handle(self, path: str):
+        key = path.strip("/")
+        with self._lock:
+            count = self._counts.get(key)
+        if count is None:
+            return None
+        # 0 local endpoints -> 503: the LB must not target this node for a
+        # Local-policy service it has no backends on
+        return (200 if count > 0 else 503,
+                {"service": key, "localEndpoints": count})

@@ -1,0 +1,253 @@
+"""Federation: cluster registry, fan-out sync, status rollup,
+cross-cluster DNS, kubefed — patterned on
+``federation/pkg/federation-controller`` tests (fake member clusters)."""
+
+import io
+import json
+
+import pytest
+
+from kubernetes_tpu.api import (
+    ConfigMap,
+    Container,
+    Deployment,
+    LabelSelector,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    Service,
+    ServicePort,
+)
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.federation import (
+    PLACEMENT_ANNOTATION,
+    FederationControllerManager,
+)
+from kubernetes_tpu.federation.kubefed import main as kubefed_main
+from kubernetes_tpu.store import Store
+
+
+def make_fed(n_members=2, zones=("z1", "z2"), regions=("r1", "r1")):
+    """Federation store + N in-proc member clusters, joined via kubefed."""
+    fed = Clientset(Store())
+    members = {f"c{i}": Clientset(Store()) for i in range(n_members)}
+
+    def factory(cluster):
+        return members[cluster.meta.name]
+
+    mgr = FederationControllerManager(fed, member_factory=factory)
+    mgr.start(manual=True)
+    for i, name in enumerate(members):
+        out = io.StringIO()
+        rc = kubefed_main(
+            ["join", name, "--cluster-server", f"inproc://{name}",
+             "--zone", zones[i % len(zones)], "--region", regions[i % len(regions)]],
+            clientset=fed, out=out)
+        assert rc == 0
+    return fed, members, mgr
+
+
+def drive(mgr, rounds=6):
+    for _ in range(rounds):
+        mgr.tick()
+        mgr.reconcile_all()
+
+
+def _dep(name, replicas=3, image="app:v1", annotations=None):
+    return Deployment(
+        meta=ObjectMeta(name=name, annotations=dict(annotations or {})),
+        replicas=replicas,
+        selector=LabelSelector.from_match_labels({"app": name}),
+        template=PodTemplateSpec(labels={"app": name},
+                                 spec=PodSpec(containers=[Container(name="c", image=image)])),
+    )
+
+
+def test_cluster_health_and_kubefed():
+    fed, members, mgr = make_fed()
+    drive(mgr)
+    clusters = fed.client_for("Cluster").list("")[0]
+    assert len(clusters) == 2 and all(c.ready for c in clusters)
+    out = io.StringIO()
+    assert kubefed_main(["get-clusters"], clientset=fed, out=out) == 0
+    assert "c0" in out.getvalue() and "True" in out.getvalue()
+    # unjoin removes the member from the registry
+    assert kubefed_main(["unjoin", "c1"], clientset=fed, out=io.StringIO()) == 0
+    assert len(fed.client_for("Cluster").list("")[0]) == 1
+    # duplicate join fails
+    out = io.StringIO()
+    assert kubefed_main(["join", "c0", "--cluster-server", "x"],
+                        clientset=fed, out=out) == 1
+
+
+def test_fanout_create_update_delete():
+    fed, members, mgr = make_fed()
+    drive(mgr)
+    fed.deployments.create(_dep("web"))
+    drive(mgr)
+    for name, member in members.items():
+        dep = member.deployments.get("web")
+        assert dep.replicas == 3, f"not propagated to {name}"
+    # spec drift in a member is reconciled back
+    def _drift(cur):
+        cur.replicas = 99
+        return cur
+
+    members["c0"].deployments.guaranteed_update("web", _drift)
+    drive(mgr)
+    assert members["c0"].deployments.get("web").replicas == 3
+    # fed update propagates
+    def _v2(cur):
+        cur.template.spec.containers[0].image = "app:v2"
+        return cur
+
+    fed.deployments.guaranteed_update("web", _v2)
+    drive(mgr)
+    for member in members.values():
+        assert member.deployments.get("web").template.spec.containers[0].image == "app:v2"
+    # fed delete removes from every member
+    fed.deployments.delete("web")
+    drive(mgr)
+    for member in members.values():
+        with pytest.raises(Exception):
+            member.deployments.get("web")
+
+
+def test_placement_annotation_scopes_fanout():
+    fed, members, mgr = make_fed()
+    drive(mgr)
+    fed.deployments.create(_dep(
+        "scoped", annotations={PLACEMENT_ANNOTATION: json.dumps(["c1"])}))
+    drive(mgr)
+    with pytest.raises(Exception):
+        members["c0"].deployments.get("scoped")
+    assert members["c1"].deployments.get("scoped").replicas == 3
+    # widening the placement adds the member; narrowing removes it
+    def _to_c0(cur):
+        cur.meta.annotations[PLACEMENT_ANNOTATION] = json.dumps(["c0"])
+        return cur
+
+    fed.deployments.guaranteed_update("scoped", _to_c0)
+    drive(mgr)
+    assert members["c0"].deployments.get("scoped").replicas == 3
+    with pytest.raises(Exception):
+        members["c1"].deployments.get("scoped")
+
+
+def test_status_rollup_sums_members():
+    fed, members, mgr = make_fed()
+    drive(mgr)
+    fed.deployments.create(_dep("web"))
+    drive(mgr)
+    # members' deployment controllers "run" (simulated status)
+    for i, member in enumerate(members.values()):
+        def _status(cur, n=2 + i):
+            cur.status_replicas = n
+            cur.status_ready_replicas = n
+            return cur
+
+        member.deployments.guaranteed_update("web", _status)
+    drive(mgr)
+    fed_dep = fed.deployments.get("web")
+    assert fed_dep.status_replicas == 5  # 2 + 3
+    assert fed_dep.status_ready_replicas == 5
+
+
+def test_configmap_fanout():
+    fed, members, mgr = make_fed()
+    drive(mgr)
+    fed.client_for("ConfigMap").create(ConfigMap(meta=ObjectMeta(name="cfg"),
+                                                 data={"k": "v"}))
+    drive(mgr)
+    for member in members.values():
+        assert member.client_for("ConfigMap").get("cfg").data == {"k": "v"}
+
+
+def test_cross_cluster_service_dns():
+    fed, members, mgr = make_fed(zones=("z1", "z2"), regions=("r1", "r1"))
+    drive(mgr)
+    fed.services.create(Service(meta=ObjectMeta(name="web"),
+                                selector={"app": "web"},
+                                ports=[ServicePort(port=80)]))
+    drive(mgr)
+    # members publish LB ingress (their cloud controllers would)
+    for i, member in enumerate(members.values()):
+        def _lb(cur, ip=f"198.51.100.{i+1}"):
+            cur.status_load_balancer = [ip]
+            return cur
+
+        member.services.guaranteed_update("web", _lb)
+    drive(mgr)
+    dns = mgr.dns
+    base = "web.default.myfed.svc.example.com"
+    assert dns.records[base] == ["198.51.100.1", "198.51.100.2"]
+    assert dns.records[f"z1.{base}"] == ["198.51.100.1"]
+    assert dns.records[f"z2.{base}"] == ["198.51.100.2"]
+    assert dns.records[f"r1.{base}"] == ["198.51.100.1", "198.51.100.2"]
+    # three-level resolution: unknown zone falls back up the chain
+    assert dns.resolve(f"z9.{base}") == ["198.51.100.1", "198.51.100.2"]
+    assert dns.resolve(f"z1.{base}") == ["198.51.100.1"]
+    # fed service deletion clears the records
+    fed.services.delete("web")
+    drive(mgr)
+    assert base not in dns.records
+
+
+def test_unready_cluster_excluded_from_fanout():
+    fed, members, mgr = make_fed()
+    drive(mgr)
+
+    # make c1's probe fail by replacing its clientset with a broken one
+    class Broken:
+        def __getattr__(self, _):
+            raise ConnectionError("down")
+
+    mgr.members._cache["c1"] = Broken()
+    drive(mgr)
+    clusters = {c.meta.name: c.ready for c in fed.client_for("Cluster").list("")[0]}
+    assert clusters["c1"] is False and clusters["c0"] is True
+    fed.deployments.create(_dep("web"))
+    drive(mgr)
+    assert members["c0"].deployments.get("web") is not None
+    # c1 never got it (not ready)
+    with pytest.raises(Exception):
+        members["c1"].deployments.get("web")
+
+
+def test_controllers_quiesce_at_steady_state():
+    """Steady state must converge to ZERO syncs per drive: unconditional
+    status writes would MODIFIED-requeue their own keys forever."""
+    fed, members, mgr = make_fed()
+    fed.deployments.create(_dep("web"))
+    drive(mgr)
+    # fully converged: one more tick+reconcile performs no syncs at all
+    mgr.tick()
+    mgr.informers.pump_all()
+    # the tick re-enqueued probe keys; they must resolve without writes
+    first = mgr.reconcile_all()
+    second = mgr.reconcile_all()
+    assert second == 0, f"controllers never quiesce ({second} syncs/round)"
+
+
+def test_dns_drops_stale_zone_records():
+    fed, members, mgr = make_fed(zones=("z1", "z2"))
+    drive(mgr)
+    fed.services.create(Service(meta=ObjectMeta(name="web"),
+                                selector={"app": "web"},
+                                ports=[ServicePort(port=80)]))
+    drive(mgr)
+    for i, member in enumerate(members.values()):
+        def _lb(cur, ip=f"198.51.100.{i+1}"):
+            cur.status_load_balancer = [ip]
+            return cur
+
+        member.services.guaranteed_update("web", _lb)
+    drive(mgr)
+    base = "web.default.myfed.svc.example.com"
+    assert mgr.dns.records[f"z1.{base}"] == ["198.51.100.1"]
+    # member c0 (z1) drops its service: the z1 record must VANISH so a
+    # scoped lookup falls back instead of serving the dead IP
+    members["c0"].services.delete("web")
+    drive(mgr)
+    assert f"z1.{base}" not in mgr.dns.records
+    assert mgr.dns.resolve(f"z1.{base}") == ["198.51.100.2"]
