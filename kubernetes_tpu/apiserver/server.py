@@ -52,6 +52,9 @@ logger = logging.getLogger("kubernetes_tpu.apiserver")
 # served by the generic apiserver; evaluated against the live authorizer)
 SSAR_PATH = "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews"
 
+# binary wire negotiation (reference application/vnd.kubernetes.protobuf)
+from ..api.wire import CONTENT_TYPE as BINARY_CONTENT_TYPE  # noqa: E402
+
 
 class TLSConfig:
     """Serving-side TLS for the wire server (reference
@@ -191,9 +194,18 @@ def _make_handler(server: APIServer):
 
         def _send(self, code: int, obj) -> None:
             self._last_code = code
-            data = json.dumps(obj).encode()
+            # content negotiation (reference protobuf negotiation via
+            # Accept: application/vnd.kubernetes.protobuf)
+            if BINARY_CONTENT_TYPE in self.headers.get("Accept", ""):
+                from ..api import wire as binwire
+
+                data = binwire.encode(obj)
+                ctype = BINARY_CONTENT_TYPE
+            else:
+                data = json.dumps(obj).encode()
+                ctype = "application/json"
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -206,7 +218,13 @@ def _make_handler(server: APIServer):
             # authorization) before dispatch consumes it
             if not hasattr(self, "_cached_body"):
                 length = int(self.headers.get("Content-Length", 0))
-                self._cached_body = json.loads(self.rfile.read(length)) if length else {}
+                raw = self.rfile.read(length) if length else b""
+                if raw and BINARY_CONTENT_TYPE in self.headers.get("Content-Type", ""):
+                    from ..api import wire as binwire
+
+                    self._cached_body = binwire.decode(raw)
+                else:
+                    self._cached_body = json.loads(raw) if raw else {}
             return self._cached_body
 
         def _request_info(self, method: str):

@@ -108,13 +108,16 @@ class RemoteStore:
 
     def __init__(self, base_url: str, token: Optional[str] = None, timeout: float = 10.0,
                  ca_file: Optional[str] = None, client_cert: Optional[str] = None,
-                 client_key: Optional[str] = None):
+                 client_key: Optional[str] = None, binary: bool = False):
         """``ca_file`` pins the server CA for https:// servers;
         ``client_cert``/``client_key`` present an x509 client identity
-        (reference kubeconfig certificate-authority / client-certificate)."""
+        (reference kubeconfig certificate-authority / client-certificate).
+        ``binary=True`` negotiates the compact binary wire form for
+        resource bodies (reference protobuf content type)."""
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self.binary = binary
         self._ssl_ctx = None
         if base_url.startswith("https://"):
             import ipaddress
@@ -144,23 +147,37 @@ class RemoteStore:
         return urllib.request.urlopen(req, timeout=self.timeout, context=self._ssl_ctx)
 
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
-        data = json.dumps(body).encode() if body is not None else None
+        if self.binary:
+            from ..api import wire as binwire
+
+            data = binwire.encode(body) if body is not None else None
+            headers = {"Content-Type": binwire.CONTENT_TYPE,
+                       "Accept": binwire.CONTENT_TYPE}
+        else:
+            data = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"}
         req = urllib.request.Request(
-            f"{self.base_url}{path}",
-            data=data,
-            method=method,
-            headers={"Content-Type": "application/json"},
+            f"{self.base_url}{path}", data=data, method=method, headers=headers,
         )
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout,
                                         context=self._ssl_ctx) as resp:
-                out = json.loads(resp.read().decode())
+                out = self._decode(resp)
         except urllib.error.HTTPError as e:
-            out = json.loads(e.read().decode())
+            out = self._decode(e)
         _raise_for_status(out)
         return out
+
+    @staticmethod
+    def _decode(resp) -> dict:
+        from ..api import wire as binwire
+
+        raw = resp.read()
+        if binwire.CONTENT_TYPE in (resp.headers.get("Content-Type") or ""):
+            return binwire.decode(raw)
+        return json.loads(raw.decode())
 
     def raw(self, method: str, path: str, body: Optional[dict] = None,
             timeout: Optional[float] = None) -> bytes:

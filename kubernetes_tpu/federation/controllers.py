@@ -48,11 +48,15 @@ class MemberRegistry:
         return out
 
     def client(self, cluster: Cluster) -> Clientset:
-        cs = self._cache.get(cluster.meta.name)
-        if cs is None:
-            cs = self.factory(cluster)
-            self._cache[cluster.meta.name] = cs
-        return cs
+        # cache keyed on the full connection identity: a rejoined or
+        # re-addressed cluster must get a fresh clientset, never keep
+        # syncing to the old endpoint
+        entry = self._cache.get(cluster.meta.name)
+        ident = (cluster.server_address, cluster.token)
+        if entry is None or entry[0] != ident:
+            entry = (ident, self.factory(cluster))
+            self._cache[cluster.meta.name] = entry
+        return entry[1]
 
 
 class ClusterController(Controller):
@@ -96,7 +100,7 @@ class ClusterController(Controller):
 
         def _set(cur):
             for ctype, status in want.items():
-                cur.set_condition(ctype, status)
+                cur.set_condition(ctype, status, clock=self.clock)
             return cur
 
         self.clientset.client_for("Cluster").guaranteed_update(name, _set, "")

@@ -202,7 +202,7 @@ def test_unready_cluster_excluded_from_fanout():
         def __getattr__(self, _):
             raise ConnectionError("down")
 
-    mgr.members._cache["c1"] = Broken()
+    mgr.members._cache["c1"] = (("inproc://c1", ""), Broken())
     drive(mgr)
     clusters = {c.meta.name: c.ready for c in fed.client_for("Cluster").list("")[0]}
     assert clusters["c1"] is False and clusters["c0"] is True
@@ -251,3 +251,25 @@ def test_dns_drops_stale_zone_records():
     drive(mgr)
     assert f"z1.{base}" not in mgr.dns.records
     assert mgr.dns.resolve(f"z1.{base}") == ["198.51.100.2"]
+
+
+def test_member_cache_invalidates_on_address_change():
+    """Rejoining a cluster at a new serverAddress must not keep syncing
+    to the old endpoint through a stale cached clientset."""
+    from kubernetes_tpu.federation import MemberRegistry
+    from kubernetes_tpu.federation.types import Cluster
+    from kubernetes_tpu.api import ObjectMeta
+
+    built = []
+
+    def factory(cluster):
+        built.append(cluster.server_address)
+        return object()
+
+    reg = MemberRegistry(Clientset(Store()), factory=factory)
+    c = Cluster(meta=ObjectMeta(name="c0"), server_address="http://old:1")
+    first = reg.client(c)
+    assert reg.client(c) is first  # cached while identity unchanged
+    c2 = Cluster(meta=ObjectMeta(name="c0"), server_address="http://new:2")
+    second = reg.client(c2)
+    assert second is not first and built == ["http://old:1", "http://new:2"]
