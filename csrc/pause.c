@@ -1,0 +1,51 @@
+/* pause: the per-pod infrastructure process.
+ *
+ * Capability of the reference's pause container (build/pause/pause.c,
+ * 51 lines): the one real process in every pod sandbox.  It
+ *   - holds the sandbox alive (and in the reference, its netns),
+ *   - reaps zombies re-parented to it as PID 1 of the pod
+ *     (sigreap: waitpid WNOHANG loop on SIGCHLD),
+ *   - exits cleanly on SIGINT/SIGTERM,
+ *   - otherwise sleeps forever.
+ *
+ * Built by kubernetes_tpu.native.pause_binary(); spawned per sandbox by
+ * ProcessSandboxManager when real-process sandboxes are enabled.
+ */
+
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static void sigdown(int signo) {
+  psignal(signo, "shutting down, got signal");
+  exit(0);
+}
+
+static void sigreap(int signo) {
+  (void)signo;
+  while (waitpid(-1, NULL, WNOHANG) > 0)
+    ;
+}
+
+int main(int argc, char **argv) {
+  if (argc > 1 && strcmp(argv[1], "--version") == 0) {
+    printf("ktpu-pause 1.0\n");
+    return 0;
+  }
+  if (sigaction(SIGINT, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
+    return 1;
+  if (sigaction(SIGTERM, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
+    return 2;
+  if (sigaction(SIGCHLD,
+                &(struct sigaction){.sa_handler = sigreap,
+                                    .sa_flags = SA_NOCLDSTOP},
+                NULL) < 0)
+    return 3;
+  for (;;)
+    pause();
+  return 42; /* unreachable */
+}

@@ -38,7 +38,8 @@ class MemberRegistry:
                  factory: Callable[[Cluster], Clientset] = default_member_factory):
         self.clientset = clientset
         self.factory = factory
-        self._cache: dict[str, Clientset] = {}
+        # name -> ((server_address, token), clientset)
+        self._cache: dict[str, tuple[tuple[str, str], Clientset]] = {}
 
     def clusters(self, only_ready: bool = True) -> list[Cluster]:
         out = []

@@ -44,6 +44,7 @@ class HollowKubelet:
         memory_pressure_fraction: float = 0.95,
         serve: bool = False,
         mount_latency: float = 0.0,
+        real_sandboxes: bool = False,
     ):
         from .runtime import FakeRuntime, PodRuntimeManager
 
@@ -63,6 +64,15 @@ class HollowKubelet:
         # eviction manager over a scriptable fake runtime)
         self.runtime = runtime or FakeRuntime()
         self.pod_manager = PodRuntimeManager(self.runtime, clock)
+        # optional REAL per-pod sandbox processes (csrc/pause.c, the
+        # reference's pause container): a pause process runs exactly
+        # while the pod is Running; teardown on termination or removal
+        self.sandboxes = None
+        if real_sandboxes:
+            from .runtime import ProcessSandboxManager
+
+            mgr = ProcessSandboxManager()
+            self.sandboxes = mgr if mgr.enabled else None
         from .volumemanager import VolumeManager
 
         self.volume_manager = VolumeManager(clock, mount_latency=mount_latency)
@@ -137,6 +147,7 @@ class HollowKubelet:
             self.volume_manager.sync(mine, attached, pvc_to_pv or {})
             self._report_volumes_in_use()
         running: list[api.Pod] = []
+        started_keys: set[str] = set()
         for pod in mine:
             if pod.status.phase == api.RUNNING:
                 running.append(pod)
@@ -154,12 +165,27 @@ class HollowKubelet:
                     continue  # WaitForAttachAndMount: stay Pending
                 if self._set_running(pod, now):
                     out["started"] += 1
+                    started_keys.add(key)
+                    if self.sandboxes is not None:
+                        # RunPodSandbox in the same tick the pod starts
+                        self.sandboxes.create(key)
                 del self._starting[key]
         self._starting = {k: t for k, t in self._starting.items() if k in live}
 
         out["restarts"], still_running = self._sync_running(running)
         for gone in self.pod_manager.known() - live:
             self.pod_manager.forget(gone)
+        if self.sandboxes is not None:
+            # sandboxes exist exactly while the pod is Running (incl. pods
+            # started THIS tick): a pod that went Succeeded/Failed/Evicted
+            # this tick leaves the set and its pause process is stopped
+            # NOW, not at object deletion (the reference stops the sandbox
+            # on pod termination)
+            running_keys = {p.meta.key for p in still_running} | started_keys
+            for key in running_keys:
+                self.sandboxes.create(key)
+            for gone in self.sandboxes.known() - running_keys:
+                self.sandboxes.remove(gone)
         out["evicted"] = self._eviction_pass(still_running)
         return out
 

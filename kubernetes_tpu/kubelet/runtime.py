@@ -259,3 +259,77 @@ def rank_for_eviction(pods: list[api.Pod], usage: dict[str, int]) -> list[api.Po
             -usage.get(p.meta.key, 0),
         ),
     )
+
+
+class ProcessSandboxManager:
+    """Real pod sandboxes: one ``ktpu-pause`` process per pod.
+
+    The reference's RunPodSandbox starts the pause container before any
+    workload container (``kuberuntime_sandbox.go``); pause holds the
+    sandbox's namespaces and reaps re-parented zombies
+    (``build/pause/pause.c``).  This manager does the same with the
+    compiled ``csrc/pause.c`` — giving the hollow node a REAL process
+    backbone when enabled, so sandbox lifecycle (create/exists/remove,
+    TERM teardown) is exercised against the actual kernel instead of a
+    dict.  Falls back to inert (no processes) when no C toolchain built
+    the binary."""
+
+    def __init__(self):
+        import atexit
+        import subprocess
+
+        from ..native import pause_binary
+
+        self._subprocess = subprocess
+        self._bin = pause_binary()
+        self._procs: dict[str, object] = {}
+        if self._bin is not None:
+            # pause sleeps forever: without this, an interpreter exit with
+            # running sandboxes leaves one orphan OS process per pod
+            atexit.register(self.remove_all)
+
+    @property
+    def enabled(self) -> bool:
+        return self._bin is not None
+
+    def create(self, pod_key: str) -> Optional[int]:
+        """Idempotent RunPodSandbox: returns the sandbox pid (None when
+        disabled)."""
+        if self._bin is None:
+            return None
+        proc = self._procs.get(pod_key)
+        if proc is not None and proc.poll() is None:
+            return proc.pid
+        proc = self._subprocess.Popen(
+            [self._bin],
+            stdout=self._subprocess.DEVNULL,
+            stderr=self._subprocess.DEVNULL,
+        )
+        self._procs[pod_key] = proc
+        return proc.pid
+
+    def exists(self, pod_key: str) -> bool:
+        proc = self._procs.get(pod_key)
+        return proc is not None and proc.poll() is None
+
+    def known(self) -> set:
+        """Keys with a sandbox (live or pending reap) — the public view
+        the kubelet's GC pass diffs against."""
+        return set(self._procs)
+
+    def remove(self, pod_key: str, timeout: float = 5.0) -> None:
+        """StopPodSandbox + RemovePodSandbox: TERM, wait, KILL on
+        overrun."""
+        proc = self._procs.pop(pod_key, None)
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except self._subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=timeout)
+
+    def remove_all(self) -> None:
+        for key in list(self._procs):
+            self.remove(key)

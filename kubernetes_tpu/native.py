@@ -276,3 +276,29 @@ class MatchEngine:
             elif not Requirement(key, op_name, list(values)).matches(labels):
                 return False
         return True
+
+
+# -- pause: the per-pod infrastructure binary -------------------------------
+# (reference build/pause/pause.c — reaps zombies, exits on TERM, sleeps)
+_PAUSE_SRC = os.path.join(_CSRC, "pause.c")
+_PAUSE_BIN = os.path.join(_CSRC, "ktpu-pause")
+_pause_failed = False
+
+
+def pause_binary() -> Optional[str]:
+    """Path to the compiled pause binary, building on first use; None if
+    no C toolchain is available (sandboxes then stay process-less).
+    Failure is memoized like the other native components — a 5k-node
+    fleet must not re-spawn a failing compiler per kubelet."""
+    global _pause_failed
+    if _pause_failed:
+        return None
+    out = _compile_cached(
+        _PAUSE_SRC, _PAUSE_BIN, ["gcc", "-O2", "-static", _PAUSE_SRC]
+    ) or _compile_cached(
+        # -static can fail where no static libc is installed
+        _PAUSE_SRC, _PAUSE_BIN, ["gcc", "-O2", _PAUSE_SRC]
+    )
+    if out is None:
+        _pause_failed = True
+    return out

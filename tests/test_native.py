@@ -144,3 +144,92 @@ def test_store_isolation_with_active_copier():
     stored["metadata"]["labels"]["k"] = "hacked"
     again = s.get("Pod", "default", "p")
     assert again["metadata"]["labels"]["k"] == "v"
+
+
+# -- pause binary + process sandboxes (reference build/pause/pause.c) ------
+
+
+def test_pause_binary_builds_and_reports_version():
+    import subprocess
+
+    from kubernetes_tpu.native import pause_binary
+
+    binpath = pause_binary()
+    assert binpath is not None
+    out = subprocess.run([binpath, "--version"], capture_output=True, text=True)
+    assert out.returncode == 0 and "ktpu-pause" in out.stdout
+
+
+def test_pause_survives_sigchld_and_exits_on_term():
+    import signal
+    import subprocess
+    import time
+
+    from kubernetes_tpu.native import pause_binary
+
+    proc = subprocess.Popen([pause_binary()], stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        time.sleep(0.1)
+        assert proc.poll() is None
+        # SIGCHLD (zombie-reap signal) must NOT kill it
+        proc.send_signal(signal.SIGCHLD)
+        time.sleep(0.1)
+        assert proc.poll() is None
+        # TERM is a clean shutdown
+        proc.terminate()
+        assert proc.wait(timeout=5) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_process_sandbox_manager_lifecycle():
+    import os
+
+    from kubernetes_tpu.kubelet.runtime import ProcessSandboxManager
+
+    mgr = ProcessSandboxManager()
+    assert mgr.enabled
+    pid = mgr.create("default/p1")
+    assert pid is not None and mgr.exists("default/p1")
+    os.kill(pid, 0)  # alive
+    # idempotent create returns the same sandbox
+    assert mgr.create("default/p1") == pid
+    mgr.remove("default/p1")
+    assert not mgr.exists("default/p1")
+    # removing twice is fine; removing unknown is fine
+    mgr.remove("default/p1")
+    mgr.remove("default/ghost")
+    # remove_all tears down everything
+    mgr.create("a/1")
+    mgr.create("a/2")
+    mgr.remove_all()
+    assert not mgr.exists("a/1") and not mgr.exists("a/2")
+
+
+def test_hollow_kubelet_real_sandboxes():
+    """A pod going Running on the hollow node spawns a real pause
+    process; deleting the pod tears the sandbox down."""
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+    from kubernetes_tpu.store import Store
+    from kubernetes_tpu.testutil import make_pod
+
+    clock = [0.0]
+    cs = Clientset(Store())
+    kubelet = HollowKubelet(cs, "n1", clock=lambda: clock[0],
+                            real_sandboxes=True)
+    if kubelet.sandboxes is None:
+        import pytest
+
+        pytest.skip("no C toolchain")
+    kubelet.register()
+    cs.pods.create(make_pod("p1", node_name="n1"))
+    kubelet.tick()
+    clock[0] += 1.0
+    kubelet.tick()  # pod flips to Running AND is sandboxed this tick
+    assert kubelet.sandboxes.exists("default/p1")
+    cs.pods.delete("p1")
+    kubelet.tick()
+    assert not kubelet.sandboxes.exists("default/p1")
