@@ -243,6 +243,61 @@ def run_parity(backend_res: dict, n_nodes: int, n_pods: int, workload: str, seed
     }
 
 
+def run_micro() -> dict:
+    """Scheduler microbenchmark matrix (reference
+    ``scheduler_perf/scheduler_bench_test.go:32-51``): latency of ONE
+    ``Schedule()`` call over {100, 1000 nodes} x {0, 1000 scheduled
+    pods}, for the CPU oracle, plus the TPU batch path's amortized
+    per-pod cost at each cell (its per-call floor is the kernel launch,
+    so the honest number is batched)."""
+    from kubernetes_tpu.ops.backend import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, PriorityContext
+    from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    results = {}
+    for n_nodes in (100, 1000):
+        for n_scheduled in (0, 1000):
+            node_info_map = {}
+            for i in range(n_nodes):
+                node = make_node(
+                    f"node-{i:04d}", cpu="32", memory="64Gi", pods=110,
+                    labels={"kubernetes.io/hostname": f"node-{i:04d}",
+                            ZONE: f"zone-{i % 3}"},
+                )
+                node_info_map[node.meta.name] = NodeInfo(node)
+            for i in range(n_scheduled):
+                pod = make_pod(f"sched-{i:05d}", cpu="100m", memory="128Mi",
+                               labels={"app": "web"},
+                               node_name=f"node-{i % n_nodes:04d}")
+                node_info_map[pod.spec.node_name].add_pod(pod)
+            algo = GenericScheduler()
+            pctx = PriorityContext(node_info_map)
+            probe = make_pod("probe", cpu="100m", memory="128Mi",
+                             labels={"app": "web"})
+            algo.schedule(probe, node_info_map, pctx)  # warm caches
+            iters = 30 if n_nodes == 100 else 10
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                algo.schedule(probe, node_info_map, pctx)
+            oracle_us = (time.perf_counter() - t0) / iters * 1e6
+
+            # TPU path: amortized per-pod over a 1k-pod batch
+            pending = [make_pod(f"p-{i:05d}", cpu="100m", memory="128Mi",
+                                labels={"app": "web"}) for i in range(1000)]
+            backend = TPUBatchBackend(algorithm=algo)
+            backend.schedule_batch(pending, node_info_map, pctx)  # compile
+            t0 = time.perf_counter()
+            backend.schedule_batch(pending, node_info_map, pctx)
+            tpu_us = (time.perf_counter() - t0) / len(pending) * 1e6
+            key = f"{n_nodes}nodes/{n_scheduled}pods"
+            results[key] = {"oracle_us_per_schedule": round(oracle_us, 1),
+                            "tpu_us_per_pod_batched": round(tpu_us, 2)}
+            print(f"# micro {key}: oracle {oracle_us:.0f}us/Schedule, "
+                  f"tpu {tpu_us:.2f}us/pod (batched)", file=sys.stderr)
+    return results
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--preset", choices=PRESETS, default="north")
@@ -261,7 +316,23 @@ def main() -> None:
     parser.add_argument(
         "--compare", action="store_true", help="also run the oracle and report speedup to stderr"
     )
+    parser.add_argument(
+        "--micro", action="store_true",
+        help="Schedule()-latency matrix ({100,1000} nodes x {0,1000} pods)",
+    )
     args = parser.parse_args()
+
+    if args.micro:
+        matrix = run_micro()
+        cell = matrix["1000nodes/1000pods"]
+        print(json.dumps({
+            "metric": "schedule-latency-us",
+            "value": cell["oracle_us_per_schedule"],
+            "unit": "us/Schedule@1000nodes/1000pods",
+            "vs_baseline": 0,
+            "matrix": matrix,
+        }))
+        return
     n_nodes, n_pods, workload = PRESETS[args.preset]
     if args.nodes:
         n_nodes = args.nodes

@@ -166,27 +166,26 @@ class HollowKubelet:
                 if self._set_running(pod, now):
                     out["started"] += 1
                     started_keys.add(key)
-                    if self.sandboxes is not None:
-                        # RunPodSandbox in the same tick the pod starts
-                        self.sandboxes.create(key)
                 del self._starting[key]
         self._starting = {k: t for k, t in self._starting.items() if k in live}
 
         out["restarts"], still_running = self._sync_running(running)
         for gone in self.pod_manager.known() - live:
             self.pod_manager.forget(gone)
+        evicted_keys: set[str] = set()
+        out["evicted"] = self._eviction_pass(still_running, evicted_keys)
         if self.sandboxes is not None:
             # sandboxes exist exactly while the pod is Running (incl. pods
-            # started THIS tick): a pod that went Succeeded/Failed/Evicted
-            # this tick leaves the set and its pause process is stopped
-            # NOW, not at object deletion (the reference stops the sandbox
-            # on pod termination)
-            running_keys = {p.meta.key for p in still_running} | started_keys
+            # started THIS tick, excl. pods evicted this tick): a pod that
+            # went Succeeded/Failed/Evicted leaves the set and its pause
+            # process is stopped NOW, not at object deletion (the
+            # reference stops the sandbox on pod termination)
+            running_keys = ({p.meta.key for p in still_running}
+                            | started_keys) - evicted_keys
             for key in running_keys:
                 self.sandboxes.create(key)
             for gone in self.sandboxes.known() - running_keys:
                 self.sandboxes.remove(gone)
-        out["evicted"] = self._eviction_pass(still_running)
         return out
 
     def _sync_running(self, running: list[api.Pod]) -> tuple[int, list[api.Pod]]:
@@ -235,9 +234,12 @@ class HollowKubelet:
                 continue
         return restarts, still_running
 
-    def _eviction_pass(self, running: list[api.Pod]) -> int:
+    def _eviction_pass(self, running: list[api.Pod],
+                       evicted_keys: Optional[set] = None) -> int:
         """eviction_manager.go:213 synchronize — memory signal vs the
-        threshold; rank by QoS then usage; evict until under."""
+        threshold; rank by QoS then usage; evict until under.  Victims'
+        keys are added to ``evicted_keys`` so the caller's sandbox
+        reconcile drops their pause processes the same tick."""
         from .runtime import rank_for_eviction
 
         usage = self.runtime.pod_memory_usage
@@ -260,6 +262,8 @@ class HollowKubelet:
                 continue
             used -= usage.get(victim.meta.key, 0)
             self.pod_manager.forget(victim.meta.key)
+            if evicted_keys is not None:
+                evicted_keys.add(victim.meta.key)
             evicted += 1
         return evicted
 

@@ -328,7 +328,13 @@ class ProcessSandboxManager:
             proc.wait(timeout=timeout)
         except self._subprocess.TimeoutExpired:
             proc.kill()
-            proc.wait(timeout=timeout)
+            try:
+                # KILL is eventually fatal; a process stuck in D-state
+                # past this wait must not abort the caller's sweep and
+                # orphan every sandbox after it
+                proc.wait(timeout=timeout)
+            except self._subprocess.TimeoutExpired:
+                pass
 
     def remove_all(self) -> None:
         for key in list(self._procs):
