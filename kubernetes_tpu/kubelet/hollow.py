@@ -172,8 +172,8 @@ class HollowKubelet:
         out["restarts"], still_running = self._sync_running(running)
         for gone in self.pod_manager.known() - live:
             self.pod_manager.forget(gone)
-        evicted_keys: set[str] = set()
-        out["evicted"] = self._eviction_pass(still_running, evicted_keys)
+        evicted_keys = self._eviction_pass(still_running)
+        out["evicted"] = len(evicted_keys)
         if self.sandboxes is not None:
             # sandboxes exist exactly while the pod is Running (incl. pods
             # started THIS tick, excl. pods evicted this tick): a pod that
@@ -234,12 +234,11 @@ class HollowKubelet:
                 continue
         return restarts, still_running
 
-    def _eviction_pass(self, running: list[api.Pod],
-                       evicted_keys: Optional[set] = None) -> int:
+    def _eviction_pass(self, running: list[api.Pod]) -> set:
         """eviction_manager.go:213 synchronize — memory signal vs the
-        threshold; rank by QoS then usage; evict until under.  Victims'
-        keys are added to ``evicted_keys`` so the caller's sandbox
-        reconcile drops their pause processes the same tick."""
+        threshold; rank by QoS then usage; evict until under.  Returns the
+        victims' keys so the caller's sandbox reconcile drops their pause
+        processes the same tick."""
         from .runtime import rank_for_eviction
 
         usage = self.runtime.pod_memory_usage
@@ -247,9 +246,9 @@ class HollowKubelet:
         threshold = self._memory_capacity * self.memory_pressure_fraction
         under_pressure = used > threshold
         self._set_pressure_condition(under_pressure)
+        evicted: set = set()
         if not under_pressure:
-            return 0
-        evicted = 0
+            return evicted
         for victim in rank_for_eviction(running, usage):
             if used <= threshold:
                 break
@@ -262,9 +261,7 @@ class HollowKubelet:
                 continue
             used -= usage.get(victim.meta.key, 0)
             self.pod_manager.forget(victim.meta.key)
-            if evicted_keys is not None:
-                evicted_keys.add(victim.meta.key)
-            evicted += 1
+            evicted.add(victim.meta.key)
         return evicted
 
     def _pvc_to_pv(self, mine: list[api.Pod]):

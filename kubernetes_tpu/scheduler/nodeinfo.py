@@ -182,18 +182,31 @@ class SchedulerCache:
 
     # -- assume / confirm / forget ----------------------------------------
     def assume_pod(self, pod: api.Pod, node_name: str) -> None:
+        self.assume_many([(pod, node_name)])
+
+    def assume_many(self, pairs: list) -> None:
+        """Batch assume under ONE lock acquisition + deadline read — the
+        TPU path lands 150k assumptions at once and per-pod locking is
+        measurable at that scale.  Same semantics as assume_pod per pair."""
+        deadline = self._clock() + self._ttl
         with self._mu:
-            key = pod.meta.key
-            if key in self._pod_states:
-                raise ValueError(f"pod {key} already assumed/added")
-            self._node_info(node_name).add_pod(pod)
-            self._pod_states[key] = (pod, node_name, "assumed")
-            self._assume_deadlines[key] = self._clock() + self._ttl
+            for pod, node_name in pairs:
+                key = pod.meta.key
+                if key in self._pod_states:
+                    raise ValueError(f"pod {key} already assumed/added")
+                self._node_info(node_name).add_pod(pod)
+                self._pod_states[key] = (pod, node_name, "assumed")
+                self._assume_deadlines[key] = deadline
 
     def finish_binding(self, pod_key: str) -> None:
         """Binding RPC issued; start the expiry clock (``cache.go:130``)."""
+        self.finish_binding_many([pod_key])
+
+    def finish_binding_many(self, pod_keys: list) -> None:
+        deadline = self._clock() + self._ttl
         with self._mu:
-            self._assume_deadlines[pod_key] = self._clock() + self._ttl
+            for key in pod_keys:
+                self._assume_deadlines[key] = deadline
 
     def forget_pod(self, pod: api.Pod) -> None:
         """Bind failed: roll the assumption back (``cache.go:154``)."""

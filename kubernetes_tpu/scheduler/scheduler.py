@@ -293,54 +293,74 @@ class Scheduler:
         if not pods:
             return (0, 0)
         self.metrics.batch_size.observe(len(pods))
-        start = self._clock()
-        snapshot = self.snapshot()
-        pctx = self.priority_context(snapshot)
-        algo_start = self._clock()
-        assignments = self.backend.schedule_batch(pods, snapshot, pctx)
-        self.metrics.batch_device_latency.observe((self._clock() - algo_start) * 1e6)
+        # Cyclic GC is paused for the whole batch (tensorize + kernel +
+        # commit): at 150k pods a collection pass walks millions of live
+        # objects and costs more than everything it frees (the Go
+        # reference has a concurrent GC; Python's stop-the-world pass
+        # must not land inside the hot loop).
+        import gc as _gc
 
-        # assume everything first, then commit all bindings in one store txn
-        # (the batch generalization of the reference's async-bind pipeline,
-        # SURVEY.md P9), then roll back the individual CAS losers.
-        bound = failed = 0
-        to_bind: list[tuple[api.Pod, api.Binding]] = []
-        for pod, node_name in zip(pods, assignments):
-            self.metrics.schedule_attempts.inc()
-            if node_name is None:
-                self.handle_schedule_failure(pod, FitError(pod, {}))
-                failed += 1
-                continue
-            self.cache.assume_pod(pod, node_name)
-            self.backoff.forget(pod.meta.key)
-            to_bind.append(
-                (
-                    pod,
-                    api.Binding(
-                        pod_namespace=pod.meta.namespace,
-                        pod_name=pod.meta.name,
-                        node_name=node_name,
-                    ),
+        gc_was_enabled = _gc.isenabled()
+        _gc.disable()
+        try:
+            start = self._clock()
+            snapshot = self.snapshot()
+            pctx = self.priority_context(snapshot)
+            algo_start = self._clock()
+            assignments = self.backend.schedule_batch(pods, snapshot, pctx)
+            self.metrics.batch_device_latency.observe((self._clock() - algo_start) * 1e6)
+
+            # assume everything first, then commit all bindings in one
+            # store txn (the batch generalization of the reference's
+            # async-bind pipeline, SURVEY.md P9), then roll back the
+            # individual CAS losers.
+            bound = failed = 0
+            to_bind: list[tuple[api.Pod, api.Binding]] = []
+            to_assume: list[tuple[api.Pod, str]] = []
+            for pod, node_name in zip(pods, assignments):
+                if node_name is None:
+                    self.handle_schedule_failure(pod, FitError(pod, {}))
+                    failed += 1
+                    continue
+                to_assume.append((pod, node_name))
+                self.backoff.forget(pod.meta.key)
+                to_bind.append(
+                    (
+                        pod,
+                        api.Binding(
+                            pod_namespace=pod.meta.namespace,
+                            pod_name=pod.meta.name,
+                            node_name=node_name,
+                        ),
+                    )
                 )
-            )
-        bind_start = self._clock()
-        errors = self.clientset.pods.bind_many([b for _, b in to_bind])
-        self.metrics.binding_latency.observe((self._clock() - bind_start) * 1e6)
-        now = self._clock()
-        for (pod, binding), err in zip(to_bind, errors):
-            if err is None:
-                self.cache.finish_binding(pod.meta.key)
-                self._event(
-                    pod, "Normal", "Scheduled",
-                    f"Successfully assigned {pod.meta.key} to {binding.node_name}",
-                )
-                bound += 1
-            else:
-                logger.warning("bind failed for %s: %s", pod.meta.key, err)
-                self.cache.forget_pod(pod)
-                self._event(pod, "Warning", "FailedBinding", err)
-                failed += 1
-            self.metrics.e2e_scheduling_latency.observe((now - start) * 1e6)
+            self.metrics.schedule_attempts.inc(len(pods))
+            self.cache.assume_many(to_assume)
+            bind_start = self._clock()
+            errors = self.clientset.pods.bind_many([b for _, b in to_bind])
+            self.metrics.binding_latency.observe((self._clock() - bind_start) * 1e6)
+            now = self._clock()
+            finished: list[str] = []
+            for (pod, binding), err in zip(to_bind, errors):
+                if err is None:
+                    finished.append(pod.meta.key)
+                    if self.emit_events:
+                        self._event(
+                            pod, "Normal", "Scheduled",
+                            f"Successfully assigned {pod.meta.key} to {binding.node_name}",
+                        )
+                    bound += 1
+                else:
+                    logger.warning("bind failed for %s: %s", pod.meta.key, err)
+                    self.cache.forget_pod(pod)
+                    self._event(pod, "Warning", "FailedBinding", err)
+                    failed += 1
+            self.cache.finish_binding_many(finished)
+            self.metrics.e2e_scheduling_latency.observe_many(
+                (now - start) * 1e6, len(to_bind))
+        finally:
+            if gc_was_enabled:
+                _gc.enable()
         if self.emit_events and not self.broadcaster.running:
             # manual drive (no sink thread): drain synchronously so the
             # batch path's events land just like the per-pod path's
