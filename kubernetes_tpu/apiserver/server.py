@@ -272,9 +272,11 @@ def _make_handler(server: APIServer):
                     name = rest[3]
                     if len(rest) == 5 and rest[4] == "binding":
                         verb = "bind"
-                    elif len(rest) == 5 and rest[4] == "exec":
-                        # its own verb: create-pods rights must not imply
-                        # command execution (pods/exec subresource)
+                    elif len(rest) == 5 and rest[4] in ("exec", "attach", "cp"):
+                        # their own verb: create-pods rights must not imply
+                        # command execution / container IO (pods/exec,
+                        # pods/attach, pods/cp subresources — the reference
+                        # gates attach and cp-over-exec the same way)
                         verb = "exec"
                     elif len(rest) == 5 and rest[4] == "eviction":
                         # distinct verb so create-pods rights do not imply
@@ -495,6 +497,78 @@ def _make_handler(server: APIServer):
             self._last_code = 200
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _proxy_pod_simple(self, ns: str, name: str, q, endpoint: str,
+                              what: str) -> None:
+            """GET-style pod subresource proxied verbatim to the owning
+            kubelet (attach — reference ``pod/rest`` AttachREST)."""
+            import urllib.error
+            import urllib.request as _rq
+
+            resolved = self._resolve_pod_kubelet(ns, name, q)
+            if resolved is None:
+                return
+            kubelet_url, container, _ = resolved
+            try:
+                with _rq.urlopen(f"{kubelet_url}/{endpoint}/{ns}/{name}/{container}",
+                                 timeout=10) as resp:
+                    data = resp.read()
+            except urllib.error.HTTPError as e:
+                return self._error(e.code, "KubeletError", e.read().decode()[:200])
+            except Exception as e:
+                return self._error(502, "BadGateway", f"kubelet {what} failed: {e}")
+            self._last_code = 200
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _proxy_pod_cp(self, ns: str, name: str, q, method: str) -> None:
+            """pods/cp subresource: file read (GET) / write (PUT) proxied
+            to the kubelet's container file API, write-authenticated with
+            the cluster exec token (the reference streams tar over exec —
+            same capability, same credential class)."""
+            import urllib.error
+            import urllib.parse as _up
+            import urllib.request as _rq
+
+            from ..auth.authn import kubelet_exec_token
+
+            resolved = self._resolve_pod_kubelet(ns, name, q)
+            if resolved is None:
+                return
+            kubelet_url, container, node_name = resolved
+            path = q.get("path", [""])[0]
+            if not path:
+                return self._error(400, "BadRequest", "path required")
+            target = (f"{kubelet_url}/cp/{ns}/{name}/{container}"
+                      f"?path={_up.quote(path)}")
+            if method == "GET":
+                req = _rq.Request(target)
+            elif method == "PUT":
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                self._cached_body = {}  # raw body consumed here, not JSON
+                req = _rq.Request(
+                    target, data=raw, method="PUT",
+                    headers={"Authorization":
+                             f"Bearer {kubelet_exec_token(node_name)}"})
+            else:
+                return self._error(405, "MethodNotAllowed", method)
+            try:
+                with _rq.urlopen(req, timeout=30) as resp:
+                    data = resp.read()
+            except urllib.error.HTTPError as e:
+                return self._error(e.code, "KubeletError", e.read().decode()[:200])
+            except Exception as e:
+                return self._error(502, "BadGateway", f"kubelet cp failed: {e}")
+            self._last_code = 200
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -729,6 +803,11 @@ def _make_handler(server: APIServer):
                         return self._proxy_pod_log(ns, name, q)
                     if parts[4] == "exec" and kind == "Pod" and method == "POST":
                         return self._proxy_pod_exec(ns, name, q)
+                    if parts[4] == "attach" and kind == "Pod" and method == "GET":
+                        return self._proxy_pod_simple(
+                            ns, name, q, "attach", "attach stream")
+                    if parts[4] == "cp" and kind == "Pod":
+                        return self._proxy_pod_cp(ns, name, q, method)
                     if parts[4] == "eviction" and kind == "Pod" and method == "POST":
                         from ..client.clientset import Clientset, EvictionDisallowed
 

@@ -1206,6 +1206,338 @@ class Kubectl:
                        f"({'healthy' if ok else 'UNREACHABLE'})\n")
         return 0 if ok else 1
 
+    # -- attach / cp / port-forward / proxy (streaming verbs) --------------
+    def attach(self, name: str, namespace: Optional[str] = None,
+               container: str = "") -> int:
+        """``kubectl attach POD``: the container's output stream (no TTY
+        at this depth — reference attach without stdin)."""
+        import urllib.error
+        import urllib.request
+
+        ns = namespace or "default"
+        base = getattr(self.cs.store, "base_url", None)
+        try:
+            if base is None:
+                resolved = self._kubelet_target(name, ns, container)
+                if resolved is None:
+                    return 1
+                kubelet_url, c, _ = resolved
+                with urllib.request.urlopen(
+                        f"{kubelet_url}/attach/{ns}/{name}/{c}", timeout=10) as r:
+                    self.out.write(r.read().decode())
+            else:
+                path = f"/api/v1/namespaces/{ns}/pods/{name}/attach"
+                if container:
+                    path += f"?container={container}"
+                self.out.write(self.cs.store.raw("GET", path).decode())
+            return 0
+        except urllib.error.HTTPError as e:
+            self.out.write(f"error: {e.read().decode()}\n")
+            return 1
+        except Exception as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+
+    def cp(self, src: str, dst: str, namespace: Optional[str] = None,
+           container: str = "") -> int:
+        """``kubectl cp`` — ``local pod:/path`` or ``pod:/path local``
+        (reference cmd/cp.go: tar over exec; here the pods/cp
+        subresource)."""
+        import urllib.error
+        import urllib.parse as _up
+        import urllib.request
+
+        ns = namespace or "default"
+
+        def remote_parts(spec: str):
+            if ":" not in spec:
+                return None
+            pod, _, path = spec.partition(":")
+            return pod, path
+
+        src_r, dst_r = remote_parts(src), remote_parts(dst)
+        if (src_r is None) == (dst_r is None):
+            self.out.write("error: exactly one of SRC/DST must be POD:PATH\n")
+            return 1
+        pod, path = src_r or dst_r
+        base = getattr(self.cs.store, "base_url", None)
+        try:
+            if base is None:
+                resolved = self._kubelet_target(pod, ns, container)
+                if resolved is None:
+                    return 1
+                kubelet_url, c, node = resolved
+                target = (f"{kubelet_url}/cp/{ns}/{pod}/{c}"
+                          f"?path={_up.quote(path)}")
+                if src_r is not None:  # pod -> local
+                    with urllib.request.urlopen(target, timeout=30) as r:
+                        data = r.read()
+                    open(dst, "wb").write(data)
+                else:  # local -> pod
+                    from ..auth.authn import kubelet_exec_token
+
+                    req = urllib.request.Request(
+                        target, data=open(src, "rb").read(), method="PUT",
+                        headers={"Authorization":
+                                 f"Bearer {kubelet_exec_token(node)}"})
+                    urllib.request.urlopen(req, timeout=30).read()
+            else:
+                sub = (f"/api/v1/namespaces/{ns}/pods/{pod}/cp"
+                       f"?path={_up.quote(path)}")
+                if container:
+                    sub += f"&container={container}"
+                if src_r is not None:
+                    open(dst, "wb").write(self.cs.store.raw("GET", sub))
+                else:
+                    # raw() sends dict bodies; file bytes need a manual PUT
+                    req = urllib.request.Request(
+                        f"{base}{sub}", data=open(src, "rb").read(), method="PUT")
+                    token = getattr(self.cs.store, "token", None)
+                    if token:
+                        req.add_header("Authorization", f"Bearer {token}")
+                    urllib.request.urlopen(
+                        req, timeout=30,
+                        context=getattr(self.cs.store, "_ssl_ctx", None)).read()
+            self.out.write("copied\n")
+            return 0
+        except FileNotFoundError as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        except urllib.error.HTTPError as e:
+            self.out.write(f"error: {e.read().decode()}\n")
+            return 1
+        except Exception as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+
+    def port_forward(self, name: str, ports: str,
+                     namespace: Optional[str] = None):
+        """``kubectl port-forward POD LOCAL:REMOTE`` — a real local
+        listener forwarding each connection to the pod's IP (the
+        reference forwards SPDY streams via the kubelet; the pod IP is
+        the hollow fleet's reachable address).  Returns the forwarder
+        (caller stops it); None after printing an error."""
+        ns = namespace or "default"
+        try:
+            pod = self.cs.pods.get(name, ns)
+        except NotFoundError:
+            self.out.write(f'Error: pod "{name}" not found\n')
+            return None
+        if not pod.status.pod_ip:
+            self.out.write("error: pod has no IP\n")
+            return None
+        local_s, _, remote_s = ports.partition(":")
+        try:
+            remote = int(remote_s or local_s)
+            local = int(local_s) if local_s else 0
+        except ValueError:
+            self.out.write(f"error: invalid port spec {ports!r} "
+                           "(want LOCAL:REMOTE or PORT)\n")
+            return None
+        from ..proxy.userspace import UserspaceProxier
+
+        fwd = UserspaceProxier()
+        try:
+            port = fwd.set_service(f"port-forward/{ns}/{name}",
+                                   [(pod.status.pod_ip, remote)],
+                                   local_port=local)
+        except OSError as e:
+            self.out.write(f"error: cannot bind local port {local_s}: {e}\n")
+            return None
+        self.out.write(f"Forwarding from 127.0.0.1:{port} -> {remote}\n")
+        fwd.local_port = port
+        return fwd
+
+    def proxy(self, port: int = 0):
+        """``kubectl proxy``: local HTTP front door that forwards every
+        request to the apiserver with this client's credential attached
+        (reference cmd/proxy.go).  Returns the running server."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        store = self.cs.store
+        outer_out = self.out
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _forward(self, method):
+                import urllib.error
+
+                try:
+                    # bodies forward as RAW bytes: the proxy must not
+                    # assume JSON (cp PUTs file payloads through here)
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length) if length else None
+                    data = store.raw(method, self.path, body=body)
+                    code = 200
+                except urllib.error.HTTPError as e:
+                    data, code = e.read(), e.code
+                except Exception as e:  # noqa: BLE001
+                    data, code = str(e).encode(), 502
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._forward("GET")
+
+            def do_POST(self):
+                self._forward("POST")
+
+            def do_PUT(self):
+                self._forward("PUT")
+
+            def do_DELETE(self):
+                self._forward("DELETE")
+
+        if getattr(store, "base_url", None) is None:
+            outer_out.write("error: proxy requires --server\n")
+            return None
+        httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        outer_out.write(f"Starting to serve on 127.0.0.1:{httpd.server_port}\n")
+        httpd.local_port = httpd.server_port
+        return httpd
+
+    # -- explain / edit (cmd/explain.go, cmd/edit.go) ----------------------
+    def explain(self, resource: str) -> int:
+        """``kubectl explain RESOURCE[.field...]``: the wire schema of a
+        kind, derived from the live type registry (the discovery-driven
+        analogue of the reference's OpenAPI-backed explain)."""
+        parts = resource.split(".")
+        plural, kind = _resolve(parts[0])
+        if kind is None:
+            self.out.write(f"error: unknown resource {parts[0]!r}\n")
+            return 1
+        cls = api.KINDS[kind]
+        doc = cls().to_dict()
+        for seg in parts[1:]:
+            if not isinstance(doc, dict) or seg not in doc:
+                self.out.write(f"error: field {seg!r} does not exist\n")
+                return 1
+            doc = doc[seg]
+            if isinstance(doc, list):
+                doc = doc[0] if doc else {}
+        self.out.write(f"KIND:     {kind}\n")
+        if cls.__doc__:
+            self.out.write(f"DESCRIPTION:\n  {cls.__doc__.strip().splitlines()[0]}\n")
+        self.out.write("FIELDS:\n")
+
+        def emit(d, indent):
+            if not isinstance(d, dict):
+                self.out.write(f"{' ' * indent}<{type(d).__name__}>\n")
+                return
+            for k, v in sorted(d.items()):
+                tname = ("Object" if isinstance(v, dict)
+                         else "[]Object" if isinstance(v, list)
+                         else type(v).__name__)
+                self.out.write(f"{' ' * indent}{k}\t<{tname}>\n")
+
+        emit(doc, 2)
+        return 0
+
+    def edit(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
+        """``kubectl edit``: object -> $EDITOR -> update (the reference's
+        edit loop without the conflict-retry interactive path)."""
+        import os
+        import subprocess
+        import tempfile
+
+        resource, kind = _resolve(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return 1
+        client = self.cs.client_for(kind)
+        try:
+            obj = client.get(name, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        editor = os.environ.get("EDITOR", "vi")
+        with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+            yaml.safe_dump(obj.to_dict(), f, sort_keys=False)
+            tmp = f.name
+        try:
+            rc = subprocess.run([*editor.split(), tmp]).returncode
+            if rc != 0:
+                self.out.write("Edit cancelled\n")
+                return 1
+            edited = yaml.safe_load(open(tmp).read())
+        finally:
+            os.unlink(tmp)
+        if edited == obj.to_dict():
+            self.out.write("Edit cancelled, no changes made\n")
+            return 0
+
+        def _replace(cur):
+            new = type(cur).from_dict(edited)
+            new.meta.uid = cur.meta.uid
+            new.meta.resource_version = cur.meta.resource_version
+            return new
+
+        client.guaranteed_update(name, _replace, namespace)
+        self.out.write(f"{resource}/{name} edited\n")
+        return 0
+
+    # -- rolling-update (cmd/rollingupdate.go, rolling_updater.go) ---------
+    def rolling_update(self, old_name: str, image: str,
+                       namespace: Optional[str] = None,
+                       new_name: str = "", drive=None) -> int:
+        """Client-side rolling update of a ReplicaSet (the reference's
+        kubectl rolling-update on RCs): create the new RS at 0, then step
+        new up / old down one replica at a time, finally delete the old.
+        ``drive`` (callable) runs controllers between steps so replica
+        counts actually converge (tests pass a manager pump; against a
+        live cluster the controller manager does it)."""
+        ns = namespace or "default"
+        try:
+            old = self.cs.replicasets.get(old_name, ns)
+        except NotFoundError:
+            self.out.write(f'Error: replicaset "{old_name}" not found\n')
+            return 1
+        new_name = new_name or f"{old_name}-next"
+        desired = old.replicas
+        new_rs = type(old).from_dict(old.to_dict())
+        new_rs.meta = api.ObjectMeta(name=new_name, namespace=ns,
+                                     labels=dict(old.meta.labels))
+        new_rs.replicas = 0
+        # distinct selector + template labels so the two RSes never adopt
+        # each other's pods (the reference requires a differentiating label)
+        bump = {"rolling-update": new_name}
+        new_rs.selector = api.LabelSelector.from_match_labels(
+            {**old.selector.match_labels, **bump})
+        new_rs.template.labels.update(bump)
+        if new_rs.template.spec.containers:
+            new_rs.template.spec.containers[0].image = image
+        try:
+            self.cs.replicasets.create(new_rs)
+        except AlreadyExistsError:
+            self.out.write(f'Error: replicaset "{new_name}" already exists\n')
+            return 1
+        self.out.write(f"Created {new_name}\n")
+        for step in range(1, desired + 1):
+            def _scale_new(rs, n=step):
+                rs.replicas = n
+                return rs
+
+            def _scale_old(rs, n=desired - step):
+                rs.replicas = n
+                return rs
+
+            self.cs.replicasets.guaranteed_update(new_name, _scale_new, ns)
+            self.cs.replicasets.guaranteed_update(old_name, _scale_old, ns)
+            self.out.write(f"Scaling {new_name} up to {step}, "
+                           f"{old_name} down to {desired - step}\n")
+            if drive is not None:
+                drive()
+        self.cs.replicasets.delete(old_name, ns)
+        self.out.write(f"Update succeeded. Deleting {old_name}\n")
+        return 0
+
     # -- wait (cmd/wait.go) ------------------------------------------------
     def wait_for(self, resource: str, name: str, condition: str,
                  namespace: Optional[str] = None, timeout: float = 30.0) -> int:
@@ -1358,6 +1690,36 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("--for", dest="condition", required=True,
                    help="condition=TYPE or delete")
     p.add_argument("--timeout", type=float, default=30.0)
+    p = sub.add_parser("attach", parents=[common])
+    p.add_argument("name")
+    p.add_argument("-c", "--container", default="")
+    p = sub.add_parser("cp", parents=[common])
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.add_argument("-c", "--container", default="")
+    p = sub.add_parser("port-forward", parents=[common])
+    p.add_argument("name")
+    p.add_argument("ports", help="LOCAL:REMOTE or PORT")
+    p = sub.add_parser("proxy", parents=[common])
+    p.add_argument("--port", type=int, default=0)
+    p = sub.add_parser("explain", parents=[common])
+    p.add_argument("resource", help="RESOURCE[.field...]")
+    p = sub.add_parser("edit", parents=[common])
+    p.add_argument("resource")
+    p.add_argument("name")
+    p = sub.add_parser("rolling-update", parents=[common])
+    p.add_argument("old")
+    p.add_argument("--image", required=True)
+    p.add_argument("--new-name", default="")
+
+    # plugin dispatch BEFORE argparse rejects the verb: the FIRST token
+    # (plugin convention — never a flag's value, never a later positional)
+    # names either a built-in or a kubectl-<verb> plugin
+    raw_args = list(argv) if argv is not None else sys.argv[1:]
+    if raw_args and not raw_args[0].startswith("-") and raw_args[0] not in sub.choices:
+        rc = _run_plugin(raw_args[0], raw_args[1:], out or sys.stdout)
+        if rc is not None:
+            return rc
 
     args = parser.parse_args(argv)
     server = getattr(args, "server", "http://127.0.0.1:8080")
@@ -1467,7 +1829,66 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
             k.out.write("error: wait requires RESOURCE/NAME\n")
             return 1
         return k.wait_for(res, name, args.condition, namespace, args.timeout)
+    if args.verb == "attach":
+        return k.attach(args.name, namespace, args.container)
+    if args.verb == "cp":
+        return k.cp(args.src, args.dst, namespace, args.container)
+    if args.verb == "port-forward":
+        fwd = k.port_forward(args.name, args.ports, namespace)
+        if fwd is None:
+            return 1
+        try:
+            import time as _time
+
+            while True:  # serve until interrupted (reference behavior)
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            fwd.stop()
+            return 0
+    if args.verb == "proxy":
+        httpd = k.proxy(args.port)
+        if httpd is None:
+            return 1
+        try:
+            import time as _time
+
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            httpd.shutdown()
+            return 0
+    if args.verb == "explain":
+        return k.explain(args.resource)
+    if args.verb == "edit":
+        return k.edit(args.resource, args.name, namespace)
+    if args.verb == "rolling-update":
+        return k.rolling_update(args.old, args.image, namespace, args.new_name)
     return 2
+
+
+def _run_plugin(verb: str, rest: list[str], out) -> Optional[int]:
+    """kubectl plugin mechanism (reference ``pkg/kubectl/plugins``): an
+    unknown verb resolves to an executable ``kubectl-<verb>`` on
+    KUBECTL_PLUGINS_PATH (then PATH) and runs with the remaining args."""
+    import os
+    import shutil
+    import subprocess
+
+    name = f"kubectl-{verb}"
+    candidate = None
+    for d in os.environ.get("KUBECTL_PLUGINS_PATH", "").split(os.pathsep):
+        if d and os.path.isfile(os.path.join(d, name)) and os.access(
+                os.path.join(d, name), os.X_OK):
+            candidate = os.path.join(d, name)
+            break
+    candidate = candidate or shutil.which(name)
+    if candidate is None:
+        return None
+    proc = subprocess.run([candidate, *rest], capture_output=True, text=True)
+    out.write(proc.stdout)
+    if proc.stderr:
+        out.write(proc.stderr)
+    return proc.returncode
 
 
 if __name__ == "__main__":

@@ -179,16 +179,22 @@ class RemoteStore:
             return binwire.decode(raw)
         return json.loads(raw.decode())
 
-    def raw(self, method: str, path: str, body: Optional[dict] = None,
+    def raw(self, method: str, path: str, body=None,
             timeout: Optional[float] = None) -> bytes:
         """Raw request carrying the store's credential and TLS context —
         the path for non-resource endpoints (discovery, /version,
         /healthz, subresource streams) so callers never hand-roll a
-        urlopen that would drop the token or the pinned CA."""
-        data = json.dumps(body).encode() if body is not None else None
+        urlopen that would drop the token or the pinned CA.  ``body`` may
+        be a dict (JSON-encoded) or raw bytes (forwarded verbatim, e.g.
+        file payloads through kubectl proxy)."""
+        if isinstance(body, (bytes, bytearray)):
+            data = bytes(body)
+            headers = {}
+        else:
+            data = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if data else {}
         req = urllib.request.Request(
-            f"{self.base_url}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {})
+            f"{self.base_url}{path}", data=data, method=method, headers=headers)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         with urllib.request.urlopen(

@@ -92,6 +92,17 @@ class FakeRuntime:
         self._logs: dict[tuple[str, str], list[str]] = {}
         # (pod_key, container) -> exec handler (the CRI ExecSync stand-in)
         self._exec_handlers: dict = {}
+        # (pod_key, container) -> {path: bytes} — the container filesystem
+        # stand-in backing ``kubectl cp`` (the reference streams tar over
+        # exec; the capability is per-container file read/write)
+        self._files: dict[tuple[str, str], dict[str, bytes]] = {}
+
+    def write_file(self, pod_key: str, container: str, path: str, data: bytes) -> None:
+        self._files.setdefault((pod_key, container), {})[path] = bytes(data)
+
+    def read_file(self, pod_key: str, container: str, path: str):
+        """Bytes, or None if absent."""
+        return self._files.get((pod_key, container), {}).get(path)
 
     def append_log(self, pod_key: str, container: str, line: str) -> None:
         self._logs.setdefault((pod_key, container), []).append(line)

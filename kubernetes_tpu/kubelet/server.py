@@ -98,6 +98,51 @@ def _make_handler(kubelet, server_ref=None):
                     lines = lines[-int(tail):]
                 return self._send(200, ("\n".join(lines) + "\n" if lines else "").encode(),
                                   "text/plain")
+            if len(parts) == 4 and parts[0] == "attach":
+                # attach = the container's live output stream; at this
+                # depth (no TTY) it serves the stream so far, like the
+                # reference's attach without stdin.  A silent container is
+                # an EMPTY stream, not a 404 — existence is judged by the
+                # pod spec, not by whether it has logged yet.
+                _, ns, pod, container = parts
+                key = f"{ns}/{pod}"
+                target = next((p2 for p2 in kubelet._my_pods() if p2.meta.key == key), None)
+                if target is None:
+                    return self._send(404, b"pod not on this node", "text/plain")
+                if container not in [c.name for c in target.spec.containers]:
+                    return self._send(404, b"container not found", "text/plain")
+                lines = kubelet.runtime.read_logs(key, container) or []
+                return self._send(200, ("\n".join(lines) + "\n" if lines else "").encode(),
+                                  "text/plain")
+            if len(parts) == 4 and parts[0] == "cp":
+                _, ns, pod, container = parts
+                q = parse_qs(url.query)
+                path = q.get("path", [""])[0]
+                data = kubelet.runtime.read_file(f"{ns}/{pod}", container, path)
+                if data is None:
+                    return self._send(404, b"file not found", "text/plain")
+                return self._send(200, data, "application/octet-stream")
+            return self._send(404, b"not found", "text/plain")
+
+        def do_PUT(self):
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            if len(parts) == 4 and parts[0] == "cp":
+                # cp is a WRITE capability like exec: same token gate
+                token = server_ref.exec_token
+                if token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth != f"Bearer {token}":
+                        return self._send(401, b"unauthorized", "text/plain")
+                _, ns, pod, container = parts
+                q = parse_qs(url.query)
+                path = q.get("path", [""])[0]
+                if not path:
+                    return self._send(400, b"path required", "text/plain")
+                length = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(length) if length else b""
+                kubelet.runtime.write_file(f"{ns}/{pod}", container, path, data)
+                return self._send(200, b"{}")
             return self._send(404, b"not found", "text/plain")
 
         def do_POST(self):

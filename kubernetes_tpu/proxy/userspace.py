@@ -52,15 +52,17 @@ class UserspaceProxier:
 
     # -- service table (OnServiceUpdate / OnEndpointsUpdate) ---------------
     def set_service(self, key: str, backends: list[tuple[str, int]],
-                    affinity: str = "None") -> int:
+                    affinity: str = "None", local_port: int = 0) -> int:
         """Create/update a proxied service; returns the local proxy port
-        (the reference allocates a node port per userspace service)."""
+        (the reference allocates a node port per userspace service).
+        ``local_port`` pins the listener (port-forward's LOCAL:REMOTE);
+        0 = ephemeral."""
         with self._lock:
             st = self._services.get(key)
             if st is None:
                 listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-                listener.bind((self.host, 0))
+                listener.bind((self.host, local_port))
                 listener.listen(64)
                 st = _ServiceState(listener=listener,
                                    proxy_port=listener.getsockname()[1])
