@@ -45,16 +45,26 @@ def main(argv=None) -> int:
     ap.add_argument("--audit-log", default=None)
     ap.add_argument("--event-log-window", type=int, default=300_000)
     ap.add_argument("--disable-admission", action="store_true")
+    ap.add_argument("--data-dir", default=None,
+                    help="durable state directory (WAL + snapshots; "
+                         "restart recovers the cluster — the etcd analogue)")
+    ap.add_argument("--fsync", action="store_true",
+                    help="fsync every WAL append (durability over latency)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
+    store_kw = dict(event_log_window=args.event_log_window,
+                    data_dir=args.data_dir, fsync=args.fsync)
     if args.disable_admission:
-        store = Store(event_log_window=args.event_log_window)
+        store = Store(**store_kw)
     else:
         from ..admission import AdmittedStore
 
-        store = AdmittedStore(default_chain(), event_log_window=args.event_log_window)
+        store = AdmittedStore(default_chain(), **store_kw)
+    if args.data_dir:
+        logging.info("durable store at %s (recovered to revision %d)",
+                     args.data_dir, store.revision)
 
     tokens = parse_token_file(args.token_file) if args.token_file else None
     authorizer = None

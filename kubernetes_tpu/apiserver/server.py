@@ -547,16 +547,16 @@ def _make_handler(server: APIServer):
                 return self._error(400, "BadRequest", "path required")
             target = (f"{kubelet_url}/cp/{ns}/{name}/{container}"
                       f"?path={_up.quote(path)}")
+            # both directions carry the exec credential: cp READ is an
+            # exec-class capability (file exfiltration) on the kubelet too
+            auth = {"Authorization": f"Bearer {kubelet_exec_token(node_name)}"}
             if method == "GET":
-                req = _rq.Request(target)
+                req = _rq.Request(target, headers=auth)
             elif method == "PUT":
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
                 self._cached_body = {}  # raw body consumed here, not JSON
-                req = _rq.Request(
-                    target, data=raw, method="PUT",
-                    headers={"Authorization":
-                             f"Bearer {kubelet_exec_token(node_name)}"})
+                req = _rq.Request(target, data=raw, method="PUT", headers=auth)
             else:
                 return self._error(405, "MethodNotAllowed", method)
             try:

@@ -1267,19 +1267,20 @@ class Kubectl:
                 if resolved is None:
                     return 1
                 kubelet_url, c, node = resolved
+                from ..auth.authn import kubelet_exec_token
+
                 target = (f"{kubelet_url}/cp/{ns}/{pod}/{c}"
                           f"?path={_up.quote(path)}")
+                auth = {"Authorization": f"Bearer {kubelet_exec_token(node)}"}
                 if src_r is not None:  # pod -> local
-                    with urllib.request.urlopen(target, timeout=30) as r:
+                    req = urllib.request.Request(target, headers=auth)
+                    with urllib.request.urlopen(req, timeout=30) as r:
                         data = r.read()
                     open(dst, "wb").write(data)
                 else:  # local -> pod
-                    from ..auth.authn import kubelet_exec_token
-
                     req = urllib.request.Request(
                         target, data=open(src, "rb").read(), method="PUT",
-                        headers={"Authorization":
-                                 f"Bearer {kubelet_exec_token(node)}"})
+                        headers=auth)
                     urllib.request.urlopen(req, timeout=30).read()
             else:
                 sub = (f"/api/v1/namespaces/{ns}/pods/{pod}/cp"
@@ -1462,11 +1463,19 @@ class Kubectl:
             yaml.safe_dump(obj.to_dict(), f, sort_keys=False)
             tmp = f.name
         try:
-            rc = subprocess.run([*editor.split(), tmp]).returncode
+            try:
+                rc = subprocess.run([*editor.split(), tmp]).returncode
+            except OSError as e:
+                self.out.write(f"error: cannot run editor {editor!r}: {e}\n")
+                return 1
             if rc != 0:
                 self.out.write("Edit cancelled\n")
                 return 1
-            edited = yaml.safe_load(open(tmp).read())
+            try:
+                edited = yaml.safe_load(open(tmp).read())
+            except yaml.YAMLError as e:
+                self.out.write(f"error: edited file is not valid YAML: {e}\n")
+                return 1
         finally:
             os.unlink(tmp)
         if edited == obj.to_dict():

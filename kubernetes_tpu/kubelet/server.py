@@ -115,33 +115,56 @@ def _make_handler(kubelet, server_ref=None):
                 return self._send(200, ("\n".join(lines) + "\n" if lines else "").encode(),
                                   "text/plain")
             if len(parts) == 4 and parts[0] == "cp":
-                _, ns, pod, container = parts
+                # cp READ is an exec-class capability too (it exfiltrates
+                # container files): same token gate as exec/cp-write
+                resolved = self._resolve_cp(parts)
+                if resolved is None:
+                    return
+                key, container = resolved
                 q = parse_qs(url.query)
                 path = q.get("path", [""])[0]
-                data = kubelet.runtime.read_file(f"{ns}/{pod}", container, path)
+                data = kubelet.runtime.read_file(key, container, path)
                 if data is None:
                     return self._send(404, b"file not found", "text/plain")
                 return self._send(200, data, "application/octet-stream")
             return self._send(404, b"not found", "text/plain")
 
+        def _resolve_cp(self, parts):
+            """Shared cp validation: exec token + pod-on-node +
+            container-in-spec (the same gates exec/attach apply).  Returns
+            (pod_key, container) or None after writing the error."""
+            token = server_ref.exec_token
+            if token:
+                auth = self.headers.get("Authorization", "")
+                if auth != f"Bearer {token}":
+                    self._send(401, b"unauthorized", "text/plain")
+                    return None
+            _, ns, pod, container = parts
+            key = f"{ns}/{pod}"
+            target = next((p2 for p2 in kubelet._my_pods() if p2.meta.key == key), None)
+            if target is None:
+                self._send(404, b"pod not on this node", "text/plain")
+                return None
+            if container not in [c.name for c in target.spec.containers]:
+                self._send(404, b"container not found", "text/plain")
+                return None
+            return key, container
+
         def do_PUT(self):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
             if len(parts) == 4 and parts[0] == "cp":
-                # cp is a WRITE capability like exec: same token gate
-                token = server_ref.exec_token
-                if token:
-                    auth = self.headers.get("Authorization", "")
-                    if auth != f"Bearer {token}":
-                        return self._send(401, b"unauthorized", "text/plain")
-                _, ns, pod, container = parts
+                resolved = self._resolve_cp(parts)
+                if resolved is None:
+                    return
+                key, container = resolved
                 q = parse_qs(url.query)
                 path = q.get("path", [""])[0]
                 if not path:
                     return self._send(400, b"path required", "text/plain")
                 length = int(self.headers.get("Content-Length", 0))
                 data = self.rfile.read(length) if length else b""
-                kubelet.runtime.write_file(f"{ns}/{pod}", container, path, data)
+                kubelet.runtime.write_file(key, container, path, data)
                 return self._send(200, b"{}")
             return self._send(404, b"not found", "text/plain")
 

@@ -132,7 +132,9 @@ class Watch:
 class Store:
     """In-process strongly-ordered object store (etcd3 + watch-cache analogue)."""
 
-    def __init__(self, event_log_window: int = 100_000):
+    def __init__(self, event_log_window: int = 100_000,
+                 data_dir: Optional[str] = None, fsync: bool = False,
+                 compact_every: int = 100_000):
         self._mu = threading.RLock()
         self._rev = 0
         # kind -> {key -> _Item}
@@ -144,6 +146,41 @@ class Store:
         self._log: collections.deque[WatchEvent] = collections.deque(maxlen=event_log_window)
         self._log_window = event_log_window
         self._watchers: list[tuple[Optional[str], "queue.Queue[Optional[WatchEvent]]"]] = []
+        # durability (the etcd WAL+snapshot analogue, store/wal.py):
+        # with a data_dir every committed event is logged before the call
+        # returns, and a fresh Store over the same dir recovers the state
+        self._wal = None
+        if data_dir is not None:
+            from .wal import WriteAheadLog
+
+            self._wal = WriteAheadLog(data_dir, compact_every=compact_every,
+                                      fsync=fsync)
+            rev, objects, _ = self._wal.recover()
+            self._rev = rev
+            for kind, bucket in objects.items():
+                for key, data in bucket.items():
+                    self._objects.setdefault(kind, {})[key] = _Item(
+                        data=data,
+                        revision=int(data.get("metadata", {}).get("resourceVersion", rev)),
+                    )
+            self._wal.open()
+
+    def compact(self) -> None:
+        """Write a snapshot and truncate the WAL (etcd compaction).  No
+        copy needed: write_snapshot serializes synchronously while we
+        hold the store lock, so the live dicts cannot mutate mid-encode."""
+        if self._wal is None:
+            return
+        with self._mu:
+            objects = {
+                kind: {key: item.data for key, item in bucket.items()}
+                for kind, bucket in self._objects.items()
+            }
+            self._wal.write_snapshot(self._rev, objects)
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
 
     # -- revision ----------------------------------------------------------
     @property
@@ -349,6 +386,16 @@ class Store:
         # emit time and handed to the log and every watcher.  Consumers must
         # not mutate it (the informer parses it into fresh typed objects;
         # the mutation detector catches violations in tests).
+        if self._wal is not None:
+            # durability BEFORE visibility: the record is on disk before
+            # any watcher (or the caller) observes the commit
+            self._wal.append(ev.type, ev.kind, ev.key, ev.revision, ev.object)
+            if self._wal.needs_compaction():
+                objects = {
+                    kind: {key: item.data for key, item in bucket.items()}
+                    for kind, bucket in self._objects.items()
+                }
+                self._wal.write_snapshot(self._rev, objects)
         self._log.append(ev)  # deque maxlen trims the window in C
         for kind, q in self._watchers:
             if kind is None or kind == ev.kind:

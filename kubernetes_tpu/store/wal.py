@@ -1,0 +1,140 @@
+"""Durable persistence for the store: write-ahead log + snapshot.
+
+The reference's L0 is etcd: every write lands in a raft-replicated WAL
+before it is acknowledged, and periodic snapshots bound replay time
+(``vendor/github.com/coreos/etcd``; forked WAL code under
+``third_party/forked/etcd221``).  This module gives the in-process store
+the same durability contract on one node:
+
+- every committed event appends a length-prefixed record to ``wal.bin``
+  (binary wire codec — the same serialization the HTTP layer negotiates),
+- ``snapshot.bin`` holds a full state image at a revision; opening a
+  store replays snapshot + WAL tail,
+- compaction rewrites the snapshot and truncates the WAL once it grows
+  past ``compact_every`` records,
+- a torn final record (crash mid-append) is detected by its length
+  prefix and dropped — exactly the record that was never acknowledged.
+
+Replication/HA remains by the reference's own split: the store process
+is the etcd analogue; stateless apiservers above it restart freely, and
+control-plane daemons fail over with leader election.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Optional
+
+from ..api import wire
+
+SNAPSHOT = "snapshot.bin"
+WAL = "wal.bin"
+_LEN = struct.Struct(">I")
+
+
+class WriteAheadLog:
+    def __init__(self, data_dir: str, compact_every: int = 100_000,
+                 fsync: bool = False):
+        os.makedirs(data_dir, exist_ok=True)
+        self.dir = data_dir
+        self.compact_every = compact_every
+        self.fsync = fsync
+        self._mu = threading.Lock()
+        self._wal_path = os.path.join(data_dir, WAL)
+        self._snap_path = os.path.join(data_dir, SNAPSHOT)
+        self._f = None
+        self._records_since_snapshot = 0
+
+    # -- recovery ----------------------------------------------------------
+    def recover(self) -> tuple[int, dict, int]:
+        """Returns (revision, {kind: {key: data}}, replayed_records)."""
+        rev = 0
+        objects: dict[str, dict[str, dict]] = {}
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                snap = wire.decode(f.read())
+            rev = int(snap["rev"])
+            objects = snap["objects"]
+        replayed = 0
+        valid_end = 0
+        for rec, offset in self._read_wal():
+            replayed += 1
+            valid_end = offset
+            rev = max(rev, int(rec["r"]))
+            kind, key = rec["k"], rec["key"]
+            bucket = objects.setdefault(kind, {})
+            if rec["t"] == "DELETED":
+                bucket.pop(key, None)
+            else:
+                bucket[key] = rec["o"]
+        # drop the torn/corrupt tail NOW: future appends must follow the
+        # last valid record, or they'd be unreachable behind the garbage
+        if (os.path.exists(self._wal_path)
+                and os.path.getsize(self._wal_path) > valid_end):
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(valid_end)
+        self._records_since_snapshot = replayed
+        return rev, objects, replayed
+
+    def _read_wal(self):
+        """Yields (record, end_offset) for every intact record."""
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            while True:
+                head = f.read(_LEN.size)
+                if len(head) < _LEN.size:
+                    return  # clean EOF or torn length prefix
+                (n,) = _LEN.unpack(head)
+                payload = f.read(n)
+                if len(payload) < n:
+                    return  # torn record: crash mid-append, never acked
+                try:
+                    yield wire.decode(payload), f.tell()
+                except ValueError:
+                    return  # corrupt tail
+
+    # -- append ------------------------------------------------------------
+    def open(self) -> None:
+        self._f = open(self._wal_path, "ab")
+
+    def append(self, ev_type: str, kind: str, key: str, rev: int,
+               obj: dict) -> None:
+        payload = wire.encode({"t": ev_type, "k": kind, "key": key,
+                               "r": rev, "o": obj})
+        with self._mu:
+            if self._f is None:
+                self.open()
+            self._f.write(_LEN.pack(len(payload)))
+            self._f.write(payload)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+            self._records_since_snapshot += 1
+
+    def needs_compaction(self) -> bool:
+        return self._records_since_snapshot >= self.compact_every
+
+    # -- snapshot / compaction ----------------------------------------------
+    def write_snapshot(self, rev: int, objects: dict) -> None:
+        """Atomic snapshot + WAL truncation (the never-lose-state order:
+        new snapshot durable FIRST, then drop the log it subsumes)."""
+        with self._mu:
+            tmp = f"{self._snap_path}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(wire.encode({"rev": rev, "objects": objects}))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snap_path)
+            if self._f is not None:
+                self._f.close()
+            self._f = open(self._wal_path, "wb")  # truncate
+            self._records_since_snapshot = 0
+
+    def close(self) -> None:
+        with self._mu:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

@@ -142,3 +142,155 @@ def test_expired_revision():
         s.create("Pod", make_pod_dict(f"p{i}"))
     with pytest.raises(ExpiredRevisionError):
         s.watch("Pod", from_revision=1)
+
+
+# -- durability: WAL + snapshot + recovery (the etcd analogue) -------------
+
+
+def _mk(name, ns="default", labels=None):
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": dict(labels or {})},
+            "spec": {}, "status": {"phase": "Pending"}}
+
+
+def test_wal_recovery_roundtrip(tmp_path):
+    d = str(tmp_path / "state")
+    s = Store(data_dir=d)
+    s.create("Pod", _mk("a"))
+    s.create("Pod", _mk("b", labels={"app": "web"}))
+    b = s.get("Pod", "default", "b")
+    b["status"]["phase"] = "Running"
+    s.update("Pod", b)
+    s.delete("Pod", "default", "a")
+    rev = s.revision
+    s.close()
+
+    s2 = Store(data_dir=d)
+    pods, _ = s2.list("Pod", None)
+    assert [p["metadata"]["name"] for p in pods] == ["b"]
+    assert pods[0]["status"]["phase"] == "Running"
+    assert pods[0]["metadata"]["labels"] == {"app": "web"}
+    # revision continuity: new writes continue AFTER the recovered rev
+    assert s2.revision == rev
+    created = s2.create("Pod", _mk("c"))
+    assert int(created["metadata"]["resourceVersion"]) == rev + 1
+    s2.close()
+
+
+def test_wal_survives_many_restarts(tmp_path):
+    d = str(tmp_path / "state")
+    for i in range(5):
+        s = Store(data_dir=d)
+        s.create("Pod", _mk(f"p{i}"))
+        s.close()
+    s = Store(data_dir=d)
+    assert len(s.list("Pod", None)[0]) == 5
+    s.close()
+
+
+def test_wal_torn_tail_is_dropped(tmp_path):
+    """A crash mid-append leaves a torn record; recovery keeps everything
+    acknowledged before it and drops only the unacked tail."""
+    d = str(tmp_path / "state")
+    s = Store(data_dir=d)
+    s.create("Pod", _mk("ok1"))
+    s.create("Pod", _mk("ok2"))
+    s.close()
+    wal = tmp_path / "state" / "wal.bin"
+    data = wal.read_bytes()
+    # simulate torn write: append a length prefix promising more than exists
+    wal.write_bytes(data + b"\x00\x00\x10\x00" + b"partial")
+    s2 = Store(data_dir=d)
+    assert {p["metadata"]["name"] for p in s2.list("Pod", None)[0]} == {"ok1", "ok2"}
+    # the store is writable after recovery from a torn tail
+    s2.create("Pod", _mk("ok3"))
+    s2.close()
+    s3 = Store(data_dir=d)
+    assert len(s3.list("Pod", None)[0]) == 3
+    s3.close()
+
+
+def test_compaction_snapshot_and_truncate(tmp_path):
+    d = str(tmp_path / "state")
+    s = Store(data_dir=d, compact_every=50)
+    for i in range(120):  # crosses the compaction threshold twice
+        s.create("Pod", _mk(f"p{i:03d}"))
+    s.close()
+    import os
+
+    snap_size = os.path.getsize(tmp_path / "state" / "snapshot.bin")
+    assert snap_size > 0
+    # WAL holds at most one compaction window, not all 120 records: a
+    # broken truncation (e.g. reopening append-mode) would fail here
+    from kubernetes_tpu.store.wal import WriteAheadLog
+
+    leftover = sum(1 for _ in WriteAheadLog(d)._read_wal())
+    assert leftover < 50, f"WAL not truncated by compaction ({leftover} records)"
+    s2 = Store(data_dir=d, compact_every=50)
+    assert len(s2.list("Pod", None)[0]) == 120
+    s2.close()
+    # explicit compact truncates the WAL entirely
+    s3 = Store(data_dir=d)
+    s3.compact()
+    assert os.path.getsize(tmp_path / "state" / "wal.bin") == 0
+    s3.close()
+    s4 = Store(data_dir=d)
+    assert len(s4.list("Pod", None)[0]) == 120
+    s4.close()
+
+
+def test_durable_store_watch_and_finalizers_across_restart(tmp_path):
+    d = str(tmp_path / "state")
+    s = Store(data_dir=d)
+    obj = _mk("guarded")
+    obj["metadata"]["finalizers"] = ["test/finalizer"]
+    s.create("Pod", obj)
+    s.delete("Pod", "default", "guarded")  # only MARKS deleting
+    s.close()
+    s2 = Store(data_dir=d)
+    got = s2.get("Pod", "default", "guarded")
+    assert got["metadata"].get("deletionRevision")  # tombstone survives
+    # clearing the finalizer after restart completes the delete
+    got["metadata"]["finalizers"] = []
+    s2.update("Pod", got)
+    import pytest as _p
+
+    with _p.raises(Exception):
+        s2.get("Pod", "default", "guarded")
+    # watches on the recovered store work from the current revision
+    w = s2.watch("Pod", from_revision=None)
+    s2.create("Pod", _mk("after"))
+    ev = w.get(timeout=2)
+    assert ev is not None and ev.key == "default/after"
+    w.stop()
+    s2.close()
+
+
+def test_durable_apiserver_end_to_end(tmp_path):
+    """Full wire restart: apiserver with --data-dir dies; a new process
+    over the same dir serves the same cluster."""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.client.remote import RemoteStore
+    from kubernetes_tpu.testutil import make_node
+
+    d = str(tmp_path / "state")
+    store = Store(data_dir=d)
+    server = APIServer(store)
+    server.start()
+    cs = Clientset(RemoteStore(server.url))
+    cs.nodes.create(make_node("n1", cpu="8"))
+    server.stop()
+    store.close()
+
+    store2 = Store(data_dir=d)
+    server2 = APIServer(store2)
+    server2.start()
+    try:
+        cs2 = Clientset(RemoteStore(server2.url))
+        node = cs2.nodes.get("n1")
+        assert str(node.status.allocatable["cpu"]) == "8"
+    finally:
+        server2.stop()
+        store2.close()
