@@ -1,0 +1,52 @@
+"""hyperkube: the all-in-one multiplexer binary (reference
+``cmd/hyperkube``, ``pkg/hyperkube``) — one entry point, every
+component:
+
+    python -m kubernetes_tpu apiserver --port 6443 ...
+    python -m kubernetes_tpu scheduler --apiserver ...
+    python -m kubernetes_tpu controller-manager --apiserver ...
+    python -m kubernetes_tpu cloud-controller-manager --apiserver ...
+    python -m kubernetes_tpu kubelet --apiserver ...
+    python -m kubernetes_tpu kubectl get pods ...
+    python -m kubernetes_tpu kubefed join ...
+"""
+
+from __future__ import annotations
+
+import sys
+
+COMPONENTS = {
+    "apiserver": "kubernetes_tpu.apiserver.__main__",
+    "kube-apiserver": "kubernetes_tpu.apiserver.__main__",
+    "scheduler": "kubernetes_tpu.scheduler.__main__",
+    "kube-scheduler": "kubernetes_tpu.scheduler.__main__",
+    "controller-manager": "kubernetes_tpu.controllers.__main__",
+    "kube-controller-manager": "kubernetes_tpu.controllers.__main__",
+    "cloud-controller-manager": "kubernetes_tpu.cloud.__main__",
+    "kubelet": "kubernetes_tpu.kubelet.__main__",
+    "kubectl": "kubernetes_tpu.cli.kubectl",
+    "kubefed": "kubernetes_tpu.federation.kubefed",
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        sys.stderr.write(
+            "usage: python -m kubernetes_tpu COMPONENT [args...]\n"
+            "components: " + ", ".join(sorted(set(COMPONENTS))) + "\n")
+        return 0 if argv else 2
+    component = argv[0]
+    mod_name = COMPONENTS.get(component)
+    if mod_name is None:
+        sys.stderr.write(f"unknown component {component!r}; "
+                         f"one of {sorted(set(COMPONENTS))}\n")
+        return 2
+    import importlib
+
+    mod = importlib.import_module(mod_name)
+    return mod.main(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

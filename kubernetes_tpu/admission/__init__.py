@@ -26,7 +26,11 @@ from .plugins import (
 from .plugins_ext import (
     AlwaysAdmit,
     AlwaysDeny,
+    DenyEscalatingExec,
+    Initializers,
     NamespaceAutoProvision,
+    OwnerReferencesPermissionEnforcement,
+    PersistentVolumeLabel,
     SecurityContextDeny,
     AlwaysPullImages,
     DefaultStorageClass,

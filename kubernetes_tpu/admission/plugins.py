@@ -337,5 +337,6 @@ def default_chain() -> AdmissionChain:
         DefaultTolerationSeconds(),
         LimitPodHardAntiAffinityTopology(),
         Priority(),
+        _PluginsExt.DenyEscalatingExec(),
         ResourceQuota(),
     ])

@@ -413,3 +413,143 @@ class AlwaysDeny(AdmissionPlugin):
 
     def validate(self, attrs: Attributes) -> None:
         self.deny("AlwaysDeny rejects all requests")
+
+
+class DenyEscalatingExec(AdmissionPlugin):
+    """Reject exec/attach on privileged pods
+    (``plugin/pkg/admission/exec/admission.go`` DenyEscalatingExec):
+    create-exec rights must not escalate into the host through a
+    privileged or host-namespace container."""
+
+    name = "DenyEscalatingExec"
+    operations = ("CONNECT",)
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Pod" and attrs.operation == "CONNECT"
+
+    def validate(self, attrs: Attributes) -> None:
+        pod = attrs.old_obj or {}
+        spec = pod.get("spec") or {}
+        for flag in ("hostPID", "hostIPC", "hostNetwork"):
+            if spec.get(flag):
+                self.deny(f"cannot exec into a pod sharing the host's "
+                          f"{flag[4:].lower()} namespace")
+        for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
+            if (c.get("securityContext") or {}).get("privileged"):
+                self.deny(
+                    f"cannot exec into privileged container {c.get('name')!r}")
+
+
+class OwnerReferencesPermissionEnforcement(AdmissionPlugin):
+    """``plugin/pkg/admission/gc/gc_admission.go``: changing an object's
+    ownerReferences requires DELETE rights on the object — otherwise a
+    user with only update rights could trick the garbage collector into
+    deleting objects for them (set an ownerRef to something they can
+    delete, remove the owner, GC does the rest)."""
+
+    name = "OwnerReferencesPermissionEnforcement"
+    operations = (UPDATE,)
+
+    def __init__(self, authorizer=None):
+        # authorizer is optional: without one, ownerRef changes by
+        # non-privileged identities are denied outright (fail closed)
+        self.authorizer = authorizer
+
+    def validate(self, attrs: Attributes) -> None:
+        new_refs = ((attrs.obj or {}).get("metadata") or {}).get("ownerReferences") or []
+        old_refs = ((attrs.old_obj or {}).get("metadata") or {}).get("ownerReferences") or []
+        if new_refs == old_refs:
+            return
+        user = attrs.user or ""
+        if user.startswith("system:") or not user:
+            # controllers (and the unauthenticated in-proc path) manage
+            # ownership legitimately — the reference exempts them via RBAC
+            return
+        if self.authorizer is not None:
+            from ..auth import ALLOW, AuthzAttributes, UserInfo
+            from ..api.types import KIND_PLURALS
+
+            decision, _ = self.authorizer.authorize(AuthzAttributes(
+                user=UserInfo(name=user), verb="delete",
+                resource=KIND_PLURALS.get(attrs.kind, attrs.kind.lower()),
+                namespace=attrs.namespace, name=attrs.name))
+            if decision == ALLOW:
+                return
+        self.deny("cannot set/change ownerReferences without delete "
+                  "permission on the object")
+
+
+class PersistentVolumeLabel(AdmissionPlugin):
+    """``plugin/pkg/admission/persistentvolume/label``: stamp cloud
+    topology labels (zone/region) onto PersistentVolumes at create time
+    so the volume-zone predicate can act on them."""
+
+    name = "PersistentVolumeLabel"
+    operations = (CREATE,)
+
+    ZONE = "failure-domain.beta.kubernetes.io/zone"
+    REGION = "failure-domain.beta.kubernetes.io/region"
+
+    def __init__(self, cloud=None):
+        self.cloud = cloud  # CloudProvider with zones(); None = inert
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "PersistentVolume" and super().handles(attrs)
+
+    def admit(self, attrs: Attributes) -> None:
+        if self.cloud is None or self.cloud.zones() is None:
+            return
+        meta = attrs.obj.setdefault("metadata", {})
+        labels = meta.setdefault("labels", {})
+        if self.ZONE in labels:
+            return
+        # the volume's disk lives where its (cloud) source does; the fake
+        # cloud keys zone by the spec's source instance/disk name
+        source = ((attrs.obj.get("spec") or {}).get("diskID")
+                  or meta.get("name", ""))
+        try:
+            zone, region = self.cloud.zones().get_zone(source)
+        except KeyError:
+            return
+        if zone:
+            labels[self.ZONE] = zone
+        if region:
+            labels[self.REGION] = region
+
+
+class Initializers(AdmissionPlugin):
+    """``plugin/pkg/admission/initialization`` (alpha in the reference
+    era): objects created with ``metadata.initializers.pending`` are
+    hidden from ordinary LISTs until every initializer controller removes
+    its entry; this plugin enforces the protocol — only the FIRST pending
+    initializer may be removed per update, and new objects may not
+    self-declare an empty-but-present result."""
+
+    name = "Initializers"
+    operations = (CREATE, UPDATE)
+
+    def validate(self, attrs: Attributes) -> None:
+        if attrs.operation == CREATE:
+            init = ((attrs.obj or {}).get("metadata") or {}).get("initializers")
+            if init is not None and "result" in init:
+                # a creator may arrive WITH pending initializers (the
+                # reference's initializer admission stamps them) but must
+                # not self-declare completion
+                self.deny("cannot create an object with a self-declared "
+                          "initializer result")
+            return
+        new_pending = [i.get("name") for i in
+                       (((attrs.obj or {}).get("metadata") or {})
+                        .get("initializers") or {}).get("pending") or []]
+        old_pending = [i.get("name") for i in
+                       (((attrs.old_obj or {}).get("metadata") or {})
+                        .get("initializers") or {}).get("pending") or []]
+        if new_pending == old_pending:
+            return
+        # removal must be prefix-order: the first pending initializer is
+        # the only one allowed to complete
+        if old_pending and new_pending == old_pending[1:]:
+            return
+        if not old_pending and new_pending:
+            self.deny("cannot add initializers after creation")
+        self.deny("initializers must be removed in order, first first")

@@ -442,13 +442,27 @@ def _make_handler(server: APIServer):
 
         def _resolve_pod_kubelet(self, ns: str, name: str, q):
             """Shared pod-subresource resolution: pod -> node -> kubelet
-            endpoint + validated container.  Returns (kubelet_url,
-            container, node_name) or None after writing the error."""
+            endpoint + validated container, with CONNECT admission
+            (reference exec/attach admission — DenyEscalatingExec runs
+            here).  Returns (kubelet_url, container, node_name) or None
+            after writing the error."""
             try:
                 pod = server.store.get("Pod", ns, name)
             except NotFoundError:
                 self._error(404, "NotFound", f"pod {ns}/{name}")
                 return None
+            chain = getattr(server.store, "chain", None)
+            if chain is not None:
+                from ..admission.framework import Attributes
+
+                try:
+                    chain.run(Attributes(operation="CONNECT", kind="Pod",
+                                         namespace=ns, name=name,
+                                         old_obj=pod,
+                                         user=getattr(server.store, "user", "")))
+                except AdmissionDenied as e:
+                    self._error(403, "Forbidden", str(e))
+                    return None
             node_name = (pod.get("spec") or {}).get("nodeName", "")
             if not node_name:
                 self._error(400, "BadRequest", "pod is not scheduled yet")

@@ -1463,21 +1463,25 @@ class Kubectl:
             yaml.safe_dump(obj.to_dict(), f, sort_keys=False)
             tmp = f.name
         try:
-            try:
-                rc = subprocess.run([*editor.split(), tmp]).returncode
-            except OSError as e:
-                self.out.write(f"error: cannot run editor {editor!r}: {e}\n")
-                return 1
-            if rc != 0:
-                self.out.write("Edit cancelled\n")
-                return 1
-            try:
-                edited = yaml.safe_load(open(tmp).read())
-            except yaml.YAMLError as e:
-                self.out.write(f"error: edited file is not valid YAML: {e}\n")
-                return 1
-        finally:
+            rc = subprocess.run([*editor.split(), tmp]).returncode
+        except OSError as e:
             os.unlink(tmp)
+            self.out.write(f"error: cannot run editor {editor!r}: {e}\n")
+            return 1
+        if rc != 0:
+            os.unlink(tmp)
+            self.out.write("Edit cancelled\n")
+            return 1
+        try:
+            edited = yaml.safe_load(open(tmp).read())
+        except yaml.YAMLError as e:
+            # the user's edits must SURVIVE a typo — keep the file and
+            # point at it (the reference re-opens the editor; one shot
+            # here, but never data loss)
+            self.out.write(f"error: edited file is not valid YAML: {e}\n"
+                           f"your changes are preserved in {tmp}\n")
+            return 1
+        os.unlink(tmp)
         if edited == obj.to_dict():
             self.out.write("Edit cancelled, no changes made\n")
             return 0

@@ -143,7 +143,8 @@ class GenericScheduler:
         if not node_names:
             raise FitError(pod, {})
         pctx = pctx or PriorityContext(node_info_map)
-        ctx = PredicateContext(node_info_map, pvcs=pctx.pvcs, pvs=pctx.pvs)
+        ctx = PredicateContext(node_info_map, pvcs=pctx.pvcs, pvs=pctx.pvs,
+                               services=pctx.services)
         feasible, failures = self.find_nodes_that_fit(pod, node_names, node_info_map, ctx)
         if not feasible:
             raise FitError(pod, failures)

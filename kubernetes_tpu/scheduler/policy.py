@@ -83,6 +83,22 @@ def algorithm_from_policy(policy: "dict | str", extenders: Optional[list] = None
         predicates = {}
         for spec in policy["predicates"]:
             name = spec["name"]
+            arg = spec.get("argument") or {}
+            if "labelsPresence" in arg:
+                # CheckNodeLabelPresence-style factory (api/types.go:
+                # PredicateArgument.LabelsPresence)
+                from .predicates import make_check_node_label_presence
+
+                lp = arg["labelsPresence"]
+                predicates[name] = make_check_node_label_presence(
+                    list(lp.get("labels") or []), bool(lp.get("presence", True)))
+                continue
+            if "serviceAffinity" in arg:
+                from .predicates import make_check_service_affinity
+
+                predicates[name] = make_check_service_affinity(
+                    list(arg["serviceAffinity"].get("labels") or []))
+                continue
             fn = PREDICATE_REGISTRY.get(name)
             if fn is None:
                 raise PolicyError(f"unknown predicate {name!r}")

@@ -391,11 +391,7 @@ class Store:
             # any watcher (or the caller) observes the commit
             self._wal.append(ev.type, ev.kind, ev.key, ev.revision, ev.object)
             if self._wal.needs_compaction():
-                objects = {
-                    kind: {key: item.data for key, item in bucket.items()}
-                    for kind, bucket in self._objects.items()
-                }
-                self._wal.write_snapshot(self._rev, objects)
+                self.compact()  # RLock: safe to re-enter from the write path
         self._log.append(ev)  # deque maxlen trims the window in C
         for kind, q in self._watchers:
             if kind is None or kind == ev.kind:

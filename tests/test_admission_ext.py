@@ -273,3 +273,185 @@ def test_namespace_autoprovision_security_context_always_deny():
     locked = Clientset(AdmittedStore(AdmissionChain([AlwaysDeny()])))
     with pytest.raises(AdmissionDenied):
         locked.pods.create(make_pod("x"))
+
+
+# -- the last four reference plugins ---------------------------------------
+
+
+def test_deny_escalating_exec():
+    from kubernetes_tpu.admission.framework import AdmissionDenied, Attributes
+    from kubernetes_tpu.admission.plugins_ext import DenyEscalatingExec
+
+    plug = DenyEscalatingExec()
+    priv = {"spec": {"containers": [
+        {"name": "c", "securityContext": {"privileged": True}}]}}
+    plain = {"spec": {"containers": [{"name": "c"}]}}
+    attrs = Attributes(operation="CONNECT", kind="Pod", namespace="default",
+                       name="p", old_obj=priv)
+    assert plug.handles(attrs)
+    with pytest.raises(AdmissionDenied):
+        plug.validate(attrs)
+    ok = Attributes(operation="CONNECT", kind="Pod", namespace="default",
+                    name="p", old_obj=plain)
+    plug.validate(ok)  # no raise
+    # non-CONNECT operations are not handled
+    assert not plug.handles(Attributes(operation="CREATE", kind="Pod",
+                                       namespace="default", name="p"))
+
+
+def test_owner_references_permission_enforcement():
+    from kubernetes_tpu.admission.framework import AdmissionDenied, Attributes
+    from kubernetes_tpu.admission.plugins_ext import (
+        OwnerReferencesPermissionEnforcement,
+    )
+
+    plug = OwnerReferencesPermissionEnforcement()
+    old = {"metadata": {"ownerReferences": []}}
+    new = {"metadata": {"ownerReferences": [
+        {"kind": "ReplicaSet", "name": "rs", "uid": "u1"}]}}
+    # ordinary user without delete rights: denied
+    attrs = Attributes(operation="UPDATE", kind="Pod", namespace="default",
+                       name="p", obj=new, old_obj=old, user="mallory")
+    with pytest.raises(AdmissionDenied):
+        plug.validate(attrs)
+    # controllers (system: identities) pass
+    sysattrs = Attributes(operation="UPDATE", kind="Pod", namespace="default",
+                          name="p", obj=new, old_obj=old,
+                          user="system:serviceaccount:kube-system:gc")
+    plug.validate(sysattrs)
+    # unchanged ownerRefs pass for anyone
+    same = Attributes(operation="UPDATE", kind="Pod", namespace="default",
+                      name="p", obj=old, old_obj=old, user="mallory")
+    plug.validate(same)
+    # with an authorizer granting delete, the user may change refs
+    class AllowAll:
+        def authorize(self, a):
+            from kubernetes_tpu.auth import ALLOW
+
+            return ALLOW, "ok"
+
+    plug2 = OwnerReferencesPermissionEnforcement(authorizer=AllowAll())
+    plug2.validate(attrs)
+
+
+def test_persistent_volume_label():
+    from kubernetes_tpu.admission.framework import Attributes
+    from kubernetes_tpu.admission.plugins_ext import PersistentVolumeLabel
+    from kubernetes_tpu.cloud import FakeCloud, Instance
+
+    cloud = FakeCloud()
+    cloud.add_instance(Instance(name="disk-1", zone="z1", region="r1"))
+    plug = PersistentVolumeLabel(cloud=cloud)
+    obj = {"kind": "PersistentVolume",
+           "metadata": {"name": "pv1"}, "spec": {"diskID": "disk-1"}}
+    attrs = Attributes(operation="CREATE", kind="PersistentVolume",
+                       namespace="", name="pv1", obj=obj)
+    plug.admit(attrs)
+    labels = obj["metadata"]["labels"]
+    assert labels["failure-domain.beta.kubernetes.io/zone"] == "z1"
+    assert labels["failure-domain.beta.kubernetes.io/region"] == "r1"
+    # unknown disk: no labels, no crash; existing zone label untouched
+    obj2 = {"kind": "PersistentVolume", "metadata": {"name": "pv2"},
+            "spec": {"diskID": "ghost"}}
+    plug.admit(Attributes(operation="CREATE", kind="PersistentVolume",
+                          namespace="", name="pv2", obj=obj2))
+    assert "labels" not in obj2["metadata"] or not obj2["metadata"]["labels"]
+    # inert without a cloud
+    PersistentVolumeLabel().admit(attrs)
+
+
+def test_initializers_protocol():
+    from kubernetes_tpu.admission.framework import AdmissionDenied, Attributes
+    from kubernetes_tpu.admission.plugins_ext import Initializers
+
+    plug = Initializers()
+
+    def upd(old_pending, new_pending):
+        return Attributes(
+            operation="UPDATE", kind="Pod", namespace="default", name="p",
+            obj={"metadata": {"initializers":
+                 {"pending": [{"name": n} for n in new_pending]}}},
+            old_obj={"metadata": {"initializers":
+                     {"pending": [{"name": n} for n in old_pending]}}})
+
+    # removing the FIRST pending initializer is the protocol
+    plug.validate(upd(["a.io", "b.io"], ["b.io"]))
+    # removing out of order is denied
+    with pytest.raises(AdmissionDenied):
+        plug.validate(upd(["a.io", "b.io"], ["a.io"]))
+    # adding initializers after creation is denied
+    with pytest.raises(AdmissionDenied):
+        plug.validate(upd([], ["late.io"]))
+    # unchanged passes
+    plug.validate(upd(["a.io"], ["a.io"]))
+    # create is unrestricted (controllers stamp initializers at birth)
+    plug.validate(Attributes(operation="CREATE", kind="Pod",
+                             namespace="default", name="p",
+                             obj={"metadata": {}}))
+
+
+def test_deny_escalating_exec_enforced_on_the_wire():
+    """The CONNECT chain runs in the apiserver's exec path: exec into a
+    privileged pod is 403, a plain pod passes through to the kubelet."""
+    import io
+
+    from kubernetes_tpu.admission import AdmittedStore, default_chain
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.cli.kubectl import main as kubectl_main
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+    from kubernetes_tpu.testutil import make_pod
+
+    store = AdmittedStore(default_chain())
+    server = APIServer(store)
+    server.start()
+    try:
+        cs = Clientset(store)
+        kubelet = HollowKubelet(cs, "n1", serve=True)
+        kubelet.register()
+        priv = make_pod("priv", node_name="n1")
+        priv.spec.containers[0].privileged = True
+        cs.pods.create(priv)
+        cs.pods.create(make_pod("plain", node_name="n1"))
+        import time
+
+        kubelet.tick()
+        time.sleep(0.6)
+        kubelet.tick()
+        kubelet.runtime.set_exec_handler("default/plain", "c0",
+                                         lambda cmd: ("ok", 0))
+        out = io.StringIO()
+        rc = kubectl_main(["--server", server.url, "exec", "priv", "--", "id"],
+                          out=out)
+        assert rc == 1 and "privileged" in out.getvalue()
+        out = io.StringIO()
+        rc = kubectl_main(["--server", server.url, "exec", "plain", "--", "id"],
+                          out=out)
+        assert rc == 0 and "ok" in out.getvalue()
+        # host-namespace pods are blocked too
+        hostpid = make_pod("hostpid", node_name="n1")
+        hostpid_d = hostpid.to_dict()
+        hostpid_d["spec"]["hostPID"] = True
+        store.create("Pod", hostpid_d)
+        out = io.StringIO()
+        rc = kubectl_main(["--server", server.url, "attach", "hostpid"], out=out)
+        assert rc == 1 and "pid" in out.getvalue().lower()
+    finally:
+        server.stop()
+
+
+def test_initializers_create_rule():
+    from kubernetes_tpu.admission.framework import AdmissionDenied, Attributes
+    from kubernetes_tpu.admission.plugins_ext import Initializers
+
+    plug = Initializers()
+    # pending initializers at create are fine (the admission controller
+    # stamps them); a self-declared RESULT is not
+    plug.validate(Attributes(
+        operation="CREATE", kind="Pod", namespace="default", name="p",
+        obj={"metadata": {"initializers": {"pending": [{"name": "a.io"}]}}}))
+    with pytest.raises(AdmissionDenied):
+        plug.validate(Attributes(
+            operation="CREATE", kind="Pod", namespace="default", name="p",
+            obj={"metadata": {"initializers": {"pending": [],
+                                               "result": {"status": "Failure"}}}}))

@@ -470,3 +470,79 @@ def test_equivalence_cache_verdicts_match_cold_run():
     assert "n02" not in f_clone  # clone full
     f_orig, _ = run(pod, use_sig=True)
     assert "n02" in f_orig  # original unaffected by the clone's cache
+
+
+# -- registered non-default predicates (predicates.go:737, :821) ----------
+
+
+def test_check_node_label_presence():
+    from kubernetes_tpu.scheduler.predicates import (
+        PredicateContext,
+        make_check_node_label_presence,
+    )
+
+    labeled = NodeInfo(make_node("n1", labels={"pool": "gpu", "ssd": "yes"}))
+    bare = NodeInfo(make_node("n2"))
+    ctx = PredicateContext({"n1": labeled, "n2": bare})
+    pod = make_pod("p")
+    require = make_check_node_label_presence(["pool"], presence=True)
+    assert require(pod, None, labeled, ctx)[0] is True
+    ok, reasons = require(pod, None, bare, ctx)
+    assert ok is False and "present" in reasons[0]
+    forbid = make_check_node_label_presence(["pool"], presence=False)
+    assert forbid(pod, None, bare, ctx)[0] is True
+    assert forbid(pod, None, labeled, ctx)[0] is False
+
+
+def test_check_service_affinity_pins_label_values():
+    from kubernetes_tpu.api import ObjectMeta, Service
+    from kubernetes_tpu.scheduler.predicates import (
+        PredicateContext,
+        make_check_service_affinity,
+    )
+
+    east = NodeInfo(make_node("n-east", labels={"region": "east"}))
+    west = NodeInfo(make_node("n-west", labels={"region": "west"}))
+    # one pod of service "web" already runs in east
+    resident = make_pod("web-1", labels={"app": "web"}, node_name="n-east")
+    east.add_pod(resident)
+    svc = Service(meta=ObjectMeta(name="web"), selector={"app": "web"})
+    ctx = PredicateContext({"n-east": east, "n-west": west}, services=[svc])
+    pred = make_check_service_affinity(["region"])
+    candidate = make_pod("web-2", labels={"app": "web"})
+    # same service -> must follow the pinned region
+    assert pred(candidate, None, east, ctx)[0] is True
+    ok, reasons = pred(candidate, None, west, ctx)
+    assert ok is False and "region" in reasons[0]
+    # a pod of a DIFFERENT service is unconstrained
+    other = make_pod("db-1", labels={"app": "db"})
+    assert pred(other, None, west, ctx)[0] is True
+    # an explicit nodeSelector on the label wins over the pinned value
+    chooser = make_pod("web-3", labels={"app": "web"},
+                       node_selector={"region": "west"})
+    assert pred(chooser, None, west, ctx)[0] is True
+
+
+def test_policy_with_predicate_arguments():
+    from kubernetes_tpu.scheduler.policy import algorithm_from_policy
+
+    algo = algorithm_from_policy({
+        "predicates": [
+            {"name": "GeneralPredicates"},
+            {"name": "NoGpuPool",
+             "argument": {"labelsPresence": {"labels": ["gpu"],
+                                             "presence": False}}},
+            {"name": "RegionAffinity",
+             "argument": {"serviceAffinity": {"labels": ["region"]}}},
+        ],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+    })
+    assert set(algo.predicates) == {"GeneralPredicates", "NoGpuPool",
+                                    "RegionAffinity"}
+    # end-to-end: the labels-presence predicate steers off the gpu pool
+    from kubernetes_tpu.scheduler.nodeinfo import NodeInfo as NI
+
+    gpu = NI(make_node("gpu-1", labels={"gpu": "a100"}))
+    cpu = NI(make_node("cpu-1"))
+    res = algo.schedule(make_pod("p"), {"gpu-1": gpu, "cpu-1": cpu})
+    assert res.node_name == "cpu-1"
