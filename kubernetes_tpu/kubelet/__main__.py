@@ -30,7 +30,13 @@ def main(argv=None) -> int:
     ap.add_argument("--memory", default="16Gi")
     ap.add_argument("--serve-logs", action="store_true",
                     help="expose the kubelet read API (logs/pods/healthz)")
+    ap.add_argument("--feature-gates", default="",
+                    help="A=true,B=false (e.g. DynamicKubeletConfig=true)")
     args = ap.parse_args(argv)
+    if args.feature_gates:
+        from ..utils.features import DEFAULT_FEATURE_GATES
+
+        DEFAULT_FEATURE_GATES.set_from_string(args.feature_gates)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 from ..api import types as api
 from ..api.meta import ObjectMeta
 from ..client.clientset import Clientset
+from ..utils.features import DEFAULT_FEATURE_GATES
 from ..client.informer import PodNodeIndex, SharedInformer
 from ..store.store import AlreadyExistsError, ConflictError, NotFoundError
 
@@ -133,6 +134,7 @@ class HollowKubelet:
         probes/restarts, then the eviction manager pass."""
         now = self._clock()
         out = {"started": 0, "observed": 0, "restarts": 0, "evicted": 0}
+        self._maybe_apply_dynamic_config()
         self._heartbeat()
 
         mine = self._my_pods()
@@ -233,6 +235,55 @@ class HollowKubelet:
             except (NotFoundError, ConflictError):
                 continue
         return restarts, still_running
+
+    # tunables a ConfigMap may override (reference KubeletConfiguration
+    # fields this hollow node actually consumes)
+    _DYNAMIC_FIELDS = {
+        "podStartLatency": ("pod_start_latency", float),
+        "heartbeatInterval": ("heartbeat_interval", float),
+        "memoryPressureFraction": ("memory_pressure_fraction", float),
+    }
+
+    def _maybe_apply_dynamic_config(self) -> None:
+        """Dynamic kubelet config (reference ``kubelet/kubeletconfig``,
+        gated by DynamicKubeletConfig): a ConfigMap named
+        ``kubelet-config-<node>`` in kube-system overrides the node's
+        tunables live; deleting it (or a field going invalid) rolls back
+        to the boot values.  Polled at heartbeat cadence, never per tick
+        — a 5k-node fleet must not turn the gate into 5k GETs/s."""
+        if not DEFAULT_FEATURE_GATES.enabled("DynamicKubeletConfig"):
+            return
+        now = self._clock()
+        last = getattr(self, "_last_config_check", None)
+        if last is not None and now - last < self.heartbeat_interval:
+            return
+        self._last_config_check = now
+        if not hasattr(self, "_boot_config"):
+            self._boot_config = {attr: getattr(self, attr)
+                                 for attr, _ in self._DYNAMIC_FIELDS.values()}
+            self._config_rv = None
+        try:
+            cm = self.clientset.client_for("ConfigMap").get(
+                f"kubelet-config-{self.node_name}", "kube-system")
+        except NotFoundError:
+            for attr, value in self._boot_config.items():
+                setattr(self, attr, value)
+            self._config_rv = None
+            return
+        rv = cm.meta.resource_version
+        if rv == self._config_rv:
+            return
+        for key, (attr, cast) in self._DYNAMIC_FIELDS.items():
+            raw = cm.data.get(key)
+            if raw is None:
+                setattr(self, attr, self._boot_config[attr])
+                continue
+            try:
+                setattr(self, attr, cast(raw))
+            except (TypeError, ValueError):
+                # an invalid value must not keep a STALE prior override
+                setattr(self, attr, self._boot_config[attr])
+        self._config_rv = rv
 
     def _eviction_pass(self, running: list[api.Pod]) -> set:
         """eviction_manager.go:213 synchronize — memory signal vs the

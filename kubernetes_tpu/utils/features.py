@@ -35,6 +35,7 @@ KNOWN_FEATURES: dict[str, tuple[bool, str]] = {
     "PallasKernels": (True, BETA),  # fused kernel vs XLA scan
     "DynamicKindRegistration": (True, BETA),  # CRDs
     "ExperimentalCriticalPodAnnotation": (False, ALPHA),
+    "DynamicKubeletConfig": (False, ALPHA),  # kubelet config from the API
 }
 
 

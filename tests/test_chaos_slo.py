@@ -214,3 +214,128 @@ def test_scheduler_slis_meet_slo_in_density_run():
     )
     slo.check_counter_max("schedule failures", sched.metrics.schedule_failures, 0)
     slo.assert_all()
+
+
+def drive(mgr, sched, fleet, clock, rounds=8, dt=1.0):
+    for _ in range(rounds):
+        clock.advance(dt)
+        sched.pump()
+        sched.run_pending()
+        mgr.reconcile_all()
+        mgr.tick()
+        fleet.tick_all()
+
+
+def test_node_reboot_replays_pods(tmp_path):
+    """nodes_util.go reboot e2e: a kubelet process dies and a fresh one
+    comes up for the same Node — it replays resident pods as ADDs and
+    re-converges their statuses without the control plane evicting."""
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+    cs, clock, fleet, mgr, sched = build_world(n_nodes=3)
+    cs.replicasets.create(make_rs(6))
+    drive(mgr, sched, fleet, clock)
+    running = [p for p in cs.pods.list()[0] if p.status.phase == "Running"]
+    assert len(running) == 6
+    victim_node = running[0].spec.node_name
+
+    # "reboot": a brand-new kubelet object for the same node (all
+    # in-memory kubelet state lost, store state intact)
+    fresh = HollowKubelet(cs, victim_node, clock=clock, pod_start_latency=0.0,
+                          cpu="4", memory="8Gi")
+    for i, kubelet in enumerate(fleet.kubelets):
+        if kubelet.node_name == victim_node:
+            fleet.kubelets[i] = fresh
+            break
+    drive(mgr, sched, fleet, clock)
+    after = [p for p in cs.pods.list()[0] if p.status.phase == "Running"]
+    assert len(after) == 6, "reboot must not lose or duplicate pods"
+    assert {p.meta.name for p in after} == {p.meta.name for p in running}
+
+
+def test_apiserver_restart_mid_rollout_with_durable_store(tmp_path):
+    """Upgrade e2e: the apiserver (durable store) restarts mid-rollout;
+    controllers rebuild informers from LIST+WATCH and the rollout
+    finishes — the store IS the checkpoint, now durably."""
+    d = str(tmp_path / "state")
+    clock = FakeClock()
+    store = Store(data_dir=d)
+    cs = Clientset(store)
+    fleet = HollowFleet(cs, 3, clock=clock, pod_start_latency=0.0,
+                        cpu="4", memory="8Gi")
+    fleet.register_all()
+    mgr = ControllerManager(cs, enabled=["replicaset"], clock=clock)
+    mgr.start()
+    sched = Scheduler(cs, clock=clock)
+    sched.start()
+    cs.replicasets.create(make_rs(6))
+    # partial progress only
+    clock.advance(1.0)
+    sched.pump()
+    sched.run_pending()
+    mgr.reconcile_all()
+    store.close()
+
+    # restart: new store over the same dir; every component rebuilt
+    store2 = Store(data_dir=d)
+    cs2 = Clientset(store2)
+    fleet2 = HollowFleet(cs2, 0, clock=clock)
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+    for node in cs2.nodes.list()[0]:
+        fleet2.kubelets.append(HollowKubelet(
+            cs2, node.meta.name, clock=clock, pod_start_latency=0.0,
+            cpu="4", memory="8Gi"))
+    mgr2 = ControllerManager(cs2, enabled=["replicaset"], clock=clock)
+    mgr2.start()
+    sched2 = Scheduler(cs2, clock=clock)
+    sched2.start()
+    drive(mgr2, sched2, fleet2, clock)
+    running = [p for p in cs2.pods.list()[0] if p.status.phase == "Running"]
+    assert len(running) == 6
+    store2.close()
+
+
+def test_dynamic_kubelet_config():
+    """kubelet/kubeletconfig (DynamicKubeletConfig gate): a ConfigMap
+    overrides node tunables live; deletion rolls back."""
+    from kubernetes_tpu.api import ObjectMeta
+    from kubernetes_tpu.api.cluster import ConfigMap
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+    from kubernetes_tpu.utils.features import DEFAULT_FEATURE_GATES
+
+    clock = FakeClock()
+    cs = Clientset(Store())
+    kubelet = HollowKubelet(cs, "n1", clock=clock, heartbeat_interval=10.0)
+    kubelet.register()
+    with DEFAULT_FEATURE_GATES.override("DynamicKubeletConfig", True):
+        cs.client_for("ConfigMap").create(ConfigMap(
+            meta=ObjectMeta(name="kubelet-config-n1", namespace="kube-system"),
+            data={"heartbeatInterval": "2.5", "memoryPressureFraction": "0.5",
+                  "podStartLatency": "not-a-number"}))
+        kubelet.tick()
+        assert kubelet.heartbeat_interval == 2.5
+        assert kubelet.memory_pressure_fraction == 0.5
+        assert kubelet.pod_start_latency == 0.5  # bad value ignored (default)
+        # a field going INVALID rolls that field back, not just absent ones
+        def _bad(cm):
+            cm.data["heartbeatInterval"] = "oops"
+            return cm
+
+        cs.client_for("ConfigMap").guaranteed_update(
+            "kubelet-config-n1", _bad, "kube-system")
+        clock.advance(5.0)  # past the (already-lowered) poll cadence
+        kubelet.tick()
+        assert kubelet.heartbeat_interval == 10.0  # boot value, not stale 2.5
+        # deleting the ConfigMap rolls back everything
+        cs.client_for("ConfigMap").delete("kubelet-config-n1", "kube-system")
+        clock.advance(11.0)
+        kubelet.tick()
+        assert kubelet.memory_pressure_fraction == kubelet._boot_config["memory_pressure_fraction"]
+    # gate off: config is ignored entirely
+    cs.client_for("ConfigMap").create(ConfigMap(
+        meta=ObjectMeta(name="kubelet-config-n1", namespace="kube-system"),
+        data={"heartbeatInterval": "99"}))
+    clock.advance(11.0)
+    kubelet.tick()
+    assert kubelet.heartbeat_interval == 10.0
