@@ -31,6 +31,8 @@ def main(argv=None) -> int:
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--node-monitor-period", type=float, default=5.0)
     ap.add_argument("--feature-gates", default="")
+    ap.add_argument("--healthz-port", type=int, default=-1,
+                    help="serve /healthz (reference :10252); -1 = off")
     args = ap.parse_args(argv)
     from ..utils.features import DEFAULT_FEATURE_GATES
 
@@ -55,10 +57,20 @@ def main(argv=None) -> int:
         mgr.stop()
 
     stop = install_signal_stop()
-    run_with_leader_election(
-        cs, "kube-controller-manager", f"kcm-{os.getpid()}", run, stop,
-        leader_elect=args.leader_elect,
-    )
+    # health BEFORE leader election: standbys must answer liveness probes
+    from ..daemon import serve_health
+
+    health = serve_health(args.healthz_port)
+    if health is not None:
+        logging.info("healthz on :%d", health.local_port)
+    try:
+        run_with_leader_election(
+            cs, "kube-controller-manager", f"kcm-{os.getpid()}", run, stop,
+            leader_elect=args.leader_elect,
+        )
+    finally:
+        if health is not None:
+            health.stop()
     return 0
 
 

@@ -103,3 +103,32 @@ def wait_forever(stop: threading.Event, tick: Optional[Callable[[], None]] = Non
         if tick is not None:
             tick()
         stop.wait(interval)
+
+
+def serve_health(port: int, registry=None, host: str = "127.0.0.1"):
+    """Daemon healthz + metrics endpoint (the reference mounts /healthz,
+    /metrics and pprof on every daemon — scheduler app/server.go:149).
+    Must be started BEFORE leader election: a standby that serves no
+    health endpoint gets killed by its supervisor's liveness probe.
+    Returns the running server (.local_port, .stop()), or None when
+    port<0."""
+    from .proxy.healthcheck import _HealthHTTPServer
+
+    if port is None or port < 0:
+        return None
+
+    class _DaemonHealth(_HealthHTTPServer):
+        def handle(self, path: str):
+            if path == "/healthz":
+                return 200, {"status": "ok"}
+            if path == "/metrics" and registry is not None:
+                try:
+                    return 200, registry.expose()  # raw exposition text
+                except Exception as e:  # noqa: BLE001 - never crash health
+                    return 500, {"error": str(e)}
+            return None
+
+    server = _DaemonHealth(host=host, port=port)
+    server.start()
+    server.local_port = server.port
+    return server

@@ -253,22 +253,30 @@ class HollowKubelet:
         — a 5k-node fleet must not turn the gate into 5k GETs/s."""
         if not DEFAULT_FEATURE_GATES.enabled("DynamicKubeletConfig"):
             return
-        now = self._clock()
-        last = getattr(self, "_last_config_check", None)
-        if last is not None and now - last < self.heartbeat_interval:
-            return
-        self._last_config_check = now
         if not hasattr(self, "_boot_config"):
             self._boot_config = {attr: getattr(self, attr)
                                  for attr, _ in self._DYNAMIC_FIELDS.values()}
             self._config_rv = None
+            self._last_config_check = None
+        now = self._clock()
+        # throttle on the BOOT heartbeat interval: a ConfigMap that raises
+        # heartbeatInterval must not lock out its own rollback
+        if (self._last_config_check is not None
+                and now - self._last_config_check
+                < self._boot_config["heartbeat_interval"]):
+            return
+        self._last_config_check = now
         try:
             cm = self.clientset.client_for("ConfigMap").get(
                 f"kubelet-config-{self.node_name}", "kube-system")
         except NotFoundError:
-            for attr, value in self._boot_config.items():
-                setattr(self, attr, value)
-            self._config_rv = None
+            if self._config_rv is not None:
+                # roll back ONLY when an override was actually applied —
+                # never clobber harness-set attributes in the normal
+                # no-ConfigMap fleet state
+                for attr, value in self._boot_config.items():
+                    setattr(self, attr, value)
+                self._config_rv = None
             return
         rv = cm.meta.resource_version
         if rv == self._config_rv:

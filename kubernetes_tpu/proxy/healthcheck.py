@@ -39,8 +39,15 @@ class _HealthHTTPServer:
                     self.end_headers()
                     return
                 code, payload = result
-                body = json.dumps(payload).encode()
+                if isinstance(payload, str):
+                    # raw text responses (Prometheus exposition format)
+                    body = payload.encode()
+                    ctype = "text/plain"
+                else:
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
                 self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

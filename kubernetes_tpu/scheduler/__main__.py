@@ -32,6 +32,9 @@ def main(argv=None) -> int:
     ap.add_argument("--feature-gates", default="")
     ap.add_argument("--config", default=None,
                     help="SchedulerConfiguration YAML (componentconfig)")
+    ap.add_argument("--healthz-port", type=int, default=-1,
+                    help="serve /healthz + /metrics (reference :10251); "
+                         "-1 = off, 0 = ephemeral")
     args = ap.parse_args(argv)
     from ..utils.features import SchedulerConfiguration, load_component_config
 
@@ -55,6 +58,22 @@ def main(argv=None) -> int:
 
     cs = remote_clientset(args.apiserver, args.token)
 
+    # health BEFORE leader election: a standby must still answer its
+    # liveness probe or the supervisor kills a healthy HA peer.  The
+    # metrics registry appears once the payload constructs the scheduler.
+    from ..daemon import serve_health
+
+    metrics_holder: dict = {}
+
+    class _LazyRegistry:
+        def expose(self):
+            reg = metrics_holder.get("registry")
+            return reg.expose() if reg is not None else "# standby\n"
+
+    health = serve_health(args.healthz_port, _LazyRegistry())
+    if health is not None:
+        logging.info("healthz/metrics on :%d", health.local_port)
+
     def run(payload_stop: threading.Event) -> None:
         from .generic_scheduler import GenericScheduler
         from .scheduler import Scheduler
@@ -71,6 +90,7 @@ def main(argv=None) -> int:
             backend = TPUBatchBackend(algorithm=algo)
         sched = Scheduler(cs, algorithm=algo, backend=backend,
                           scheduler_name=args.scheduler_name)
+        metrics_holder["registry"] = sched.metrics.registry
         sched.start(manual=False)  # threaded informers + event sink
         logging.info("scheduler running (backend=%s)", args.backend)
         while not payload_stop.is_set():
@@ -88,10 +108,14 @@ def main(argv=None) -> int:
         sched.broadcaster.stop()
 
     stop = install_signal_stop()
-    run_with_leader_election(
-        cs, "kube-scheduler", f"scheduler-{os.getpid()}", run, stop,
-        leader_elect=args.leader_elect,
-    )
+    try:
+        run_with_leader_election(
+            cs, "kube-scheduler", f"scheduler-{os.getpid()}", run, stop,
+            leader_elect=args.leader_elect,
+        )
+    finally:
+        if health is not None:
+            health.stop()
     return 0
 
 

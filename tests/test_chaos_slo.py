@@ -324,7 +324,7 @@ def test_dynamic_kubelet_config():
 
         cs.client_for("ConfigMap").guaranteed_update(
             "kubelet-config-n1", _bad, "kube-system")
-        clock.advance(5.0)  # past the (already-lowered) poll cadence
+        clock.advance(11.0)  # past the BOOT poll cadence (never the override's)
         kubelet.tick()
         assert kubelet.heartbeat_interval == 10.0  # boot value, not stale 2.5
         # deleting the ConfigMap rolls back everything

@@ -107,3 +107,54 @@ def _run(server):
     running = sum(1 for p in cli.pods.list()[0] if p.status.phase == "Running")
     assert running >= N_PODS
     elector.release()
+
+
+def test_scheduler_daemon_serves_healthz_and_metrics():
+    """server.go:149: the scheduler daemon mounts /healthz + /metrics."""
+    import json
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.store import Store
+
+    import os
+    import socket
+
+    server = APIServer(Store())
+    server.start()
+    proc = None
+    # pick a free port up front: no output parsing, no unbounded readline
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    try:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.scheduler",
+             "--apiserver", server.url, "--backend", "oracle",
+             "--healthz-port", str(port)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        deadline = time.time() + 20
+        status = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1) as r:
+                    status = json.loads(r.read())["status"]
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert status == "ok", "daemon healthz never came up"
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                    timeout=5) as r:
+            text = r.read().decode()
+        assert "scheduler" in text  # the SLI histograms are exposed
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=10)
+        server.stop()
