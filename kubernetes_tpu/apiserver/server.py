@@ -233,7 +233,8 @@ def _make_handler(server: APIServer):
             url = urlparse(self.path)
             q = parse_qs(url.query)
             parts = [p for p in url.path.split("/") if p]
-            verb = {"POST": "create", "PUT": "update", "DELETE": "delete"}.get(method, "get")
+            verb = {"POST": "create", "PUT": "update", "DELETE": "delete",
+                    "PATCH": "patch"}.get(method, "get")
             resource, ns, name = "", "", ""
             if parts and parts[0] == "apis" and len(parts) >= 2:
                 # aggregated APIs: authorize/audit on "<group>/<resource>"
@@ -385,8 +386,97 @@ def _make_handler(server: APIServer):
         def do_PUT(self):
             self._route("PUT")
 
+        def do_PATCH(self):
+            self._route("PATCH")
+
         def do_DELETE(self):
             self._route("DELETE")
+
+        def _apply_list_selectors(self, items, q):
+            """labelSelector / fieldSelector on LIST (reference
+            ``ListOptions``; kubelets list pods with
+            ``fieldSelector=spec.nodeName=X`` so a 5k-node fleet doesn't
+            pull the whole cluster per node).  Returns filtered items, or
+            None after writing a 400."""
+            label_sel = q.get("labelSelector", [None])[0]
+            field_sel = q.get("fieldSelector", [None])[0]
+            if label_sel:
+                from ..api.selectors import parse_selector_string
+
+                try:
+                    sel = parse_selector_string(label_sel)
+                except ValueError as e:
+                    self._error(400, "BadRequest", f"bad labelSelector: {e}")
+                    return None
+                items = [i for i in items
+                         if sel.matches((i.get("metadata") or {}).get("labels") or {})]
+            if field_sel:
+                import re as _re
+
+                # the fields the reference's own callers select on
+                getters = {
+                    "spec.nodeName": lambda i: (i.get("spec") or {}).get("nodeName") or "",
+                    "metadata.name": lambda i: (i.get("metadata") or {}).get("name"),
+                    "metadata.namespace": lambda i: (i.get("metadata") or {}).get("namespace"),
+                    "status.phase": lambda i: (i.get("status") or {}).get("phase") or "",
+                }
+                for clause in field_sel.split(","):
+                    m = _re.fullmatch(r"([^=!]+?)\s*(==|!=|=)\s*(.*)", clause.strip())
+                    if m is None:
+                        self._error(400, "BadRequest",
+                                    f"bad fieldSelector clause {clause!r}")
+                        return None
+                    key, op, value = m.group(1), m.group(2), m.group(3)
+                    get = getters.get(key)
+                    if get is None:
+                        self._error(400, "BadRequest",
+                                    f"unsupported fieldSelector {key!r}")
+                        return None
+                    if op == "!=":
+                        items = [i for i in items if get(i) != value]
+                    else:  # '=' and '==' are the same operator
+                        items = [i for i in items if get(i) == value]
+            return items
+
+        def _serve_patch(self, kind: str, ns: str, name: str) -> None:
+            """The PATCH verb (reference ``handlers/rest.go`` PatchResource):
+            patch type negotiated via Content-Type, applied server-side
+            under the CAS retry loop so concurrent writers never lose."""
+            from ..api.patch import CONTENT_TYPES, apply_patch
+            from ..api.scheme import convert_to_internal
+
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+            patch_type = CONTENT_TYPES.get(ctype)
+            if patch_type is None:
+                # a mislabeled body must not be silently merge-patched
+                return self._error(415, "UnsupportedMediaType",
+                                   f"patch content type {ctype!r}; want one of "
+                                   f"{sorted(CONTENT_TYPES)}")
+            patch_doc = self._body()
+
+            def _mutate(cur):
+                gv = (patch_doc.get("apiVersion", "")
+                      if isinstance(patch_doc, dict) else "")
+                if gv:
+                    # a VERSIONED patch applies in wire space: spoke-encode
+                    # the stored hub object, merge, decode back — nested
+                    # wire keys land where the conversion puts them, never
+                    # as dead keys on the hub form (the reference patches
+                    # the versioned object for the same reason)
+                    from ..api.scheme import convert_from_internal
+
+                    wire = convert_from_internal(cur, gv)
+                    patched = apply_patch(wire, patch_doc, patch_type)
+                    return convert_to_internal(patched)
+                return apply_patch(cur, patch_doc, patch_type)
+
+            try:
+                out = server.store.guaranteed_update(kind, ns, name, _mutate)
+            except NotFoundError:
+                raise
+            except (KeyError, IndexError, ValueError, TypeError) as e:
+                return self._error(422, "Invalid", f"cannot apply patch: {e}")
+            return self._send(200, out)
 
         def _serve_ssar(self) -> None:
             """SelfSubjectAccessReview: "can the CALLING user do X?"
@@ -789,6 +879,9 @@ def _make_handler(server: APIServer):
                         return self._serve_watch(kind, q)
                     ns = q.get("namespace", [None])[0]
                     items, rev = server.store.list(kind, ns)
+                    items = self._apply_list_selectors(items, q)
+                    if items is None:
+                        return  # error already written
                     return self._send(200, {"items": items, "resourceVersion": rev})
                 if method == "POST":
                     from ..api.scheme import convert_to_internal
@@ -841,6 +934,8 @@ def _make_handler(server: APIServer):
                     expect = None if cas else 0
                     out = server.store.update(kind, obj, expect_rev=expect or None)
                     return self._send(200, out)
+                if method == "PATCH":
+                    return self._serve_patch(kind, ns, name)
                 if method == "DELETE":
                     return self._send(200, server.store.delete(kind, ns, name))
                 return self._error(405, "MethodNotAllowed", method)
@@ -853,6 +948,9 @@ def _make_handler(server: APIServer):
             if "resourceVersion" in q:
                 from_rev = int(q["resourceVersion"][0])
             timeout = float(q.get("timeoutSeconds", ["30"])[0])
+            has_selectors = bool(q.get("labelSelector") or q.get("fieldSelector"))
+            if has_selectors and self._apply_list_selectors([], q) is None:
+                return  # bad selector: 400 written BEFORE the stream starts
             watch = server.store.watch(kind, from_revision=from_rev)
             try:
                 self._last_code = 200
@@ -867,6 +965,14 @@ def _make_handler(server: APIServer):
                     ev = watch.get(timeout=min(0.5, max(0.0, deadline - _t.monotonic())))
                     if ev is None:
                         continue
+                    if has_selectors:
+                        # the LIST-then-WATCH contract: the same selectors
+                        # filter the event stream (a selector silently
+                        # ignored on watch would re-create the full-cluster
+                        # fan-out the selector exists to avoid)
+                        kept = self._apply_list_selectors([ev.object], q)
+                        if not kept:  # no match, or a bad selector (None)
+                            continue
                     line = (
                         json.dumps(
                             {

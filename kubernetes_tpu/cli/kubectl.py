@@ -65,41 +65,22 @@ def _update_if_changed(client, name, mutate, namespace):
 
 
 def _parse_selector(spec: str):
-    """kubectl's equality selector forms: "k=v", "k==v", "k!=v", comma
-    separated.  Returns [(key, op, value)] or None on a malformed (or
-    effectively empty) selector — an empty selector must NOT silently
-    mean match-all, because delete -l rides on it."""
-    out = []
-    for part in spec.split(","):
-        part = part.strip()
-        if not part:
-            continue
-        if "!=" in part:
-            k, v = part.split("!=", 1)
-            op = "!="
-        elif "==" in part:
-            k, v = part.split("==", 1)
-            op = "="
-        elif "=" in part:
-            k, v = part.split("=", 1)
-            op = "="
-        else:
-            return None
-        k, v = k.strip(), v.strip()
-        if not k:
-            return None
-        out.append((k, op, v))
-    return out or None
+    """kubectl's selector grammar — the SAME parser the wire API uses
+    (``api.selectors.parse_selector_string``: equality, set-based ``in``/
+    ``notin``, exists), so ``-l`` accepts exactly what
+    ``?labelSelector=`` does.  Returns a LabelSelector or None on a
+    malformed (or effectively empty) selector — an empty selector must
+    NOT silently mean match-all, because delete -l rides on it."""
+    from ..api.selectors import parse_selector_string
+
+    try:
+        return parse_selector_string(spec)
+    except ValueError:
+        return None
 
 
-def _labels_match(obj, want: list) -> bool:
-    labels = obj.meta.labels
-    for k, op, v in want:
-        if op == "=" and labels.get(k) != v:
-            return False
-        if op == "!=" and labels.get(k) == v:
-            return False
-    return True
+def _labels_match(obj, want) -> bool:
+    return want.matches(obj.meta.labels)
 REVISION_ANNOTATION = api.DEPLOYMENT_REVISION_ANNOTATION
 
 
@@ -782,67 +763,14 @@ class Kubectl:
             self.out.write(f"error: bad patch: {e}\n")
             return 1
 
-        def _merge(base, overlay, strategic=False):
-            if (strategic and isinstance(base, list) and isinstance(overlay, list)
-                    and all(isinstance(x, dict) and "name" in x for x in base + overlay)):
-                # strategic list merge keyed on "name" (the reference's
-                # patchMergeKey for containers/ports/env/volumes): named
-                # entries merge in place, new ones append, siblings survive
-                out_list = list(base)
-                index = {x["name"]: i for i, x in enumerate(out_list)}
-                for item in overlay:
-                    i = index.get(item["name"])
-                    if i is None:
-                        out_list.append(item)
-                    else:
-                        out_list[i] = _merge(out_list[i], item, strategic)
-                return out_list
-            if not isinstance(base, dict) or not isinstance(overlay, dict):
-                return overlay
-            out = dict(base)
-            for k, v in overlay.items():
-                if v is None:
-                    out.pop(k, None)
-                else:
-                    out[k] = _merge(out.get(k), v, strategic)
-            return out
-
-        def _json_patch(base, ops):
-            for op in ops:
-                path = [p for p in op.get("path", "").split("/") if p]
-                target = base
-                for seg in path[:-1]:
-                    target = target[int(seg)] if isinstance(target, list) else target[seg]
-                leaf = path[-1] if path else ""
-                action = op.get("op")
-                if isinstance(target, list):
-                    idx = len(target) if leaf == "-" else int(leaf)
-                    if action == "add":
-                        target.insert(idx, op.get("value"))
-                    elif action == "replace":
-                        target[idx] = op.get("value")
-                    elif action == "remove":
-                        del target[idx]
-                    else:
-                        raise ValueError(f"unsupported op {action!r}")
-                else:
-                    if action in ("add", "replace"):
-                        target[leaf] = op.get("value")
-                    elif action == "remove":
-                        del target[leaf]
-                    else:
-                        raise ValueError(f"unsupported op {action!r}")
-            return base
-
         errors = []
+
+        from ..api.patch import apply_patch
 
         def _mutate(obj):
             wire = obj.to_dict()
             try:
-                if patch_type == "json":
-                    patched = _json_patch(wire, doc)
-                else:
-                    patched = _merge(wire, doc, strategic=patch_type == "strategic")
+                patched = apply_patch(wire, doc, patch_type)
             except (KeyError, IndexError, ValueError, TypeError) as e:
                 errors.append(str(e))
                 raise _AbortMutation from e

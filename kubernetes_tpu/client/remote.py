@@ -146,8 +146,18 @@ class RemoteStore:
             req.add_header("Authorization", f"Bearer {self.token}")
         return urllib.request.urlopen(req, timeout=self.timeout, context=self._ssl_ctx)
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
-        if self.binary:
+    def _call(self, method: str, path: str, body=None,
+              content_type: Optional[str] = None) -> dict:
+        if content_type is not None:
+            # explicit content type (PATCH negotiation) always sends JSON
+            # bodies; binary Accept still applies to the response
+            data = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": content_type}
+            if self.binary:
+                from ..api import wire as binwire
+
+                headers["Accept"] = binwire.CONTENT_TYPE
+        elif self.binary:
             from ..api import wire as binwire
 
             data = binwire.encode(body) if body is not None else None
@@ -225,12 +235,38 @@ class RemoteStore:
             f"/api/v1/namespaces/{self._ns_path(namespace)}/{self._resource(kind)}/{name}",
         )
 
-    def list(self, kind: str, namespace: Optional[str] = None) -> tuple[list[dict], int]:
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[str] = None,
+             field_selector: Optional[str] = None) -> tuple[list[dict], int]:
+        from urllib.parse import quote
+
         path = f"/api/v1/{self._resource(kind)}"
+        params = []
         if namespace is not None:
-            path += f"?namespace={namespace}"
+            params.append(f"namespace={quote(namespace)}")
+        if label_selector:
+            params.append(f"labelSelector={quote(label_selector)}")
+        if field_selector:
+            params.append(f"fieldSelector={quote(field_selector)}")
+        if params:
+            path += "?" + "&".join(params)
         out = self._call("GET", path)
         return out["items"], int(out["resourceVersion"])
+
+    def patch(self, kind: str, namespace: str, name: str, patch,
+              patch_type: str = "merge") -> dict:
+        """Server-side PATCH (the reference's PATCH verb): the server
+        applies the patch under its CAS loop — no read-modify-write round
+        trips from the client."""
+        from ..api.patch import CONTENT_TYPES
+
+        ctype = next((c for c, t in CONTENT_TYPES.items() if t == patch_type),
+                     "application/merge-patch+json")
+        ns = self._ns_path(namespace)
+        return self._call(
+            "PATCH",
+            f"/api/v1/namespaces/{ns}/{self._resource(kind)}/{name}",
+            body=patch, content_type=ctype)
 
     def update(self, kind: str, obj: dict, expect_rev: Optional[int] = None, _trusted: bool = False) -> dict:
         meta = obj.get("metadata") or {}

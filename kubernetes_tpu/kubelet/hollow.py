@@ -123,6 +123,13 @@ class HollowKubelet:
     def _my_pods(self) -> list[api.Pod]:
         if self.pod_index is not None:
             return self.pod_index.pods_on(self.node_name)
+        store = self.clientset.store
+        if getattr(store, "base_url", None) is not None:
+            # remote node: server-side fieldSelector (the real kubelet's
+            # spec.nodeName= list) — never pull the whole cluster per node
+            items, _ = store.list("Pod", None,
+                                  field_selector=f"spec.nodeName={self.node_name}")
+            return [api.Pod.from_dict(d) for d in items]
         return [
             p for p in self.clientset.pods.list()[0] if p.spec.node_name == self.node_name
         ]

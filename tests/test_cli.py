@@ -257,7 +257,14 @@ def test_get_and_delete_by_label_selector(cs):
     rc, out = run(cs, "delete", "pods", "-l", "app=web")
     assert rc == 0 and out.count("deleted") == 2
     assert {p.meta.name for p in cs.pods.list()[0]} == {"b1"}
-    rc, out = run(cs, "get", "pods", "-l", "bad-selector")
+    # a bare key is now a valid Exists selector (the wire grammar)
+    rc, out = run(cs, "get", "pods", "-l", "app")
+    assert rc == 0 and "b1" in out
+    # set-based grammar works through -l too (one parser everywhere)
+    cs.pods.create(make_pod("c1", labels={"app": "cache"}))
+    rc, out = run(cs, "get", "pods", "-l", "app in (db,cache)")
+    assert rc == 0 and "b1" in out and "c1" in out
+    rc, out = run(cs, "get", "pods", "-l", "=garbage")
     assert rc == 1 and "bad selector" in out
 
 
