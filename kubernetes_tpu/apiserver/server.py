@@ -98,7 +98,8 @@ class APIServer:
 
     Filter order mirrors the reference's handler chain
     (``server/config.go:469 DefaultBuildHandlerChain``): panic recovery →
-    request-info → authentication → audit → authorization → dispatch.
+    request-info → max-in-flight → authentication → audit → impersonation
+    → authorization → dispatch.
     ``tokens`` is the legacy static-token shorthand; pass ``authenticator``
     / ``authorizer`` / ``auditor`` for the full stack (admission runs in
     the store itself when constructed over an ``AdmittedStore``)."""
@@ -113,9 +114,14 @@ class APIServer:
         authorizer=None,
         auditor=None,
         tls: Optional["TLSConfig"] = None,
+        max_in_flight: int = 0,  # 0 = unlimited (reference default 400)
     ):
         self.store = store
         self.tls = tls
+        # max-in-flight filter (server/filters/maxinflight.go): a
+        # semaphore, never a queue — overload answers 429 immediately
+        self._inflight = (threading.Semaphore(max_in_flight)
+                          if max_in_flight > 0 else None)
         self.tokens = tokens
         self.authenticator = authenticator
         if authenticator is None and tokens is not None:
@@ -304,6 +310,39 @@ def _make_handler(server: APIServer):
                 if user is None:
                     self._error(401, "Unauthorized", "invalid or missing credentials")
                     return False
+                # impersonation filter (endpoints/filters/impersonation.go):
+                # Impersonate-User requires the "impersonate" verb on
+                # "users" for the REAL identity; on success the request
+                # proceeds AS the impersonated identity
+                target = self.headers.get("Impersonate-User", "")
+                if target:
+                    from ..auth import ALLOW, AuthzAttributes, UserInfo
+
+                    if server.authorizer is None:
+                        self._error(403, "Forbidden",
+                                    "impersonation requires an authorizer")
+                        return False
+                    # repeated headers (kubectl sends one per --as-group)
+                    groups = [g.strip()
+                              for raw in (self.headers.get_all("Impersonate-Group")
+                                          or [])
+                              for g in raw.split(",") if g.strip()]
+                    # EVERY impersonated identity part is authorized for
+                    # the REAL user: users AND each group — otherwise
+                    # impersonate-users rights escalate to arbitrary
+                    # group membership (impersonation.go checks each)
+                    checks = [("users", target)] + [("groups", g) for g in groups]
+                    for resource_name, name in checks:
+                        decision, reason = server.authorizer.authorize(
+                            AuthzAttributes(user=user, verb="impersonate",
+                                            resource=resource_name, name=name))
+                        if decision != ALLOW:
+                            self._error(
+                                403, "Forbidden",
+                                f"cannot impersonate {resource_name[:-1]} "
+                                f"{name!r}: {reason}")
+                            return False
+                    user = UserInfo(name=target, groups=groups)
                 self._user = user
             verb, resource, ns, name = self._request_info(method)
             if server.auditor is not None:
@@ -345,6 +384,18 @@ def _make_handler(server: APIServer):
             start = time.perf_counter()
             server.request_count.inc()
             self._last_code = 0
+            acquired = False
+            # long-running requests (watches) are EXEMPT, as in
+            # maxinflight.go's longRunningRequestCheck: N held watch
+            # streams must never starve short requests into steady 429
+            is_long_running = "watch=true" in (self.path or "")
+            if server._inflight is not None and not is_long_running:
+                acquired = server._inflight.acquire(blocking=False)
+                if not acquired:
+                    # shed load NOW (maxinflight.go): queueing under
+                    # overload just converts overload into latency
+                    return self._error(429, "TooManyRequests",
+                                       "server overloaded (max in flight)")
             try:
                 if not self._auth_filters(method):
                     return
@@ -368,6 +419,8 @@ def _make_handler(server: APIServer):
                 except Exception:
                     pass
             finally:
+                if acquired:
+                    server._inflight.release()
                 server.request_latency.observe((time.perf_counter() - start) * 1e6)
                 if server.auditor is not None:
                     verb, resource, ns, name = self._request_info(method)

@@ -6,6 +6,7 @@ from .audit import (
     Auditor,
     LogBackend,
     MemoryBackend,
+    WebhookBackend,
 )
 from .audit import PolicyRule as AuditPolicyRule
 from .authn import (

@@ -112,6 +112,82 @@ class LogBackend(Backend):
                 f.write(line + "\n")
 
 
+class WebhookBackend(Backend):
+    """POST audit events to an external collector (reference webhook
+    backend, ``apiserver/plugin/pkg/audit/webhook``): batched in a
+    background thread so audit never sits on the request path; a dead
+    collector drops batches after ``max_buffer`` (audit must not wedge
+    the apiserver)."""
+
+    def __init__(self, url: str, batch_size: int = 100,
+                 flush_interval: float = 1.0, max_buffer: int = 10_000,
+                 timeout: float = 5.0):
+        import queue
+
+        self.url = url
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.timeout = timeout
+        self._q: "queue.Queue[AuditEvent]" = queue.Queue(maxsize=max_buffer)
+        self.dropped = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def process(self, event: AuditEvent) -> None:
+        try:
+            self._q.put_nowait(event)
+        except Exception:  # queue full: shed, never block the request
+            self.dropped += 1
+
+    def _loop(self) -> None:
+        import queue as _queue
+
+        while not self._stop.is_set():
+            batch: list[AuditEvent] = []
+            try:
+                batch.append(self._q.get(timeout=self.flush_interval))
+            except _queue.Empty:
+                continue
+            while len(batch) < self.batch_size:
+                try:
+                    batch.append(self._q.get_nowait())
+                except _queue.Empty:
+                    break
+            try:
+                self._post(batch)
+            finally:
+                # task_done AFTER the POST: stop()'s drain tracks
+                # unfinished_tasks, so an in-flight batch counts until it
+                # is actually delivered (or given up on)
+                for _ in batch:
+                    self._q.task_done()
+
+    def _post(self, batch: list[AuditEvent]) -> None:
+        import urllib.request
+
+        body = json.dumps({"kind": "EventList",
+                           "items": [e.to_dict() for e in batch]}).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+        except Exception:  # noqa: BLE001 - a dead collector loses batches
+            self.dropped += len(batch)
+
+    def stop(self, drain_timeout: float = 2.0) -> None:
+        import time as _t
+
+        deadline = _t.monotonic() + drain_timeout
+        # unfinished_tasks covers the batch IN FLIGHT, not just the queue:
+        # a drain must not declare victory while the final POST is running
+        while self._q.unfinished_tasks and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
 class Auditor:
     """Policy + backends; the apiserver calls :meth:`record` per request."""
 

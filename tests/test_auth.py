@@ -681,3 +681,156 @@ def test_webhook_5xx_is_not_cached_as_verdict():
         assert user is not None and user.name == "u1"
     finally:
         httpd.shutdown()
+
+
+def test_impersonation_filter():
+    """Impersonate-User requires the impersonate verb on users for the
+    REAL identity; the request then proceeds AS the target (reference
+    endpoints/filters/impersonation.go)."""
+    from kubernetes_tpu.api.rbac import ClusterRole, ClusterRoleBinding, PolicyRule, Subject
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.auth import RBACAuthorizer, TokenFileAuthenticator, UnionAuthenticator
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    store = Store()
+    # admin may impersonate; alice has pod-list rights; bob has nothing
+    store.create("ClusterRole", ClusterRole(
+        meta=ObjectMeta(name="impersonator"),
+        rules=[PolicyRule(verbs=["impersonate"], resources=["users"])]).to_dict())
+    store.create("ClusterRoleBinding", ClusterRoleBinding(
+        meta=ObjectMeta(name="admin-impersonates"), role_name="impersonator",
+        subjects=[Subject(kind="User", name="admin")]).to_dict())
+    store.create("ClusterRole", ClusterRole(
+        meta=ObjectMeta(name="pod-reader"),
+        rules=[PolicyRule(verbs=["list"], resources=["pods"])]).to_dict())
+    store.create("ClusterRoleBinding", ClusterRoleBinding(
+        meta=ObjectMeta(name="alice-reads"), role_name="pod-reader",
+        subjects=[Subject(kind="User", name="alice")]).to_dict())
+    server = APIServer(
+        store,
+        authenticator=UnionAuthenticator(
+            TokenFileAuthenticator({"t-admin": "admin", "t-bob": "bob"}),
+            allow_anonymous=False),
+        authorizer=RBACAuthorizer(store))
+    server.start()
+    try:
+        def req(token, impersonate=None):
+            r = urllib.request.Request(f"{server.url}/api/v1/pods")
+            r.add_header("Authorization", f"Bearer {token}")
+            if impersonate:
+                r.add_header("Impersonate-User", impersonate)
+            try:
+                with urllib.request.urlopen(r, timeout=5) as resp:
+                    return resp.status, _json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read())
+
+        # admin impersonating alice inherits ALICE's rights -> 200
+        code, _ = req("t-admin", impersonate="alice")
+        assert code == 200
+        # admin AS ITSELF has no pod rights -> 403
+        code, _ = req("t-admin")
+        assert code == 403
+        # bob may not impersonate at all -> 403
+        code, body = req("t-bob", impersonate="alice")
+        assert code == 403 and "impersonate" in body["message"]
+
+        # group escalation blocked: impersonate-users rights do NOT grant
+        # arbitrary group membership (each group needs its own grant)
+        r = urllib.request.Request(f"{server.url}/api/v1/pods")
+        r.add_header("Authorization", "Bearer t-admin")
+        r.add_header("Impersonate-User", "alice")
+        r.add_header("Impersonate-Group", "system:masters")
+        try:
+            urllib.request.urlopen(r, timeout=5)
+            assert False, "expected 403"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403 and "group" in _json.loads(e.read())["message"]
+    finally:
+        server.stop()
+
+
+def test_max_in_flight_sheds_load_but_exempts_watches():
+    """maxinflight.go: requests beyond the cap answer 429 immediately;
+    long-running watches are EXEMPT (held watch streams must never
+    starve short requests)."""
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+
+    server = APIServer(Store(), max_in_flight=2)
+    server.start()
+    try:
+        # exhaust the slots (the filter's own semaphore: deterministic,
+        # no reliance on slow endpoints)
+        assert server._inflight.acquire(blocking=False)
+        assert server._inflight.acquire(blocking=False)
+        try:
+            urllib.request.urlopen(f"{server.url}/api/v1/pods", timeout=5)
+            assert False, "expected 429"
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+        # a WATCH still flows while the cap is exhausted
+        with urllib.request.urlopen(
+                f"{server.url}/api/v1/pods?watch=true&timeoutSeconds=1",
+                timeout=10) as r:
+            assert r.status == 200
+            r.read()
+        server._inflight.release()
+        server._inflight.release()
+        with urllib.request.urlopen(f"{server.url}/api/v1/pods", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        server.stop()
+
+
+def test_audit_webhook_backend_batches_and_sheds():
+    import json as _json
+    import threading
+    import time
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from kubernetes_tpu.auth import Auditor, WebhookBackend
+
+    received = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = _json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+            received.extend(body["items"])
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    httpd = HTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        backend = WebhookBackend(f"http://127.0.0.1:{httpd.server_port}/",
+                                 flush_interval=0.1)
+        auditor = Auditor(backends=[backend])
+        for i in range(25):
+            auditor.record("ResponseComplete", "alice", "get", "pods",
+                           "default", f"p{i}", code=200)
+        deadline = time.time() + 5
+        while len(received) < 25 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(received) == 25
+        assert received[0]["user"] == "alice"
+        backend.stop()
+    finally:
+        httpd.shutdown()
+    # a dead collector sheds instead of wedging the request path
+    dead = WebhookBackend("http://127.0.0.1:1/", flush_interval=0.05,
+                          max_buffer=5, timeout=0.1)
+    auditor2 = Auditor(backends=[dead])
+    t0 = time.time()
+    for i in range(200):
+        auditor2.record("ResponseComplete", "bob", "get", "pods", "d", f"x{i}")
+    assert time.time() - t0 < 1.0, "audit must never block the request path"
+    dead.stop()
