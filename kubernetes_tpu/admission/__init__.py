@@ -39,6 +39,7 @@ from .plugins_ext import (
     NodeRestriction,
     PodNodeSelector,
     PodPreset,
+    PodSecurityPolicyPlugin,
     ServiceIPAllocator,
 )
 from . import quota

@@ -338,5 +338,8 @@ def default_chain() -> AdmissionChain:
         LimitPodHardAntiAffinityTopology(),
         Priority(),
         _PluginsExt.DenyEscalatingExec(),
+        # inert until PodSecurityPolicy objects exist (opt-in like the
+        # reference's plugin enablement)
+        _PluginsExt.PodSecurityPolicyPlugin(),
         ResourceQuota(),
     ])

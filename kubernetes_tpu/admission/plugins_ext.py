@@ -553,3 +553,73 @@ class Initializers(AdmissionPlugin):
         if not old_pending and new_pending:
             self.deny("cannot add initializers after creation")
         self.deny("initializers must be removed in order, first first")
+
+
+class PodSecurityPolicyPlugin(AdmissionPlugin):
+    """``plugin/pkg/admission/security/podsecuritypolicy``: a pod is
+    admitted by the FIRST policy (name order) that allows everything it
+    requests — privilege, host namespaces, user range, volume kinds; the
+    admitting policy's name is stamped on the pod.  With no policies
+    registered the plugin is inert (the cluster hasn't opted into PSP)."""
+
+    name = "PodSecurityPolicy"
+    operations = (CREATE,)
+
+    ANNOTATION = "kubernetes.io/psp"
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "Pod" and super().handles(attrs)
+
+    def _violations(self, policy: dict, pod: dict) -> list:
+        spec = pod.get("spec") or {}
+        pspec = policy.get("spec") or {}
+        out = []
+        for flag, allowed_key in (("hostPID", "hostPID"), ("hostIPC", "hostIPC"),
+                                  ("hostNetwork", "hostNetwork")):
+            if spec.get(flag) and not pspec.get(allowed_key):
+                out.append(f"{flag} is not allowed")
+        run_rule = (pspec.get("runAsUser") or {}).get("rule", "RunAsAny")
+        for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
+            sc = c.get("securityContext") or {}
+            if sc.get("privileged") and not pspec.get("privileged"):
+                out.append(f"privileged container {c.get('name')!r} is not allowed")
+            if run_rule == "MustRunAs":
+                uid = sc.get("runAsUser")
+                lo = (pspec.get("runAsUser") or {}).get("min", 0)
+                hi = (pspec.get("runAsUser") or {}).get("max", 1 << 31)
+                if uid is None or not (lo <= uid <= hi):
+                    out.append(
+                        f"container {c.get('name')!r} runAsUser {uid} outside "
+                        f"[{lo}, {hi}]")
+        allowed_kinds = pspec.get("allowedVolumeKinds")
+        if allowed_kinds is None:
+            allowed_kinds = ["*"]
+        # NOTE: [] is a VALID policy (deny all volumes) — never coerce an
+        # empty list to the wildcard
+        if "*" not in allowed_kinds:
+            for v in spec.get("volumes") or []:
+                kind = v.get("diskKind") or ("pvc" if v.get("pvcName") else "")
+                if kind and kind not in allowed_kinds:
+                    out.append(f"volume kind {kind!r} is not allowed")
+        return out
+
+    def validate(self, attrs: Attributes) -> None:
+        if attrs.store is None:
+            return
+        policies, _ = attrs.store.list("PodSecurityPolicy", "")
+        if not policies:
+            return  # PSP not in use
+        failures = {}
+        for policy in sorted(policies,
+                             key=lambda p: (p.get("metadata") or {}).get("name", "")):
+            bad = self._violations(policy, attrs.obj or {})
+            pname = (policy.get("metadata") or {}).get("name", "")
+            if not bad:
+                # stamp the admitting policy (validate runs after admit;
+                # the annotation write here is the reference's behavior)
+                ((attrs.obj or {}).setdefault("metadata", {})
+                 .setdefault("annotations", {}))[self.ANNOTATION] = pname
+                return
+            failures[pname] = bad[0]
+        detail = "; ".join(f"{n}: {m}" for n, m in failures.items())
+        self.deny(f"no PodSecurityPolicy admits this pod ({detail})")

@@ -730,3 +730,58 @@ class CertificateSigningRequest:
             conditions=list(status.get("conditions") or []),
             certificate=status.get("certificate", ""),
         )
+
+
+@dataclass
+class PodSecurityPolicy:
+    """Cluster-scoped pod security policy (reference
+    ``pkg/apis/extensions`` PodSecurityPolicy; admission at
+    ``plugin/pkg/admission/security/podsecuritypolicy``): what a pod may
+    request — privilege, host namespaces, user ranges, volume kinds."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    privileged: bool = False
+    host_pid: bool = False
+    host_ipc: bool = False
+    host_network: bool = False
+    # {"rule": "RunAsAny"} or {"rule": "MustRunAs", "min": N, "max": M}
+    run_as_user: dict = field(default_factory=lambda: {"rule": "RunAsAny"})
+    # volume disk kinds a pod may mount; ["*"] = all
+    allowed_volume_kinds: list = field(default_factory=lambda: ["*"])
+
+    KIND = "PodSecurityPolicy"
+
+    def __post_init__(self):
+        self.meta.namespace = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "privileged": self.privileged,
+                "hostPID": self.host_pid,
+                "hostIPC": self.host_ipc,
+                "hostNetwork": self.host_network,
+                "runAsUser": dict(self.run_as_user),
+                "allowedVolumeKinds": list(self.allowed_volume_kinds),
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PodSecurityPolicy":
+        spec = d.get("spec") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            privileged=bool(spec.get("privileged", False)),
+            host_pid=bool(spec.get("hostPID", False)),
+            host_ipc=bool(spec.get("hostIPC", False)),
+            host_network=bool(spec.get("hostNetwork", False)),
+            run_as_user=dict(spec.get("runAsUser") or {"rule": "RunAsAny"}),
+            allowed_volume_kinds=(list(spec["allowedVolumeKinds"])
+                                  if spec.get("allowedVolumeKinds") is not None
+                                  else ["*"]),
+        )
+
+
+register_kind(PodSecurityPolicy, cluster_scoped=True)

@@ -169,6 +169,7 @@ class Container:
     env: dict[str, str] = field(default_factory=dict)  # injected by PodPreset
     image_pull_policy: str = ""  # "" | Always | IfNotPresent | Never
     privileged: bool = False  # securityContext.privileged essential
+    run_as_user: Optional[int] = None  # securityContext.runAsUser (PSP ranges)
 
     def to_dict(self) -> dict:
         d = {
@@ -185,8 +186,13 @@ class Container:
             d["env"] = dict(self.env)
         if self.image_pull_policy:
             d["imagePullPolicy"] = self.image_pull_policy
-        if self.privileged:
-            d["securityContext"] = {"privileged": True}
+        if self.privileged or self.run_as_user is not None:
+            sc: dict = {}
+            if self.privileged:
+                sc["privileged"] = True
+            if self.run_as_user is not None:
+                sc["runAsUser"] = self.run_as_user
+            d["securityContext"] = sc
         return d
 
     @classmethod
@@ -201,6 +207,7 @@ class Container:
             env=dict(d.get("env") or {}),
             image_pull_policy=d.get("imagePullPolicy", ""),
             privileged=bool((d.get("securityContext") or {}).get("privileged")),
+            run_as_user=(d.get("securityContext") or {}).get("runAsUser"),
         )
 
 

@@ -296,6 +296,8 @@ def _make_handler(server: APIServer):
             """authentication → audit(RequestReceived) → authorization.
             Returns False (response already sent) on 401/403."""
             self._user = None
+            self._audit_user = None  # reset per request (keep-alive reuses
+            # this handler instance across requests on one connection)
             if server.authenticator is not None:
                 user = None
                 if server.tls is not None and server.tls.client_ca:
@@ -342,13 +344,18 @@ def _make_handler(server: APIServer):
                                 f"cannot impersonate {resource_name[:-1]} "
                                 f"{name!r}: {reason}")
                             return False
+                    # the AUDIT trail must keep the real actor: the
+                    # reference annotates impersonated requests with the
+                    # original user (filters/impersonation.go + audit)
+                    self._audit_user = f"{target} (impersonated-by {user.name})"
                     user = UserInfo(name=target, groups=groups)
                 self._user = user
             verb, resource, ns, name = self._request_info(method)
             if server.auditor is not None:
                 server.auditor.record(
                     "RequestReceived",
-                    self._user.name if self._user else "",
+                    getattr(self, "_audit_user", None)
+                    or (self._user.name if self._user else ""),
                     verb, resource, ns, name,
                 )
             if urlparse(self.path).path in ("/api", "/api/v1", "/apis", SSAR_PATH):
@@ -387,8 +394,11 @@ def _make_handler(server: APIServer):
             acquired = False
             # long-running requests (watches) are EXEMPT, as in
             # maxinflight.go's longRunningRequestCheck: N held watch
-            # streams must never starve short requests into steady 429
-            is_long_running = "watch=true" in (self.path or "")
+            # streams must never starve short requests into steady 429.
+            # Parse the query PROPERLY — a substring match would let any
+            # client opt out via ?foo=watch=true
+            is_long_running = parse_qs(urlparse(self.path or "").query).get(
+                "watch", ["false"])[0] == "true"
             if server._inflight is not None and not is_long_running:
                 acquired = server._inflight.acquire(blocking=False)
                 if not acquired:
@@ -424,9 +434,11 @@ def _make_handler(server: APIServer):
                 server.request_latency.observe((time.perf_counter() - start) * 1e6)
                 if server.auditor is not None:
                     verb, resource, ns, name = self._request_info(method)
+                    audit_user = getattr(self, "_audit_user", None) or (
+                        self._user.name if getattr(self, "_user", None) else "")
                     server.auditor.record(
                         "ResponseComplete",
-                        self._user.name if getattr(self, "_user", None) else "",
+                        audit_user,
                         verb, resource, ns, name, code=self._last_code,
                     )
 

@@ -168,6 +168,54 @@ class Kubectl:
         for r in rows:
             self.out.write("  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
 
+    # -- get --watch --------------------------------------------------------
+    def get_watch(self, resource: str, namespace: Optional[str] = None,
+                  selector: str = "", timeout: float = 30.0) -> int:
+        """``kubectl get RES -w``: print the current table, then stream
+        event rows until ``timeout`` (the reference streams forever;
+        bounded here so scripts and tests terminate)."""
+        resource, kind = _resolve(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return 1
+        want = None
+        if selector:
+            want = _parse_selector(selector)
+            if want is None:
+                self.out.write(f"error: bad selector {selector!r}\n")
+                return 1
+        client = self.cs.client_for(kind)
+        ns_scope = namespace if namespace is not None else client.default_namespace
+        # LIST at a revision, then WATCH strictly after it: events landing
+        # between the table and the stream are never lost
+        objs, rev = client.list(ns_scope)
+        if want is not None:
+            objs = [o for o in objs if _labels_match(o, want)]
+        rows = [self._headers(kind)] + [self._row(kind, o) for o in objs]
+        self._print(*rows)
+        watch = self.cs.store.watch(kind, from_revision=rev)
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        try:
+            while _time.monotonic() < deadline:
+                ev = watch.get(timeout=min(0.5, max(0.0, deadline - _time.monotonic())))
+                if ev is None:
+                    continue
+                obj = api.from_dict(ev.object) if isinstance(ev.object, dict) else ev.object
+                # the stream scopes like the table: one namespace (unless
+                # the kind is cluster-scoped, where ns is always "")
+                if (kind not in api.CLUSTER_SCOPED_KINDS
+                        and obj.meta.namespace != ns_scope):
+                    continue
+                if want is not None and not _labels_match(obj, want):
+                    continue
+                row = self._row(kind, obj)
+                self.out.write(f"{ev.type:<9} " + "  ".join(str(c) for c in row) + "\n")
+        finally:
+            watch.stop()
+        return 0
+
     # -- get ---------------------------------------------------------------
     def get(self, resource: str, name: Optional[str] = None, namespace: Optional[str] = None,
             output: str = "", selector: str = "") -> int:
@@ -1537,6 +1585,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("resource")
     p.add_argument("name", nargs="?")
     p.add_argument("-l", "--selector", default="")
+    p.add_argument("-w", "--watch", action="store_true")
+    p.add_argument("--watch-timeout", type=float, default=30.0)
     p = sub.add_parser("describe", parents=[common])
     p.add_argument("resource")
     p.add_argument("name")
@@ -1670,6 +1720,12 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     cs = clientset or Clientset(RemoteStore(server, token=token))
     k = Kubectl(cs, out=out)
     if args.verb == "get":
+        if getattr(args, "watch", False):
+            if args.name:
+                k.out.write("error: -w does not take a name\n")
+                return 1
+            return k.get_watch(args.resource, namespace, args.selector,
+                               args.watch_timeout)
         return k.get(args.resource, args.name, namespace, output, args.selector)
     if args.verb == "describe":
         return k.describe(args.resource, args.name, namespace)

@@ -455,3 +455,123 @@ def test_initializers_create_rule():
             operation="CREATE", kind="Pod", namespace="default", name="p",
             obj={"metadata": {"initializers": {"pending": [],
                                                "result": {"status": "Failure"}}}}))
+
+
+def test_pod_security_policy_plugin():
+    from kubernetes_tpu.admission.framework import AdmissionDenied, Attributes
+    from kubernetes_tpu.admission.plugins_ext import PodSecurityPolicyPlugin
+    from kubernetes_tpu.api.cluster import PodSecurityPolicy
+    from kubernetes_tpu.api import ObjectMeta
+    from kubernetes_tpu.store import Store
+
+    store = Store()
+    plug = PodSecurityPolicyPlugin()
+
+    def attrs_for(pod):
+        return Attributes(operation="CREATE", kind="Pod", namespace="default",
+                          name="p", obj=pod, store=store)
+
+    priv_pod = {"spec": {"containers": [
+        {"name": "c", "securityContext": {"privileged": True}}]}}
+    plain_pod = {"spec": {"containers": [{"name": "c"}]}}
+
+    # no policies registered: inert (cluster hasn't opted into PSP)
+    plug.validate(attrs_for(priv_pod))
+
+    # restricted-only: privileged pods denied, plain pods stamped
+    store.create("PodSecurityPolicy", PodSecurityPolicy(
+        meta=ObjectMeta(name="10-restricted")).to_dict())
+    with pytest.raises(AdmissionDenied):
+        plug.validate(attrs_for(priv_pod))
+    pod = dict(plain_pod, metadata={})
+    plug.validate(attrs_for(pod))
+    assert pod["metadata"]["annotations"]["kubernetes.io/psp"] == "10-restricted"
+
+    # adding a privileged policy admits the privileged pod under ITS name
+    store.create("PodSecurityPolicy", PodSecurityPolicy(
+        meta=ObjectMeta(name="50-privileged"), privileged=True,
+        host_pid=True).to_dict())
+    pod = dict(priv_pod, metadata={})
+    plug.validate(attrs_for(pod))
+    assert pod["metadata"]["annotations"]["kubernetes.io/psp"] == "50-privileged"
+
+    # host namespaces gated
+    hostpid = {"spec": {"hostPID": True, "containers": [{"name": "c"}]}}
+    pod = dict(hostpid, metadata={})
+    plug.validate(attrs_for(pod))  # 50-privileged allows hostPID
+    assert pod["metadata"]["annotations"]["kubernetes.io/psp"] == "50-privileged"
+
+    # MustRunAs user range enforced
+    store.create("PodSecurityPolicy", PodSecurityPolicy(
+        meta=ObjectMeta(name="00-ranged"),
+        run_as_user={"rule": "MustRunAs", "min": 1000, "max": 2000}).to_dict())
+    ranged_ok = {"spec": {"containers": [
+        {"name": "c", "securityContext": {"runAsUser": 1500}}]}, "metadata": {}}
+    plug.validate(attrs_for(ranged_ok))
+    # 00-ranged sorts first and admits
+    assert ranged_ok["metadata"]["annotations"]["kubernetes.io/psp"] == "00-ranged"
+
+    # volume kinds gated
+    store2 = Store()
+    store2.create("PodSecurityPolicy", PodSecurityPolicy(
+        meta=ObjectMeta(name="novol"), allowed_volume_kinds=["pvc"]).to_dict())
+    plug2 = PodSecurityPolicyPlugin()
+    disky = {"spec": {"containers": [{"name": "c"}],
+                      "volumes": [{"name": "v", "diskKind": "gce-pd",
+                                   "diskID": "d1"}]}}
+    with pytest.raises(AdmissionDenied):
+        plug2.validate(Attributes(operation="CREATE", kind="Pod",
+                                  namespace="default", name="p",
+                                  obj=disky, store=store2))
+
+
+def test_psp_empty_volume_kinds_denies_all_volumes():
+    """allowedVolumeKinds: [] is a real policy (no volumes) — it must not
+    fail open to the wildcard."""
+    from kubernetes_tpu.admission.framework import AdmissionDenied, Attributes
+    from kubernetes_tpu.admission.plugins_ext import PodSecurityPolicyPlugin
+    from kubernetes_tpu.api.cluster import PodSecurityPolicy
+    from kubernetes_tpu.api import ObjectMeta
+    from kubernetes_tpu.store import Store
+
+    store = Store()
+    store.create("PodSecurityPolicy", PodSecurityPolicy(
+        meta=ObjectMeta(name="novols"), allowed_volume_kinds=[]).to_dict())
+    assert (store.get("PodSecurityPolicy", "", "novols")["spec"]
+            ["allowedVolumeKinds"] == [])
+    plug = PodSecurityPolicyPlugin()
+    disky = {"spec": {"containers": [{"name": "c"}],
+                      "volumes": [{"name": "v", "diskKind": "gce-pd",
+                                   "diskID": "d"}]}}
+    with pytest.raises(AdmissionDenied):
+        plug.validate(Attributes(operation="CREATE", kind="Pod",
+                                 namespace="default", name="p",
+                                 obj=disky, store=store))
+
+
+def test_psp_must_run_as_with_typed_containers():
+    """runAsUser survives the typed API round trip, so MustRunAs policies
+    work for kubectl/typed-client pods."""
+    from kubernetes_tpu.api import Container
+
+    c = Container(name="c", run_as_user=1500)
+    assert Container.from_dict(c.to_dict()).run_as_user == 1500
+
+    from kubernetes_tpu.admission.framework import Attributes
+    from kubernetes_tpu.admission.plugins_ext import PodSecurityPolicyPlugin
+    from kubernetes_tpu.api.cluster import PodSecurityPolicy
+    from kubernetes_tpu.api import ObjectMeta
+    from kubernetes_tpu.store import Store
+    from kubernetes_tpu.testutil import make_pod
+
+    store = Store()
+    store.create("PodSecurityPolicy", PodSecurityPolicy(
+        meta=ObjectMeta(name="ranged"),
+        run_as_user={"rule": "MustRunAs", "min": 1000, "max": 2000}).to_dict())
+    pod = make_pod("p")
+    pod.spec.containers[0].run_as_user = 1500
+    wire = pod.to_dict()
+    PodSecurityPolicyPlugin().validate(Attributes(
+        operation="CREATE", kind="Pod", namespace="default", name="p",
+        obj=wire, store=store))
+    assert wire["metadata"]["annotations"]["kubernetes.io/psp"] == "ranged"

@@ -737,3 +737,31 @@ def test_plugin_mechanism(cs, tmp_path, monkeypatch):
 
     with _pytest.raises(SystemExit):
         kubectl_main(["nope"], clientset=cs, out=io.StringIO())
+
+
+def test_get_watch_streams_events(cs):
+    import threading
+
+    out = io.StringIO()
+    from kubernetes_tpu.cli.kubectl import main as km
+
+    done = threading.Event()
+
+    def run_watch():
+        km(["get", "pods", "-w", "--watch-timeout", "2", "-l", "app=web"],
+           clientset=cs, out=out)
+        done.set()
+
+    t = threading.Thread(target=run_watch, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.3)
+    cs.pods.create(make_pod("seen", labels={"app": "web"}))
+    cs.pods.create(make_pod("hidden", labels={"app": "db"}))
+    cs.pods.delete("seen")
+    assert done.wait(timeout=10)
+    text = out.getvalue()
+    assert "ADDED" in text and "seen" in text
+    assert "DELETED" in text
+    assert "hidden" not in text  # selector filters the stream
