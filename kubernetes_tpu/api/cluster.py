@@ -784,4 +784,5 @@ class PodSecurityPolicy:
         )
 
 
-register_kind(PodSecurityPolicy, cluster_scoped=True)
+register_kind(PodSecurityPolicy, cluster_scoped=True,
+              plural="podsecuritypolicies")

@@ -436,6 +436,10 @@ class PodSpec:
     service_account_name: str = ""
     termination_grace_period_seconds: int = 30
     active_deadline_seconds: Optional[int] = None
+    # host namespace sharing (PSP/DenyEscalatingExec gates read these)
+    host_pid: bool = False
+    host_ipc: bool = False
+    host_network: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -452,6 +456,9 @@ class PodSpec:
             "serviceAccountName": self.service_account_name,
             "terminationGracePeriodSeconds": self.termination_grace_period_seconds,
             "activeDeadlineSeconds": self.active_deadline_seconds,
+            "hostPID": self.host_pid,
+            "hostIPC": self.host_ipc,
+            "hostNetwork": self.host_network,
         }
 
     @classmethod
@@ -472,6 +479,9 @@ class PodSpec:
             service_account_name=d.get("serviceAccountName", ""),
             termination_grace_period_seconds=int(d.get("terminationGracePeriodSeconds", 30)),
             active_deadline_seconds=None if ads is None else int(ads),
+            host_pid=bool(d.get("hostPID", False)),
+            host_ipc=bool(d.get("hostIPC", False)),
+            host_network=bool(d.get("hostNetwork", False)),
         )
 
 

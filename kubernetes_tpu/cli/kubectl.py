@@ -188,11 +188,7 @@ class Kubectl:
         ns_scope = namespace if namespace is not None else client.default_namespace
         # LIST at a revision, then WATCH strictly after it: events landing
         # between the table and the stream are never lost
-        objs, rev = client.list(ns_scope)
-        if want is not None:
-            objs = [o for o in objs if _labels_match(o, want)]
-        rows = [self._headers(kind)] + [self._row(kind, o) for o in objs]
-        self._print(*rows)
+        rev = self._print_table(kind, client, ns_scope, want)
         watch = self.cs.store.watch(kind, from_revision=rev)
         import time as _time
 
@@ -215,6 +211,16 @@ class Kubectl:
         finally:
             watch.stop()
         return 0
+
+    def _print_table(self, kind, client, ns_scope, want) -> int:
+        """List + filter + print the table; returns the list revision
+        (shared by ``get`` and ``get -w``)."""
+        objs, rev = client.list(ns_scope)
+        if want is not None:
+            objs = [o for o in objs if _labels_match(o, want)]
+        rows = [self._headers(kind)] + [self._row(kind, o) for o in objs]
+        self._print(*rows)
+        return rev
 
     # -- get ---------------------------------------------------------------
     def get(self, resource: str, name: Optional[str] = None, namespace: Optional[str] = None,
@@ -341,6 +347,9 @@ class Kubectl:
         return [convert_to_internal(d) for d in yaml.safe_load_all(text) if d]
 
     def create(self, filename: str) -> int:
+        from ..admission.framework import AdmissionDenied
+        from ..client.remote import ForbiddenError
+
         rc = 0
         for doc in self._load_manifests(filename):
             kind = doc.get("kind", "")
@@ -353,6 +362,12 @@ class Kubectl:
                 self.out.write(f"{KIND_TO_RESOURCE[kind]}/{obj.meta.name} created\n")
             except AlreadyExistsError:
                 self.out.write(f"Error: {kind} already exists\n")
+                rc = 1
+            except (AdmissionDenied, ForbiddenError) as e:
+                # the reference surfaces admission/authz denials as
+                # "Error from server (Forbidden)" — in-proc raises
+                # AdmissionDenied, the wire raises ForbiddenError (403)
+                self.out.write(f"Error from server (Forbidden): {e}\n")
                 rc = 1
         return rc
 

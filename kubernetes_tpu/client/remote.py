@@ -31,12 +31,20 @@ class RemoteError(Exception):
     pass
 
 
+class ForbiddenError(RemoteError):
+    """HTTP 403 — authorization or admission said no.  A distinct type so
+    callers (kubectl) surface 'Error from server (Forbidden)' instead of
+    crashing on a generic RemoteError."""
+
+
 def _raise_for_status(body: dict) -> None:
     if body.get("kind") != "Status":
         return
     code, msg = body.get("code"), body.get("message", "")
     if code == 404:
         raise NotFoundError(msg)
+    if code == 403:
+        raise ForbiddenError(msg)
     if code == 409:
         if body.get("reason") == "AlreadyExists":
             raise AlreadyExistsError(msg)

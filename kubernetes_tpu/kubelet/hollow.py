@@ -392,11 +392,40 @@ class HollowKubelet:
         update = api.Pod.from_dict(pod.to_dict())
         update.status.phase = api.RUNNING
         update.status.host_ip = self.node_name
+        if not update.status.pod_ip:
+            # the CNI step of pod startup: a sandbox gets an address the
+            # moment it runs (endpoints/proxy rules are built from it)
+            update.status.pod_ip = self._next_pod_ip()
         try:
             self.clientset.pods.update_status(update)
             return True
         except (NotFoundError, ConflictError):
             return False
+
+    def _next_pod_ip(self) -> str:
+        """Per-node pod addressing (the kubenet/CNI IPAM shape): the
+        node's ALLOCATED podCIDR when the IPAM controller has assigned
+        one (collision-free by construction, like the reference), else a
+        stable crc32-derived /24 — never ``hash()``, which is
+        PYTHONHASHSEED-randomized and 256-bucket collision-prone."""
+        n = (getattr(self, "_ip_counter", 0) % 254) + 1
+        self._ip_counter = n
+        base = getattr(self, "_pod_ip_base", None)
+        if base is None:
+            cidr = ""
+            try:
+                cidr = self.clientset.nodes.get(self.node_name).spec.pod_cidr
+            except Exception:  # noqa: BLE001 - fall through to the hash base
+                pass
+            if cidr and "/" in cidr:
+                base = cidr.split("/", 1)[0].rsplit(".", 1)[0]
+            else:
+                import zlib
+
+                h = zlib.crc32(self.node_name.encode()) & 0xFFFF
+                base = f"10.{h >> 8}.{h & 0xFF}"
+            self._pod_ip_base = base
+        return f"{base}.{n}"
 
     def _heartbeat(self, force: bool = False) -> None:
         now = self._clock()

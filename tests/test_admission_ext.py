@@ -575,3 +575,31 @@ def test_psp_must_run_as_with_typed_containers():
         operation="CREATE", kind="Pod", namespace="default", name="p",
         obj=wire, store=store))
     assert wire["metadata"]["annotations"]["kubernetes.io/psp"] == "ranged"
+
+
+def test_psp_host_namespaces_survive_typed_round_trip():
+    """spec.hostPID/... must survive the typed API so the PSP host gate
+    is enforceable end-to-end (not only for raw-dict clients)."""
+    from kubernetes_tpu.admission import AdmittedStore, default_chain
+    from kubernetes_tpu.api import Pod, PodSpec
+    from kubernetes_tpu.api.cluster import PodSecurityPolicy
+    from kubernetes_tpu.api import ObjectMeta
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.store.store import Store
+    from kubernetes_tpu.admission.framework import AdmissionDenied
+    from kubernetes_tpu.testutil import make_pod
+
+    assert PodSpec.from_dict(PodSpec(host_pid=True).to_dict()).host_pid is True
+
+    cs = Clientset(AdmittedStore(default_chain()))
+    cs.client_for("PodSecurityPolicy").create(
+        PodSecurityPolicy(meta=ObjectMeta(name="restricted")))
+    pod = make_pod("hosty")
+    pod.spec.host_pid = True
+    with pytest.raises(AdmissionDenied):
+        cs.pods.create(pod)
+    # allowed once a policy permits it
+    cs.client_for("PodSecurityPolicy").create(PodSecurityPolicy(
+        meta=ObjectMeta(name="zz-host"), host_pid=True))
+    created = cs.pods.create(pod)
+    assert created.meta.annotations["kubernetes.io/psp"] == "zz-host"
