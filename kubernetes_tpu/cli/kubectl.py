@@ -20,6 +20,7 @@ clientset when embedded (tests, single-binary demos).
 from __future__ import annotations
 
 import argparse
+import io
 import json
 import sys
 from typing import Optional
@@ -224,7 +225,8 @@ class Kubectl:
 
     # -- get ---------------------------------------------------------------
     def get(self, resource: str, name: Optional[str] = None, namespace: Optional[str] = None,
-            output: str = "", selector: str = "") -> int:
+            output: str = "", selector: str = "", sort_by: str = "",
+            show_labels: bool = False, no_headers: bool = False) -> int:
         resource, kind = _resolve(resource)
         if kind is None:
             self.out.write(f"error: unknown resource {resource!r}\n")
@@ -247,6 +249,51 @@ class Kubectl:
                     self.out.write(f"error: bad selector {selector!r}\n")
                     return 1
                 objs = [o for o in objs if _labels_match(o, want)]
+        if sort_by:
+            # --sort-by '{.spec.nodeName}' (pkg/kubectl sorting_printer.go):
+            # numbers sort numerically, everything else as strings
+            def _sort_key(vals):
+                v = vals[0] if vals else ""
+                if isinstance(v, bool):
+                    return (1, str(v))
+                if isinstance(v, (int, float)):
+                    return (0, v)
+                try:
+                    return (0, float(v))
+                except (TypeError, ValueError):
+                    return (1, str(v))
+
+            try:
+                keyed = [(_sort_key(_jsonpath(o.to_dict(), sort_by)), o)
+                         for o in objs]
+            except (KeyError, IndexError, TypeError, ValueError) as e:
+                self.out.write(f"error: sort-by: {e}\n")
+                return 1
+            objs = [o for _, o in sorted(keyed, key=lambda kv: kv[0])]
+        if output.startswith("custom-columns="):
+            # -o custom-columns=HDR:.path,HDR2:.path (custom_column_printer)
+            spec = output[len("custom-columns="):]
+            cols = []
+            for part in spec.split(","):
+                hdr, _, path = part.partition(":")
+                if not hdr or not path:
+                    self.out.write(f"error: bad custom-columns spec {part!r}\n")
+                    return 1
+                cols.append((hdr, path))
+            rows = [] if no_headers else [tuple(h for h, _ in cols)]
+            for o in objs:
+                doc = o.to_dict()
+                row = []
+                for _, path in cols:
+                    try:
+                        vals = _jsonpath(doc, "{" + path + "}")
+                        row.append(",".join(str(v) for v in vals) or "<none>")
+                    except (KeyError, IndexError, TypeError, ValueError):
+                        row.append("<none>")
+                rows.append(tuple(row))
+            if rows:
+                self._print(*rows)
+            return 0
         if output == "json":
             docs = [o.to_dict() for o in objs]
             self.out.write(json.dumps(docs[0] if name else {"items": docs}, indent=2) + "\n")
@@ -255,7 +302,7 @@ class Kubectl:
             docs = [o.to_dict() for o in objs]
             self.out.write(yaml.safe_dump(docs[0] if name else {"items": docs}))
             return 0
-        if output and not output.startswith("jsonpath="):
+        if output and output != "wide" and not output.startswith("jsonpath="):
             self.out.write(f"error: unsupported output format {output!r}\n")
             return 1
         if output.startswith("jsonpath="):
@@ -268,11 +315,39 @@ class Kubectl:
                 return 1
             self.out.write(" ".join(str(v) for v in values) + "\n")
             return 0
-        rows = [self._headers(kind)]
+        wide = output == "wide"
+        header = self._headers(kind)
+        if wide:
+            header = header + self._wide_headers(kind)
+        if show_labels:
+            header = header + ("LABELS",)
+        rows = [] if no_headers else [header]
         for o in objs:
-            rows.append(self._row(kind, o))
-        self._print(*rows)
+            row = self._row(kind, o)
+            if wide:
+                row = row + self._wide_row(kind, o)
+            if show_labels:
+                row = row + (",".join(f"{k}={v}" for k, v in sorted(o.meta.labels.items()))
+                             or "<none>",)
+            rows.append(row)
+        if rows:
+            self._print(*rows)
         return 0
+
+    def _wide_headers(self, kind: str):
+        return {"Pod": ("IP",), "Node": ("ADDRESSES", "CIDR"),
+                "Service": ("CLUSTER-IP", "PORTS")}.get(kind, ())
+
+    def _wide_row(self, kind: str, o):
+        if kind == "Pod":
+            return (o.status.pod_ip or "<none>",)
+        if kind == "Node":
+            addrs = ",".join(a.get("address", "") for a in o.status.addresses)
+            return (addrs or "<none>", o.spec.pod_cidr or "<none>")
+        if kind == "Service":
+            ports = ",".join(str(p.port) for p in o.ports)
+            return (o.cluster_ip or "<none>", ports or "<none>")
+        return ()
 
     def _headers(self, kind: str):
         return {
@@ -319,7 +394,7 @@ class Kubectl:
             return (o.meta.name, o.phase)
         return (o.meta.name,)
 
-    # -- describe ----------------------------------------------------------
+    # -- describe (pkg/kubectl describe.go: per-kind describers) -----------
     def describe(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
         resource, kind = _resolve(resource)
         try:
@@ -327,7 +402,13 @@ class Kubectl:
         except (NotFoundError, KeyError):
             self.out.write(f'Error: {resource} "{name}" not found\n')
             return 1
-        self.out.write(yaml.safe_dump(obj.to_dict(), sort_keys=False))
+        describer = {"Pod": self._describe_pod, "Node": self._describe_node,
+                     "Deployment": self._describe_deployment,
+                     "Service": self._describe_service}.get(kind)
+        if describer is not None:
+            describer(obj)
+        else:
+            self.out.write(yaml.safe_dump(obj.to_dict(), sort_keys=False))
         events, _ = self.cs.events.list()
         related = [e for e in events if e.involved_key.endswith(f"/{name}") or e.involved_key == name]
         if related:
@@ -335,6 +416,105 @@ class Kubectl:
             for e in related[-10:]:
                 self.out.write(f"  {e.type}\t{e.reason}\t{e.message}\n")
         return 0
+
+    def _kv(self, key: str, value) -> None:
+        self.out.write(f"{key + ':':<22}{value}\n")
+
+    def _labels_line(self, labels: dict) -> str:
+        return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "<none>"
+
+    def _describe_pod(self, pod) -> None:
+        self._kv("Name", pod.meta.name)
+        self._kv("Namespace", pod.meta.namespace)
+        self._kv("Node", pod.spec.node_name or "<none>")
+        self._kv("Labels", self._labels_line(pod.meta.labels))
+        self._kv("Annotations", self._labels_line(pod.meta.annotations))
+        self._kv("Status", pod.status.phase)
+        self._kv("IP", pod.status.pod_ip or "<none>")
+        if pod.spec.priority:
+            self._kv("Priority", pod.spec.priority)
+        self.out.write("Containers:\n")
+        statuses = {s.name: s for s in pod.status.container_statuses}
+        for c in pod.spec.containers:
+            self.out.write(f"  {c.name}:\n")
+            self.out.write(f"    Image:    {c.image or '<none>'}\n")
+            req = ", ".join(f"{k}={v}" for k, v in c.resources.requests.items())
+            if req:
+                self.out.write(f"    Requests: {req}\n")
+            st = statuses.get(c.name)
+            if st is not None:
+                self.out.write(f"    Ready:    {st.ready}\n")
+                self.out.write(f"    Restarts: {st.restart_count}\n")
+        if pod.spec.tolerations:
+            tols = "; ".join(f"{t.key or '<all>'}:{t.effect or '<all>'}"
+                             for t in pod.spec.tolerations)
+            self._kv("Tolerations", tols)
+        conds = [f"{c.get('type')}={c.get('status')}" for c in pod.status.conditions]
+        if conds:
+            self._kv("Conditions", ", ".join(conds))
+
+    def _describe_node(self, node) -> None:
+        self._kv("Name", node.meta.name)
+        self._kv("Labels", self._labels_line(node.meta.labels))
+        self._kv("Unschedulable", node.spec.unschedulable)
+        if node.spec.taints:
+            self._kv("Taints", "; ".join(
+                f"{t.key}={t.value}:{t.effect}" for t in node.spec.taints))
+        if node.spec.pod_cidr:
+            self._kv("PodCIDR", node.spec.pod_cidr)
+        conds = [f"{c.type}={c.status}" for c in node.status.conditions]
+        self._kv("Conditions", ", ".join(conds) or "<none>")
+        self._kv("Capacity", ", ".join(
+            f"{k}={v}" for k, v in node.status.capacity.items()))
+        self._kv("Allocatable", ", ".join(
+            f"{k}={v}" for k, v in node.status.allocatable.items()))
+        pods = [p for p in self.cs.pods.list()[0]
+                if p.spec.node_name == node.meta.name]
+        self.out.write(f"Non-terminated Pods:  ({len(pods)} in total)\n")
+        for p in pods[:20]:
+            self.out.write(f"  {p.meta.namespace}/{p.meta.name}  {p.status.phase}\n")
+
+    def _describe_deployment(self, dep) -> None:
+        self._kv("Name", dep.meta.name)
+        self._kv("Namespace", dep.meta.namespace)
+        self._kv("Selector", self._labels_line(dep.selector.match_labels))
+        self._kv("Replicas", f"{dep.replicas} desired | "
+                             f"{dep.status_updated_replicas} updated | "
+                             f"{dep.status_replicas} total | "
+                             f"{dep.status_ready_replicas} ready")
+        self._kv("StrategyType", dep.strategy)
+        if dep.strategy == "RollingUpdate":
+            self._kv("RollingUpdateStrategy",
+                     f"{dep.max_unavailable} max unavailable, "
+                     f"{dep.max_surge} max surge")
+        images = ", ".join(c.image for c in dep.template.spec.containers if c.image)
+        self._kv("Pod Template Image", images or "<none>")
+        rses = [rs for rs in self.cs.replicasets.list(dep.meta.namespace)[0]
+                if (ref := rs.meta.controller_ref()) is not None
+                and ref.uid == dep.meta.uid]
+        if rses:
+            self._kv("ReplicaSets", ", ".join(
+                f"{rs.meta.name} ({rs.status_replicas}/{rs.replicas})"
+                for rs in rses))
+
+    def _describe_service(self, svc) -> None:
+        self._kv("Name", svc.meta.name)
+        self._kv("Namespace", svc.meta.namespace)
+        self._kv("Selector", self._labels_line(svc.selector))
+        self._kv("Type", svc.type)
+        self._kv("IP", svc.cluster_ip or "<none>")
+        if svc.status_load_balancer:
+            self._kv("LoadBalancer Ingress", ", ".join(svc.status_load_balancer))
+        for p in svc.ports:
+            self._kv("Port", f"{p.name or '<unset>'}  {p.port}/{p.protocol}"
+                             + (f" -> {p.target_port}" if p.target_port else ""))
+        try:
+            eps = self.cs.endpoints.get(svc.meta.name, svc.meta.namespace)
+            addrs = [f"{a.ip}:{p.port}" for s in eps.subsets
+                     for a in s.addresses for p in s.ports]
+            self._kv("Endpoints", ", ".join(addrs) or "<none>")
+        except (NotFoundError, KeyError):
+            self._kv("Endpoints", "<none>")
 
     # -- create / apply / delete ------------------------------------------
     def _load_manifests(self, path: str) -> list[dict]:
@@ -606,6 +786,36 @@ class Kubectl:
             return None
         c = container or (pod.spec.containers[0].name if pod.spec.containers else "")
         return node.status.kubelet_url, c, pod.spec.node_name
+
+    def logs_follow(self, name: str, namespace: Optional[str] = None,
+                    container: str = "", timeout: float = 10.0,
+                    poll: float = 0.2, tail: int = 0) -> int:
+        """``kubectl logs -f [--tail N]``: the last N existing lines (all
+        when N=0), then new lines as they appear (the reference streams
+        the kubelet's follow; a bounded poll here so scripts terminate)."""
+        import time as _time
+
+        seen = 0
+        first = True
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            buf = io.StringIO()
+            sub = Kubectl(self.cs, out=buf)
+            if sub.logs(name, namespace, container) != 0:
+                self.out.write(buf.getvalue())
+                return 1
+            lines = buf.getvalue().splitlines()
+            if first and tail:
+                # --tail bounds the backlog; everything AFTER it follows
+                start = max(0, len(lines) - tail)
+            else:
+                start = seen
+            for line in lines[start:]:
+                self.out.write(line + "\n")
+            seen = len(lines)
+            first = False
+            _time.sleep(poll)
+        return 0
 
     def logs(self, name: str, namespace: Optional[str] = None,
              container: str = "", tail: int = 0) -> int:
@@ -1602,6 +1812,9 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("-l", "--selector", default="")
     p.add_argument("-w", "--watch", action="store_true")
     p.add_argument("--watch-timeout", type=float, default=30.0)
+    p.add_argument("--sort-by", default="")
+    p.add_argument("--show-labels", action="store_true")
+    p.add_argument("--no-headers", action="store_true")
     p = sub.add_parser("describe", parents=[common])
     p.add_argument("resource")
     p.add_argument("name")
@@ -1629,6 +1842,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("name")
     p.add_argument("-c", "--container", default="")
     p.add_argument("--tail", type=int, default=0)
+    p.add_argument("-f", "--follow", action="store_true")
+    p.add_argument("--follow-timeout", type=float, default=10.0)
     p = sub.add_parser("exec", parents=[common])
     p.add_argument("name")
     p.add_argument("-c", "--container", default="")
@@ -1741,7 +1956,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
                 return 1
             return k.get_watch(args.resource, namespace, args.selector,
                                args.watch_timeout)
-        return k.get(args.resource, args.name, namespace, output, args.selector)
+        return k.get(args.resource, args.name, namespace, output, args.selector,
+                     args.sort_by, args.show_labels, args.no_headers)
     if args.verb == "describe":
         return k.describe(args.resource, args.name, namespace)
     if args.verb == "create":
@@ -1766,6 +1982,9 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
             return k.top_pods(namespace)
         return k.top_nodes()
     if args.verb == "logs":
+        if args.follow:
+            return k.logs_follow(args.name, namespace, args.container,
+                                 args.follow_timeout, tail=args.tail)
         return k.logs(args.name, namespace, args.container, args.tail)
     if args.verb == "exec":
         cmd = list(args.command)

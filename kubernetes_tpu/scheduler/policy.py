@@ -29,6 +29,7 @@ from .priorities import (
     NodeAffinityPriority,
     NodePreferAvoidPodsPriority,
     SelectorSpreadPriority,
+    ServiceSpreadingPriority,
     TaintTolerationPriority,
     cluster_autoscaler_priorities,
     default_priorities,
@@ -48,6 +49,7 @@ PRIORITY_REGISTRY = {
     "NodePreferAvoidPodsPriority": NodePreferAvoidPodsPriority,
     "InterPodAffinityPriority": InterPodAffinityPriority,
     "ImageLocalityPriority": ImageLocalityPriority,
+    "ServiceSpreadingPriority": ServiceSpreadingPriority,
     "EqualPriority": EqualPriority,
 }
 

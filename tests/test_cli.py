@@ -765,3 +765,131 @@ def test_get_watch_streams_events(cs):
     assert "ADDED" in text and "seen" in text
     assert "DELETED" in text
     assert "hidden" not in text  # selector filters the stream
+
+
+def test_printers_wide_labels_sort_custom_columns(cs):
+    cs.nodes.create(make_node("n1"))
+    for name, app, cpu in (("b-pod", "web", "100m"), ("a-pod", "db", "200m")):
+        p = make_pod(name, labels={"app": app}, cpu=cpu, node_name="n1")
+        p.status.pod_ip = f"10.0.0.{1 if name == 'b-pod' else 2}"
+        cs.pods.create(p)
+        cs.pods.update_status(p)
+    # wide adds the IP column
+    rc, out = run(cs, "get", "pods", "-o", "wide")
+    assert rc == 0 and "IP" in out and "10.0.0.1" in out
+    # show-labels appends a LABELS column
+    rc, out = run(cs, "get", "pods", "--show-labels")
+    assert rc == 0 and "app=web" in out
+    # no-headers drops the header row
+    rc, out = run(cs, "get", "pods", "--no-headers")
+    assert "NAME" not in out and "a-pod" in out
+    # sort-by orders rows by jsonpath
+    rc, out = run(cs, "get", "pods", "--sort-by", "{.metadata.name}",
+                  "--no-headers")
+    lines = [l.split()[0] for l in out.splitlines() if l.strip()]
+    assert lines == ["a-pod", "b-pod"]
+    # custom-columns
+    rc, out = run(cs, "get", "pods", "-o",
+                  "custom-columns=NAME:.metadata.name,IP:.status.podIP")
+    assert rc == 0 and "NAME" in out and "10.0.0.2" in out
+    rc, out = run(cs, "get", "pods", "-o", "custom-columns=BAD")
+    assert rc == 1
+
+
+def test_describers(cs):
+    from kubernetes_tpu.api import Service, ServicePort, ObjectMeta
+
+    node = make_node("desc-n", cpu="8", memory="16Gi")
+    node.spec.pod_cidr = "10.9.0.0/24"
+    cs.nodes.create(node)
+    pod = make_pod("desc-p", cpu="100m", labels={"app": "w"}, node_name="desc-n")
+    pod.status.pod_ip = "10.9.0.5"
+    cs.pods.create(pod)
+    cs.pods.update_status(pod)
+    rc, out = run(cs, "describe", "pod", "desc-p")
+    assert rc == 0 and "Node:" in out and "desc-n" in out and "10.9.0.5" in out
+    rc, out = run(cs, "describe", "node", "desc-n")
+    assert rc == 0 and "PodCIDR:" in out and "Non-terminated Pods" in out
+    assert "desc-p" in out
+    run(cs, "run", "desc-d", "--image", "app:v9")
+    rc, out = run(cs, "describe", "deployment", "desc-d")
+    assert rc == 0 and "StrategyType:" in out and "app:v9" in out
+    cs.services.create(Service(meta=ObjectMeta(name="desc-s"),
+                               selector={"app": "w"},
+                               ports=[ServicePort(port=80, target_port=8080)]))
+    rc, out = run(cs, "describe", "service", "desc-s")
+    assert rc == 0 and "80/TCP -> 8080" in out
+
+
+def test_logs_follow(cs):
+    import threading
+    import time
+
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+    clock = [0.0]
+    kubelet = HollowKubelet(cs, "lf-n", clock=lambda: clock[0], serve=True)
+    kubelet.register()
+    cs.pods.create(make_pod("lf-p", node_name="lf-n"))
+    kubelet.tick()
+    clock[0] += 1.0
+    kubelet.tick()
+    kubelet.runtime.append_log("default/lf-p", "c0", "line-1")
+    out = io.StringIO()
+    from kubernetes_tpu.cli.kubectl import main as km
+
+    done = threading.Event()
+
+    def follow():
+        km(["logs", "lf-p", "-f", "--follow-timeout", "1.5"],
+           clientset=cs, out=out)
+        done.set()
+
+    threading.Thread(target=follow, daemon=True).start()
+    time.sleep(0.5)
+    kubelet.runtime.append_log("default/lf-p", "c0", "line-2-late")
+    assert done.wait(timeout=10)
+    text = out.getvalue()
+    assert "line-1" in text and "line-2-late" in text
+    assert text.count("line-1") == 1  # no duplicate re-prints
+
+
+def test_service_spreading_priority_registered():
+    from kubernetes_tpu.scheduler.policy import algorithm_from_policy
+
+    algo = algorithm_from_policy({
+        "priorities": [{"name": "ServiceSpreadingPriority", "weight": 1}]})
+    assert [p.name for p, _ in algo.priorities] == ["ServiceSpreadingPriority"]
+
+
+def test_sort_by_numeric_and_logs_follow_tail(cs):
+    # numeric sort: 2 < 10 numerically (lexical would invert)
+    for name, prio in (("pr-a", 10), ("pr-b", 2)):
+        p = make_pod(name)
+        p.spec.priority = prio
+        cs.pods.create(p)
+    rc, out = run(cs, "get", "pods", "--sort-by", "{.spec.priority}",
+                  "--no-headers")
+    lines = [l.split()[0] for l in out.splitlines() if l.strip()]
+    assert lines == ["pr-b", "pr-a"]
+
+    # logs -f --tail bounds the backlog
+    from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+    clock = [0.0]
+    kubelet = HollowKubelet(cs, "lt-n", clock=lambda: clock[0], serve=True)
+    kubelet.register()
+    cs.pods.create(make_pod("lt-p", node_name="lt-n"))
+    kubelet.tick()
+    clock[0] += 1.0
+    kubelet.tick()
+    for i in range(10):
+        kubelet.runtime.append_log("default/lt-p", "c0", f"old-{i}")
+    out_buf = io.StringIO()
+    from kubernetes_tpu.cli.kubectl import main as km
+
+    rc = km(["logs", "lt-p", "-f", "--tail", "2", "--follow-timeout", "0.5"],
+            clientset=cs, out=out_buf)
+    text = out_buf.getvalue()
+    assert rc == 0 and "old-9" in text and "old-8" in text
+    assert "old-0" not in text  # backlog bounded to the last 2
