@@ -616,12 +616,23 @@ class Kubectl:
             if not victims:
                 self.out.write("No resources found\n")
             return 0
-        return self._delete_one(resource, name, namespace)
+        return self._delete_one(resource, name, namespace, cascade)
 
-    def _delete_one(self, resource: str, name: str, namespace: Optional[str] = None) -> int:
+    def _delete_one(self, resource: str, name: str, namespace: Optional[str] = None,
+                    cascade: str = "background") -> int:
         resource, kind = _resolve(resource)
+        client = self.cs.client_for(kind)
         try:
-            self.cs.client_for(kind).delete(name, namespace)
+            if cascade == "orphan":
+                # the orphan finalizer makes the GC release dependents
+                # instead of cascading (graph_builder orphanDependents)
+                def _mark(obj):
+                    if "orphan" not in obj.meta.finalizers:
+                        obj.meta.finalizers.append("orphan")
+                    return obj
+
+                client.guaranteed_update(name, _mark, namespace)
+            client.delete(name, namespace)
         except (NotFoundError, KeyError):
             self.out.write(f'Error: {resource} "{name}" not found\n')
             return 1
@@ -931,19 +942,42 @@ class Kubectl:
         self.out.write(f"node/{name} {'cordoned' if on else 'uncordoned'}\n")
         return 0
 
-    def drain(self, name: str) -> int:
-        """cordon + evict every pod on the node (cmd/drain.go)."""
+    def drain(self, name: str, ignore_daemonsets: bool = False,
+              force: bool = False) -> int:
+        """cordon + evict every pod on the node (cmd/drain.go), with the
+        reference's safety rails: DaemonSet pods are skipped only with
+        --ignore-daemonsets (the DS controller would just recreate them),
+        and UNMANAGED pods (no controller owner) abort the drain unless
+        --force — they would not come back anywhere else."""
+        pods, _ = self.cs.pods.list()
+        mine = [p for p in pods if p.spec.node_name == name]
+        ds_pods = [p for p in mine
+                   if (ref := p.meta.controller_ref()) is not None
+                   and ref.kind == "DaemonSet"]
+        unmanaged = [p for p in mine if p.meta.controller_ref() is None]
+        if ds_pods and not ignore_daemonsets:
+            names = ", ".join(p.meta.name for p in ds_pods[:5])
+            self.out.write(f"error: cannot delete DaemonSet-managed pods "
+                           f"({names}); use --ignore-daemonsets\n")
+            return 1
+        if unmanaged and not force:
+            names = ", ".join(p.meta.name for p in unmanaged[:5])
+            self.out.write(f"error: cannot delete pods not managed by a "
+                           f"controller ({names}); use --force\n")
+            return 1
         rc = self.cordon(name, True)
         if rc:
             return rc
-        pods, _ = self.cs.pods.list()
-        for pod in pods:
-            if pod.spec.node_name == name:
-                try:
-                    self.cs.pods.delete(pod.meta.name, pod.meta.namespace)
-                    self.out.write(f"pod/{pod.meta.name} evicted\n")
-                except NotFoundError:
-                    pass
+        skip = {p.meta.key for p in ds_pods}
+        for pod in mine:
+            if pod.meta.key in skip:
+                self.out.write(f"pod/{pod.meta.name} ignored (DaemonSet-managed)\n")
+                continue
+            try:
+                self.cs.pods.delete(pod.meta.name, pod.meta.namespace)
+                self.out.write(f"pod/{pod.meta.name} evicted\n")
+            except NotFoundError:
+                pass
         self.out.write(f"node/{name} drained\n")
         return 0
 
