@@ -168,6 +168,50 @@ def make_pods(n_pods: int, rng: random.Random, workload: str):
     return pods
 
 
+def _failure_reasons(cs, sched, assignments: dict, sample_cap: int = 500) -> dict:
+    """Why pods stayed unbound: re-evaluate a sample of them against the
+    final cluster state and histogram each pod's dominant predicate-failure
+    reason (the per-node detail the oracle's FitError carries).  Off-clock;
+    explains the unbound tail in the artifact instead of leaving it mute."""
+    from kubernetes_tpu.scheduler.predicates import PredicateContext
+
+    unbound = [k for k, v in assignments.items() if v is None]
+    if not unbound:
+        return {"unbound_total": 0, "sampled": 0, "reasons": {}}
+    pods_by_key = {p.meta.key: p for p in cs.pods.list()[0]}
+    snapshot = sched.snapshot()
+    pctx = sched.priority_context(snapshot)
+    ctx = PredicateContext(snapshot, pvcs=pctx.pvcs, pvs=pctx.pvs,
+                           services=pctx.services)
+    node_names = sorted(n for n, i in snapshot.items() if i.node is not None)
+    hist: dict[str, int] = {}
+    sample = unbound[:sample_cap]
+    for key in sample:
+        pod = pods_by_key.get(key)
+        if pod is None:
+            continue
+        feasible, failures = sched.algorithm.find_nodes_that_fit(
+            pod, node_names, snapshot, ctx
+        )
+        if feasible:
+            # fits now (space freed since the run); call it out as such
+            hist["fits-now (state changed since attempt)"] = (
+                hist.get("fits-now (state changed since attempt)", 0) + 1)
+            continue
+        per_reason: dict[str, int] = {}
+        for reasons in failures.values():
+            for r in reasons:
+                per_reason[r] = per_reason.get(r, 0) + 1
+        if per_reason:
+            dominant = max(per_reason, key=per_reason.get)
+            hist[dominant] = hist.get(dominant, 0) + 1
+    return {
+        "unbound_total": len(unbound),
+        "sampled": len(sample),
+        "reasons": dict(sorted(hist.items(), key=lambda kv: -kv[1])),
+    }
+
+
 def run_once(
     n_nodes: int,
     n_pods: int,
@@ -175,6 +219,7 @@ def run_once(
     workload: str,
     seed: int = 0,
     emit_events: bool = False,
+    want_failure_reasons: bool = False,
 ) -> dict:
     from kubernetes_tpu.client import Clientset
     from kubernetes_tpu.ops import TPUBatchBackend
@@ -220,9 +265,24 @@ def run_once(
         # correlator actually did during the run
         sched.broadcaster.stop(drain=True)
         result["event_stats"] = dict(sched.broadcaster.correlator.stats)
+    # the three reference SLIs (metrics/metrics.go:26-50), p50/p99 in ms
+    m = sched.metrics
+
+    def _pq(h, q):
+        v = h.quantile(q)
+        return round(v / 1e3, 3) if v != float("inf") else None
+
+    result["sli"] = {
+        "e2e_scheduling_ms": {"p50": _pq(m.e2e_scheduling_latency, 0.5),
+                              "p99": _pq(m.e2e_scheduling_latency, 0.99)},
+        "binding_ms": {"p50": _pq(m.binding_latency, 0.5),
+                       "p99": _pq(m.binding_latency, 0.99)},
+    }
     # final pod→node assignment map, for parity comparison across runs
     pods, _ = cs.pods.list()
     result["assignments"] = {p.meta.key: p.spec.node_name or None for p in pods}
+    if want_failure_reasons:
+        result["failure_reasons"] = _failure_reasons(cs, sched, result["assignments"])
     return result
 
 
@@ -304,8 +364,14 @@ def main() -> None:
     parser.add_argument("--nodes", type=int, default=None)
     parser.add_argument("--pods", type=int, default=None)
     parser.add_argument("--workload", choices=["plain", "mixed"], default=None)
-    parser.add_argument("--events", action="store_true",
-                        help="emit Scheduled/FailedScheduling events on the timed run")
+    parser.add_argument("--events", dest="events", action="store_true", default=True,
+                        help="emit Scheduled/FailedScheduling events on the timed run "
+                        "(DEFAULT — the reference scheduler always emits them)")
+    parser.add_argument("--no-events", dest="events", action="store_false")
+    parser.add_argument("--no-certify", dest="certify", action="store_false",
+                        default=True,
+                        help="skip the default parity certification sub-run "
+                        "(dense-mixed 1000 nodes x 10k pods vs the oracle)")
     parser.add_argument("--oracle", action="store_true", help="bench the CPU oracle path instead")
     parser.add_argument(
         "--parity",
@@ -350,6 +416,7 @@ def main() -> None:
     result = run_once(
         n_nodes, n_pods, use_backend=not args.oracle, workload=workload,
         seed=0, emit_events=args.events,
+        want_failure_reasons=not args.oracle,
     )
     if result["bound"] == 0:
         print(json.dumps({"metric": "pods-scheduled/sec", "value": 0, "unit": "pods/s", "vs_baseline": 0}))
@@ -375,12 +442,36 @@ def main() -> None:
             file=sys.stderr,
         )
 
+    # parity CERTIFICATION (default): dense-mixed preset, backend vs oracle
+    # over identical clusters — the artifact carries the north star's
+    # "identical bindings" evidence on every recorded run
+    # (scheduler_perf/scheduler_test.go:83-88 gates, it doesn't just print)
+    certify = None
+    at_cert_scale = (n_nodes, n_pods, workload) == PRESETS["mixed"]
+    if args.certify and not args.oracle and not (args.parity and at_cert_scale):
+        cert_nodes, cert_pods, cert_workload = PRESETS["mixed"]
+        # the timed run already IS the certification workload when the
+        # preset matches — don't re-run identical multi-minute work
+        cert_backend = result if at_cert_scale else run_once(
+            cert_nodes, cert_pods, use_backend=True,
+            workload=cert_workload, seed=0)
+        certify = run_parity(cert_backend, cert_nodes, cert_pods, cert_workload, seed=0)
+        print(
+            f"# certify[dense-mixed]: {certify['checked']} pods checked, "
+            f"{certify['mismatches']} mismatches "
+            f"(backend {certify['backend_pods_per_sec']} vs oracle "
+            f"{certify['oracle_pods_per_sec']} pods/s)",
+            file=sys.stderr,
+        )
+
     stats = result.get("backend_stats", {})
     print(
         f"# {args.preset}[{workload}]: {result['bound']} bound / {result['failed']} failed "
         f"in {result['elapsed_s']:.2f}s on {n_nodes} nodes "
         f"(kernel={stats.get('kernel_pods', 0)} oracle={stats.get('oracle_pods', 0)} "
-        f"segments={stats.get('segments', 0)} events={'on' if args.events else 'off'})",
+        f"segments={stats.get('segments', 0)} "
+        f"pallas_segments={stats.get('pallas_segments', 0)} "
+        f"events={'on' if args.events else 'off'})",
         file=sys.stderr,
     )
     # baseline: the reference harness's expected throughput (100 pods/s).
@@ -396,12 +487,29 @@ def main() -> None:
         "nodes": n_nodes,
         "pods": result["bound"] + result["failed"],
         "workload": workload,
+        "events": "on" if args.events else "off",
+        "pallas_segments": stats.get("pallas_segments", 0),
+        "kernel_pods": stats.get("kernel_pods", 0),
+        "oracle_pods": stats.get("oracle_pods", 0),
+        "sli": result.get("sli"),
     }
+    if "event_stats" in result:
+        line["event_stats"] = result["event_stats"]
+    if "failure_reasons" in result:
+        line["failure_reasons"] = result["failure_reasons"]
+    if certify is not None:
+        line["parity_checked"] = certify["checked"]
+        line["parity_mismatches"] = certify["mismatches"]
+        line["parity_preset"] = "mixed"
     if parity is not None:
+        # --parity: at-scale parity at the TIMED preset overrides the
+        # certification sub-run's numbers
         line["parity_checked"] = parity["checked"]
         line["parity_mismatches"] = parity["mismatches"]
+        line["parity_preset"] = args.preset
     print(json.dumps(line))
-    if parity is not None and parity["mismatches"]:
+    mism = [p["mismatches"] for p in (parity, certify) if p is not None]
+    if any(mism):
         sys.exit(1)
 
 

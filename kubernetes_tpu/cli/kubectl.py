@@ -589,7 +589,7 @@ class Kubectl:
         return 0
 
     def delete(self, resource: str, name: Optional[str], namespace: Optional[str] = None,
-               selector: str = "") -> int:
+               selector: str = "", cascade: str = "background") -> int:
         if name and selector:
             self.out.write("error: a name cannot be combined with -l\n")
             return 1
@@ -1860,6 +1860,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("resource")
     p.add_argument("name", nargs="?")
     p.add_argument("-l", "--selector", default="")
+    p.add_argument("--cascade", default="background",
+                   choices=["background", "orphan"])
     p = sub.add_parser("scale", parents=[common])
     p.add_argument("resource")
     p.add_argument("name")
@@ -1870,6 +1872,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("name")
     p = sub.add_parser("drain", parents=[common])
     p.add_argument("name")
+    p.add_argument("--ignore-daemonsets", action="store_true")
+    p.add_argument("--force", action="store_true")
     p = sub.add_parser("top", parents=[common])
     p.add_argument("what", choices=["nodes", "pods"])
     p = sub.add_parser("logs", parents=[common])
@@ -2002,7 +2006,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
         if not args.name and not args.selector:
             k.out.write("error: a name or -l selector is required\n")
             return 1
-        return k.delete(args.resource, args.name, namespace, args.selector)
+        return k.delete(args.resource, args.name, namespace, args.selector,
+                        getattr(args, "cascade", "background"))
     if args.verb == "scale":
         return k.scale(args.resource, args.name, args.replicas, namespace)
     if args.verb == "cordon":
@@ -2010,7 +2015,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     if args.verb == "uncordon":
         return k.cordon(args.name, False)
     if args.verb == "drain":
-        return k.drain(args.name)
+        return k.drain(args.name, getattr(args, "ignore_daemonsets", False),
+                       getattr(args, "force", False))
     if args.verb == "top":
         if args.what == "pods":
             return k.top_pods(namespace)

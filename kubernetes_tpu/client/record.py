@@ -42,9 +42,15 @@ class _PendingEvent:
     namespace: str
     etype: str
     reason: str
-    message: str
+    # str, or a lazy ("fmt %s", arg, ...) tuple formatted on the SINK
+    # thread — the emitting hot loop never pays string interpolation
+    message: object
     time: float = 0.0  # emitter-side clock; correlation uses THIS, not
     # drain time, so a backed-up sink doesn't warp windows/buckets
+
+
+def _fmt(message) -> str:
+    return message if isinstance(message, str) else message[0] % tuple(message[1:])
 
 
 class _TokenBucket:
@@ -107,63 +113,75 @@ class EventCorrelator:
             od.popitem(last=False)
 
     def observe(self, ev: _PendingEvent):
-        now = ev.time
         with self._lock:
-            # -- spam filter (EventSourceObjectSpamFilter) ------------------
-            bkey = f"{self.source}\x00{ev.involved_key}"
-            bucket = self._buckets.get(bkey)
-            if bucket is None:
-                bucket = self._buckets[bkey] = _TokenBucket(self.burst, now)
-                self._trim(self._buckets)
-            else:
-                self._buckets.move_to_end(bkey)
-            if not bucket.take(self.burst, self.refill_period, now):
-                self.stats["dropped_spam"] += 1
-                return ("drop", None, None)
+            return self._observe_locked(ev)
 
-            # -- aggregation by similarity group ----------------------------
-            group = (ev.involved_kind, ev.involved_key, ev.etype, ev.reason)
-            rec = self._similar.get(group)
-            if rec is None or now - rec[1] > self.similar_window:
-                rec = self._similar[group] = [0, now]
-                self._trim(self._similar)
-            else:
-                self._similar.move_to_end(group)
-            rec[0] += 1
-            message = ev.message
-            aggregated = rec[0] > self.max_similar
-            if aggregated:
-                message = f"(combined from similar events): {ev.message}"
-                self.stats["aggregated"] += 1
+    def observe_many(self, evs: list[_PendingEvent]) -> list:
+        """Correlate a whole drained chunk under ONE lock acquisition."""
+        with self._lock:
+            return [self._observe_locked(ev) for ev in evs]
 
-            # -- dedup (bump count on an identical prior event) -------------
-            ident = group if aggregated else group + (ev.message,)
-            stored = self._seen.get(ident)
-            if stored is not None:
-                self._seen.move_to_end(ident)
-                self.stats["patched"] += 1
-                return ("patch", stored, ev.namespace)
+    def _observe_locked(self, ev: _PendingEvent):
+        now = ev.time
+        # -- spam filter (EventSourceObjectSpamFilter) ------------------
+        bkey = f"{self.source}\x00{ev.involved_key}"
+        bucket = self._buckets.get(bkey)
+        if bucket is None:
+            bucket = self._buckets[bkey] = _TokenBucket(self.burst, now)
+            self._trim(self._buckets)
+        else:
+            self._buckets.move_to_end(bkey)
+        if not bucket.take(self.burst, self.refill_period, now):
+            self.stats["dropped_spam"] += 1
+            return ("drop", None, None)
 
-            self._name_seq += 1
-            _, name = (ev.involved_key.rsplit("/", 1) + [ev.involved_key])[:2] \
-                if "/" in ev.involved_key else ("", ev.involved_key)
-            stored_name = f"{name}.{self._name_seq:x}"
-            self._seen[ident] = stored_name
-            self._trim(self._seen)
-            self.stats["created"] += 1
-            return (
-                "create",
-                api.Event(
-                    meta=api.ObjectMeta(name=stored_name, namespace=ev.namespace),
-                    involved_kind=ev.involved_kind,
-                    involved_key=ev.involved_key,
-                    reason=ev.reason,
-                    message=message,
-                    type=ev.etype,
-                    count=1,
-                ),
-                ev.namespace,
-            )
+        # -- aggregation by similarity group ----------------------------
+        group = (ev.involved_kind, ev.involved_key, ev.etype, ev.reason)
+        rec = self._similar.get(group)
+        if rec is None or now - rec[1] > self.similar_window:
+            rec = self._similar[group] = [0, now]
+            self._trim(self._similar)
+        else:
+            self._similar.move_to_end(group)
+        rec[0] += 1
+        aggregated = rec[0] > self.max_similar
+        if aggregated:
+            self.stats["aggregated"] += 1
+
+        # -- dedup (bump count on an identical prior event) -------------
+        # (key on the FORMATTED message so a str emit and a lazy-tuple
+        # emit of the same final text dedup together; formatting happens
+        # here on the sink thread, never on the emitting hot path)
+        message = _fmt(ev.message)
+        ident = group if aggregated else group + (message,)
+        stored = self._seen.get(ident)
+        if stored is not None:
+            self._seen.move_to_end(ident)
+            self.stats["patched"] += 1
+            return ("patch", stored, ev.namespace)
+        if aggregated:
+            message = f"(combined from similar events): {message}"
+
+        self._name_seq += 1
+        _, name = (ev.involved_key.rsplit("/", 1) + [ev.involved_key])[:2] \
+            if "/" in ev.involved_key else ("", ev.involved_key)
+        stored_name = f"{name}.{self._name_seq:x}"
+        self._seen[ident] = stored_name
+        self._trim(self._seen)
+        self.stats["created"] += 1
+        return (
+            "create",
+            api.Event(
+                meta=api.ObjectMeta(name=stored_name, namespace=ev.namespace),
+                involved_kind=ev.involved_kind,
+                involved_key=ev.involved_key,
+                reason=ev.reason,
+                message=message,
+                type=ev.etype,
+                count=1,
+            ),
+            ev.namespace,
+        )
 
 
 class EventBroadcaster:
@@ -195,6 +213,19 @@ class EventBroadcaster:
             self._queue.append(ev)
             self._cv.notify()
 
+    def enqueue_many(self, evs: list[_PendingEvent]) -> None:
+        """Batch append under ONE lock acquisition + ONE sink wake-up — the
+        batch scheduler's whole bind wave enqueues without per-event
+        synchronization (and without waking the sink mid-timed-section)."""
+        with self._cv:
+            room = self._max_queued - len(self._queue)
+            if room < len(evs):
+                self.dropped_overflow += len(evs) - max(room, 0)
+                evs = evs[:max(room, 0)]
+            if evs:
+                self._queue.extend(evs)
+                self._cv.notify()
+
     def recorder(self, involved_kind: str = "Pod") -> "EventRecorder":
         return EventRecorder(self, involved_kind)
 
@@ -222,10 +253,21 @@ class EventBroadcaster:
         self._write(self.correlator.observe(ev))
         return True
 
+    def process_batch(self, max_n: int = 4096) -> int:
+        """Pop a chunk, correlate it under one lock, write the decisions."""
+        with self._cv:
+            if not self._queue:
+                return 0
+            chunk = [self._queue.popleft()
+                     for _ in range(min(max_n, len(self._queue)))]
+        for decision in self.correlator.observe_many(chunk):
+            self._write(decision)
+        return len(chunk)
+
     def flush(self) -> int:
         n = 0
-        while self.process_one():
-            n += 1
+        while (k := self.process_batch()):
+            n += k
         return n
 
     def start(self) -> None:
@@ -242,9 +284,11 @@ class EventBroadcaster:
                     self._cv.wait(timeout=0.2)
                 if self._stopped and not self._queue:
                     return
-                ev = self._queue.popleft() if self._queue else None
-            if ev is not None:
-                self._write(self.correlator.observe(ev))
+                chunk = [self._queue.popleft()
+                         for _ in range(min(4096, len(self._queue)))]
+            if chunk:
+                for decision in self.correlator.observe_many(chunk):
+                    self._write(decision)
 
     @property
     def running(self) -> bool:
@@ -271,7 +315,7 @@ class EventRecorder:
         self.broadcaster = broadcaster
         self.involved_kind = involved_kind
 
-    def event(self, obj, etype: str, reason: str, message: str) -> None:
+    def event(self, obj, etype: str, reason: str, message) -> None:
         meta = getattr(obj, "meta", None)
         key = meta.key if meta is not None else str(obj)
         namespace = meta.namespace if meta is not None else "default"
@@ -286,3 +330,21 @@ class EventRecorder:
                 time=self.broadcaster.correlator.clock(),
             )
         )
+
+    def event_batch(self, items) -> None:
+        """items: iterable of (obj, etype, reason, message) — message may be
+        a lazy ("fmt %s", arg) tuple.  One timestamp, one lock, one wake."""
+        now = self.broadcaster.correlator.clock()
+        kind = self.involved_kind
+        self.broadcaster.enqueue_many([
+            _PendingEvent(
+                involved_kind=getattr(obj, "KIND", kind),
+                involved_key=obj.meta.key,
+                namespace=obj.meta.namespace,
+                etype=etype,
+                reason=reason,
+                message=message,
+                time=now,
+            )
+            for obj, etype, reason, message in items
+        ])

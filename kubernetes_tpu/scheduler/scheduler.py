@@ -341,20 +341,30 @@ class Scheduler:
             self.metrics.binding_latency.observe((self._clock() - bind_start) * 1e6)
             now = self._clock()
             finished: list[str] = []
+            # events accumulate locally and enqueue in ONE batch after the
+            # commit loop: no per-pod lock traffic, no string formatting
+            # (lazy %-tuples format on the sink thread), and the sink does
+            # not wake — and contend for the GIL — mid-timed-section
+            ev_batch: list = []
+            emit = self.emit_events
             for (pod, binding), err in zip(to_bind, errors):
                 if err is None:
                     finished.append(pod.meta.key)
-                    if self.emit_events:
-                        self._event(
+                    if emit:
+                        ev_batch.append((
                             pod, "Normal", "Scheduled",
-                            f"Successfully assigned {pod.meta.key} to {binding.node_name}",
-                        )
+                            ("Successfully assigned %s to %s",
+                             pod.meta.key, binding.node_name),
+                        ))
                     bound += 1
                 else:
                     logger.warning("bind failed for %s: %s", pod.meta.key, err)
                     self.cache.forget_pod(pod)
-                    self._event(pod, "Warning", "FailedBinding", err)
+                    if emit:
+                        ev_batch.append((pod, "Warning", "FailedBinding", err))
                     failed += 1
+            if ev_batch:
+                self._recorder.event_batch(ev_batch)
             self.cache.finish_binding_many(finished)
             self.metrics.e2e_scheduling_latency.observe_many(
                 (now - start) * 1e6, len(to_bind))

@@ -79,7 +79,10 @@ def test_cordon_drain_uncordon(cs):
     cs.nodes.create(make_node("n1"))
     cs.nodes.create(make_node("n2"))
     cs.pods.create(make_pod("p1", node_name="n1"))
+    # unmanaged pod: the safety rail refuses without --force (cmd/drain.go)
     rc, out = run(cs, "drain", "n1")
+    assert rc == 1 and "--force" in out
+    rc, out = run(cs, "drain", "n1", "--force")
     assert rc == 0 and "pod/p1 evicted" in out
     assert cs.nodes.get("n1").spec.unschedulable is True
     assert cs.pods.list()[0] == []
