@@ -35,6 +35,10 @@ from ..store.store import AlreadyExistsError, NotFoundError
 
 LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
 
+# populated from the live subparser table each time main() builds it, so
+# `kubectl completion` always reflects the real verb set
+ALL_VERBS: list[str] = []
+
 
 class _AbortMutation(Exception):
     """Raised inside a guaranteed_update mutate to cancel the write: a CLI
@@ -1640,6 +1644,267 @@ class Kubectl:
         return httpd
 
     # -- explain / edit (cmd/explain.go, cmd/edit.go) ----------------------
+    # -- replace (cmd/replace.go) ------------------------------------------
+    def replace(self, filename: str, force: bool = False) -> int:
+        """Full-object update from a manifest; the object must exist
+        (create is ``kubectl create``'s job).  ``--force`` deletes and
+        recreates — a new uid, like the reference's delete+create path."""
+        from ..admission.framework import AdmissionDenied
+        from ..client.remote import ForbiddenError
+
+        rc = 0
+        for doc in self._load_manifests(filename):
+            kind = doc.get("kind", "")
+            if kind not in KIND_TO_RESOURCE:
+                self.out.write(f"error: unknown kind {kind!r} in manifest\n")
+                rc = 1
+                continue
+            client = self.cs.client_for(kind)
+            desired = api.from_dict(doc)
+            name = desired.meta.name
+            plural = KIND_TO_RESOURCE[kind]
+            if force:
+                try:
+                    client.delete(name, desired.meta.namespace or None)
+                except (NotFoundError, KeyError):
+                    pass
+                # identity is cluster-owned: recreate mints a fresh uid even
+                # if the manifest was exported from a live object
+                desired.meta.uid = ""
+                desired.meta.resource_version = 0
+                desired.meta.creation_revision = 0
+                try:
+                    client.create(desired)
+                except (AdmissionDenied, ForbiddenError, AlreadyExistsError) as e:
+                    self.out.write(f"Error from server (Forbidden): {e}\n")
+                    rc = 1
+                    continue
+                self.out.write(f"{plural}/{name} replaced\n")
+                continue
+
+            def _swap(live):
+                desired.meta.uid = live.meta.uid
+                desired.meta.resource_version = live.meta.resource_version
+                desired.meta.creation_revision = live.meta.creation_revision
+                return desired
+
+            try:
+                client.guaranteed_update(name, _swap, desired.meta.namespace or None)
+            except (NotFoundError, KeyError):
+                self.out.write(f'Error: {plural} "{name}" not found '
+                               f'(use create or --force)\n')
+                rc = 1
+                continue
+            except (AdmissionDenied, ForbiddenError) as e:
+                self.out.write(f"Error from server (Forbidden): {e}\n")
+                rc = 1
+                continue
+            self.out.write(f"{plural}/{name} replaced\n")
+        return rc
+
+    # -- convert (cmd/convert.go) ------------------------------------------
+    def convert(self, filename: str, output_version: str) -> int:
+        """Re-encode manifests between API versions through the scheme's
+        hub-and-spoke converters (``api/scheme.py`` — decode to internal,
+        encode to the requested group/version)."""
+        from ..api.scheme import convert_from_internal
+
+        docs = []
+        for doc in self._load_manifests(filename):  # already internal form
+            kind = doc.get("kind", "")
+            if kind not in KIND_TO_RESOURCE:
+                self.out.write(f"error: unknown kind {kind!r} in manifest\n")
+                return 1
+            docs.append(convert_from_internal(doc, output_version))
+        for i, doc in enumerate(docs):
+            if i:
+                self.out.write("---\n")
+            self.out.write(yaml.safe_dump(doc, sort_keys=False))
+        return 0
+
+    # -- completion (cmd/completion.go) ------------------------------------
+    def completion(self, shell: str) -> int:
+        """Emit a shell completion script over the live verb + resource
+        tables (the reference generates from cobra; here from argparse's
+        registered subcommands)."""
+        verbs = sorted(ALL_VERBS)
+        resources = sorted(set(KIND_TO_RESOURCE.values()))
+        if shell == "bash":
+            self.out.write(
+                "# bash completion for kubectl\n"
+                "_kubectl_completions() {\n"
+                "  local cur=${COMP_WORDS[COMP_CWORD]}\n"
+                f"  local verbs=\"{' '.join(verbs)}\"\n"
+                f"  local resources=\"{' '.join(resources)}\"\n"
+                "  if [ $COMP_CWORD -eq 1 ]; then\n"
+                "    COMPREPLY=($(compgen -W \"$verbs\" -- \"$cur\"))\n"
+                "  else\n"
+                "    COMPREPLY=($(compgen -W \"$resources\" -- \"$cur\"))\n"
+                "  fi\n"
+                "}\n"
+                "complete -F _kubectl_completions kubectl\n")
+            return 0
+        if shell == "zsh":
+            self.out.write(
+                "#compdef kubectl\n"
+                f"local -a verbs=({' '.join(verbs)})\n"
+                f"local -a resources=({' '.join(resources)})\n"
+                "if (( CURRENT == 2 )); then\n"
+                "  _describe 'verb' verbs\n"
+                "else\n"
+                "  _describe 'resource' resources\n"
+                "fi\n")
+            return 0
+        self.out.write(f"error: unsupported shell {shell!r}\n")
+        return 1
+
+    # -- config (cmd/config/) ----------------------------------------------
+    def config(self, args: list[str], kubeconfig: Optional[str] = None) -> int:
+        """kubeconfig file manipulation: view / get-contexts /
+        current-context / use-context / set-context / set-cluster /
+        delete-context over the reference's clusters+contexts+users shape
+        (``staging/src/k8s.io/client-go/tools/clientcmd/api/types.go``)."""
+        import os
+
+        path = kubeconfig or os.environ.get("KUBECONFIG") or os.path.expanduser(
+            "~/.kube/config")
+
+        def load() -> dict:
+            try:
+                with open(path) as f:
+                    return yaml.safe_load(f) or {}
+            except FileNotFoundError:
+                return {}
+
+        def save(cfg: dict) -> None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                yaml.safe_dump(cfg, f, sort_keys=False)
+
+        if not args:
+            self.out.write("error: config needs a subcommand "
+                           "(view|get-contexts|current-context|use-context|"
+                           "set-context|set-cluster|delete-context)\n")
+            return 1
+        sub, rest = args[0], args[1:]
+        cfg = load()
+        if sub == "view":
+            self.out.write(yaml.safe_dump(cfg or {"apiVersion": "v1",
+                                                  "kind": "Config"},
+                                          sort_keys=False))
+            return 0
+        if sub == "current-context":
+            cur = cfg.get("current-context", "")
+            if not cur:
+                self.out.write("error: current-context is not set\n")
+                return 1
+            self.out.write(cur + "\n")
+            return 0
+        if sub == "get-contexts":
+            cur = cfg.get("current-context", "")
+            self.out.write("CURRENT   NAME   CLUSTER   USER\n")
+            for c in cfg.get("contexts", []):
+                mark = "*" if c.get("name") == cur else " "
+                ctx = c.get("context", {})
+                self.out.write(f"{mark}         {c.get('name')}   "
+                               f"{ctx.get('cluster', '')}   "
+                               f"{ctx.get('user', '')}\n")
+            return 0
+        if sub == "use-context":
+            if not rest:
+                self.out.write("error: use-context needs a name\n")
+                return 1
+            if not any(c.get("name") == rest[0] for c in cfg.get("contexts", [])):
+                self.out.write(f"error: no context exists with the name "
+                               f"{rest[0]!r}\n")
+                return 1
+            cfg["current-context"] = rest[0]
+            save(cfg)
+            self.out.write(f'Switched to context "{rest[0]}".\n')
+            return 0
+        if sub == "set-context":
+            if not rest:
+                self.out.write("error: set-context needs a name\n")
+                return 1
+            name, kv = rest[0], dict(p.split("=", 1) for p in rest[1:] if "=" in p)
+            ctxs = cfg.setdefault("contexts", [])
+            for c in ctxs:
+                if c.get("name") == name:
+                    c.setdefault("context", {}).update(kv)
+                    break
+            else:
+                ctxs.append({"name": name, "context": kv})
+            save(cfg)
+            self.out.write(f'Context "{name}" modified.\n')
+            return 0
+        if sub == "set-cluster":
+            if not rest:
+                self.out.write("error: set-cluster needs a name\n")
+                return 1
+            name, kv = rest[0], dict(p.split("=", 1) for p in rest[1:] if "=" in p)
+            clusters = cfg.setdefault("clusters", [])
+            for c in clusters:
+                if c.get("name") == name:
+                    c.setdefault("cluster", {}).update(kv)
+                    break
+            else:
+                clusters.append({"name": name, "cluster": kv})
+            save(cfg)
+            self.out.write(f'Cluster "{name}" set.\n')
+            return 0
+        if sub == "delete-context":
+            if not rest:
+                self.out.write("error: delete-context needs a name\n")
+                return 1
+            before = len(cfg.get("contexts", []))
+            cfg["contexts"] = [c for c in cfg.get("contexts", [])
+                               if c.get("name") != rest[0]]
+            if len(cfg["contexts"]) == before:
+                self.out.write(f"error: cannot delete context {rest[0]!r}, "
+                               f"not in {path}\n")
+                return 1
+            if cfg.get("current-context") == rest[0]:
+                cfg.pop("current-context", None)
+            save(cfg)
+            self.out.write(f'deleted context {rest[0]} from {path}\n')
+            return 0
+        self.out.write(f"error: unknown config subcommand {sub!r}\n")
+        return 1
+
+    # -- cluster-info dump (cmd/clusterinfo_dump.go) -----------------------
+    def cluster_info_dump(self, output_directory: str = "") -> int:
+        """Dump cluster state (nodes + per-namespace pods/services/
+        events/RCs/RSs/deployments) as JSON — to stdout, or one file per
+        kind under --output-directory like the reference."""
+        import os
+
+        dumps: list[tuple[str, list]] = [
+            ("nodes", self.cs.nodes.list()[0]),
+        ]
+        for plural in ("pods", "services", "events", "replicationcontrollers",
+                       "replicasets", "deployments", "daemonsets"):
+            try:
+                client = getattr(self.cs, plural)
+            except AttributeError:
+                continue
+            dumps.append((plural, client.list()[0]))  # all namespaces
+        if output_directory:
+            for plural, objs in dumps:
+                p = os.path.join(output_directory, f"{plural}.json")
+                os.makedirs(output_directory, exist_ok=True)
+                with open(p, "w") as f:
+                    json.dump({"kind": "List",
+                               "items": [o.to_dict() for o in objs]}, f,
+                              indent=2, default=str)
+            self.out.write(f"Cluster info dumped to {output_directory}\n")
+            return 0
+        for plural, objs in dumps:
+            self.out.write(json.dumps(
+                {"kind": "List", "resource": plural,
+                 "items": [o.to_dict() for o in objs]}, indent=2,
+                default=str) + "\n")
+        return 0
+
     def explain(self, resource: str) -> int:
         """``kubectl explain RESOURCE[.field...]``: the wire schema of a
         kind, derived from the live type registry (the discovery-driven
@@ -1942,7 +2207,21 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     sub.add_parser("api-versions", parents=[common])
     sub.add_parser("api-resources", parents=[common])
     sub.add_parser("version", parents=[common])
-    sub.add_parser("cluster-info", parents=[common])
+    p = sub.add_parser("cluster-info", parents=[common])
+    p.add_argument("action", nargs="?", default="", choices=["", "dump"])
+    p.add_argument("--output-directory", default="")
+    p = sub.add_parser("replace", parents=[common])
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--force", action="store_true")
+    p = sub.add_parser("convert", parents=[common])
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--output-version", required=True,
+                   help="e.g. apps/v1beta1, extensions/v1beta1")
+    p = sub.add_parser("completion", parents=[common])
+    p.add_argument("shell", choices=["bash", "zsh"])
+    p = sub.add_parser("config", parents=[common])
+    p.add_argument("config_args", nargs="*")
+    p.add_argument("--kubeconfig", default=None)
     p = sub.add_parser("wait", parents=[common])
     p.add_argument("resource")  # "pod/NAME" or "pod NAME"
     p.add_argument("name", nargs="?")
@@ -1974,6 +2253,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     # plugin dispatch BEFORE argparse rejects the verb: the FIRST token
     # (plugin convention — never a flag's value, never a later positional)
     # names either a built-in or a kubectl-<verb> plugin
+    ALL_VERBS[:] = list(sub.choices)
+
     raw_args = list(argv) if argv is not None else sys.argv[1:]
     if raw_args and not raw_args[0].startswith("-") and raw_args[0] not in sub.choices:
         rc = _run_plugin(raw_args[0], raw_args[1:], out or sys.stdout)
@@ -2091,7 +2372,17 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     if args.verb == "version":
         return k.version()
     if args.verb == "cluster-info":
+        if getattr(args, "action", "") == "dump":
+            return k.cluster_info_dump(args.output_directory)
         return k.cluster_info()
+    if args.verb == "replace":
+        return k.replace(args.filename, args.force)
+    if args.verb == "convert":
+        return k.convert(args.filename, args.output_version)
+    if args.verb == "completion":
+        return k.completion(args.shell)
+    if args.verb == "config":
+        return k.config(args.config_args, args.kubeconfig)
     if args.verb == "wait":
         res, name = args.resource, args.name
         if name is None and "/" in res:

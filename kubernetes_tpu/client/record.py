@@ -192,7 +192,7 @@ class EventBroadcaster:
         clientset,
         source: str = "",
         clock: Callable[[], float] = time.monotonic,
-        max_queued: int = 100_000,
+        max_queued: int = 1_000_000,
         correlator: Optional[EventCorrelator] = None,
     ):
         self.clientset = clientset
@@ -300,9 +300,18 @@ class EventBroadcaster:
             if not drain:
                 self._queue.clear()
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            # a huge backlog can outlive the join timeout; the loop is
+            # draining it, so keep waiting for THE THREAD — a concurrent
+            # caller-side flush would invert create/patch ordering, and
+            # nulling _thread while it lives would let start() double-sink
+            while drain and t.is_alive():
+                t.join(timeout=10)
             self._thread = None
+        if drain and (t is None or not t.is_alive()):
+            self.flush()  # manual mode, or a remainder after thread exit
 
     def __len__(self) -> int:
         return len(self._queue)

@@ -1,0 +1,43 @@
+"""The kube-dns daemon: ``python -m kubernetes_tpu.dns --apiserver URL``.
+
+Watches Services/Endpoints over the wire and serves the cluster zone on a
+UDP port (reference: the kube-dns addon pod, ``cluster/addons/dns/``)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="kube-dns")
+    parser.add_argument("--apiserver", required=True)
+    parser.add_argument("--token", default=None)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=10053)
+    parser.add_argument("--zone", default="cluster.local")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    from ..client import Clientset
+    from ..client.remote import RemoteStore
+    from .records import DNSRecordStore
+    from .server import DNSServer
+
+    cs = Clientset(RemoteStore(args.apiserver, token=args.token))
+    records = DNSRecordStore(cs, zone=args.zone)
+    records.start(manual=False)  # threaded informer watch loops
+    server = DNSServer(records, host=args.host, port=args.port)
+    server.start()
+    logging.info("kube-dns serving zone %s on %s:%d", args.zone,
+                 *server.address)
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

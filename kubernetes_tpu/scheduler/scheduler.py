@@ -198,7 +198,8 @@ class Scheduler:
         self._event(pod, "Normal", "Scheduled", f"Successfully assigned {pod.meta.key} to {node_name}")
         return True
 
-    def handle_schedule_failure(self, pod: api.Pod, err: Exception) -> None:
+    def handle_schedule_failure(self, pod: api.Pod, err: Exception,
+                                ev_batch: Optional[list] = None) -> None:
         """MakeDefaultErrorFunc (factory.go:718): re-enqueue with backoff.
 
         Re-enqueues the *latest* version from the informer cache, not the
@@ -207,9 +208,16 @@ class Scheduler:
 
         For priority pods, tries preemption first (the PostFilter phase):
         evicting a minimal set of lower-priority victims and requeueing the
-        preemptor without backoff into the freed space."""
+        preemptor without backoff into the freed space.
+
+        ``ev_batch``: batch callers pass a list to collect the
+        FailedScheduling event instead of enqueueing (and waking the sink)
+        per pod mid-batch."""
         self.metrics.schedule_failures.inc()
-        self._event(pod, "Warning", "FailedScheduling", str(err))
+        if ev_batch is not None and self.emit_events:
+            ev_batch.append((pod, "Warning", "FailedScheduling", str(err)))
+        else:
+            self._event(pod, "Warning", "FailedScheduling", str(err))
         latest = self.informers.informer("Pod").get(pod.meta.key)
         if latest is None:
             return  # deleted while we were scheduling it
@@ -315,11 +323,17 @@ class Scheduler:
             # async-bind pipeline, SURVEY.md P9), then roll back the
             # individual CAS losers.
             bound = failed = 0
+            # events accumulate locally (bind wave + failures) and enqueue
+            # in ONE batch at the end: no per-pod lock traffic, no string
+            # formatting on the hot path (lazy %-tuples format on the sink
+            # thread), and the sink does not wake — and contend for the
+            # GIL — mid-timed-section
+            ev_batch: list = []
             to_bind: list[tuple[api.Pod, api.Binding]] = []
             to_assume: list[tuple[api.Pod, str]] = []
             for pod, node_name in zip(pods, assignments):
                 if node_name is None:
-                    self.handle_schedule_failure(pod, FitError(pod, {}))
+                    self.handle_schedule_failure(pod, FitError(pod, {}), ev_batch)
                     failed += 1
                     continue
                 to_assume.append((pod, node_name))
@@ -341,11 +355,6 @@ class Scheduler:
             self.metrics.binding_latency.observe((self._clock() - bind_start) * 1e6)
             now = self._clock()
             finished: list[str] = []
-            # events accumulate locally and enqueue in ONE batch after the
-            # commit loop: no per-pod lock traffic, no string formatting
-            # (lazy %-tuples format on the sink thread), and the sink does
-            # not wake — and contend for the GIL — mid-timed-section
-            ev_batch: list = []
             emit = self.emit_events
             for (pod, binding), err in zip(to_bind, errors):
                 if err is None:

@@ -12,3 +12,9 @@ from .store import (
     Watch,
     WatchEvent,
 )
+from .replication import (
+    FollowerReplica,
+    NoQuorumError,
+    ReplicaDownError,
+    ReplicatedStore,
+)

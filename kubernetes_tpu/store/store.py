@@ -344,6 +344,45 @@ class Store:
                 raise NotFoundError(f"{kind} {namespace}/{name}")
             return _fast_deepcopy(item.data)
 
+    # -- replication apply (store/replication.py follower side) ------------
+    def apply_replicated(self, ev: WatchEvent) -> None:
+        """Apply a committed event from a leader verbatim: the state
+        transition is taken as-is (no CAS re-check — it already won on the
+        leader), the revision sequence follows the leader's, and local
+        watchers/WAL observe it exactly like a local commit.  Idempotent:
+        an event at or below the applied revision is a no-op (duplicate
+        shipping during catch-up races)."""
+        with self._mu:
+            if ev.revision <= self._rev:
+                return
+            bucket = self._objects.setdefault(ev.kind, {})
+            if ev.type == DELETED:
+                bucket.pop(ev.key, None)
+            else:
+                bucket[ev.key] = _Item(data=_fast_deepcopy(ev.object),
+                                       revision=ev.revision)
+            self._rev = ev.revision
+            self._emit(WatchEvent(ev.type, ev.kind, ev.key, ev.revision,
+                                  _fast_deepcopy(ev.object)))
+
+    def install_snapshot(self, rev: int, objects: dict) -> None:
+        """Replace state wholesale (raft InstallSnapshot analogue): used
+        when a rejoining replica is older than the leader's log window."""
+        with self._mu:
+            self._objects = {
+                kind: {key: _Item(data=_fast_deepcopy(data),
+                                  revision=data["metadata"].get("resourceVersion", rev))
+                       for key, data in bucket.items()}
+                for kind, bucket in objects.items()
+            }
+            self._rev = rev
+            self._log.clear()  # watchers older than the snapshot must relist
+            if self._wal is not None:
+                # durability must follow the state jump: the old WAL holds
+                # pre-snapshot events that no longer compose with the new
+                # revision line — snapshot it now or recovery diverges
+                self.compact()
+
     def list(self, kind: str, namespace: Optional[str] = None) -> tuple[list[dict], int]:
         """Returns (objects, list_revision) — the revision to start a watch
         from, exactly the reflector's LIST-then-WATCH contract

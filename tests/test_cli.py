@@ -896,3 +896,144 @@ def test_sort_by_numeric_and_logs_follow_tail(cs):
     text = out_buf.getvalue()
     assert rc == 0 and "old-9" in text and "old-8" in text
     assert "old-0" not in text  # backlog bounded to the last 2
+
+
+# -- round-3 verbs: annotate / label / replace / convert / completion /
+#    config / cluster-info dump (cmd/{annotate,label,replace,convert,
+#    completion}.go, cmd/config/, cmd/clusterinfo_dump.go) ------------------
+
+def test_annotate_set_overwrite_remove(cs):
+    cs.pods.create(make_pod("a1"))
+    rc, out = run(cs, "annotate", "pod", "a1", "team=infra")
+    assert rc == 0 and "pods/a1 annotated" in out
+    assert cs.pods.get("a1").meta.annotations["team"] == "infra"
+    # changing an existing value needs --overwrite
+    rc, out = run(cs, "annotate", "pod", "a1", "team=web")
+    assert rc == 1 and "--overwrite" in out
+    rc, out = run(cs, "annotate", "pod", "a1", "team=web", "--overwrite")
+    assert rc == 0
+    assert cs.pods.get("a1").meta.annotations["team"] == "web"
+    # key- removes
+    rc, out = run(cs, "annotate", "pod", "a1", "team-")
+    assert rc == 0
+    assert "team" not in cs.pods.get("a1").meta.annotations
+    rc, out = run(cs, "annotate", "pod", "nope", "x=y")
+    assert rc == 1 and "not found" in out
+
+
+def test_label_set_and_remove(cs):
+    cs.pods.create(make_pod("l1", labels={"app": "web"}))
+    rc, out = run(cs, "label", "pod", "l1", "tier=frontend")
+    assert rc == 0 and "pods/l1 labeled" in out
+    assert cs.pods.get("l1").meta.labels["tier"] == "frontend"
+    rc, out = run(cs, "label", "pod", "l1", "app=db")
+    assert rc == 1  # overwrite refused
+    rc, out = run(cs, "label", "pod", "l1", "app=db", "--overwrite")
+    assert rc == 0 and cs.pods.get("l1").meta.labels["app"] == "db"
+    rc, out = run(cs, "label", "pod", "l1", "tier-")
+    assert rc == 0 and "tier" not in cs.pods.get("l1").meta.labels
+
+
+def test_replace_updates_and_requires_existing(cs, tmp_path):
+    import yaml as _yaml
+
+    pod = make_pod("r1", cpu="100m", labels={"app": "web"})
+    cs.pods.create(pod)
+    live = cs.pods.get("r1")
+    doc = live.to_dict()
+    doc["metadata"]["labels"] = {"app": "replaced"}
+    f = tmp_path / "pod.yaml"
+    f.write_text(_yaml.safe_dump(doc))
+    rc, out = run(cs, "replace", "-f", str(f))
+    assert rc == 0 and "pods/r1 replaced" in out
+    after = cs.pods.get("r1")
+    assert after.meta.labels == {"app": "replaced"}
+    assert after.meta.uid == live.meta.uid  # in-place replace keeps identity
+
+    # --force = delete + recreate -> NEW uid
+    rc, out = run(cs, "replace", "-f", str(f), "--force")
+    assert rc == 0
+    assert cs.pods.get("r1").meta.uid != live.meta.uid
+
+    # replacing a non-existent object fails (that's create's job)
+    doc["metadata"]["name"] = "ghost"
+    f.write_text(_yaml.safe_dump(doc))
+    rc, out = run(cs, "replace", "-f", str(f))
+    assert rc == 1 and "not found" in out
+
+
+def test_convert_roundtrips_deployment_versions(cs, tmp_path):
+    import yaml as _yaml
+
+    wire = {
+        "apiVersion": "apps/v1beta1",
+        "kind": "Deployment",
+        "metadata": {"name": "web"},
+        "spec": {
+            "replicas": 3,
+            "template": {"metadata": {"labels": {"app": "web"}},
+                         "spec": {"containers": []}},
+            "strategy": {"type": "RollingUpdate",
+                         "rollingUpdate": {"maxSurge": 1, "maxUnavailable": 0}},
+        },
+    }
+    f = tmp_path / "dep.yaml"
+    f.write_text(_yaml.safe_dump(wire))
+    rc, out = run(cs, "convert", "-f", str(f), "--output-version",
+                  "extensions/v1beta1")
+    assert rc == 0
+    got = _yaml.safe_load(out)
+    assert got["apiVersion"] == "extensions/v1beta1"
+    assert got["kind"] == "Deployment"
+    assert got["spec"]["replicas"] == 3
+
+
+def test_completion_scripts_list_live_verbs(cs):
+    rc, out = run(cs, "completion", "bash")
+    assert rc == 0 and "complete -F" in out
+    for verb in ("get", "annotate", "label", "replace", "convert", "config"):
+        assert verb in out, f"{verb} missing from bash completion"
+    rc, out = run(cs, "completion", "zsh")
+    assert rc == 0 and "#compdef kubectl" in out
+
+
+def test_config_contexts_lifecycle(cs, tmp_path):
+    kc = str(tmp_path / "kubeconfig")
+    rc, out = run(cs, "config", "--kubeconfig", kc, "set-cluster", "prod",
+                  "server=https://prod:6443")
+    assert rc == 0
+    rc, out = run(cs, "config", "--kubeconfig", kc, "set-context", "prod-ctx",
+                  "cluster=prod", "user=admin")
+    assert rc == 0
+    rc, out = run(cs, "config", "--kubeconfig", kc, "current-context")
+    assert rc == 1  # nothing selected yet
+    rc, out = run(cs, "config", "--kubeconfig", kc, "use-context", "prod-ctx")
+    assert rc == 0
+    rc, out = run(cs, "config", "--kubeconfig", kc, "current-context")
+    assert rc == 0 and out.strip() == "prod-ctx"
+    rc, out = run(cs, "config", "--kubeconfig", kc, "get-contexts")
+    assert rc == 0 and "prod-ctx" in out and "*" in out
+    rc, out = run(cs, "config", "--kubeconfig", kc, "view")
+    assert rc == 0 and "https://prod:6443" in out
+    rc, out = run(cs, "config", "--kubeconfig", kc, "use-context", "ghost")
+    assert rc == 1
+    rc, out = run(cs, "config", "--kubeconfig", kc, "delete-context", "prod-ctx")
+    assert rc == 0
+    rc, out = run(cs, "config", "--kubeconfig", kc, "current-context")
+    assert rc == 1  # deleting the current context clears it
+
+
+def test_cluster_info_dump(cs, tmp_path):
+    import json as _json
+
+    cs.nodes.create(make_node("d1"))
+    cs.pods.create(make_pod("dp", node_name="d1"))
+    rc, out = run(cs, "cluster-info", "dump")
+    assert rc == 0 and '"dp"' in out and '"d1"' in out
+    outdir = str(tmp_path / "dump")
+    rc, out = run(cs, "cluster-info", "dump", "--output-directory", outdir)
+    assert rc == 0
+    pods = _json.load(open(f"{outdir}/pods.json"))
+    assert [i["metadata"]["name"] for i in pods["items"]] == ["dp"]
+    nodes = _json.load(open(f"{outdir}/nodes.json"))
+    assert [i["metadata"]["name"] for i in nodes["items"]] == ["d1"]

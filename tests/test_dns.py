@@ -1,0 +1,148 @@
+"""In-cluster DNS: record schema, real UDP wire protocol, and the
+name→VIP→backend conformance path (reference ``cluster/addons/dns/``)."""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.cluster import (
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EndpointSubset,
+)
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import Service, ServicePort
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.controllers.endpoint import EndpointController
+from kubernetes_tpu.dns import DNSRecordStore, DNSServer, lookup
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_pod
+
+
+@pytest.fixture
+def cs():
+    return Clientset(Store())
+
+
+def _mk_service(cs, name, ip="10.96.0.10", port=80, port_name="http",
+                selector=None):
+    cs.services.create(Service(
+        meta=ObjectMeta(name=name, namespace="default"),
+        selector=selector or {"app": name},
+        ports=[ServicePort(name=port_name, port=port, target_port=8080)],
+        cluster_ip=ip,
+    ))
+
+
+def _mk_endpoints(cs, name, ip_pods):
+    cs.endpoints.create(Endpoints(
+        meta=ObjectMeta(name=name, namespace="default"),
+        subsets=[EndpointSubset(
+            addresses=[EndpointAddress(ip=ip, target_pod=f"default/{pod}")
+                       for ip, pod in ip_pods],
+            ports=[EndpointPort(name="http", port=8080)],
+        )],
+    ))
+
+
+def test_clusterip_service_a_record(cs):
+    _mk_service(cs, "web", ip="10.96.0.10")
+    records = DNSRecordStore(cs)
+    records.start()
+    assert records.resolve("web.default.svc.cluster.local") == ["10.96.0.10"]
+    # unknown names and wrong zones miss
+    assert records.resolve("nope.default.svc.cluster.local") == []
+    assert records.resolve("web.default.svc.example.com") == []
+
+
+def test_headless_service_resolves_backends_and_per_pod_names(cs):
+    cs.services.create(Service(
+        meta=ObjectMeta(name="db", namespace="default"),
+        selector={"app": "db"},
+        ports=[ServicePort(name="pg", port=5432, target_port=5432)],
+        cluster_ip="None",
+    ))
+    _mk_endpoints(cs, "db", [("10.1.0.5", "db-0"), ("10.1.0.6", "db-1")])
+    records = DNSRecordStore(cs)
+    records.start()
+    assert records.resolve("db.default.svc.cluster.local") == [
+        "10.1.0.5", "10.1.0.6"]
+    # stable per-pod identity (the StatefulSet path)
+    assert records.resolve("db-0.db.default.svc.cluster.local") == ["10.1.0.5"]
+    assert records.resolve("db-1.db.default.svc.cluster.local") == ["10.1.0.6"]
+
+
+def test_srv_and_pod_echo_records(cs):
+    _mk_service(cs, "web", ip="10.96.0.10", port=80, port_name="http")
+    records = DNSRecordStore(cs)
+    records.start()
+    assert records.resolve(
+        "_http._tcp.web.default.svc.cluster.local", "SRV"
+    ) == [(80, "web.default.svc.cluster.local")]
+    # pod echo records need no state at all
+    assert records.resolve("10-244-1-3.default.pod.cluster.local") == ["10.244.1.3"]
+    assert records.resolve("10-244-1.default.pod.cluster.local") == []
+
+
+def test_records_follow_service_and_endpoints_changes(cs):
+    _mk_service(cs, "web", ip="10.96.0.10")
+    records = DNSRecordStore(cs)
+    records.start()
+    assert records.resolve("web.default.svc.cluster.local") == ["10.96.0.10"]
+    cs.services.delete("web", "default")
+    records.pump()
+    assert records.resolve("web.default.svc.cluster.local") == []
+
+
+def test_wire_protocol_a_srv_nxdomain(cs):
+    """Real UDP datagrams: query bytes out, RFC-1035 answers back."""
+    _mk_service(cs, "web", ip="10.96.0.10", port=80, port_name="http")
+    records = DNSRecordStore(cs)
+    records.start()
+    server = DNSServer(records)
+    server.start()
+    try:
+        assert lookup(server.address, "web.default.svc.cluster.local") == [
+            "10.96.0.10"]
+        assert lookup(server.address,
+                      "_http._tcp.web.default.svc.cluster.local", "SRV") == [
+            (80, "web.default.svc.cluster.local")]
+        assert lookup(server.address, "ghost.default.svc.cluster.local") == []
+        assert server.stats["queries"] == 3
+        assert server.stats["nxdomain"] == 1
+    finally:
+        server.stop()
+
+
+def test_conformance_resolve_service_by_name_end_to_end(cs):
+    """The VERDICT-8 capstone: Running pods → endpoint controller →
+    DNS name → VIP → proxier routes to a real backend IP."""
+    from kubernetes_tpu.proxy.proxier import Proxier
+
+    _mk_service(cs, "api", ip="10.96.0.20", port=80)
+    for i, ip in enumerate(["10.244.0.4", "10.244.0.5"]):
+        p = make_pod(f"api-{i}", labels={"app": "api"}, node_name=f"n{i}")
+        p.status.phase = api.RUNNING
+        p.status.pod_ip = ip
+        p.status.conditions = [{"type": "Ready", "status": "True"}]
+        cs.pods.create(p)
+    EndpointController(cs).reconcile_all()
+
+    records = DNSRecordStore(cs)
+    records.start()
+    server = DNSServer(records)
+    server.start()
+    try:
+        # 1. the pod's resolver finds the VIP by service name over UDP
+        ips = lookup(server.address, "api.default.svc.cluster.local")
+        assert ips == ["10.96.0.20"]
+        # 2. the proxy model routes the VIP to a ready backend
+        proxier = Proxier(node_name="n0")
+        proxier.on_service_update(cs.services.get("api", "default"))
+        proxier.on_endpoints_update(cs.endpoints.get("api", "default"))
+        proxier.sync()
+        backend = proxier.route(ips[0], 80)
+        assert backend is not None
+        assert backend.ip in {"10.244.0.4", "10.244.0.5"}
+    finally:
+        server.stop()

@@ -158,3 +158,181 @@ def test_scheduler_daemon_serves_healthz_and_metrics():
             proc.terminate()
             proc.wait(timeout=10)
         server.stop()
+
+
+# -- HA failover (VERDICT r2 ask #5) ----------------------------------------
+# Two scheduler daemons against one apiserver: the leader dies mid-flood
+# WITHOUT releasing its lease; the standby must observe renewal expiry,
+# acquire, and drain the remainder with no double-bindings
+# (client-go/tools/leaderelection/leaderelection.go:152,172;
+#  plugin/cmd/kube-scheduler/app/server.go:133).
+
+@pytest.mark.timeout(120)
+def test_ha_scheduler_failover_mid_flood():
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    server = APIServer(Store(event_log_window=100_000))
+    server.start()
+    try:
+        seed_cs = Clientset(RemoteStore(server.url))
+        for i in range(20):
+            seed_cs.nodes.create(make_node(
+                f"ha-n{i:02d}", cpu="64", memory="128Gi", pods=200,
+                labels={"kubernetes.io/hostname": f"ha-n{i:02d}"}))
+        for i in range(1000):
+            seed_cs.pods.create(make_pod(f"ha-p{i:04d}", cpu="50m",
+                                         memory="64Mi", labels={"app": "ha"}))
+
+        fake_now = [time.time()]
+        clock = lambda: fake_now[0]  # noqa: E731 — shared lease clock
+
+        binds = {"sched-a": 0, "sched-b": 0}
+        conflicts = {"sched-a": 0, "sched-b": 0}
+
+        def make_daemon(ident):
+            cs = Clientset(RemoteStore(server.url))
+            elector = LeaderElector(cs, "kube-scheduler-ha", ident,
+                                    lease_duration=2.0, renew_deadline=1.5,
+                                    clock=clock)
+            sched = Scheduler(cs, algorithm=GenericScheduler(),
+                              emit_events=False)
+            orig_bind = sched._bind
+
+            def counting_bind(pod, node_name):
+                ok = orig_bind(pod, node_name)
+                if ok:
+                    binds[ident] += 1
+                else:
+                    conflicts[ident] += 1
+                return ok
+
+            sched._bind = counting_bind
+            sched.start(manual=False)  # threaded informers: standby stays warm
+            stop = threading.Event()
+
+            def loop():
+                # renew on a period (renew_deadline/2, like RunOrDie), not
+                # per pod — a lease CAS per schedule_one would triple the
+                # HTTP traffic of the hot loop
+                is_leader = False
+                next_renew = 0.0
+                while not stop.is_set():
+                    now = time.time()
+                    if not is_leader or now >= next_renew:
+                        is_leader = elector.try_acquire_or_renew()
+                        next_renew = now + 0.5
+                    if not is_leader:
+                        time.sleep(0.02)
+                        continue
+                    sched.schedule_one(timeout=0.02)
+
+            t = threading.Thread(target=loop, daemon=True)
+            return cs, elector, sched, stop, t
+
+        cs_a, el_a, sched_a, stop_a, t_a = make_daemon("sched-a")
+        cs_b, el_b, sched_b, stop_b, t_b = make_daemon("sched-b")
+        t_a.start()
+        # let A win the race outright before B enters it
+        deadline = time.time() + 10
+        while time.time() < deadline and not el_a.is_leader:
+            time.sleep(0.02)
+        assert el_a.is_leader
+        t_b.start()
+
+        # phase 1: A makes real progress mid-flood
+        deadline = time.time() + 30
+        while time.time() < deadline and binds["sched-a"] < 300:
+            fake_now[0] = time.time()
+            time.sleep(0.05)
+        assert binds["sched-a"] >= 300, f"leader stalled at {binds['sched-a']}"
+        assert binds["sched-b"] == 0  # standby must not schedule while A holds
+
+        # phase 2: A crashes (no release) -> lease must EXPIRE, not hand over
+        stop_a.set()
+        t_a.join(timeout=5)
+        crash_at = time.time()
+        fake_now[0] = crash_at
+        assert not el_b.try_acquire_or_renew()  # still within A's lease
+        fake_now[0] = crash_at + 3.0  # past leaseDurationSeconds
+
+        # phase 3: B acquires and drains the rest
+        deadline = time.time() + 90
+        bound = 0
+        while time.time() < deadline:
+            fake_now[0] += 0.05
+            pods, _ = seed_cs.pods.list()
+            bound = sum(1 for p in pods if p.spec.node_name)
+            if bound >= 1000:
+                break
+            time.sleep(0.05)
+        stop_b.set()
+        t_b.join(timeout=5)
+        assert bound == 1000, f"only {bound}/1000 bound after failover"
+        assert el_b.is_leader
+        assert binds["sched-b"] > 0, "standby never scheduled after takeover"
+        # no double-bindings: every successful bind is a distinct pod (the
+        # store CAS makes a second bind fail, so the sum can only be 1000
+        # if no pod was bound twice)
+        assert binds["sched-a"] + binds["sched-b"] == 1000
+        # handoff is near-clean: B may lose a handful of CAS races on
+        # pods A bound right before dying (informer lag), never more
+        assert conflicts["sched-b"] <= 5
+        sched_a.informers.stop_all()
+        sched_b.informers.stop_all()
+    finally:
+        server.stop()
+
+
+@pytest.mark.timeout(60)
+def test_ha_controller_manager_failover():
+    """Standby controller-manager takes over a ReplicaSet mid-scale-out
+    after the active one dies holding the lease."""
+    from kubernetes_tpu.testutil import make_node
+
+    server = APIServer(Store(event_log_window=50_000))
+    server.start()
+    try:
+        seed = Clientset(RemoteStore(server.url))
+        seed.nodes.create(make_node("cm-n0", cpu="64", memory="128Gi", pods=300))
+        seed.replicasets.create(ReplicaSet(
+            meta=ObjectMeta(name="web", namespace="default"), replicas=40,
+            selector=LabelSelector.from_match_labels({"app": "web"}),
+            template=PodTemplateSpec(labels={"app": "web"},
+                                     spec=PodSpec(containers=[Container(name="c")])),
+        ))
+
+        fake_now = [time.time()]
+        clock = lambda: fake_now[0]  # noqa: E731
+
+        def make_cm(ident):
+            cs = Clientset(RemoteStore(server.url))
+            elector = LeaderElector(cs, "kube-controller-manager-ha", ident,
+                                    lease_duration=2.0, clock=clock)
+            mgr = ControllerManager(cs, enabled=["replicaset"])
+            mgr.start()
+            return cs, elector, mgr
+
+        cs_a, el_a, mgr_a = make_cm("cm-a")
+        cs_b, el_b, mgr_b = make_cm("cm-b")
+
+        assert el_a.try_acquire_or_renew()
+        assert not el_b.try_acquire_or_renew()
+        # active manager reconciles only PART of the scale-out, then dies
+        mgr_a.reconcile_all()
+        pods_after_a = len(seed.pods.list()[0])
+        assert pods_after_a >= 40  # RS loop created the pods
+
+        # scale up while the dead leader still holds the lease
+        def _scale(rs):
+            rs.replicas = 70
+            return rs
+        seed.replicasets.guaranteed_update("web", _scale, "default")
+        fake_now[0] += 3.0  # lease expires
+
+        assert el_b.try_acquire_or_renew(), "standby failed to take over"
+        for _ in range(5):
+            mgr_b.reconcile_all()
+        pods = seed.pods.list()[0]
+        assert len(pods) == 70, f"standby reconciled to {len(pods)}, want 70"
+    finally:
+        server.stop()
