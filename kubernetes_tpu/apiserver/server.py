@@ -358,7 +358,9 @@ def _make_handler(server: APIServer):
                     or (self._user.name if self._user else ""),
                     verb, resource, ns, name,
                 )
-            if urlparse(self.path).path in ("/api", "/api/v1", "/apis", SSAR_PATH):
+            if urlparse(self.path).path in ("/api", "/api/v1", "/apis",
+                                            "/openapi/v2", "/swagger.json",
+                                            SSAR_PATH):
                 # discovery and self-subject access review are granted to
                 # every AUTHENTICATED identity (the reference's
                 # system:discovery / system:basic-user bindings) — clients
@@ -915,6 +917,15 @@ def _make_handler(server: APIServer):
                 if method != "GET":
                     return self._error(405, "MethodNotAllowed", method)
                 return self._serve_discovery(url.path)
+            if url.path in ("/openapi/v2", "/swagger.json"):
+                # the published schema (routes/openapi.go; the era also
+                # served /swagger.json) — regenerated per request so CRD
+                # kinds appear the moment they establish
+                if method != "GET":
+                    return self._error(405, "MethodNotAllowed", method)
+                from .openapi import build_openapi
+
+                return self._send(200, build_openapi())
             if url.path == "/version":
                 from .. import __version__
 
@@ -954,6 +965,31 @@ def _make_handler(server: APIServer):
                     body = convert_to_internal(self._body())
                     if kind in CLUSTER_SCOPED:
                         body.setdefault("metadata", {})["namespace"] = ""
+                    return self._send(201, server.store.create(kind, body))
+                return self._error(405, "MethodNotAllowed", method)
+
+            # namespaced collection: /api/v1/namespaces/{ns}/{resource}
+            # (the canonical path the OpenAPI doc advertises; equivalent
+            # to /api/v1/{resource}?namespace={ns})
+            if parts[0] == "namespaces" and len(parts) == 3:
+                ns = "" if parts[1] == "-" else parts[1]
+                kind = _kind_for(parts[2])
+                if kind is None:
+                    return self._error(404, "NotFound", f"unknown resource {parts[2]}")
+                if method == "GET":
+                    if q.get("watch", ["false"])[0] == "true":
+                        return self._serve_watch(kind, q)
+                    items, rev = server.store.list(kind, ns)
+                    items = self._apply_list_selectors(items, q)
+                    if items is None:
+                        return  # error already written
+                    return self._send(200, {"items": items, "resourceVersion": rev})
+                if method == "POST":
+                    from ..api.scheme import convert_to_internal
+
+                    body = convert_to_internal(self._body())
+                    meta = body.setdefault("metadata", {})
+                    meta["namespace"] = "" if kind in CLUSTER_SCOPED else ns
                     return self._send(201, server.store.create(kind, body))
                 return self._error(405, "MethodNotAllowed", method)
 

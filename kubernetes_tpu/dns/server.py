@@ -156,7 +156,13 @@ class DNSServer:
             buf, peer = self._sock.recvfrom(4096)
         except socket.timeout:
             return False
-        resp = self._answer(buf)
+        try:
+            resp = self._answer(buf)
+        except Exception:
+            # a malformed datagram (truncated QNAME, pointer loop, short
+            # header) must never kill the serving thread — drop it
+            self.stats["malformed"] = self.stats.get("malformed", 0) + 1
+            return True
         if resp is not None:
             self._sock.sendto(resp, peer)
         return True

@@ -26,6 +26,7 @@ from ..client.clientset import Clientset
 from ..utils.features import DEFAULT_FEATURE_GATES
 from ..client.informer import PodNodeIndex, SharedInformer
 from ..store.store import AlreadyExistsError, ConflictError, NotFoundError
+from .cm import AdmissionRejected
 
 
 class HollowKubelet:
@@ -74,6 +75,18 @@ class HollowKubelet:
 
             mgr = ProcessSandboxManager()
             self.sandboxes = mgr if mgr.enabled else None
+        from .cm import ContainerManager, ImageManager
+        from .pleg import PLEG
+
+        # resource accounting: the cgroup-analogue tree + node admission
+        # (pkg/kubelet/cm) and image GC (pkg/kubelet/images)
+        self.cm = ContainerManager(cpu, memory, pods)
+        self.images = ImageManager(clock=clock)
+        self.image_gc_period = 30.0
+        self._last_image_gc = -1e18
+        # relist-based lifecycle events (pleg/generic.go:181): out-of-band
+        # runtime changes surface within one relist period
+        self.pleg = PLEG(self.pod_manager, self.sandboxes, clock=clock)
         from .volumemanager import VolumeManager
 
         self.volume_manager = VolumeManager(clock, mount_latency=mount_latency)
@@ -165,6 +178,18 @@ class HollowKubelet:
                 continue
             key = pod.meta.key
             if key not in self._starting:
+                # node-side admission over allocatable (the kubelet's
+                # canAdmitPod backstop): a pod that does not fit is
+                # REJECTED here regardless of the scheduler's view.
+                # add_pod (not bare admit) so the requests RESERVE
+                # immediately — N pods admitted in one tick must each see
+                # the previous ones' debits, or they all pass
+                try:
+                    self.cm.add_pod(pod)
+                except AdmissionRejected as e:
+                    self._reject_pod(pod, e)
+                    out["rejected"] = out.get("rejected", 0) + 1
+                    continue
                 self._starting[key] = now
                 out["observed"] += 1
             elif now - self._starting[key] >= self.pod_start_latency:
@@ -175,14 +200,50 @@ class HollowKubelet:
                 if self._set_running(pod, now):
                     out["started"] += 1
                     started_keys.add(key)
+                    self.images.ensure_pulled(pod)
                 del self._starting[key]
         self._starting = {k: t for k, t in self._starting.items() if k in live}
 
         out["restarts"], still_running = self._sync_running(running)
         for gone in self.pod_manager.known() - live:
             self.pod_manager.forget(gone)
+        # resource-ledger hygiene: pods that left the runtime release
+        # their cgroup + image references (admitted-but-starting pods
+        # keep their reservation — that's the point of admitting early)
+        running_now = {p.meta.key for p in still_running} | started_keys
+        for gone in self.cm.known() - running_now - set(self._starting):
+            self.cm.remove_pod(gone)
+            self.images.release(gone)
+        # pods observed ALREADY running (kubelet restart recovery) join
+        # the ledger without re-admission
+        for pod in still_running:
+            if pod.meta.key not in self.cm.known():
+                self.cm.add_pod(pod, force=True)
+                self.images.ensure_pulled(pod)
+        # PLEG relist: out-of-band sandbox deaths surface as events; a
+        # Running pod whose pause process was killed behind our back gets
+        # its sandbox restarted (kuberuntime SyncPod recreates the
+        # sandbox when the runtime lost it)
+        out["pleg_events"] = 0
+        out["sandbox_restarts"] = 0
+        for ev in self.pleg.relist():
+            out["pleg_events"] += 1
+            if ev.type == "SandboxDied" and ev.pod_key in running_now:
+                if self.sandboxes is not None:
+                    self.sandboxes.remove(ev.pod_key)  # reap the corpse
+                    self.sandboxes.create(ev.pod_key)
+                    out["sandbox_restarts"] += 1
         evicted_keys = self._eviction_pass(still_running)
         out["evicted"] = len(evicted_keys)
+        for key in evicted_keys:
+            self.cm.remove_pod(key)
+            self.images.release(key)
+        # image GC at its own cadence; failure to reach the low target
+        # raises the disk-pressure signal
+        if now - self._last_image_gc >= self.image_gc_period:
+            self._last_image_gc = now
+            gc = self.images.garbage_collect()
+            self._set_disk_pressure_condition(gc["over"])
         if self.sandboxes is not None:
             # sandboxes exist exactly while the pod is Running (incl. pods
             # started THIS tick, excl. pods evicted this tick): a pod that
@@ -304,11 +365,16 @@ class HollowKubelet:
         """eviction_manager.go:213 synchronize — memory signal vs the
         threshold; rank by QoS then usage; evict until under.  Returns the
         victims' keys so the caller's sandbox reconcile drops their pause
-        processes the same tick."""
+        processes the same tick.
+
+        The signal is ACCOUNTED, not scripted: the cadvisor-feed sample
+        (runtime.pod_memory_usage) is charged into each pod's cgroup and
+        the decision reads the kubepods rollup (pkg/kubelet/cm)."""
         from .runtime import rank_for_eviction
 
         usage = self.runtime.pod_memory_usage
-        used = sum(usage.get(p.meta.key, 0) for p in running)
+        self.cm.charge_usage(usage)
+        used = self.cm.node_usage()
         threshold = self._memory_capacity * self.memory_pressure_fraction
         under_pressure = used > threshold
         self._set_pressure_condition(under_pressure)
@@ -360,6 +426,38 @@ class HollowKubelet:
         try:
             self.clientset.nodes.guaranteed_update(self.node_name, _mutate, "")
             self._last_in_use = in_use
+        except NotFoundError:
+            pass
+
+    def _reject_pod(self, pod: api.Pod, err) -> None:
+        """kubelet admission failure: phase Failed, reason OutOf<res>
+        (the reference's lifecycle.PodAdmitResult rejection path)."""
+        update = api.Pod.from_dict(pod.to_dict())
+        update.status.phase = api.FAILED
+        update.status.reason = f"OutOf{err.resource}"
+        try:
+            self.clientset.pods.update_status(update)
+        except (NotFoundError, ConflictError):
+            pass
+
+    def _set_disk_pressure_condition(self, pressure: bool) -> None:
+        if pressure == getattr(self, "_last_disk_pressure", False):
+            return
+        want = "True" if pressure else "False"
+
+        def _mutate(cur: api.Node) -> api.Node:
+            c = cur.status.condition(api.NODE_DISK_PRESSURE)
+            if c is None:
+                if not pressure:
+                    return cur
+                c = api.NodeCondition(type=api.NODE_DISK_PRESSURE)
+                cur.status.conditions.append(c)
+            c.status = want
+            return cur
+
+        try:
+            self.clientset.nodes.guaranteed_update(self.node_name, _mutate, "")
+            self._last_disk_pressure = pressure
         except NotFoundError:
             pass
 

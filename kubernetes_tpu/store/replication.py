@@ -26,7 +26,6 @@ Layering: ``apiserver.APIServer`` instances are stateless over one
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
 from .store import Store, WatchEvent, _fast_deepcopy, DELETED
@@ -82,16 +81,19 @@ class ReplicatedStore(Store):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._followers: list[FollowerReplica] = []
-        self._repl_mu = threading.Lock()
 
     # -- membership ---------------------------------------------------------
     def add_follower(self, replica: FollowerReplica) -> None:
-        with self._repl_mu:
-            self.catch_up(replica)
+        # catch-up and enlistment under the STORE lock: _emit runs with it
+        # held (every write op holds it), so no commit can land between
+        # "caught up to rev N" and "receiving N+1 via shipping" — the gap
+        # would silently lose that one event on the new follower
+        with self._mu:
+            self._catch_up_locked(replica)
             self._followers.append(replica)
 
     def remove_follower(self, replica: FollowerReplica) -> None:
-        with self._repl_mu:
+        with self._mu:
             self._followers = [f for f in self._followers if f is not replica]
 
     @property
@@ -130,21 +132,24 @@ class ReplicatedStore(Store):
         event log from its applied revision, or fall back to a full state
         snapshot when the log window has been trimmed past it."""
         with self._mu:
-            need_from = replica.applied_revision
-            oldest = self._log[0].revision if self._log else self._rev + 1
-            if need_from + 1 >= oldest or self._rev == need_from:
-                for ev in list(self._log):
-                    if ev.revision > need_from:
-                        replica.store.apply_replicated(ev)
-            else:
-                # snapshot install (raft InstallSnapshot analogue)
-                replica.store.install_snapshot(
-                    self._rev,
-                    {kind: {key: _fast_deepcopy(item.data)
-                            for key, item in bucket.items()}
-                     for kind, bucket in self._objects.items()},
-                )
-            replica.recover()
+            self._catch_up_locked(replica)
+
+    def _catch_up_locked(self, replica: FollowerReplica) -> None:
+        need_from = replica.applied_revision
+        oldest = self._log[0].revision if self._log else self._rev + 1
+        if need_from + 1 >= oldest or self._rev == need_from:
+            for ev in list(self._log):
+                if ev.revision > need_from:
+                    replica.store.apply_replicated(ev)
+        else:
+            # snapshot install (raft InstallSnapshot analogue)
+            replica.store.install_snapshot(
+                self._rev,
+                {kind: {key: _fast_deepcopy(item.data)
+                        for key, item in bucket.items()}
+                 for kind, bucket in self._objects.items()},
+            )
+        replica.recover()
 
     @classmethod
     def promote(cls, candidates: list[FollowerReplica],

@@ -134,7 +134,7 @@ class Store:
 
     def __init__(self, event_log_window: int = 100_000,
                  data_dir: Optional[str] = None, fsync: bool = False,
-                 compact_every: int = 100_000):
+                 compact_every: int = 100_000, transformer=None):
         self._mu = threading.RLock()
         self._rev = 0
         # kind -> {key -> _Item}
@@ -154,7 +154,7 @@ class Store:
             from .wal import WriteAheadLog
 
             self._wal = WriteAheadLog(data_dir, compact_every=compact_every,
-                                      fsync=fsync)
+                                      fsync=fsync, transformer=transformer)
             rev, objects, _ = self._wal.recover()
             self._rev = rev
             for kind, bucket in objects.items():

@@ -36,7 +36,7 @@ _LEN = struct.Struct(">I")
 
 class WriteAheadLog:
     def __init__(self, data_dir: str, compact_every: int = 100_000,
-                 fsync: bool = False):
+                 fsync: bool = False, transformer=None):
         os.makedirs(data_dir, exist_ok=True)
         self.dir = data_dir
         self.compact_every = compact_every
@@ -46,6 +46,10 @@ class WriteAheadLog:
         self._snap_path = os.path.join(data_dir, SNAPSHOT)
         self._f = None
         self._records_since_snapshot = 0
+        # encryption at rest (store/encryption.py, the reference's
+        # storage/value transformer seam): record/snapshot bytes pass
+        # through here on the way to and from disk; None = plaintext
+        self.transformer = transformer
 
     # -- recovery ----------------------------------------------------------
     def recover(self) -> tuple[int, dict, int]:
@@ -54,7 +58,10 @@ class WriteAheadLog:
         objects: dict[str, dict[str, dict]] = {}
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
-                snap = wire.decode(f.read())
+                raw = f.read()
+            if self.transformer is not None:
+                raw = self.transformer.decrypt(raw)
+            snap = wire.decode(raw)
             rev = int(snap["rev"])
             objects = snap["objects"]
         replayed = 0
@@ -79,7 +86,14 @@ class WriteAheadLog:
         return rev, objects, replayed
 
     def _read_wal(self):
-        """Yields (record, end_offset) for every intact record."""
+        """Yields (record, end_offset) for every intact record.
+
+        Torn appends (a crash mid-write) are STRUCTURAL: the length
+        prefix or payload comes up short and the tail is dropped — that
+        record was never acknowledged.  A structurally complete record
+        that fails decryption/decoding is a different animal entirely
+        (wrong key, or real corruption of acknowledged history) and
+        propagates loudly rather than silently truncating the log."""
         if not os.path.exists(self._wal_path):
             return
         with open(self._wal_path, "rb") as f:
@@ -91,10 +105,9 @@ class WriteAheadLog:
                 payload = f.read(n)
                 if len(payload) < n:
                     return  # torn record: crash mid-append, never acked
-                try:
-                    yield wire.decode(payload), f.tell()
-                except ValueError:
-                    return  # corrupt tail
+                if self.transformer is not None:
+                    payload = self.transformer.decrypt(payload)
+                yield wire.decode(payload), f.tell()
 
     # -- append ------------------------------------------------------------
     def open(self) -> None:
@@ -104,6 +117,8 @@ class WriteAheadLog:
                obj: dict) -> None:
         payload = wire.encode({"t": ev_type, "k": kind, "key": key,
                                "r": rev, "o": obj})
+        if self.transformer is not None:
+            payload = self.transformer.encrypt(payload)
         with self._mu:
             if self._f is None:
                 self.open()
@@ -123,8 +138,11 @@ class WriteAheadLog:
         new snapshot durable FIRST, then drop the log it subsumes)."""
         with self._mu:
             tmp = f"{self._snap_path}.tmp"
+            blob = wire.encode({"rev": rev, "objects": objects})
+            if self.transformer is not None:
+                blob = self.transformer.encrypt(blob)
             with open(tmp, "wb") as f:
-                f.write(wire.encode({"rev": rev, "objects": objects}))
+                f.write(blob)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._snap_path)

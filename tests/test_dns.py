@@ -146,3 +146,24 @@ def test_conformance_resolve_service_by_name_end_to_end(cs):
         assert backend.ip in {"10.244.0.4", "10.244.0.5"}
     finally:
         server.stop()
+
+
+def test_malformed_datagrams_do_not_kill_the_server(cs):
+    import socket as _socket
+
+    _mk_service(cs, "web", ip="10.96.0.10")
+    records = DNSRecordStore(cs)
+    records.start()
+    server = DNSServer(records)
+    server.start()
+    try:
+        with _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM) as s:
+            # truncated header, pointer loop, and short-QNAME garbage
+            for junk in (b"\x01", b"\x124\x01\x00\x00\x01" + b"\x00" * 6 + b"\xc0\x0c",
+                         b"\x00" * 12 + b"\x09abc"):
+                s.sendto(junk, server.address)
+        # the thread survives and still answers real queries
+        assert lookup(server.address, "web.default.svc.cluster.local") == [
+            "10.96.0.10"]
+    finally:
+        server.stop()

@@ -1,0 +1,121 @@
+"""Encryption at rest (storage/value transformer analogue):
+authenticated stream encryption over the WAL + snapshot, key rotation,
+and plaintext migration (VERDICT r2 missing #6)."""
+
+import pytest
+
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.store.encryption import (
+    DecryptionError,
+    HMACStreamTransformer,
+    TransformerChain,
+)
+from kubernetes_tpu.testutil import make_pod
+
+
+def test_roundtrip_and_nonce_freshness():
+    t = HMACStreamTransformer("key1", b"secret-material")
+    ct1 = t.encrypt(b"hello world")
+    ct2 = t.encrypt(b"hello world")
+    assert ct1 != ct2  # fresh nonce per record
+    assert t.decrypt(ct1) == b"hello world"
+    assert t.decrypt(ct2) == b"hello world"
+    assert b"hello world" not in ct1
+
+
+def test_tamper_detection():
+    t = HMACStreamTransformer("key1", b"secret-material")
+    ct = bytearray(t.encrypt(b"payload"))
+    ct[-1] ^= 0x01
+    with pytest.raises(DecryptionError):
+        t.decrypt(bytes(ct))
+    # truncation is also caught
+    with pytest.raises(DecryptionError):
+        t.decrypt(t.encrypt(b"payload")[:20])
+
+
+def test_chain_rotation_and_plaintext_fallback():
+    old = TransformerChain.from_keys([("k1", b"old-secret")])
+    ct_old = old.encrypt(b"written-under-k1")
+    # rotated config: new primary, old key still readable
+    rotated = TransformerChain.from_keys([("k2", b"new-secret"),
+                                          ("k1", b"old-secret")])
+    assert rotated.decrypt(ct_old) == b"written-under-k1"
+    ct_new = rotated.encrypt(b"written-under-k2")
+    assert ct_new[:8] == ct_old[:8]  # same magic
+    assert rotated.decrypt(ct_new) == b"written-under-k2"
+    # the old chain cannot read the new key's records
+    with pytest.raises(DecryptionError):
+        old.decrypt(ct_new)
+    # pre-encryption plaintext records pass through (migration)
+    assert rotated.decrypt(b"plain-old-record") == b"plain-old-record"
+
+
+def test_encrypted_store_recovers(tmp_path):
+    chain = TransformerChain.from_keys([("k1", b"store-secret")])
+    store = Store(data_dir=str(tmp_path), transformer=chain)
+    cs = Clientset(store)
+    cs.pods.create(make_pod("secret-pod", labels={"token": "s3cr3t-value"}))
+    cs.pods.create(make_pod("p2"))
+    cs.pods.delete("p2")
+    rev = store.revision
+    store.close()
+
+    # the disk holds NO plaintext: neither names nor label values
+    blob = (tmp_path / "wal.bin").read_bytes()
+    snap_path = tmp_path / "snapshot.bin"
+    if snap_path.exists():
+        blob += snap_path.read_bytes()
+    assert b"secret-pod" not in blob
+    assert b"s3cr3t-value" not in blob
+
+    revived = Store(data_dir=str(tmp_path),
+                    transformer=TransformerChain.from_keys(
+                        [("k1", b"store-secret")]))
+    assert revived.revision == rev
+    pods, _ = revived.list("Pod")
+    assert [p["metadata"]["name"] for p in pods] == ["secret-pod"]
+    assert pods[0]["metadata"]["labels"]["token"] == "s3cr3t-value"
+
+
+def test_encrypted_snapshot_roundtrip(tmp_path):
+    chain = TransformerChain.from_keys([("k1", b"store-secret")])
+    store = Store(data_dir=str(tmp_path), transformer=chain, compact_every=5)
+    cs = Clientset(store)
+    for i in range(12):  # crosses the compaction threshold
+        cs.pods.create(make_pod(f"p{i:02d}"))
+    store.compact()
+    store.close()
+    assert b"p00" not in (tmp_path / "snapshot.bin").read_bytes()
+    revived = Store(data_dir=str(tmp_path),
+                    transformer=TransformerChain.from_keys(
+                        [("k1", b"store-secret")]))
+    assert len(revived.list("Pod")[0]) == 12
+
+
+def test_wrong_key_fails_loudly(tmp_path):
+    store = Store(data_dir=str(tmp_path),
+                  transformer=TransformerChain.from_keys([("k1", b"right")]))
+    Clientset(store).pods.create(make_pod("p1"))
+    store.close()
+    with pytest.raises(DecryptionError):
+        Store(data_dir=str(tmp_path),
+              transformer=TransformerChain.from_keys([("k1", b"wrong")]))
+
+
+def test_migration_plaintext_wal_readable_with_encryption_on(tmp_path):
+    """Turning encryption on over an existing plaintext WAL: old records
+    replay, new records land encrypted (EncryptionConfig + identity)."""
+    plain = Store(data_dir=str(tmp_path))
+    Clientset(plain).pods.create(make_pod("old-pod"))
+    plain.close()
+    enc = Store(data_dir=str(tmp_path),
+                transformer=TransformerChain.from_keys([("k1", b"s")]))
+    cs = Clientset(enc)
+    assert cs.pods.get("old-pod").meta.name == "old-pod"
+    cs.pods.create(make_pod("new-pod"))
+    enc.close()
+    blob = (tmp_path / "wal.bin").read_bytes()
+    assert b"old-pod" in blob      # the pre-encryption record
+    assert b"new-pod" not in blob  # the new one is ciphertext
