@@ -102,13 +102,19 @@ class SchedulingQueue:
             # phantom (removed while queued): keep draining
 
     def drain(self, max_n: Optional[int] = None) -> list[api.Pod]:
-        """Pop every currently-ready pod in FIFO order — the batch seam."""
+        """Pop every currently-ready pod in FIFO order — the batch seam.
+        One lock round for the keys, one for the pod map (the per-pod
+        pop() path costs four lock rounds each; at 150k pods that's the
+        difference between microseconds and a second of pure locking)."""
+        keys = self._wq.drain_ready(max_n)
+        if not keys:
+            return []
         out: list[api.Pod] = []
-        while max_n is None or len(out) < max_n:
-            pod = self.pop(timeout=0.0)
-            if pod is None:
-                break
-            out.append(pod)
+        with self._mu:
+            for key in keys:
+                pod = self._pods.pop(key, None)
+                if pod is not None:  # phantom: removed while queued
+                    out.append(pod)
         return out
 
     def __len__(self) -> int:
