@@ -1644,6 +1644,163 @@ class Kubectl:
         return httpd
 
     # -- explain / edit (cmd/explain.go, cmd/edit.go) ----------------------
+    # -- create generators (cmd/create_*.go) -------------------------------
+    def create_resource(self, what: str, name: str, namespace: Optional[str],
+                        from_literal: list[str], from_file: list[str],
+                        hard: str, tcp: list[str], secret_type: str,
+                        svc_type: str = "ClusterIP") -> int:
+        """Imperative object generators: ``kubectl create
+        namespace|configmap|secret|serviceaccount|quota|service NAME ...``
+        (reference ``cmd/create_{namespace,configmap,secret,
+        serviceaccount,quota,service}.go``)."""
+        import base64
+
+        from ..admission.framework import AdmissionDenied
+        from ..api import (
+            ConfigMap,
+            Namespace,
+            ResourceQuota,
+            Secret,
+            ServiceAccount,
+        )
+        from ..client.remote import ForbiddenError
+
+        def _kv_data(binary_ok: bool) -> Optional[dict]:
+            """key→value from --from-literal/--from-file.  Files read as
+            bytes; non-UTF-8 content is allowed only where the target
+            kind can hold it (secrets — the canonical home of certs and
+            keystores), mirroring the reference's data/binaryData split."""
+            data = {}
+            for spec in from_literal:
+                if "=" not in spec:
+                    self.out.write(f"error: --from-literal needs key=value, "
+                                   f"got {spec!r}\n")
+                    return None
+                k, _, v = spec.partition("=")
+                data[k] = v
+            for path in from_file:
+                key, _, p = path.partition("=")
+                if not p:
+                    key, p = None, path
+                try:
+                    with open(p, "rb") as fh:
+                        raw = fh.read()
+                except OSError as e:
+                    self.out.write(f"error: {e}\n")
+                    return None
+                import os as _os
+
+                try:
+                    content = raw.decode()
+                except UnicodeDecodeError:
+                    if not binary_ok:
+                        self.out.write(
+                            f"error: {p} is not UTF-8; binary content is "
+                            f"only supported in secrets\n")
+                        return None
+                    content = raw
+                data[key or _os.path.basename(p)] = content
+            return data
+
+        if what == "namespace":
+            obj = Namespace(meta=api.ObjectMeta(name=name, namespace=""))
+        elif what == "configmap":
+            data = _kv_data(binary_ok=False)
+            if data is None:
+                return 1
+            obj = ConfigMap(meta=api.ObjectMeta(name=name), data=data)
+        elif what == "secret":
+            data = _kv_data(binary_ok=True)
+            if data is None:
+                return 1
+            # the in-repo Secret convention stores plain values (the
+            # serviceaccount-token controller does); binary file content
+            # is base64-armored so it survives the string field
+            obj = Secret(
+                meta=api.ObjectMeta(name=name), type=secret_type,
+                data={k: (v if isinstance(v, str)
+                          else base64.b64encode(v).decode())
+                      for k, v in data.items()},
+            )
+        elif what == "serviceaccount":
+            obj = ServiceAccount(meta=api.ObjectMeta(name=name))
+        elif what == "quota":
+            limits = {}
+            for spec in (hard or "").split(","):
+                if not spec:
+                    continue
+                k, _, v = spec.partition("=")
+                try:
+                    limits[k] = api.Quantity(v)
+                except ValueError:
+                    self.out.write(f"error: bad quantity {v!r} for {k}\n")
+                    return 1
+            obj = ResourceQuota(meta=api.ObjectMeta(name=name), hard=limits)
+        elif what == "service":
+            ports = []
+            for spec in tcp or []:
+                port_s, _, target_s = spec.partition(":")
+                try:
+                    port = int(port_s)
+                    target = int(target_s) if target_s else port
+                except ValueError:
+                    self.out.write(f"error: bad --tcp {spec!r}\n")
+                    return 1
+                ports.append(api.ServicePort(name=f"tcp-{port}", port=port,
+                                             target_port=target))
+            obj = api.Service(meta=api.ObjectMeta(name=name),
+                              selector={"app": name}, ports=ports,
+                              type=svc_type)
+        else:
+            self.out.write(f"error: unknown generator {what!r}\n")
+            return 1
+        if namespace and hasattr(obj.meta, "namespace") and obj.meta.namespace != "":
+            obj.meta.namespace = namespace
+        kind = type(obj).KIND
+        try:
+            self.cs.client_for(kind).create(obj)
+        except AlreadyExistsError:
+            self.out.write(f"Error: {kind} {name!r} already exists\n")
+            return 1
+        except (AdmissionDenied, ForbiddenError) as e:
+            self.out.write(f"Error from server (Forbidden): {e}\n")
+            return 1
+        self.out.write(f"{KIND_TO_RESOURCE[kind]}/{name} created\n")
+        return 0
+
+    # -- certificate approve/deny (cmd/certificates.go) --------------------
+    def certificate(self, action: str, name: str) -> int:
+        """Flip a CSR's approval condition; the certificates controller
+        then issues (reference ``cmd/certificates.go`` +
+        ``pkg/controller/certificates``)."""
+        cond_type = "Approved" if action == "approve" else "Denied"
+        past = "approved" if action == "approve" else "denied"
+
+        def _mutate(csr):
+            have = {c.get("type") for c in csr.conditions}
+            if cond_type in have:
+                return csr  # idempotent: the no-op write is skipped
+            if ("Denied" if cond_type == "Approved" else "Approved") in have:
+                raise _AbortMutation
+            csr.conditions.append({
+                "type": cond_type, "reason": "KubectlCertificate",
+                "message": f"{past} via kubectl certificate {action}",
+            })
+            return csr
+
+        try:
+            _update_if_changed(self.cs.certificatesigningrequests, name,
+                               _mutate, None)
+        except _AbortMutation:
+            self.out.write(f"error: CSR {name!r} is already "
+                           f"{'denied' if cond_type == 'Approved' else 'approved'}\n")
+            return 1
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: certificatesigningrequest "{name}" not found\n')
+            return 1
+        self.out.write(f"certificatesigningrequest/{name} {past}\n")
+        return 0
+
     # -- replace (cmd/replace.go) ------------------------------------------
     def replace(self, filename: str, force: bool = False) -> int:
         """Full-object update from a manifest; the object must exist
@@ -2118,7 +2275,20 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("resource")
     p.add_argument("name")
     p = sub.add_parser("create", parents=[common])
-    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("what", nargs="?",
+                   help="generator: namespace|configmap|secret|"
+                        "serviceaccount|quota|service (or use -f)")
+    p.add_argument("gen_name", nargs="?")
+    p.add_argument("gen_extra", nargs="?")
+    p.add_argument("-f", "--filename", default=None)
+    p.add_argument("--from-literal", action="append", default=[])
+    p.add_argument("--from-file", action="append", default=[])
+    p.add_argument("--hard", default="")
+    p.add_argument("--tcp", action="append", default=[])
+    p.add_argument("--type", dest="secret_type", default="Opaque")
+    p = sub.add_parser("certificate", parents=[common])
+    p.add_argument("action", choices=["approve", "deny"])
+    p.add_argument("name")
     p = sub.add_parser("apply", parents=[common])
     p.add_argument("-f", "--filename", required=True)
     p = sub.add_parser("delete", parents=[common])
@@ -2280,7 +2450,41 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     if args.verb == "describe":
         return k.describe(args.resource, args.name, namespace)
     if args.verb == "create":
-        return k.create(args.filename)
+        if args.filename:
+            return k.create(args.filename)
+        what, name, extra = args.what, args.gen_name, args.gen_extra
+        if not what or not name:
+            k.out.write("error: create needs -f FILE or a generator "
+                        "(namespace|configmap|secret|serviceaccount|quota|"
+                        "service) and a name\n")
+            return 1
+        svc_type = "ClusterIP"
+        if what == "secret":
+            # "secret generic NAME" — the subtype token precedes the name
+            if not extra:
+                k.out.write("error: usage: create secret generic NAME\n")
+                return 1
+            if name != "generic":
+                k.out.write(f"error: unsupported secret type {name!r} "
+                            f"(only generic)\n")
+                return 1
+            name = extra
+        elif what == "service":
+            if not extra:
+                k.out.write("error: usage: create service "
+                            "clusterip|nodeport|loadbalancer NAME\n")
+                return 1
+            svc_type = {"clusterip": "ClusterIP", "nodeport": "NodePort",
+                        "loadbalancer": "LoadBalancer"}.get(name.lower(), "")
+            if not svc_type:
+                k.out.write(f"error: unknown service type {name!r}\n")
+                return 1
+            name = extra
+        return k.create_resource(what, name, namespace, args.from_literal,
+                                 args.from_file, args.hard, args.tcp,
+                                 args.secret_type, svc_type)
+    if args.verb == "certificate":
+        return k.certificate(args.action, args.name)
     if args.verb == "apply":
         return k.apply(args.filename)
     if args.verb == "delete":

@@ -87,6 +87,11 @@ class HollowKubelet:
         # relist-based lifecycle events (pleg/generic.go:181): out-of-band
         # runtime changes surface within one relist period
         self.pleg = PLEG(self.pod_manager, self.sandboxes, clock=clock)
+        # pod networking through the plugin seam (pkg/kubelet/network):
+        # constructed lazily at first setup so the node's ALLOCATED
+        # podCIDR (written by the IPAM controller after registration) is
+        # respected
+        self.network = None
         from .volumemanager import VolumeManager
 
         self.volume_manager = VolumeManager(clock, mount_latency=mount_latency)
@@ -214,12 +219,22 @@ class HollowKubelet:
         for gone in self.cm.known() - running_now - set(self._starting):
             self.cm.remove_pod(gone)
             self.images.release(gone)
+        # CNI DEL: release address leases for departed pods so the range
+        # recycles (a churning node must not exhaust its /24)
+        if self.network is not None:
+            for gone in self.network.leased() - running_now:
+                self.network.teardown_pod(gone)
         # pods observed ALREADY running (kubelet restart recovery) join
-        # the ledger without re-admission
+        # the ledger without re-admission — and their existing addresses
+        # are adopted into the network plugin so a fresh process cannot
+        # lease a running pod's IP to a newcomer
         for pod in still_running:
             if pod.meta.key not in self.cm.known():
                 self.cm.add_pod(pod, force=True)
                 self.images.ensure_pulled(pod)
+            if (pod.status.pod_ip and not pod.spec.host_network
+                    and self._network().pod_ip(pod.meta.key) is None):
+                self.network.adopt(pod.meta.key, pod.status.pod_ip)
         # PLEG relist: out-of-band sandbox deaths surface as events; a
         # Running pod whose pause process was killed behind our back gets
         # its sandbox restarted (kuberuntime SyncPod recreates the
@@ -238,6 +253,8 @@ class HollowKubelet:
         for key in evicted_keys:
             self.cm.remove_pod(key)
             self.images.release(key)
+            if self.network is not None:
+                self.network.teardown_pod(key)
         # image GC at its own cadence; failure to reach the low target
         # raises the disk-pressure signal
         if now - self._last_image_gc >= self.image_gc_period:
@@ -491,39 +508,47 @@ class HollowKubelet:
         update.status.phase = api.RUNNING
         update.status.host_ip = self.node_name
         if not update.status.pod_ip:
-            # the CNI step of pod startup: a sandbox gets an address the
-            # moment it runs (endpoints/proxy rules are built from it)
-            update.status.pod_ip = self._next_pod_ip()
+            # the CNI ADD step of pod startup (pkg/kubelet/network): the
+            # plugin leases an address the moment the sandbox runs;
+            # failure keeps the pod Pending, like a failed CNI ADD
+            if pod.spec.host_network:
+                update.status.pod_ip = self.node_name
+            else:
+                from .network import NetworkSetupError
+
+                try:
+                    update.status.pod_ip = self._network().setup_pod(pod.meta.key)
+                except NetworkSetupError:
+                    return False
         try:
             self.clientset.pods.update_status(update)
             return True
         except (NotFoundError, ConflictError):
+            if not pod.spec.host_network and self.network is not None:
+                self.network.teardown_pod(pod.meta.key)  # lease back
             return False
 
-    def _next_pod_ip(self) -> str:
-        """Per-node pod addressing (the kubenet/CNI IPAM shape): the
-        node's ALLOCATED podCIDR when the IPAM controller has assigned
-        one (collision-free by construction, like the reference), else a
-        stable crc32-derived /24 — never ``hash()``, which is
-        PYTHONHASHSEED-randomized and 256-bucket collision-prone."""
-        n = (getattr(self, "_ip_counter", 0) % 254) + 1
-        self._ip_counter = n
-        base = getattr(self, "_pod_ip_base", None)
-        if base is None:
+    def _network(self):
+        """The network plugin, built on first use so the node's ALLOCATED
+        podCIDR (IPAM controller) wins over the hash fallback.  While the
+        plugin is still on the fallback base AND has leased nothing, each
+        call re-checks the node — a CIDR that lands after the first probe
+        (IPAM races pod starts) still takes effect before any address
+        goes out under the hash base."""
+        from .network import KubenetPlugin
+
+        needs_probe = (self.network is None
+                       or (not self.network.has_cidr
+                           and not self.network.leased()))
+        if needs_probe:
             cidr = ""
             try:
                 cidr = self.clientset.nodes.get(self.node_name).spec.pod_cidr
             except Exception:  # noqa: BLE001 - fall through to the hash base
                 pass
-            if cidr and "/" in cidr:
-                base = cidr.split("/", 1)[0].rsplit(".", 1)[0]
-            else:
-                import zlib
-
-                h = zlib.crc32(self.node_name.encode()) & 0xFFFF
-                base = f"10.{h >> 8}.{h & 0xFF}"
-            self._pod_ip_base = base
-        return f"{base}.{n}"
+            if self.network is None or (cidr and "/" in cidr):
+                self.network = KubenetPlugin(self.node_name, cidr)
+        return self.network
 
     def _heartbeat(self, force: bool = False) -> None:
         now = self._clock()

@@ -1037,3 +1037,100 @@ def test_cluster_info_dump(cs, tmp_path):
     assert [i["metadata"]["name"] for i in pods["items"]] == ["dp"]
     nodes = _json.load(open(f"{outdir}/nodes.json"))
     assert [i["metadata"]["name"] for i in nodes["items"]] == ["d1"]
+
+
+# -- create generators + certificate (cmd/create_*.go, certificates.go) ------
+
+def test_create_generators(cs, tmp_path):
+    rc, out = run(cs, "create", "namespace", "staging")
+    assert rc == 0 and "namespaces/staging created" in out
+    assert cs.namespaces.get("staging").meta.name == "staging"
+
+    f = tmp_path / "app.conf"
+    f.write_text("verbose=true\n")
+    rc, out = run(cs, "create", "configmap", "app-config",
+                  "--from-literal", "mode=prod", "--from-file", str(f))
+    assert rc == 0
+    cm = cs.configmaps.get("app-config")
+    assert cm.data["mode"] == "prod"
+    assert cm.data["app.conf"] == "verbose=true\n"
+
+    rc, out = run(cs, "create", "secret", "generic", "db-pass",
+                  "--from-literal", "password=hunter2")
+    assert rc == 0
+    sec = cs.secrets.get("db-pass")
+    # plain-value convention (matches the serviceaccount-token controller)
+    assert sec.data["password"] == "hunter2"
+    assert sec.type == "Opaque"
+
+    # binary file content is base64-armored into the string field
+    binf = tmp_path / "cert.der"
+    binf.write_bytes(b"\x80\x01\x02DER")
+    rc, out = run(cs, "create", "secret", "generic", "tls-cert",
+                  "--from-file", str(binf))
+    assert rc == 0
+    import base64
+    assert base64.b64decode(
+        cs.secrets.get("tls-cert").data["cert.der"]) == b"\x80\x01\x02DER"
+    # configmaps refuse binary (the data/binaryData split)
+    rc, out = run(cs, "create", "configmap", "bad-cm", "--from-file", str(binf))
+    assert rc == 1 and "not UTF-8" in out
+
+    rc, out = run(cs, "create", "serviceaccount", "builder")
+    assert rc == 0 and cs.serviceaccounts.get("builder").meta.name == "builder"
+
+    rc, out = run(cs, "create", "quota", "team-quota",
+                  "--hard", "cpu=4,memory=8Gi")
+    assert rc == 0
+    q = cs.resourcequotas.get("team-quota")
+    assert str(q.hard["cpu"]) == "4"
+
+    rc, out = run(cs, "create", "service", "clusterip", "web",
+                  "--tcp", "80:8080")
+    assert rc == 0
+    svc = cs.services.get("web")
+    assert svc.ports[0].port == 80 and svc.ports[0].target_port == 8080
+    assert svc.type == "ClusterIP"
+
+    # duplicates and bad input fail cleanly
+    rc, out = run(cs, "create", "namespace", "staging")
+    assert rc == 1 and "already exists" in out
+    rc, out = run(cs, "create", "quota", "q2", "--hard", "cpu=banana")
+    assert rc == 1 and "bad quantity" in out
+    rc, out = run(cs, "create", "secret", "tls", "x")
+    assert rc == 1 and "only generic" in out
+    # forgetting NAME after the subtype token errors instead of creating
+    # an object named after the token
+    rc, out = run(cs, "create", "secret", "generic")
+    assert rc == 1 and "usage" in out
+    rc, out = run(cs, "create", "service", "nodeport")
+    assert rc == 1 and "usage" in out
+
+
+def test_certificate_approve_deny(cs):
+    from kubernetes_tpu.api.cluster import CertificateSigningRequest
+    from kubernetes_tpu.api.meta import ObjectMeta
+    from kubernetes_tpu.controllers.certificates import CertificateController
+
+    cs.certificatesigningrequests.create(CertificateSigningRequest(
+        meta=ObjectMeta(name="node-1-csr", namespace=""),
+        request="pem-ish", username="system:node:n1"))
+    rc, out = run(cs, "certificate", "approve", "node-1-csr")
+    assert rc == 0 and "approved" in out
+    # approving again is a no-op success (idempotent)
+    rc, out = run(cs, "certificate", "approve", "node-1-csr")
+    assert rc == 0
+    # the controller issues against the approval
+    CertificateController(cs).reconcile_all()
+    assert cs.certificatesigningrequests.get("node-1-csr").certificate
+
+    cs.certificatesigningrequests.create(CertificateSigningRequest(
+        meta=ObjectMeta(name="bad-csr", namespace=""), request="x",
+        username="mallory"))
+    rc, out = run(cs, "certificate", "deny", "bad-csr")
+    assert rc == 0 and "denied" in out
+    # conflicting flip is refused
+    rc, out = run(cs, "certificate", "approve", "bad-csr")
+    assert rc == 1 and "already denied" in out
+    rc, out = run(cs, "certificate", "approve", "ghost")
+    assert rc == 1 and "not found" in out

@@ -1,0 +1,135 @@
+"""Kubelet network plugin seam: kubenet-shaped IPAM with a real
+lease/release lifecycle (pkg/kubelet/network + host-local IPAM)."""
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.kubelet.hollow import HollowKubelet
+from kubernetes_tpu.kubelet.network import KubenetPlugin, NetworkSetupError
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture
+def cs():
+    return Clientset(Store())
+
+
+def test_lease_release_reuse():
+    net = KubenetPlugin("n1", "10.244.3.0/24")
+    a = net.setup_pod("default/a")
+    b = net.setup_pod("default/b")
+    assert a == "10.244.3.2" and b == "10.244.3.3"  # .1 is the bridge
+    assert net.setup_pod("default/a") == a  # idempotent lease
+    net.teardown_pod("default/a")
+    assert net.setup_pod("default/c") == "10.244.3.2"  # lowest-free reuse
+    assert net.pod_ip("default/a") is None
+
+
+def test_exhaustion_is_a_hard_error():
+    net = KubenetPlugin("n1", "10.244.3.0/24")
+    for i in range(253):  # .2 .. .254
+        net.setup_pod(f"default/p{i}")
+    with pytest.raises(NetworkSetupError):
+        net.setup_pod("default/one-too-many")
+    assert net.stats["exhausted"] == 1
+
+
+def test_kubelet_uses_allocated_pod_cidr_and_recycles(cs):
+    clock = [0.0]
+    kubelet = HollowKubelet(cs, "n1", pod_start_latency=0.0,
+                            clock=lambda: clock[0])
+    kubelet.register()
+
+    def _cidr(n):
+        n.spec.pod_cidr = "10.200.7.0/24"
+        return n
+
+    cs.nodes.guaranteed_update("n1", _cidr, "")
+    cs.pods.create(make_pod("p1", node_name="n1"))
+    kubelet.tick()
+    clock[0] += 1
+    kubelet.tick()
+    pod = cs.pods.get("p1")
+    assert pod.status.phase == api.RUNNING
+    assert pod.status.pod_ip.startswith("10.200.7.")
+    leased_ip = pod.status.pod_ip
+    # deletion releases the lease; the next pod reuses the address
+    cs.pods.delete("p1")
+    clock[0] += 1
+    kubelet.tick()
+    assert kubelet.network.pod_ip("default/p1") is None
+    cs.pods.create(make_pod("p2", node_name="n1"))
+    clock[0] += 1
+    kubelet.tick()
+    clock[0] += 1
+    kubelet.tick()
+    assert cs.pods.get("p2").status.pod_ip == leased_ip
+
+
+def test_host_network_pod_bypasses_plugin(cs):
+    kubelet = HollowKubelet(cs, "n1", pod_start_latency=0.0)
+    kubelet.register()
+    p = make_pod("hostnet", node_name="n1")
+    p.spec.host_network = True
+    cs.pods.create(p)
+    kubelet.tick()
+    kubelet.tick()
+    pod = cs.pods.get("hostnet")
+    assert pod.status.phase == api.RUNNING
+    assert pod.status.pod_ip == "n1"  # the node's own address
+    assert kubelet.network is None  # plugin never engaged
+
+
+def test_restart_recovery_adopts_existing_leases(cs):
+    """A restarted kubelet must seed running pods' addresses into its
+    fresh plugin — a newcomer cannot lease a running pod's IP."""
+    clock = [0.0]
+    k1 = HollowKubelet(cs, "n1", pod_start_latency=0.0,
+                       clock=lambda: clock[0])
+    k1.register()
+
+    def _cidr(n):
+        n.spec.pod_cidr = "10.200.9.0/24"
+        return n
+
+    cs.nodes.guaranteed_update("n1", _cidr, "")
+    cs.pods.create(make_pod("p1", node_name="n1"))
+    k1.tick()
+    clock[0] += 1
+    k1.tick()
+    ip1 = cs.pods.get("p1").status.pod_ip
+    assert ip1 == "10.200.9.2"
+
+    # the kubelet process restarts
+    k2 = HollowKubelet(cs, "n1", pod_start_latency=0.0,
+                       clock=lambda: clock[0])
+    clock[0] += 1
+    k2.tick()  # recovery: p1 adopted
+    cs.pods.create(make_pod("p2", node_name="n1"))
+    clock[0] += 1
+    k2.tick()
+    clock[0] += 1
+    k2.tick()
+    ip2 = cs.pods.get("p2").status.pod_ip
+    assert ip2 and ip2 != ip1
+
+
+def test_cidr_arriving_after_first_probe_still_wins(cs):
+    """IPAM races the first pod start: as long as nothing was leased yet,
+    a later-arriving podCIDR replaces the hash-fallback base."""
+    kubelet = HollowKubelet(cs, "n1", pod_start_latency=0.0)
+    kubelet.register()
+    # first probe happens with no CIDR -> fallback base, zero leases
+    assert not kubelet._network().has_cidr
+
+    def _cidr(n):
+        n.spec.pod_cidr = "10.201.1.0/24"
+        return n
+
+    cs.nodes.guaranteed_update("n1", _cidr, "")
+    cs.pods.create(make_pod("p1", node_name="n1"))
+    kubelet.tick()
+    kubelet.tick()
+    assert cs.pods.get("p1").status.pod_ip.startswith("10.201.1.")
