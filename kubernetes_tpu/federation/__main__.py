@@ -1,0 +1,60 @@
+"""The federation control plane daemon: apiserver + controller manager.
+
+Capability of ``federation/cmd/federation-apiserver`` +
+``federation-controller-manager`` (reference federation/): one process
+serving the federation-scoped API over HTTP (Cluster + the federated
+kinds, through the same generic apiserver machinery — the reference's
+federation-apiserver is likewise a genericapiserver instantiation) and
+running the federation control loops against it: cluster health, fan-out
+sync with placement, status rollup, cross-cluster service DNS.
+
+    python -m kubernetes_tpu.federation --port 18500 \
+        [--federation-name myfed --dns-zone example.com]
+
+Members join over the wire (``kubefed join NAME --server URL``); the
+member factory dials each cluster's own apiserver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="federation-apiserver")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--federation-name", default="myfed")
+    parser.add_argument("--dns-zone", default="example.com")
+    parser.add_argument("--sync-interval", type=float, default=1.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from ..apiserver import APIServer
+    from ..store import Store
+    from ..client import Clientset
+    from .manager import FederationControllerManager
+
+    server = APIServer(Store(), port=args.port)
+    server.start()
+    logging.info("federation-apiserver serving at %s", server.url)
+
+    cs = Clientset(server.store)
+    mgr = FederationControllerManager(
+        cs, federation_name=args.federation_name, dns_zone=args.dns_zone)
+    mgr.start()
+    try:
+        while True:
+            mgr.reconcile_all()
+            for c in mgr.controllers.values():
+                monitor = getattr(c, "monitor", None)
+                if monitor is not None:
+                    monitor()
+            time.sleep(args.sync_interval)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
