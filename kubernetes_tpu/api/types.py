@@ -942,6 +942,9 @@ class Deployment:
     strategy: str = "RollingUpdate"  # or "Recreate"
     max_surge: int = 1
     max_unavailable: int = 0
+    # kubectl rollout pause/resume (deployment/sync.go: a paused
+    # deployment reconciles SCALE but never progresses the rollout)
+    paused: bool = False
     status_replicas: int = 0
     status_updated_replicas: int = 0
     status_ready_replicas: int = 0
@@ -960,6 +963,7 @@ class Deployment:
                 "strategy": self.strategy,
                 "maxSurge": self.max_surge,
                 "maxUnavailable": self.max_unavailable,
+                "paused": self.paused,
             },
             "status": {
                 "replicas": self.status_replicas,
@@ -981,6 +985,7 @@ class Deployment:
             strategy=spec.get("strategy", "RollingUpdate"),
             max_surge=int(spec.get("maxSurge", 1)),
             max_unavailable=int(spec.get("maxUnavailable", 0)),
+            paused=bool(spec.get("paused", False)),
             status_replicas=int(status.get("replicas", 0)),
             status_updated_replicas=int(status.get("updatedReplicas", 0)),
             status_ready_replicas=int(status.get("readyReplicas", 0)),

@@ -741,6 +741,29 @@ class Kubectl:
             self.out.write(f"{rev:<9} {rs.meta.name}\n")
         return 0
 
+    def rollout_pause(self, name: str, pause: bool,
+                      namespace: Optional[str] = None) -> int:
+        """``kubectl rollout pause|resume`` (cmd/rollout/rollout_pause.go):
+        flip spec.paused; the deployment controller reconciles scale but
+        freezes rollout progress while paused."""
+        def _mutate(dep):
+            if dep.paused == pause:
+                raise _AbortMutation
+            dep.paused = pause
+            return dep
+
+        verb = "paused" if pause else "resumed"
+        try:
+            _update_if_changed(self.cs.deployments, name, _mutate, namespace)
+        except _AbortMutation:
+            self.out.write(f"error: deployment/{name} is already {verb}\n")
+            return 1
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: deployment "{name}" not found\n')
+            return 1
+        self.out.write(f"deployment/{name} {verb}\n")
+        return 0
+
     def rollout_undo(self, name: str, namespace: Optional[str] = None,
                      to_revision: int = 0) -> int:
         """``rollback.go``: re-apply the target revision's template (the
@@ -1350,6 +1373,40 @@ class Kubectl:
             self.out.write(f'Error: {resource} "{name}" not found\n')
             return 1
         self.out.write(f"{resource}/{name} resource requirements updated\n")
+        return 0
+
+    def set_env(self, resource: str, name: str, pairs: list[str],
+                namespace: Optional[str] = None) -> int:
+        """``kubectl set env`` — KEY=VALUE sets / KEY- removes on every
+        container of the workload's template (cmd/set/set_env.go)."""
+        resource, kind = _resolve(resource)
+        if kind not in ("Deployment", "ReplicaSet", "DaemonSet", "StatefulSet"):
+            self.out.write(f"error: cannot set env on {resource}\n")
+            return 1
+        sets, removes = {}, []
+        for p in pairs:
+            if p.endswith("-") and "=" not in p:
+                removes.append(p[:-1])
+            elif "=" in p:
+                k2, _, v = p.partition("=")
+                sets[k2] = v
+            else:
+                self.out.write(f"error: expected KEY=VALUE or KEY-, got {p!r}\n")
+                return 1
+
+        def _mutate(obj):
+            for c in obj.template.spec.containers:
+                c.env.update(sets)
+                for k2 in removes:
+                    c.env.pop(k2, None)
+            return obj
+
+        try:
+            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} env updated\n")
         return 0
 
     # -- auth can-i (cmd/auth/cani.go) -------------------------------------
@@ -2323,7 +2380,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="-- cmd args...")
     p = sub.add_parser("rollout", parents=[common])
-    p.add_argument("action", choices=["status", "history", "undo"])
+    p.add_argument("action", choices=["status", "history", "undo",
+                                      "pause", "resume"])
     p.add_argument("resource")  # "deployment" or "deployment/NAME"
     p.add_argument("name", nargs="?")
     p.add_argument("--to-revision", type=int, default=0)
@@ -2363,7 +2421,7 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("--max", dest="max_replicas", type=int, required=True)
     p.add_argument("--cpu-percent", type=int, default=80)
     p = sub.add_parser("set", parents=[common])
-    p.add_argument("what", choices=["image", "resources"])
+    p.add_argument("what", choices=["image", "resources", "env"])
     p.add_argument("resource")  # "deployment" or "deployment/NAME"
     p.add_argument("name", nargs="?")
     p.add_argument("pairs", nargs="*", help="container=image pairs (set image)")
@@ -2531,6 +2589,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
             return k.rollout_status(name, namespace)
         if args.action == "history":
             return k.rollout_history(name, namespace)
+        if args.action in ("pause", "resume"):
+            return k.rollout_pause(name, args.action == "pause", namespace)
         return k.rollout_undo(name, namespace, args.to_revision)
     if args.verb in ("label", "annotate"):
         fn = k.label if args.verb == "label" else k.annotate
@@ -2554,18 +2614,19 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     if args.verb == "set":
         res, name = args.resource, args.name
         pairs = list(args.pairs)
-        if name is None and "/" in res:
+        if "/" in res:
+            # "set ... deployment/web [spec...]": any name-slot token is a
+            # spec ("c=img", "KEY=VALUE", or an env "KEY-" removal)
+            if name is not None:
+                pairs.insert(0, name)
             res, name = res.split("/", 1)
-        elif name is not None and "=" in name:
-            # "set image deployment/web c=img": name slot holds a pair
-            pairs.insert(0, name)
-            if "/" in res:
-                res, name = res.split("/", 1)
         if not name:
             k.out.write("error: set requires RESOURCE/NAME\n")
             return 1
         if args.what == "image":
             return k.set_image(res, name, pairs, namespace)
+        if args.what == "env":
+            return k.set_env(res, name, pairs, namespace)
         return k.set_resources(res, name, args.requests, args.limits, namespace)
     if args.verb == "auth":
         return k.auth_can_i(args.auth_verb, args.auth_resource, args.auth_name, namespace)

@@ -145,7 +145,22 @@ class DeploymentController(Controller):
         old_rses = [rs for rs in rses if new_rs is None or rs.meta.uid != new_rs.meta.uid]
         old_total = sum(rs.replicas for rs in old_rses)
 
-        if dep.strategy == "Recreate":
+        if dep.paused:
+            # rollout pause (deployment/sync.go): SCALE still reconciles,
+            # the rollout does not — no new RS for a template change, no
+            # old→new shifting.  The delta lands on the newest RS (the
+            # single-RS steady state is the dominant paused case).
+            if rses:
+                total = sum(rs.replicas for rs in rses)
+                if total != dep.replicas:
+                    newest = max(
+                        rses,
+                        key=lambda rs: int(rs.meta.annotations.get(
+                            self.REVISION_ANNOTATION, "0") or 0))
+                    self._scale_rs(
+                        newest,
+                        max(0, newest.replicas + dep.replicas - total))
+        elif dep.strategy == "Recreate":
             for rs in old_rses:
                 self._scale_rs(rs, 0)
             old_active = sum(rs.status_replicas for rs in old_rses)

@@ -1134,3 +1134,73 @@ def test_certificate_approve_deny(cs):
     assert rc == 1 and "already denied" in out
     rc, out = run(cs, "certificate", "approve", "ghost")
     assert rc == 1 and "not found" in out
+
+
+def test_rollout_pause_resume_freezes_rollout(cs):
+    """A paused deployment reconciles SCALE but not the rollout: a
+    template change creates no new RS until resume
+    (cmd/rollout/rollout_pause.go + deployment/sync.go)."""
+    from kubernetes_tpu.api import Container, Deployment, ObjectMeta, PodSpec, PodTemplateSpec
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    cs.deployments.create(Deployment(
+        meta=ObjectMeta(name="web", namespace="default"), replicas=2,
+        selector=LabelSelector.from_match_labels({"app": "web"}),
+        template=PodTemplateSpec(labels={"app": "web"},
+                                 spec=PodSpec(containers=[Container(name="c", image="img:v1")])),
+    ))
+    _drive_deploy(cs)
+    assert len(cs.replicasets.list()[0]) == 1
+
+    rc, out = run(cs, "rollout", "pause", "deployment/web")
+    assert rc == 0 and "paused" in out
+    rc, out = run(cs, "rollout", "pause", "deployment/web")
+    assert rc == 1 and "already paused" in out
+
+    # template change while paused: NO new RS appears
+    def _to_v2(cur):
+        cur.template.spec.containers[0].image = "img:v2"
+        return cur
+
+    cs.deployments.guaranteed_update("web", _to_v2, "default")
+    _drive_deploy(cs)
+    assert len(cs.replicasets.list()[0]) == 1
+
+    # but scale still reconciles
+    def _scale(cur):
+        cur.replicas = 5
+        return cur
+
+    cs.deployments.guaranteed_update("web", _scale, "default")
+    _drive_deploy(cs)
+    rses = cs.replicasets.list()[0]
+    assert len(rses) == 1 and rses[0].replicas == 5
+
+    # resume: the held-back rollout proceeds (new RS for v2)
+    rc, out = run(cs, "rollout", "resume", "deployment/web")
+    assert rc == 0 and "resumed" in out
+    _drive_deploy(cs, rounds=12)
+    rses = cs.replicasets.list()[0]
+    assert len(rses) == 2
+
+
+def test_set_env(cs):
+    from kubernetes_tpu.api import Container, Deployment, ObjectMeta, PodSpec, PodTemplateSpec
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    cs.deployments.create(Deployment(
+        meta=ObjectMeta(name="api", namespace="default"), replicas=1,
+        selector=LabelSelector.from_match_labels({"app": "api"}),
+        template=PodTemplateSpec(labels={"app": "api"},
+                                 spec=PodSpec(containers=[Container(name="c")])),
+    ))
+    rc, out = run(cs, "set", "env", "deployment/api", "MODE=prod", "DEBUG=1")
+    assert rc == 0 and "env updated" in out
+    env = cs.deployments.get("api").template.spec.containers[0].env
+    assert env == {"MODE": "prod", "DEBUG": "1"}
+    rc, out = run(cs, "set", "env", "deployment/api", "DEBUG-")
+    assert rc == 0
+    env = cs.deployments.get("api").template.spec.containers[0].env
+    assert env == {"MODE": "prod"}
+    rc, out = run(cs, "set", "env", "pod/nope", "A=b")
+    assert rc == 1 and "cannot set env" in out
