@@ -555,7 +555,22 @@ class Kubectl:
                 rc = 1
         return rc
 
-    def apply(self, filename: str) -> int:
+    def apply(self, filename: str, prune: bool = False,
+              selector: str = "") -> int:
+        """Declarative apply; with ``--prune -l selector``, objects that
+        carry the last-applied annotation, match the selector, and are
+        ABSENT from the manifest set are deleted (cmd/apply.go prune —
+        same guard rails: never touches objects apply didn't create)."""
+        applied: set[tuple[str, str, str]] = set()  # (kind, ns, name)
+        want = None
+        if prune:
+            if not selector:
+                self.out.write("error: --prune requires -l selector\n")
+                return 1
+            want = _parse_selector(selector)
+            if want is None:
+                self.out.write(f"error: bad selector {selector!r}\n")
+                return 1
         for doc in self._load_manifests(filename):
             kind = doc.get("kind", "")
             if kind not in KIND_TO_RESOURCE:
@@ -566,6 +581,7 @@ class Kubectl:
             meta = doc.get("metadata") or {}
             name = meta.get("name", "")
             ns = meta.get("namespace", client.default_namespace)
+            applied.add((kind, ns, name))
             try:
                 cur = client.get(name, ns)
             except (NotFoundError, KeyError):
@@ -590,7 +606,31 @@ class Kubectl:
 
             client.guaranteed_update(name, _merge, ns)
             self.out.write(f"{KIND_TO_RESOURCE[kind]}/{name} configured\n")
+        if want is not None:
+            self._prune(applied, want)
         return 0
+
+    def _prune(self, applied: set, want) -> None:
+        """Delete previously-applied, selector-matching objects absent
+        from this apply set.  Scope: every kind that appeared in the
+        manifests (the reference prunes a whitelist; the applied-kind set
+        is this framework's equivalent guard)."""
+        for kind in {k for k, _, _ in applied}:
+            client = self.cs.client_for(kind)
+            for obj in client.list(None)[0]:
+                ident = (kind, obj.meta.namespace, obj.meta.name)
+                if ident in applied:
+                    continue
+                if LAST_APPLIED not in obj.meta.annotations:
+                    continue  # apply never owned it; never prune it
+                if not _labels_match(obj, want):
+                    continue
+                try:
+                    client.delete(obj.meta.name, obj.meta.namespace)
+                except NotFoundError:
+                    continue
+                self.out.write(
+                    f"{KIND_TO_RESOURCE[kind]}/{obj.meta.name} pruned\n")
 
     def delete(self, resource: str, name: Optional[str], namespace: Optional[str] = None,
                selector: str = "", cascade: str = "background") -> int:
@@ -2348,6 +2388,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("name")
     p = sub.add_parser("apply", parents=[common])
     p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--prune", action="store_true")
+    p.add_argument("-l", "--selector", default="")
     p = sub.add_parser("delete", parents=[common])
     p.add_argument("resource")
     p.add_argument("name", nargs="?")
@@ -2544,7 +2586,8 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     if args.verb == "certificate":
         return k.certificate(args.action, args.name)
     if args.verb == "apply":
-        return k.apply(args.filename)
+        return k.apply(args.filename, getattr(args, "prune", False),
+                       getattr(args, "selector", ""))
     if args.verb == "delete":
         if not args.name and not args.selector:
             k.out.write("error: a name or -l selector is required\n")

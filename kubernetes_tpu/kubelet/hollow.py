@@ -47,6 +47,10 @@ class HollowKubelet:
         serve: bool = False,
         mount_latency: float = 0.0,
         real_sandboxes: bool = False,
+        system_reserved_cpu: str = "0",
+        system_reserved_memory: str = "0",
+        kube_reserved_cpu: str = "0",
+        kube_reserved_memory: str = "0",
     ):
         from .runtime import FakeRuntime, PodRuntimeManager
 
@@ -80,7 +84,13 @@ class HollowKubelet:
 
         # resource accounting: the cgroup-analogue tree + node admission
         # (pkg/kubelet/cm) and image GC (pkg/kubelet/images)
-        self.cm = ContainerManager(cpu, memory, pods)
+        self.cm = ContainerManager(
+            cpu, memory, pods,
+            system_reserved_cpu=system_reserved_cpu,
+            system_reserved_memory=system_reserved_memory,
+            kube_reserved_cpu=kube_reserved_cpu,
+            kube_reserved_memory=kube_reserved_memory,
+        )
         self.images = ImageManager(clock=clock)
         self.image_gc_period = 30.0
         self._last_image_gc = -1e18
@@ -120,9 +130,12 @@ class HollowKubelet:
                     api.MEMORY: api.Quantity(self.memory),
                     api.PODS: api.Quantity(self.pods),
                 },
+                # allocatable = capacity − system-reserved − kube-reserved
+                # (container_manager_linux.go GetNodeAllocatable) — what
+                # the scheduler budgets against
                 allocatable={
-                    api.CPU: api.Quantity(self.cpu),
-                    api.MEMORY: api.Quantity(self.memory),
+                    api.CPU: api.Quantity(f"{self.cm.allocatable_cpu}m"),
+                    api.MEMORY: api.Quantity(str(self.cm.allocatable_memory)),
                     api.PODS: api.Quantity(self.pods),
                 },
                 conditions=[

@@ -1204,3 +1204,36 @@ def test_set_env(cs):
     assert env == {"MODE": "prod"}
     rc, out = run(cs, "set", "env", "pod/nope", "A=b")
     assert rc == 1 and "cannot set env" in out
+
+
+def test_apply_prune(cs, tmp_path):
+    """apply --prune -l app=web: previously-applied selector-matching
+    objects absent from the new manifest set are deleted; objects apply
+    never created (no last-applied annotation) are untouched."""
+    import yaml as _yaml
+
+    def cm_doc(name):
+        return {"kind": "ConfigMap",
+                "metadata": {"name": name, "labels": {"app": "web"}},
+                "data": {"k": name}}
+
+    both = tmp_path / "both.yaml"
+    both.write_text(_yaml.safe_dump_all([cm_doc("a"), cm_doc("b")]))
+    rc, out = run(cs, "apply", "-f", str(both))
+    assert rc == 0
+    # a bystander with matching labels but NOT apply-managed
+    from kubernetes_tpu.api import ConfigMap
+    from kubernetes_tpu.api.meta import ObjectMeta
+    cs.configmaps.create(ConfigMap(
+        meta=ObjectMeta(name="manual", labels={"app": "web"})))
+
+    only_a = tmp_path / "only_a.yaml"
+    only_a.write_text(_yaml.safe_dump(cm_doc("a")))
+    rc, out = run(cs, "apply", "-f", str(only_a), "--prune", "-l", "app=web")
+    assert rc == 0 and "configmaps/b pruned" in out
+    names = sorted(c.meta.name for c in cs.configmaps.list()[0])
+    assert names == ["a", "manual"]  # b pruned, bystander kept
+
+    # --prune without a selector is refused (the reference's guard)
+    rc, out = run(cs, "apply", "-f", str(only_a), "--prune")
+    assert rc == 1 and "requires -l" in out

@@ -335,3 +335,17 @@ def test_admission_reserves_within_one_tick(cs):
     # ticks (the ledger must not leak them back mid-latency)
     kubelet.tick()
     assert kubelet.cm.reserved_cpu == 3000
+
+
+def test_node_reports_reserved_aware_allocatable(cs):
+    """Registration reports allocatable = capacity - reserved; the
+    scheduler budgets against allocatable, not capacity."""
+    kubelet = HollowKubelet(cs, "n1", cpu="4", memory="8Gi",
+                            system_reserved_cpu="500m",
+                            kube_reserved_cpu="500m",
+                            system_reserved_memory="1Gi")
+    kubelet.register()
+    node = cs.nodes.get("n1")
+    assert node.status.capacity["cpu"].milli_value() == 4000
+    assert node.status.allocatable["cpu"].milli_value() == 3000
+    assert node.status.allocatable["memory"].value() == 7 << 30
