@@ -105,6 +105,13 @@ def cmd_init(args) -> dict:
     pids["controller-manager"] = _spawn(
         "kubernetes_tpu.controllers", "--apiserver", url, "--leader-elect",
     )
+    if getattr(args, "dns_port", 0):
+        # the kube-dns addon (cluster/addons/dns): part of standard
+        # turn-up, serving the cluster zone over UDP
+        pids["kube-dns"] = _spawn(
+            "kubernetes_tpu.dns", "--apiserver", url,
+            "--port", str(args.dns_port),
+        )
     token = f"{token_id}.{token_secret}"
     print(f"control plane up at {url}")
     print(f"join token: {token}")
@@ -181,6 +188,8 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=6443)
     p.add_argument("--backend", choices=["tpu", "oracle"], default="tpu")
     p.add_argument("--token-ttl", type=float, default=24 * 3600)
+    p.add_argument("--dns-port", type=int, default=10053,
+                   help="0 disables the kube-dns addon")
     p = sub.add_parser("join")
     p.add_argument("--apiserver", required=True)
     p.add_argument("--token", required=True)
@@ -190,6 +199,8 @@ def main(argv=None) -> int:
     p.add_argument("--backend", choices=["tpu", "oracle"], default="oracle")
     p.add_argument("--nodes", type=int, default=5)
     p.add_argument("--token-ttl", type=float, default=24 * 3600)
+    p.add_argument("--dns-port", type=int, default=10053,
+                   help="0 disables the kube-dns addon")
     sub.add_parser("down")
     args = ap.parse_args(argv)
 

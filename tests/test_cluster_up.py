@@ -1,0 +1,94 @@
+"""Whole-cluster turn-up e2e: real processes via
+``python -m kubernetes_tpu.cluster up`` — apiserver, scheduler,
+controller-manager, hollow kubelets, and the kube-dns addon — then a
+Service resolved by name through the addon over real UDP, then ``down``
+reaps everything (kubeadm + cluster/addons/dns)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(120)
+def test_cluster_up_with_dns_addon(tmp_path):
+    from kubernetes_tpu.api import ObjectMeta, Service, ServicePort
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.client.remote import RemoteStore
+    from kubernetes_tpu.dns.server import lookup
+
+    port, dns_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run_cluster(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.cluster", *args],
+            cwd=str(tmp_path), env=env, capture_output=True, text=True,
+            timeout=90)
+
+    up = run_cluster("up", "--nodes", "2", "--port", str(port),
+                     "--dns-port", str(dns_port), "--backend", "oracle")
+    assert up.returncode == 0, up.stderr
+    url = f"http://127.0.0.1:{port}"
+    try:
+        state = json.loads((tmp_path / ".kubernetes-tpu-cluster.json").read_text())
+        assert "kube-dns" in state["pids"], "dns addon not part of turn-up"
+
+        cs = Clientset(RemoteStore(url))
+        deadline = time.time() + 45
+        ready = 0
+        while time.time() < deadline:
+            nodes, _ = cs.nodes.list()
+            ready = sum(1 for n in nodes
+                        if any(c.type == "Ready" and c.status == "True"
+                               for c in n.status.conditions))
+            if ready >= 2:
+                break
+            time.sleep(0.5)
+        assert ready >= 2, f"only {ready}/2 nodes Ready"
+
+        cs.services.create(Service(
+            meta=ObjectMeta(name="web", namespace="default"),
+            selector={"app": "web"},
+            ports=[ServicePort(name="http", port=80, target_port=8080)],
+            cluster_ip="10.0.0.80"))
+        deadline = time.time() + 20
+        ips = []
+        while time.time() < deadline and not ips:
+            try:
+                ips = lookup(("127.0.0.1", dns_port),
+                             "web.default.svc.cluster.local")
+            except Exception:
+                pass
+            if not ips:
+                time.sleep(0.5)
+        assert ips == ["10.0.0.80"], f"dns addon never resolved: {ips}"
+    finally:
+        down = run_cluster("down")
+        assert down.returncode == 0
+    # everything reaped: the apiserver port stops answering
+    deadline = time.time() + 10
+    dead = False
+    while time.time() < deadline and not dead:
+        try:
+            urllib.request.urlopen(f"{url}/healthz", timeout=1)
+            time.sleep(0.3)
+        except Exception:
+            dead = True
+    assert dead, "apiserver survived cluster down"

@@ -185,3 +185,55 @@ def test_promoted_durable_leader_survives_restart(tmp_path):
     assert revived.revision == final_rev
     names = [d["metadata"]["name"] for d in revived.list("Pod")[0]]
     assert names == [f"p{i}" for i in range(5)] + ["post-failover"]
+
+
+def test_concurrent_writers_with_follower_churn_and_promotion():
+    """The linearizability-flavored chaos case: many writer threads, a
+    follower failing and catching up mid-stream, then leader death and
+    promotion — every write the store ACKED must exist on the promoted
+    leader; refused (NoQuorum) writes must not."""
+    import threading
+
+    leader, (f1, f2) = _mk_cluster()
+    cs = Clientset(leader)
+    acked: list[str] = []
+    refused: list[str] = []
+    lock = threading.Lock()
+
+    def writer(wid: int):
+        for i in range(60):
+            name = f"w{wid}-p{i:03d}"
+            try:
+                cs.pods.create(make_pod(name))
+                with lock:
+                    acked.append(name)
+            except NoQuorumError:
+                with lock:
+                    refused.append(name)
+
+    churn_stop = threading.Event()
+
+    def churn():
+        while not churn_stop.is_set():
+            f1.fail()
+            leader.catch_up(f1)  # rejoin via log replay or snapshot
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    churner = threading.Thread(target=churn)
+    churner.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    churn_stop.set()
+    churner.join()
+    leader.catch_up(f1)
+
+    assert len(acked) >= 200  # the cluster stayed mostly available
+    # leader dies; most-caught-up live follower takes over
+    new_leader = ReplicatedStore.promote([f1, f2])
+    names = {d["metadata"]["name"] for d in new_leader.list("Pod")[0]}
+    missing = [n for n in acked if n not in names]
+    assert not missing, f"acked writes lost in promotion: {missing[:5]}"
+    ghosts = [n for n in refused if n in names]
+    assert not ghosts, f"refused writes materialized: {ghosts[:5]}"
