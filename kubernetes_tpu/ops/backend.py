@@ -203,7 +203,15 @@ class TPUBatchBackend:
         pods: list[api.Pod],
         node_info_map: dict[str, NodeInfo],
         pctx: PriorityContext,
+        on_segment=None,
     ) -> list[Optional[str]]:
+        """``on_segment`` (optional): called with ``[(pod, node_name|None),
+        ...]`` per completed segment, AFTER the NEXT segment's device scan
+        has been dispatched — the caller's commit work (cache assume,
+        bind txn, events) runs on host while the TPU executes, hiding most
+        of the commit cost behind device time.  Entry order across calls
+        equals pod order, so sequential semantics are unchanged; with
+        ``on_segment=None`` behavior is exactly the unpipelined batch."""
         weights = self._config_supported()
         # working state: clones so neither the scheduler's CoW snapshot nor
         # the cache sees our speculative assumptions
@@ -247,6 +255,26 @@ class TPUBatchBackend:
             self.stats["oracle_pods"] += 1
 
         def run_kernel_segment(segment: list[tuple[int, api.Pod]]) -> None:
+            """Sync path: dispatch + finish immediately.  On a budget
+            reject (signatures / affinity terms / volumes), halve the
+            segment — each half re-tensorizes against the updated working
+            state, so sequential parity is preserved."""
+            finish = dispatch_kernel_segment(segment)
+            if finish is None:
+                if len(segment) == 1:
+                    run_oracle(segment[0][1], segment[0][0])
+                    return
+                mid = len(segment) // 2
+                run_kernel_segment(segment[:mid])
+                run_kernel_segment(segment[mid:])
+                return
+            finish()
+
+        def dispatch_kernel_segment(segment: list[tuple[int, api.Pod]]):
+            """Async half of run_kernel_segment: tensorize + dispatch and
+            return a finisher closure that materializes, applies, and
+            returns the segment's commit entries.  Returns None when the
+            segment needs the sync split path (budget reject)."""
             seg_pods = [p for _, p in segment]
             static = self.tensorizer.build_static(
                 seg_pods,
@@ -264,38 +292,55 @@ class TPUBatchBackend:
                 mounted_disks=mounted_disks,
             )
             if static is None:
-                # over a budget (signatures / affinity terms / volumes):
-                # halve the segment — each half re-tensorizes against the
-                # updated working state, so sequential parity is preserved
-                if len(segment) == 1:
-                    run_oracle(segment[0][1], segment[0][0])
-                    return
-                mid = len(segment) // 2
-                run_kernel_segment(segment[:mid])
-                run_kernel_segment(segment[mid:])
-                return
+                return None
             init = self.tensorizer.initial_state(
                 static, work_map, work_pctx, seg_pods,
                 round_robin=self.algorithm._round_robin, host_state=host_state,
             )
-            if self._use_pallas(static):
-                from .pallas_kernel import schedule_batch_pallas
+            use_pallas = self._use_pallas(static)
+            if use_pallas:
+                from .pallas_kernel import dispatch_batch_pallas
 
                 try:
-                    chosen, final_rr = schedule_batch_pallas(static, init)
-                    self.stats["pallas_segments"] += 1
+                    fut = dispatch_batch_pallas(static, init)
                 except Exception:
-                    logger.exception("pallas kernel failed; falling back to XLA scan")
+                    # trace/compile-time failures surface AT dispatch —
+                    # same fallback contract as the run-time path
+                    logger.exception(
+                        "pallas dispatch failed; falling back to XLA scan")
                     self._pallas_failed = True
-                    chosen, final_rr = schedule_batch_arrays(static, init)
-            else:
-                chosen, final_rr = schedule_batch_arrays(static, init)
-            self.algorithm._round_robin = final_rr
-            for (i, pod), idx in zip(segment, chosen):
-                node_name = static.node_names[int(idx)] if int(idx) >= 0 else None
-                apply(pod, node_name, i)
-            self.stats["kernel_pods"] += len(segment)
-            self.stats["segments"] += 1
+                    use_pallas = False
+            if not use_pallas:
+                from .batch_kernel import dispatch_batch_arrays
+
+                fut = dispatch_batch_arrays(static, init)
+
+            def finish() -> list:
+                nonlocal use_pallas
+                if use_pallas:
+                    from .pallas_kernel import finalize_batch_pallas
+
+                    try:
+                        chosen, final_rr = finalize_batch_pallas(static, *fut)
+                        self.stats["pallas_segments"] += 1
+                    except Exception:
+                        logger.exception(
+                            "pallas kernel failed; falling back to XLA scan")
+                        self._pallas_failed = True
+                        chosen, final_rr = schedule_batch_arrays(static, init)
+                else:
+                    from .batch_kernel import finalize_batch_arrays
+
+                    chosen, final_rr = finalize_batch_arrays(static, *fut)
+                self.algorithm._round_robin = final_rr
+                for (i, pod), idx in zip(segment, chosen):
+                    node_name = static.node_names[int(idx)] if int(idx) >= 0 else None
+                    apply(pod, node_name, i)
+                self.stats["kernel_pods"] += len(segment)
+                self.stats["segments"] += 1
+                return [(pod, assignments[i]) for i, pod in segment]
+
+            return finish
 
         # Phase B: every pod is kernel-expressible (inter-pod affinity and
         # volumes run on device).  One ordered pass cuts the batch into
@@ -305,14 +350,37 @@ class TPUBatchBackend:
         if weights is None:
             for i, pod in enumerate(pods):
                 run_oracle(pod, i)
+            if on_segment is not None and pods:
+                on_segment([(pod, assignments[i])
+                            for i, pod in enumerate(pods)])
             return assignments
+        pending: list = []  # prior segments' entries awaiting commit
+
+        def flush_pending() -> None:
+            nonlocal pending
+            if on_segment is not None and pending:
+                on_segment(pending)
+            pending = []
+
         try:
             for kind, segment in self._segments(pods, mounted_disks=mounted_disks):
                 if kind == "oracle":
                     for i, pod in segment:
                         run_oracle(pod, i)
-                else:
+                    pending.extend((pod, assignments[i]) for i, pod in segment)
+                    continue
+                finish = dispatch_kernel_segment(segment)
+                if finish is None:
+                    # budget reject (rare): sync safety-net split path
+                    flush_pending()
                     run_kernel_segment(segment)
+                    pending.extend((pod, assignments[i]) for i, pod in segment)
+                    continue
+                # the device is executing THIS segment: commit everything
+                # earlier on host in the shadow of the scan
+                flush_pending()
+                pending = finish()
+            flush_pending()
         finally:
             host_state.close()
         return assignments

@@ -427,12 +427,25 @@ def _runner_for(static: BatchStatic):
     )
 
 
-def schedule_batch_arrays(static: BatchStatic, init: InitialState) -> tuple[np.ndarray, int]:
-    """Run the kernel; returns (chosen node index per pod [-1 = unschedulable],
-    final round-robin counter)."""
+def dispatch_batch_arrays(static: BatchStatic, init: InitialState):
+    """Async half: dispatch the scan and return the UNMATERIALIZED jax
+    arrays (futures).  The caller may run host work while the device
+    executes, then block via ``finalize_batch_arrays`` — the overlap seam
+    the pipelined backend commits previous-segment bindings in."""
     dev = to_device(static)
     state = state_to_device(init)
     xs = batch_xs(static)
     run = _runner_for(static)
     final_state, chosen = run(dev, xs, state)
-    return np.asarray(chosen)[: len(static.group_of_pod)], int(final_state.round_robin)
+    return chosen, final_state.round_robin
+
+
+def finalize_batch_arrays(static: BatchStatic, chosen, rr) -> tuple[np.ndarray, int]:
+    return np.asarray(chosen)[: len(static.group_of_pod)], int(rr)
+
+
+def schedule_batch_arrays(static: BatchStatic, init: InitialState) -> tuple[np.ndarray, int]:
+    """Run the kernel; returns (chosen node index per pod [-1 = unschedulable],
+    final round-robin counter)."""
+    chosen, rr = dispatch_batch_arrays(static, init)
+    return finalize_batch_arrays(static, chosen, rr)

@@ -590,6 +590,13 @@ def _pallas_runner(
 
 def schedule_batch_pallas(static: BatchStatic, init: InitialState):
     """Drop-in replacement for ``schedule_batch_arrays`` on TPU."""
+    chosen2d, rr = dispatch_batch_pallas(static, init)
+    return finalize_batch_pallas(static, chosen2d, rr)
+
+
+def dispatch_batch_pallas(static: BatchStatic, init: InitialState):
+    """Async half of ``schedule_batch_pallas``: dispatch and return the
+    unmaterialized device arrays (see dispatch_batch_arrays)."""
     scalars, ins, p_pad = _pack(static, init)
     weights = tuple(int(static.weights.get(kk, 0)) for kk in WEIGHT_KEYS)
     run = _pallas_runner(
@@ -606,6 +613,9 @@ def schedule_batch_pallas(static: BatchStatic, init: InitialState):
         bool(static.terms),
         bool(static.use_vols),
     )
-    chosen2d, rr = run(*scalars, *ins)
+    return run(*scalars, *ins)
+
+
+def finalize_batch_pallas(static: BatchStatic, chosen2d, rr):
     chosen = np.asarray(chosen2d).reshape(-1)[: len(static.group_of_pod)]
     return chosen, int(np.asarray(rr)[0, 0])

@@ -310,31 +310,26 @@ class Scheduler:
 
         gc_was_enabled = _gc.isenabled()
         _gc.disable()
-        try:
-            start = self._clock()
-            snapshot = self.snapshot()
-            pctx = self.priority_context(snapshot)
-            algo_start = self._clock()
-            assignments = self.backend.schedule_batch(pods, snapshot, pctx)
-            self.metrics.batch_device_latency.observe((self._clock() - algo_start) * 1e6)
+        totals = {"bound": 0, "failed": 0, "committed": 0,
+                  "attempted_binds": 0, "commit_s": 0.0}
+        # ONE event enqueue for the whole batch, after the last commit:
+        # enqueueing per segment would wake the sink thread mid-batch and
+        # its correlation/store writes would steal the GIL from the host
+        # phases that are NOT in the device's shadow (tensorize/apply)
+        ev_batch: list = []
 
-            # assume everything first, then commit all bindings in one
-            # store txn (the batch generalization of the reference's
-            # async-bind pipeline, SURVEY.md P9), then roll back the
-            # individual CAS losers.
-            bound = failed = 0
-            # events accumulate locally (bind wave + failures) and enqueue
-            # in ONE batch at the end: no per-pod lock traffic, no string
-            # formatting on the hot path (lazy %-tuples format on the sink
-            # thread), and the sink does not wake — and contend for the
-            # GIL — mid-timed-section
-            ev_batch: list = []
+        def commit_segment(entries: list) -> None:
+            """Assume + bind + record one segment's results (the batch
+            generalization of the reference's async-bind pipeline,
+            SURVEY.md P9, now streamed per segment: the backend invokes
+            this while the device executes the NEXT segment, so the
+            commit cost hides in the scan's shadow)."""
             to_bind: list[tuple[api.Pod, api.Binding]] = []
             to_assume: list[tuple[api.Pod, str]] = []
-            for pod, node_name in zip(pods, assignments):
+            for pod, node_name in entries:
                 if node_name is None:
                     self.handle_schedule_failure(pod, FitError(pod, {}), ev_batch)
-                    failed += 1
+                    totals["failed"] += 1
                     continue
                 to_assume.append((pod, node_name))
                 self.backoff.forget(pod.meta.key)
@@ -348,12 +343,11 @@ class Scheduler:
                         ),
                     )
                 )
-            self.metrics.schedule_attempts.inc(len(pods))
+            commit_start = self._clock()
             self.cache.assume_many(to_assume)
             bind_start = self._clock()
             errors = self.clientset.pods.bind_many([b for _, b in to_bind])
             self.metrics.binding_latency.observe((self._clock() - bind_start) * 1e6)
-            now = self._clock()
             finished: list[str] = []
             emit = self.emit_events
             for (pod, binding), err in zip(to_bind, errors):
@@ -365,21 +359,41 @@ class Scheduler:
                             ("Successfully assigned %s to %s",
                              pod.meta.key, binding.node_name),
                         ))
-                    bound += 1
+                    totals["bound"] += 1
                 else:
                     logger.warning("bind failed for %s: %s", pod.meta.key, err)
                     self.cache.forget_pod(pod)
                     if emit:
                         ev_batch.append((pod, "Warning", "FailedBinding", err))
-                    failed += 1
-            if ev_batch:
-                self._recorder.event_batch(ev_batch)
+                    totals["failed"] += 1
             self.cache.finish_binding_many(finished)
+            totals["committed"] += len(finished)
+            totals["attempted_binds"] += len(to_bind)
+            totals["commit_s"] += self._clock() - commit_start
+
+        try:
+            start = self._clock()
+            snapshot = self.snapshot()
+            pctx = self.priority_context(snapshot)
+            algo_start = self._clock()
+            self.backend.schedule_batch(pods, snapshot, pctx,
+                                        on_segment=commit_segment)
+            # device/algorithm time only: the per-segment commit work
+            # (assume + bind txn) runs inside schedule_batch via the
+            # callback and is tracked separately (binding_latency)
+            self.metrics.batch_device_latency.observe(
+                (self._clock() - algo_start - totals["commit_s"]) * 1e6)
+            self.metrics.schedule_attempts.inc(len(pods))
+            bound, failed = totals["bound"], totals["failed"]
             self.metrics.e2e_scheduling_latency.observe_many(
-                (now - start) * 1e6, len(to_bind))
+                (self._clock() - start) * 1e6, totals["attempted_binds"])
         finally:
             if gc_was_enabled:
                 _gc.enable()
+            # committed segments' events must survive a mid-batch failure —
+            # their pods ARE bound in the cluster
+            if ev_batch:
+                self._recorder.event_batch(ev_batch)
         if self.emit_events and not self.broadcaster.running:
             # manual drive (no sink thread): drain synchronously so the
             # batch path's events land just like the per-pod path's
