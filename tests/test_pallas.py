@@ -141,3 +141,37 @@ def test_supports_pallas_budget_guard():
     static = tz.build_static(pods, m, pctx)
     assert pk.supports_pallas(static)
     assert pk.pallas_vmem_bytes(static) < pk.VMEM_BUDGET_BYTES
+
+
+def test_pallas_dispatch_failure_falls_back_to_xla(monkeypatch):
+    """A trace/compile-time pallas failure (surfacing AT dispatch) must
+    fall back to the XLA scan for the segment, memoize the failure, and
+    still produce oracle-identical bindings."""
+    import kubernetes_tpu.ops.pallas_kernel as pk
+    from kubernetes_tpu.ops.backend import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, PriorityContext
+
+    from tests.test_parity import build_cluster, make_batch, oracle_batch
+
+    def boom(static, init):
+        raise RuntimeError("injected pallas trace failure")
+
+    monkeypatch.setattr(pk, "dispatch_batch_pallas", boom)
+
+    rng = random.Random(99)
+    m = build_cluster(rng, 30, zones=3)
+    pods = make_batch(rng, 120)
+    algo = GenericScheduler()
+    pctx = PriorityContext(m)
+    backend = TPUBatchBackend(algorithm=algo, kernel_impl="pallas")
+    committed = []
+    got = backend.schedule_batch(
+        pods, m, pctx, on_segment=lambda entries: committed.extend(entries))
+    assert backend._pallas_failed  # memoized: no retry storm
+    assert backend.stats["pallas_segments"] == 0
+    assert backend.stats["kernel_pods"] == len(pods)  # XLA scan served it
+    # streamed commits cover every pod exactly once, in pod order
+    assert [p.meta.key for p, _ in committed] == [p.meta.key for p in pods]
+    # and the bindings still match the sequential oracle
+    want = oracle_batch(pods, m, pctx, GenericScheduler())
+    assert [n for _, n in committed] == want
