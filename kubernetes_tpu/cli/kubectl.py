@@ -1745,11 +1745,15 @@ class Kubectl:
     def create_resource(self, what: str, name: str, namespace: Optional[str],
                         from_literal: list[str], from_file: list[str],
                         hard: str, tcp: list[str], secret_type: str,
-                        svc_type: str = "ClusterIP") -> int:
+                        svc_type: str = "ClusterIP", verbs: str = "",
+                        resources: str = "", role: str = "",
+                        clusterrole: str = "", users: list[str] = (),
+                        groups: list[str] = (), serviceaccounts: list[str] = (),
+                        selector: str = "", min_available: int = 0) -> int:
         """Imperative object generators: ``kubectl create
-        namespace|configmap|secret|serviceaccount|quota|service NAME ...``
-        (reference ``cmd/create_{namespace,configmap,secret,
-        serviceaccount,quota,service}.go``)."""
+        namespace|configmap|secret|serviceaccount|quota|service|role|
+        rolebinding|clusterrole|clusterrolebinding|pdb NAME ...``
+        (reference ``cmd/create_*.go``)."""
         import base64
 
         from ..admission.framework import AdmissionDenied
@@ -1759,6 +1763,15 @@ class Kubectl:
             ResourceQuota,
             Secret,
             ServiceAccount,
+        )
+        from ..api.cluster import PodDisruptionBudget
+        from ..api.rbac import (
+            ClusterRole,
+            ClusterRoleBinding,
+            PolicyRule,
+            Role,
+            RoleBinding,
+            Subject,
         )
         from ..client.remote import ForbiddenError
 
@@ -1848,6 +1861,48 @@ class Kubectl:
             obj = api.Service(meta=api.ObjectMeta(name=name),
                               selector={"app": name}, ports=ports,
                               type=svc_type)
+        elif what in ("role", "clusterrole"):
+            if not verbs or not resources:
+                self.out.write("error: --verb and --resource are required\n")
+                return 1
+            rule = PolicyRule(verbs=verbs.split(","),
+                              resources=resources.split(","))
+            cls = Role if what == "role" else ClusterRole
+            obj = cls(meta=api.ObjectMeta(name=name), rules=[rule])
+        elif what in ("rolebinding", "clusterrolebinding"):
+            if bool(role) == bool(clusterrole):
+                self.out.write("error: exactly one of --role/--clusterrole "
+                               "is required\n")
+                return 1
+            subjects = ([Subject(kind="User", name=u) for u in users]
+                        + [Subject(kind="Group", name=g) for g in groups])
+            for sa in serviceaccounts:
+                sa_ns, _, sa_name = sa.partition(":")
+                if not sa_name:
+                    self.out.write(f"error: --serviceaccount wants ns:name, "
+                                   f"got {sa!r}\n")
+                    return 1
+                subjects.append(Subject(kind="ServiceAccount", name=sa_name,
+                                        namespace=sa_ns))
+            if not subjects:
+                self.out.write("error: at least one of --user/--group/"
+                               "--serviceaccount is required\n")
+                return 1
+            cls = RoleBinding if what == "rolebinding" else ClusterRoleBinding
+            obj = cls(meta=api.ObjectMeta(name=name), subjects=subjects,
+                      role_kind="ClusterRole" if clusterrole else "Role",
+                      role_name=clusterrole or role)
+        elif what == "poddisruptionbudget":
+            want = _parse_selector(selector) if selector else None
+            if want is None:
+                self.out.write("error: --selector is required (and must "
+                               "parse)\n")
+                return 1
+            obj = PodDisruptionBudget(
+                meta=api.ObjectMeta(name=name),
+                min_available=min_available,
+                selector=want,  # _parse_selector returns a LabelSelector
+            )
         else:
             self.out.write(f"error: unknown generator {what!r}\n")
             return 1
@@ -2383,6 +2438,20 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
     p.add_argument("--hard", default="")
     p.add_argument("--tcp", action="append", default=[])
     p.add_argument("--type", dest="secret_type", default="Opaque")
+    # dest must NOT be "verb": that is the subparser dest, and argparse
+    # would clobber the chosen subcommand with the flag's value
+    p.add_argument("--verb", dest="rbac_verb", default="",
+                   help="role/clusterrole verbs, comma-sep")
+    p.add_argument("--resource", dest="rbac_resource", default="",
+                   help="role/clusterrole resources, comma-sep")
+    p.add_argument("--role", default="")
+    p.add_argument("--clusterrole", default="")
+    p.add_argument("--user", action="append", default=[])
+    p.add_argument("--group", action="append", default=[])
+    p.add_argument("--serviceaccount", action="append", default=[],
+                   help="ns:name")
+    p.add_argument("--min-available", type=int, default=0)
+    p.add_argument("-l", "--selector", default=argparse.SUPPRESS)
     p = sub.add_parser("certificate", parents=[common])
     p.add_argument("action", choices=["approve", "deny"])
     p.add_argument("name")
@@ -2580,9 +2649,18 @@ def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None
                 k.out.write(f"error: unknown service type {name!r}\n")
                 return 1
             name = extra
+        if what == "pdb":
+            what = "poddisruptionbudget"
         return k.create_resource(what, name, namespace, args.from_literal,
                                  args.from_file, args.hard, args.tcp,
-                                 args.secret_type, svc_type)
+                                 args.secret_type, svc_type,
+                                 verbs=args.rbac_verb,
+                                 resources=args.rbac_resource,
+                                 role=args.role, clusterrole=args.clusterrole,
+                                 users=args.user, groups=args.group,
+                                 serviceaccounts=args.serviceaccount,
+                                 selector=getattr(args, "selector", ""),
+                                 min_available=args.min_available)
     if args.verb == "certificate":
         return k.certificate(args.action, args.name)
     if args.verb == "apply":

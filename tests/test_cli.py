@@ -1237,3 +1237,60 @@ def test_apply_prune(cs, tmp_path):
     # --prune without a selector is refused (the reference's guard)
     rc, out = run(cs, "apply", "-f", str(only_a), "--prune")
     assert rc == 1 and "requires -l" in out
+
+
+def test_create_rbac_and_pdb_generators(cs):
+    """create role/rolebinding/clusterrole/clusterrolebinding/pdb
+    (cmd/create_{role,rolebinding,clusterrole,clusterrolebinding,pdb}.go)
+    — and the created RBAC actually authorizes."""
+    rc, out = run(cs, "create", "role", "pod-reader",
+                  "--verb", "get,list", "--resource", "pods")
+    assert rc == 0 and "roles/pod-reader created" in out
+    role = cs.roles.get("pod-reader")
+    assert role.rules[0].verbs == ["get", "list"]
+    assert role.rules[0].matches("get", "pods")
+    assert not role.rules[0].matches("delete", "pods")
+
+    rc, out = run(cs, "create", "rolebinding", "alice-reads",
+                  "--role", "pod-reader", "--user", "alice")
+    assert rc == 0
+    rb = cs.rolebindings.get("alice-reads")
+    assert rb.role_name == "pod-reader" and rb.subjects[0].name == "alice"
+
+    rc, out = run(cs, "create", "clusterrole", "node-admin",
+                  "--verb", "*", "--resource", "nodes")
+    assert rc == 0
+    rc, out = run(cs, "create", "clusterrolebinding", "sa-admin",
+                  "--clusterrole", "node-admin",
+                  "--serviceaccount", "kube-system:admin")
+    assert rc == 0
+    crb = cs.clusterrolebindings.get("sa-admin")
+    assert crb.subjects[0].kind == "ServiceAccount"
+    assert crb.subjects[0].namespace == "kube-system"
+
+    # the generated objects drive the real RBAC authorizer
+    from kubernetes_tpu.auth.authn import UserInfo
+    from kubernetes_tpu.auth.authz import ALLOW, AuthzAttributes, RBACAuthorizer
+    authz = RBACAuthorizer(cs.store)
+    alice = UserInfo(name="alice")
+    assert authz.authorize(
+        AuthzAttributes(alice, "get", "pods", "default"))[0] == ALLOW
+    assert authz.authorize(
+        AuthzAttributes(alice, "delete", "pods", "default"))[0] != ALLOW
+
+    rc, out = run(cs, "create", "pdb", "web-pdb", "--min-available", "2",
+                  "-l", "app=web")
+    assert rc == 0
+    pdb = cs.poddisruptionbudgets.get("web-pdb")
+    assert pdb.min_available == 2
+
+    # guard rails
+    rc, out = run(cs, "create", "role", "r2", "--verb", "get")
+    assert rc == 1 and "--resource" in out
+    rc, out = run(cs, "create", "rolebinding", "rb2", "--role", "x",
+                  "--clusterrole", "y", "--user", "u")
+    assert rc == 1 and "exactly one" in out
+    rc, out = run(cs, "create", "rolebinding", "rb3", "--role", "x")
+    assert rc == 1 and "at least one" in out
+    rc, out = run(cs, "create", "pdb", "p2", "--min-available", "1")
+    assert rc == 1 and "--selector" in out
