@@ -2404,6 +2404,22 @@ class Kubectl:
 
 
 def main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None, out=None) -> int:
+    """Dispatch wrapper: server-side denials and wire errors become the
+    reference's "Error from server" line + exit 1, never a traceback
+    (any verb can hit a 403 once the apiserver runs with authorization)."""
+    from ..client.remote import ForbiddenError, RemoteError
+
+    try:
+        return _main(argv, clientset, out)
+    except ForbiddenError as e:
+        (out or sys.stdout).write(f"Error from server (Forbidden): {e}\n")
+        return 1
+    except RemoteError as e:
+        (out or sys.stdout).write(f"Error from server: {e}\n")
+        return 1
+
+
+def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = None, out=None) -> int:
     # SUPPRESS so a subparser never clobbers a value parsed before the verb
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--server", default=argparse.SUPPRESS)
