@@ -613,24 +613,30 @@ class Kubectl:
     def _prune(self, applied: set, want) -> None:
         """Delete previously-applied, selector-matching objects absent
         from this apply set.  Scope: every kind that appeared in the
-        manifests (the reference prunes a whitelist; the applied-kind set
-        is this framework's equivalent guard)."""
+        manifests, and for namespaced kinds ONLY the namespaces the
+        manifests touched — pruning is destructive, so it must never
+        reach into a namespace the apply set never mentioned (the
+        reference's prune visits only the apply set's namespaces;
+        cluster-scoped kinds have no namespace guard)."""
+        namespaces = sorted({ns for _, ns, _ in applied})
         for kind in {k for k, _, _ in applied}:
             client = self.cs.client_for(kind)
-            for obj in client.list(None)[0]:
-                ident = (kind, obj.meta.namespace, obj.meta.name)
-                if ident in applied:
-                    continue
-                if LAST_APPLIED not in obj.meta.annotations:
-                    continue  # apply never owned it; never prune it
-                if not _labels_match(obj, want):
-                    continue
-                try:
-                    client.delete(obj.meta.name, obj.meta.namespace)
-                except NotFoundError:
-                    continue
-                self.out.write(
-                    f"{KIND_TO_RESOURCE[kind]}/{obj.meta.name} pruned\n")
+            scopes = [None] if kind in api.CLUSTER_SCOPED_KINDS else namespaces
+            for scope in scopes:
+                for obj in client.list(scope)[0]:
+                    ident = (kind, obj.meta.namespace, obj.meta.name)
+                    if ident in applied:
+                        continue
+                    if LAST_APPLIED not in obj.meta.annotations:
+                        continue  # apply never owned it; never prune it
+                    if not _labels_match(obj, want):
+                        continue
+                    try:
+                        client.delete(obj.meta.name, obj.meta.namespace)
+                    except NotFoundError:
+                        continue
+                    self.out.write(
+                        f"{KIND_TO_RESOURCE[kind]}/{obj.meta.name} pruned\n")
 
     def delete(self, resource: str, name: Optional[str], namespace: Optional[str] = None,
                selector: str = "", cascade: str = "background") -> int:

@@ -272,7 +272,9 @@ class EventBroadcaster:
 
     def start(self) -> None:
         if self._thread is not None:
-            return
+            if self._thread.is_alive():
+                return
+            self._thread = None  # stale handle from a timed-out stop()
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -292,9 +294,13 @@ class EventBroadcaster:
 
     @property
     def running(self) -> bool:
-        return self._thread is not None
+        # a dead thread (e.g. it finished draining after a timed-out
+        # stop()) is not a running sink — misreporting True here would
+        # suppress callers' manual-drain fallbacks
+        t = self._thread
+        return t is not None and t.is_alive()
 
-    def stop(self, drain: bool = True) -> None:
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         with self._cv:
             self._stopped = True
             if not drain:
@@ -302,13 +308,20 @@ class EventBroadcaster:
             self._cv.notify_all()
         t = self._thread
         if t is not None:
-            t.join(timeout=10)
-            # a huge backlog can outlive the join timeout; the loop is
-            # draining it, so keep waiting for THE THREAD — a concurrent
-            # caller-side flush would invert create/patch ordering, and
-            # nulling _thread while it lives would let start() double-sink
-            while drain and t.is_alive():
-                t.join(timeout=10)
+            # a huge backlog can outlive one join timeout; keep waiting for
+            # THE THREAD (a concurrent caller-side flush would invert
+            # create/patch ordering) — but only up to `timeout`: a sink
+            # wedged inside _write must not hang stop() forever
+            deadline = time.monotonic() + timeout
+            while t.is_alive() and time.monotonic() < deadline:
+                t.join(timeout=min(10.0, max(0.1, deadline - time.monotonic())))
+                if not drain:
+                    break
+            if t.is_alive():
+                logger.warning(
+                    "event sink still draining after %.0fs; leaving the "
+                    "thread to finish (%d events queued)", timeout, len(self._queue))
+                return  # keep _thread set so start() cannot double-sink
             self._thread = None
         if drain and (t is None or not t.is_alive()):
             self.flush()  # manual mode, or a remainder after thread exit

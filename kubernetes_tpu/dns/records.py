@@ -92,27 +92,42 @@ class DNSRecordStore:
         if not headless:
             a[base] = [svc.cluster_ip]
         backend_ips: list[str] = []
+        pod_targets: list[str] = []
+        unnamed_backend = False  # ready address with no backing-pod name
         if eps is not None:
             for subset in eps.subsets:
                 for addr in subset.addresses:
                     if not addr.ip:
                         continue
                     backend_ips.append(addr.ip)
+                    if not addr.target_pod:
+                        unnamed_backend = True
                     # per-pod record: <pod>.<svc>.<ns>.svc.<zone> (the
                     # StatefulSet stable-identity path; hostname = the
                     # backing pod's name)
                     if addr.target_pod:
                         pod_name = addr.target_pod.rsplit("/", 1)[-1]
                         a.setdefault(f"{pod_name}.{base}", []).append(addr.ip)
+                        pod_targets.append(f"{pod_name}.{base}")
         if headless and backend_ips:
             a[base] = sorted(set(backend_ips))
         # SRV: _<portname>._<proto>.<base> -> (port, target). ClusterIP
-        # services target the service name; headless target per-pod names.
+        # services target the service name; headless services answer one
+        # SRV tuple per ready backend targeting the per-pod name (the
+        # reference skydns returns per-backend-pod SRV targets).
         for port in svc.ports:
             if not port.name:
                 continue
             sname = f"_{port.name}._{port.protocol.lower()}.{base}"
-            srv.setdefault(sname, []).append((port.port, base))
+            if headless and pod_targets:
+                for tgt in sorted(set(pod_targets)):
+                    srv.setdefault(sname, []).append((port.port, tgt))
+                if unnamed_backend:
+                    # manually-added (pod-less) backends stay reachable
+                    # through the base target, whose A record lists them
+                    srv[sname].append((port.port, base))
+            else:
+                srv.setdefault(sname, []).append((port.port, base))
         with self._mu:
             self._a_by_svc[key] = a
             self._srv_by_svc[key] = srv

@@ -92,7 +92,9 @@ class KubenetPlugin(NetworkPlugin):
             n = int(ip[len(prefix):])
         except ValueError:
             return False
-        if not 1 <= n <= 254:
+        # 2-254 only, matching setup_pod's lease range: .1 is the reserved
+        # cbr0 bridge address and must never be recorded as a pod lease
+        if not 2 <= n <= 254:
             return False
         self._leases[pod_key] = n
         self._in_use.add(n)

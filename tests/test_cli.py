@@ -1294,3 +1294,39 @@ def test_create_rbac_and_pdb_generators(cs):
     assert rc == 1 and "at least one" in out
     rc, out = run(cs, "create", "pdb", "p2", "--min-available", "1")
     assert rc == 1 and "--selector" in out
+
+
+def test_apply_prune_scoped_to_manifest_namespaces(cs, tmp_path):
+    """--prune only visits namespaces the manifests touched: an
+    apply-managed, selector-matching object in a namespace absent from
+    this apply set survives (the reference prunes per visited
+    namespace; delete is irreversible)."""
+    import yaml as _yaml
+
+    def cm_doc(name, ns):
+        return {"kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": ns,
+                             "labels": {"app": "web"}},
+                "data": {"k": name}}
+
+    both = tmp_path / "both.yaml"
+    both.write_text(_yaml.safe_dump_all(
+        [cm_doc("a", "default"), cm_doc("other", "ns2")]))
+    rc, _ = run(cs, "apply", "-f", str(both))
+    assert rc == 0
+
+    only_a = tmp_path / "only_a.yaml"
+    only_a.write_text(_yaml.safe_dump(cm_doc("a", "default")))
+    rc, out = run(cs, "apply", "-f", str(only_a), "--prune", "-l", "app=web")
+    assert rc == 0 and "pruned" not in out
+    assert cs.configmaps.get("other", "ns2").data == {"k": "other"}
+
+    # pruning still fires within a touched namespace
+    both2 = tmp_path / "both2.yaml"
+    both2.write_text(_yaml.safe_dump_all(
+        [cm_doc("a", "default"), cm_doc("b", "default")]))
+    rc, _ = run(cs, "apply", "-f", str(both2))
+    assert rc == 0
+    rc, out = run(cs, "apply", "-f", str(only_a), "--prune", "-l", "app=web")
+    assert rc == 0 and "configmaps/b pruned" in out
+    assert cs.configmaps.get("other", "ns2").data == {"k": "other"}

@@ -167,3 +167,22 @@ def test_malformed_datagrams_do_not_kill_the_server(cs):
             "10.96.0.10"]
     finally:
         server.stop()
+
+
+def test_headless_service_srv_targets_per_pod_names(cs):
+    """Headless SRV answers one tuple per ready backend targeting the
+    per-pod stable name (skydns returns per-backend-pod SRV targets for
+    headless services; ClusterIP services keep the service-name target)."""
+    cs.services.create(Service(
+        meta=ObjectMeta(name="db", namespace="default"),
+        selector={"app": "db"},
+        ports=[ServicePort(name="pg", port=5432, target_port=5432)],
+        cluster_ip="None",
+    ))
+    _mk_endpoints(cs, "db", [("10.1.0.5", "db-0"), ("10.1.0.6", "db-1")])
+    records = DNSRecordStore(cs)
+    records.start()
+    assert records.resolve("_pg._tcp.db.default.svc.cluster.local", "SRV") == [
+        (5432, "db-0.db.default.svc.cluster.local"),
+        (5432, "db-1.db.default.svc.cluster.local"),
+    ]

@@ -133,3 +133,18 @@ def test_cidr_arriving_after_first_probe_still_wins(cs):
     kubelet.tick()
     kubelet.tick()
     assert cs.pods.get("p1").status.pod_ip.startswith("10.201.1.")
+
+
+def test_adopt_rejects_bridge_and_out_of_range_octets():
+    """adopt() must only seed leases setup_pod could have handed out
+    (.2-.254): .1 is the reserved cbr0 bridge address and octet 0/255
+    are network/broadcast — recording any of them corrupts the lease
+    map on kubelet restart."""
+    from kubernetes_tpu.kubelet.network import KubenetPlugin
+
+    p = KubenetPlugin("n1", "10.200.9.0/24")
+    assert not p.adopt("default/p1", "10.200.9.1")   # bridge address
+    assert not p.adopt("default/p1", "10.200.9.0")
+    assert not p.adopt("default/p1", "10.200.9.255")
+    assert p.adopt("default/p1", "10.200.9.2")
+    assert p.pod_ip("default/p1") == "10.200.9.2"

@@ -144,3 +144,30 @@ def test_dedup_cache_is_lru_not_fifo():
     combined = [e for e in hot if e.message.startswith("(combined")]
     assert len(plain) == 1 and plain[0].count == 10
     assert len(combined) == 1 and combined[0].count == 15
+
+
+def test_stop_bounded_when_sink_wedges():
+    """stop(drain=True) must not hang forever when the sink wedges inside
+    _write (e.g. a blocked clientset/store): the wait is bounded, the
+    thread is left draining, and _thread stays set so start() cannot
+    double-sink."""
+    import threading
+    import time as _time
+
+    cs, b = make()
+    release = threading.Event()
+    b._write = lambda decision: release.wait()
+    b.start()
+    b.recorder().event(pod("p1"), "Normal", "Scheduled", "assigned to n1")
+    t0 = _time.monotonic()
+    b.stop(drain=True, timeout=0.5)
+    assert _time.monotonic() - t0 < 5.0
+    assert b._thread is not None  # still draining; double-sink guard intact
+    release.set()
+    b._thread.join(timeout=5)
+    assert not b._thread.is_alive()
+    # a dead thread is not a running sink, and start() can resume past it
+    assert not b.running
+    b.start()
+    assert b.running
+    b.stop(drain=False)
