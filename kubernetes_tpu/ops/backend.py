@@ -86,31 +86,60 @@ class TPUBatchBackend:
         # and the Pallas scan runs to the REAL pod count, not the pad.
         max_segment_pods: int = 65536,
         kernel_impl: str = "auto",  # auto | pallas | xla
+        # Per-SHAPE failure tolerance: a shape (≡ one compilation unit,
+        # pallas_kernel.shape_key) that fails this many times stops being
+        # tried; below it, later segments of the same shape retry — a
+        # transient Mosaic failure must not permanently downgrade the
+        # whole process to the XLA scan (r3 VERDICT Weak #5)
+        pallas_max_failures: int = 2,
     ):
         self.algorithm = algorithm or GenericScheduler()
         self.tensorizer = tensorizer or Tensorizer()
         self.max_segment_pods = max_segment_pods
         self.kernel_impl = kernel_impl
-        self._pallas_failed = False
-        self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0, "pallas_segments": 0}
+        self.pallas_max_failures = pallas_max_failures
+        self._pallas_fail_counts: dict[tuple, int] = {}
+        # wired to scheduler_pallas_fallback_total by Scheduler.__init__
+        self.fallback_counter = None
+        self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0,
+                      "pallas_segments": 0, "pallas_fallbacks": 0}
 
     def _use_pallas(self, static) -> bool:
         """Fused Pallas kernel on real TPU; XLA scan everywhere else (CPU
-        tests, unsupported shapes), after any runtime failure, or when the
-        PallasKernels feature gate is off."""
-        if self.kernel_impl == "xla" or self._pallas_failed:
+        tests, unsupported shapes), for shapes whose failure budget is
+        exhausted, or when the PallasKernels feature gate is off."""
+        if self.kernel_impl == "xla":
             return False
         from ..utils.features import DEFAULT_FEATURE_GATES
 
         if not DEFAULT_FEATURE_GATES.enabled("PallasKernels"):
             return False
-        from .pallas_kernel import supports_pallas
+        from .pallas_kernel import shape_key, supports_pallas
 
         if not supports_pallas(static):
+            return False
+        if self._pallas_fail_counts.get(shape_key(static), 0) >= self.pallas_max_failures:
             return False
         if self.kernel_impl == "pallas":
             return True
         return _device_platform() == "tpu"
+
+    def _note_pallas_failure(self, static) -> None:
+        """Record one dispatch/finalize failure: count it against the
+        shape's retry budget, bump the fallback counter, and log whether
+        the shape will be retried or is now blacklisted."""
+        from .pallas_kernel import shape_key
+
+        key = shape_key(static)
+        n = self._pallas_fail_counts.get(key, 0) + 1
+        self._pallas_fail_counts[key] = n
+        self.stats["pallas_fallbacks"] += 1
+        if self.fallback_counter is not None:
+            self.fallback_counter.inc()
+        logger.warning(
+            "pallas fallback #%d for shape %s — %s", n, key,
+            "shape blacklisted" if n >= self.pallas_max_failures
+            else "will retry on the next segment of this shape")
 
     # -- greedy segmentation ------------------------------------------------
     def _segments(
@@ -308,7 +337,7 @@ class TPUBatchBackend:
                     # same fallback contract as the run-time path
                     logger.exception(
                         "pallas dispatch failed; falling back to XLA scan")
-                    self._pallas_failed = True
+                    self._note_pallas_failure(static)
                     use_pallas = False
             if not use_pallas:
                 from .batch_kernel import dispatch_batch_arrays
@@ -326,7 +355,7 @@ class TPUBatchBackend:
                     except Exception:
                         logger.exception(
                             "pallas kernel failed; falling back to XLA scan")
-                        self._pallas_failed = True
+                        self._note_pallas_failure(static)
                         chosen, final_rr = schedule_batch_arrays(static, init)
                 else:
                     from .batch_kernel import finalize_batch_arrays

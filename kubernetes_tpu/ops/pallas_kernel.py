@@ -91,17 +91,24 @@ def supports_pallas(static: BatchStatic) -> bool:
     )
 
 
+def _pod_pad(p_real: int) -> int:
+    """Power-of-two pod-count buckets (same policy as batch_xs): tails of
+    different runs land in the same bucket, so the warm-up compile covers
+    them.  Shared by ``_pack`` and ``shape_key`` — the fallback blacklist
+    must bucket exactly as the compile cache does."""
+    p_pad = 128
+    while p_pad < p_real:
+        p_pad *= 2
+    return p_pad
+
+
 def _pack(static: BatchStatic, init: InitialState):
     """numpy host prep: transposes, one-hot-matmul layouts, bit packing."""
     n = static.n_pad
     g = static.static_ok.shape[0]
     t = static.term_matches_sig.shape[0]
     p_real = len(static.group_of_pod)
-    # power-of-two buckets (same policy as batch_xs): tails of different
-    # runs land in the same bucket, so the warm-up compile covers them
-    p_pad = 128
-    while p_pad < p_real:
-        p_pad *= 2
+    p_pad = _pod_pad(p_real)
     w = static.pod_vol_ids.shape[1]
 
     gids = np.zeros(p_pad, dtype=np.int32)
@@ -592,6 +599,28 @@ def schedule_batch_pallas(static: BatchStatic, init: InitialState):
     """Drop-in replacement for ``schedule_batch_arrays`` on TPU."""
     chosen2d, rr = dispatch_batch_pallas(static, init)
     return finalize_batch_pallas(static, chosen2d, rr)
+
+
+def shape_key(static: BatchStatic) -> tuple:
+    """The compiled-program identity for ``static`` — the same key
+    ``_pallas_runner`` caches compiles on (dims + weights + structure
+    flags), so a fallback-blacklist entry maps 1:1 to one compilation
+    unit (backend.py's per-shape fallback: one bad shape must not take
+    every other shape off the Pallas path)."""
+    return (
+        static.n_pad,
+        static.static_ok.shape[0],
+        static.term_matches_sig.shape[0],
+        static.g_ports.shape[1],
+        static.v_state,
+        static.node_alloc.shape[1],
+        static.pod_vol_ids.shape[1],
+        _pod_pad(len(static.group_of_pod)),
+        int(static.num_zones),
+        tuple(int(static.weights.get(kk, 0)) for kk in WEIGHT_KEYS),
+        bool(static.terms),
+        bool(static.use_vols),
+    )
 
 
 def dispatch_batch_pallas(static: BatchStatic, init: InitialState):

@@ -66,6 +66,9 @@ class Scheduler:
         self.queue = SchedulingQueue(clock=clock)
         self.backoff = PodBackoff(clock=clock)
         self.metrics = SchedulerMetrics()
+        if backend is not None and hasattr(backend, "fallback_counter"):
+            # kernel fallbacks surface in this scheduler's metrics registry
+            backend.fallback_counter = self.metrics.pallas_fallback_total
         self.emit_events = emit_events
         self.enable_preemption = enable_preemption
         self._clock = clock
@@ -368,6 +371,13 @@ class Scheduler:
             self.cache.finish_binding_many(finished)
             totals["committed"] += len(finished)
             totals["attempted_binds"] += len(to_bind)
+            # per-segment e2e SLI: pods committed in segment s of S were
+            # bound NOW, at this point of the drain — not at batch end.
+            # One observe_many per segment keeps p50/p99 distinct without
+            # per-pod lock rounds (the reference's three SLIs are per-pod
+            # for exactly this reason, metrics/metrics.go:26-50)
+            self.metrics.e2e_scheduling_latency.observe_many(
+                (self._clock() - start) * 1e6, len(to_bind))
 
         try:
             start = self._clock()
@@ -386,8 +396,6 @@ class Scheduler:
                 (self._clock() - algo_start) * 1e6)
             self.metrics.schedule_attempts.inc(len(pods))
             bound, failed = totals["bound"], totals["failed"]
-            self.metrics.e2e_scheduling_latency.observe_many(
-                (self._clock() - start) * 1e6, totals["attempted_binds"])
         finally:
             if gc_was_enabled:
                 _gc.enable()

@@ -159,3 +159,7 @@ class SchedulerMetrics:
         self.batch_device_latency = r.register(
             Histogram("scheduler_batch_device_latency_microseconds")
         )
+        self.pallas_fallback_total = r.register(Counter(
+            "scheduler_pallas_fallback_total",
+            "pallas dispatch/finalize failures that fell back to the XLA scan",
+        ))

@@ -336,3 +336,15 @@ def test_ha_controller_manager_failover():
         assert len(pods) == 70, f"standby reconciled to {len(pods)}, want 70"
     finally:
         server.stop()
+
+
+@pytest.mark.timeout(2)
+def test_conftest_timeout_watchdog_enforces(monkeypatch):
+    """The timeout mark must be load-bearing (pytest-timeout is absent;
+    the conftest SIGALRM watchdog implements it).  A test body that
+    sleeps past its deadline fails with TimeoutError instead of hanging."""
+    import time as _time
+
+    with pytest.raises(TimeoutError, match="deadline"):
+        # the watchdog fires mid-sleep; 10s would otherwise blow the mark
+        _time.sleep(10)

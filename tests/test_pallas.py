@@ -167,7 +167,7 @@ def test_pallas_dispatch_failure_falls_back_to_xla(monkeypatch):
     committed = []
     got = backend.schedule_batch(
         pods, m, pctx, on_segment=lambda entries: committed.extend(entries))
-    assert backend._pallas_failed  # memoized: no retry storm
+    assert backend.stats["pallas_fallbacks"] >= 1  # failure recorded
     assert backend.stats["pallas_segments"] == 0
     assert backend.stats["kernel_pods"] == len(pods)  # XLA scan served it
     # streamed commits cover every pod exactly once, in pod order
@@ -175,3 +175,82 @@ def test_pallas_dispatch_failure_falls_back_to_xla(monkeypatch):
     # and the bindings still match the sequential oracle
     want = oracle_batch(pods, m, pctx, GenericScheduler())
     assert [n for _, n in committed] == want
+
+
+def test_pallas_one_shot_failure_recovers_next_segment(interpret_pallas, monkeypatch):
+    """A TRANSIENT dispatch failure must not latch the whole process off
+    the Pallas path (r3 VERDICT Weak #5): the failed segment falls back
+    to the XLA scan, the fallback counter ticks, and the NEXT segment of
+    the same shape runs on Pallas again — with oracle-identical bindings
+    throughout."""
+    from kubernetes_tpu.ops.backend import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, PriorityContext
+    from kubernetes_tpu.utils.metrics import Counter
+
+    from tests.test_parity import build_cluster, make_batch, oracle_batch
+
+    calls = {"n": 0}
+    orig = pk.dispatch_batch_pallas
+
+    def one_shot_boom(static, init):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected transient Mosaic failure")
+        return orig(static, init)
+
+    monkeypatch.setattr(pk, "dispatch_batch_pallas", one_shot_boom)
+
+    rng = random.Random(5)
+    m = build_cluster(rng, 20, zones=2)
+    pods = make_batch(rng, 96)
+    algo = GenericScheduler()
+    # small segment cap -> several segments of the SAME shape bucket
+    backend = TPUBatchBackend(algorithm=algo, kernel_impl="pallas",
+                              max_segment_pods=32)
+    counter = Counter("scheduler_pallas_fallback_total")
+    backend.fallback_counter = counter
+    committed = []
+    backend.schedule_batch(pods, m, pctx := PriorityContext(m),
+                           on_segment=lambda e: committed.extend(e))
+    assert backend.stats["segments"] >= 3
+    assert backend.stats["pallas_fallbacks"] == 1
+    assert counter.value == 1
+    # recovery: later segments ran on pallas (dispatch called again)
+    assert backend.stats["pallas_segments"] >= 1
+    assert calls["n"] >= 2
+    # parity survives the mid-batch fallback
+    want = oracle_batch(pods, m, PriorityContext(m), GenericScheduler())
+    assert [n for _, n in committed] == want
+
+
+def test_pallas_shape_blacklist_after_repeated_failures(interpret_pallas, monkeypatch):
+    """A shape that keeps failing exhausts its retry budget
+    (pallas_max_failures) and stops being dispatched — no retry storm —
+    while the XLA scan keeps serving every segment with correct
+    bindings."""
+    from kubernetes_tpu.ops.backend import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, PriorityContext
+
+    from tests.test_parity import build_cluster, make_batch
+
+    calls = {"n": 0}
+
+    def always_boom(static, init):
+        calls["n"] += 1
+        raise RuntimeError("injected deterministic Mosaic failure")
+
+    monkeypatch.setattr(pk, "dispatch_batch_pallas", always_boom)
+
+    rng = random.Random(6)
+    m = build_cluster(rng, 20, zones=2)
+    pods = make_batch(rng, 128)
+    backend = TPUBatchBackend(algorithm=GenericScheduler(),
+                              kernel_impl="pallas", max_segment_pods=32,
+                              pallas_max_failures=2)
+    backend.schedule_batch(pods, m, PriorityContext(m))
+    assert backend.stats["segments"] >= 4
+    # dispatched exactly pallas_max_failures times for the (single) shape,
+    # then blacklisted — every further segment skipped pallas entirely
+    assert calls["n"] == 2
+    assert backend.stats["pallas_fallbacks"] == 2
+    assert backend.stats["kernel_pods"] == len(pods)

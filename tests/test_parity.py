@@ -585,3 +585,22 @@ def test_parity_large_randomized_with_affinity_and_volumes():
             pods.append(make_pod(f"plain-{i}", **t))
     backend = assert_parity(pods, m, pctx)
     _assert_all_kernel(backend, 300)
+
+
+def test_prefix_parity_gate_small_scale():
+    """bench.run_prefix_parity: the oracle replaying the first k pods of
+    the batch's recorded drain order matches the kernel's first k
+    assignments exactly (prefix-closure of sequential greedy)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import bench
+
+    backend_res = bench.run_once(
+        80, 600, use_backend=True, workload="mixed", seed=3)
+    assert len(backend_res["batch_order"]) == 600
+    gate = bench.run_prefix_parity(
+        backend_res, 80, 600, workload="mixed", seed=3, k=150)
+    assert gate["checked"] == 150
+    assert gate["mismatches"] == 0, gate["sample"]
