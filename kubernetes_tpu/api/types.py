@@ -135,15 +135,27 @@ class Probe:
     period_seconds: int = 10
     failure_threshold: int = 3
     success_threshold: int = 1
+    # exec handler's command (``ExecAction.Command``): when set and the
+    # node runs real containers, the prober runs it via CRI ExecSync and
+    # judges by exit code (``prober/prober.go:80 runProbe``)
+    exec_command: list[str] = field(default_factory=list)
+    # the reference's Probe.TimeoutSeconds (default 1): a hung probe
+    # command is a FAILURE after this bound, never an unbounded wait
+    timeout_seconds: int = 1
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "handler": self.handler,
             "initialDelaySeconds": self.initial_delay_seconds,
             "periodSeconds": self.period_seconds,
             "failureThreshold": self.failure_threshold,
             "successThreshold": self.success_threshold,
         }
+        if self.exec_command:
+            d["execCommand"] = list(self.exec_command)
+        if self.timeout_seconds != 1:
+            d["timeoutSeconds"] = self.timeout_seconds
+        return d
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> Optional["Probe"]:
@@ -155,7 +167,29 @@ class Probe:
             period_seconds=int(d.get("periodSeconds", 10)),
             failure_threshold=int(d.get("failureThreshold", 3)),
             success_threshold=int(d.get("successThreshold", 1)),
+            exec_command=list(d.get("execCommand") or []),
+            timeout_seconds=int(d.get("timeoutSeconds", 1)),
         )
+
+
+@dataclass
+class VolumeMount:
+    """``VolumeMount``: where a pod volume appears in the container's
+    rootfs (``pkg/api/types.go`` VolumeMount; materialized under the
+    container's rootfs dir by the real-container runtime)."""
+
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "mountPath": self.mount_path,
+                "readOnly": self.read_only}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VolumeMount":
+        return cls(name=d.get("name", ""), mount_path=d.get("mountPath", ""),
+                   read_only=bool(d.get("readOnly", False)))
 
 
 @dataclass
@@ -170,6 +204,11 @@ class Container:
     image_pull_policy: str = ""  # "" | Always | IfNotPresent | Never
     privileged: bool = False  # securityContext.privileged essential
     run_as_user: Optional[int] = None  # securityContext.runAsUser (PSP ranges)
+    # entrypoint (``Container.Command``/``Args`` collapsed): the real-
+    # container runtime execs this; empty = the image's default (a pause
+    # style sleep at this framework's depth)
+    command: list[str] = field(default_factory=list)
+    volume_mounts: list[VolumeMount] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         d = {
@@ -178,6 +217,10 @@ class Container:
             "resources": self.resources.to_dict(),
             "ports": [p.to_dict() for p in self.ports],
         }
+        if self.command:
+            d["command"] = list(self.command)
+        if self.volume_mounts:
+            d["volumeMounts"] = [m.to_dict() for m in self.volume_mounts]
         if self.liveness_probe:
             d["livenessProbe"] = self.liveness_probe.to_dict()
         if self.readiness_probe:
@@ -208,6 +251,9 @@ class Container:
             image_pull_policy=d.get("imagePullPolicy", ""),
             privileged=bool((d.get("securityContext") or {}).get("privileged")),
             run_as_user=(d.get("securityContext") or {}).get("runAsUser"),
+            command=list(d.get("command") or []),
+            volume_mounts=[VolumeMount.from_dict(m)
+                           for m in d.get("volumeMounts") or []],
         )
 
 
@@ -229,9 +275,16 @@ class Volume:
     pvc_name: str = ""
     secret_name: str = ""  # secret-backed volume (kubelet mounts, node authz)
     config_map_name: str = ""
+    # local volume types the real-container kubelet materializes on disk
+    # (reference ``pkg/volume/{empty_dir,host_path,downwardapi}``)
+    empty_dir: bool = False
+    host_path: str = ""
+    # downwardAPI: file name -> fieldRef path ("metadata.name",
+    # "metadata.namespace", "metadata.labels['k']", "metadata.annotations['k']")
+    downward_api: dict[str, str] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name,
             "diskID": self.disk_id,
             "diskKind": self.disk_kind,
@@ -240,6 +293,13 @@ class Volume:
             "secretName": self.secret_name,
             "configMapName": self.config_map_name,
         }
+        if self.empty_dir:
+            d["emptyDir"] = True
+        if self.host_path:
+            d["hostPath"] = self.host_path
+        if self.downward_api:
+            d["downwardAPI"] = dict(self.downward_api)
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Volume":
@@ -251,6 +311,9 @@ class Volume:
             pvc_name=d.get("pvcName", ""),
             secret_name=d.get("secretName", ""),
             config_map_name=d.get("configMapName", ""),
+            empty_dir=bool(d.get("emptyDir", False)),
+            host_path=d.get("hostPath", ""),
+            downward_api=dict(d.get("downwardAPI") or {}),
         )
 
 
@@ -496,9 +559,12 @@ class ContainerStatus:
     restart_count: int = 0
     exit_code: int = 0
     reason: str = ""
+    # runtime handle ("pid://<n>" under the real-container runtime) —
+    # the reference's containerID ("docker://<hash>")
+    container_id: str = ""
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name,
             "state": self.state,
             "ready": self.ready,
@@ -506,6 +572,9 @@ class ContainerStatus:
             "exitCode": self.exit_code,
             "reason": self.reason,
         }
+        if self.container_id:
+            d["containerID"] = self.container_id
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "ContainerStatus":
@@ -516,6 +585,7 @@ class ContainerStatus:
             restart_count=int(d.get("restartCount", 0)),
             exit_code=int(d.get("exitCode", 0)),
             reason=d.get("reason", ""),
+            container_id=d.get("containerID", ""),
         )
 
 

@@ -30,6 +30,9 @@ def main(argv=None) -> int:
     ap.add_argument("--memory", default="16Gi")
     ap.add_argument("--serve-logs", action="store_true",
                     help="expose the kubelet read API (logs/pods/healthz)")
+    ap.add_argument("--real-containers", action="store_true",
+                    help="run containers as real child processes with "
+                    "on-disk volumes (single-node depth; not for fleets)")
     ap.add_argument("--feature-gates", default="",
                     help="A=true,B=false (e.g. DynamicKubeletConfig=true)")
     args = ap.parse_args(argv)
@@ -52,7 +55,8 @@ def main(argv=None) -> int:
         tick = fleet.tick_all
     else:
         k = HollowKubelet(cs, args.name, cpu=args.cpu, memory=args.memory,
-                          serve=args.serve_logs)
+                          serve=args.serve_logs,
+                          real_containers=args.real_containers)
         k.register()
         kubelets = [k]
         tick = k.tick

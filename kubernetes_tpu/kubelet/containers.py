@@ -1,0 +1,220 @@
+"""Real containers: one child process per container, under the pod's
+pause sandbox.
+
+Capability of the reference's runtime manager + dockershim slice that is
+feasible on one unprivileged machine (``pkg/kubelet/kuberuntime/
+kuberuntime_manager.go:530 SyncPod`` computing container actions;
+``pkg/kubelet/dockershim`` running them):
+
+- **create/start** — each container is a REAL forked child
+  (``/bin/sh -c <command>``) with the container's env, its own rootfs
+  directory (where volume mounts materialize, see ``volumehost.py``),
+  and stdout/stderr appended to a per-container log file;
+- **stop** — TERM, bounded wait, KILL (the runtime's graceful-stop
+  contract);
+- **exec_sync** — runs a command in the container's context (rootfs cwd
+  + env), the CRI ``ExecSync`` the prober and ``kubectl exec`` ride
+  (``prober/prober.go:80`` judges by exit code);
+- **poll** — observed state from the kernel (``waitpid``), so an
+  out-of-band ``kill -9`` surfaces as a container death the next sync,
+  exactly like the PLEG discovering a dead container in a relist.
+
+There is no namespace/cgroup isolation here (unprivileged box); what IS
+real: pids, the process tree, exit codes, signals, the filesystem, and
+exec.  The pod's pause process (``csrc/pause.c``) still anchors the
+sandbox; containers are tracked per sandbox and die with it.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+# default entrypoint: a quiet long sleep (the "image default" — pause-like)
+_DEFAULT_COMMAND = ["/bin/sh", "-c", "exec sleep 1000000"]
+
+
+class ProcessContainerManager:
+    """Real child processes playing the container role (one per
+    (pod, container)); rootfs dirs under a private temp root."""
+
+    def __init__(self, root: Optional[str] = None):
+        self._own_root = root is None
+        self.root = root or tempfile.mkdtemp(prefix="ktpu-containers-")
+        self._mu = threading.Lock()
+        # (pod_key, name) -> {"proc": Popen, "rootfs": str, "env": dict,
+        #                     "log": str, "command": list}
+        self._ctrs: dict[tuple[str, str], dict] = {}
+        import atexit
+
+        atexit.register(self.remove_all)
+
+    # -- paths ---------------------------------------------------------------
+    def pod_dir(self, pod_key: str) -> str:
+        return os.path.join(self.root, pod_key.replace("/", "_"))
+
+    def rootfs(self, pod_key: str, name: str) -> str:
+        return os.path.join(self.pod_dir(pod_key), "containers", name, "rootfs")
+
+    def log_path(self, pod_key: str, name: str) -> str:
+        return os.path.join(self.pod_dir(pod_key), "containers", name, "log")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, pod_key: str, name: str, command: Optional[list[str]] = None,
+              env: Optional[dict] = None) -> int:
+        """CreateContainer + StartContainer: fork the real child; returns
+        its pid.  A container already alive under this identity is left
+        running (idempotent sync)."""
+        with self._mu:
+            cur = self._ctrs.get((pod_key, name))
+            if cur is not None and cur["proc"].poll() is None:
+                return cur["proc"].pid
+            rootfs = self.rootfs(pod_key, name)
+            os.makedirs(rootfs, exist_ok=True)
+            log = self.log_path(pod_key, name)
+            cmd = list(command) if command else list(_DEFAULT_COMMAND)
+            full_env = dict(os.environ)
+            full_env.update(env or {})
+            full_env["KTPU_POD"] = pod_key
+            full_env["KTPU_CONTAINER"] = name
+            full_env["KTPU_ROOTFS"] = rootfs
+            logf = open(log, "ab", buffering=0)
+            try:
+                try:
+                    proc = subprocess.Popen(
+                        cmd, cwd=rootfs, env=full_env,
+                        stdout=logf, stderr=logf,
+                        stdin=subprocess.DEVNULL,
+                        start_new_session=True,  # own pgid: stop() signals the tree
+                    )
+                except OSError as e:
+                    # an unrunnable entrypoint must not abort the caller's
+                    # sync sweep (reference: CreateContainerError feeding
+                    # CrashLoopBackOff).  A real child that exits 127
+                    # keeps every downstream path uniform: the death is
+                    # kernel-observed, restart policy cycles it, the
+                    # error is in the log.
+                    logf.write(f"spawn failed: {e}\n".encode())
+                    proc = subprocess.Popen(
+                        ["/bin/sh", "-c", "exit 127"], cwd=rootfs,
+                        env=full_env, stdout=logf, stderr=logf,
+                        stdin=subprocess.DEVNULL, start_new_session=True,
+                    )
+            finally:
+                logf.close()  # the child holds its own fd now
+            self._ctrs[(pod_key, name)] = {
+                "proc": proc, "rootfs": rootfs, "env": dict(env or {}),
+                "log": log, "command": cmd,
+            }
+            return proc.pid
+
+    def pid(self, pod_key: str, name: str) -> Optional[int]:
+        with self._mu:
+            c = self._ctrs.get((pod_key, name))
+            return None if c is None else c["proc"].pid
+
+    def alive(self, pod_key: str, name: str) -> bool:
+        with self._mu:
+            c = self._ctrs.get((pod_key, name))
+            return c is not None and c["proc"].poll() is None
+
+    def exit_code(self, pod_key: str, name: str) -> Optional[int]:
+        """None while running (or unknown); the real wait status once
+        dead.  A kill by signal N reports 128+N like a shell would."""
+        with self._mu:
+            c = self._ctrs.get((pod_key, name))
+            if c is None:
+                return None
+            rc = c["proc"].poll()
+            if rc is None:
+                return None
+            return 128 - rc if rc < 0 else rc
+
+    def stop(self, pod_key: str, name: str, timeout: float = 5.0) -> None:
+        with self._mu:
+            c = self._ctrs.get((pod_key, name))
+        if c is None:
+            return
+        proc = c["proc"]
+        if proc.poll() is None:
+            try:  # signal the whole process group (shell + children)
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                proc.terminate()
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                try:
+                    proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    pass  # D-state straggler; never block the sweep
+
+    def remove(self, pod_key: str, name: str) -> None:
+        self.stop(pod_key, name)
+        with self._mu:
+            self._ctrs.pop((pod_key, name), None)
+
+    def remove_pod(self, pod_key: str) -> None:
+        with self._mu:
+            names = [n for (k, n) in self._ctrs if k == pod_key]
+        for n in names:
+            self.remove(pod_key, n)
+        shutil.rmtree(self.pod_dir(pod_key), ignore_errors=True)
+
+    def remove_all(self) -> None:
+        with self._mu:
+            keys = list(self._ctrs)
+        for k, n in keys:
+            self.remove(k, n)
+        if self._own_root:
+            shutil.rmtree(self.root, ignore_errors=True)
+
+    def known_pods(self) -> set[str]:
+        with self._mu:
+            return {k for (k, _) in self._ctrs}
+
+    # -- exec ---------------------------------------------------------------
+    def exec_sync(self, pod_key: str, name: str, command: list[str],
+                  timeout: float = 10.0) -> tuple[str, int]:
+        """CRI ExecSync: run ``command`` in the container's context
+        (rootfs cwd, container env).  Like the reference, exec into a
+        dead container is an error (ValueError -> the server's 4xx)."""
+        with self._mu:
+            c = self._ctrs.get((pod_key, name))
+            if c is None or c["proc"].poll() is not None:
+                raise ValueError(f"container {pod_key}/{name} is not running")
+            rootfs, env = c["rootfs"], dict(c["env"])
+        full_env = dict(os.environ)
+        full_env.update(env)
+        full_env["KTPU_POD"] = pod_key
+        full_env["KTPU_CONTAINER"] = name
+        full_env["KTPU_ROOTFS"] = rootfs
+        try:
+            res = subprocess.run(
+                command, cwd=rootfs, env=full_env, stdin=subprocess.DEVNULL,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            return ("exec timed out", 124)
+        except (FileNotFoundError, PermissionError) as e:
+            return (str(e), 126)
+        return (res.stdout.decode(errors="replace"), res.returncode)
+
+    def read_log(self, pod_key: str, name: str) -> Optional[list[str]]:
+        path = self.log_path(pod_key, name)
+        try:
+            with open(path, "rb") as f:
+                text = f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return None
+        return text.splitlines()

@@ -30,7 +30,9 @@ class RuntimeService:
     def stop_pod_sandbox(self, sandbox_id: str) -> None:
         raise NotImplementedError
 
-    def create_container(self, sandbox_id: str, name: str, image: str) -> str:
+    def create_container(self, sandbox_id: str, name: str, image: str,
+                         command: Optional[list[str]] = None,
+                         env: Optional[dict] = None) -> str:
         raise NotImplementedError
 
     def start_container(self, container_id: str) -> None:
@@ -61,13 +63,20 @@ class ImageService:
 
 class LocalCRI(RuntimeService, ImageService):
     """In-process runtime over FakeRuntime state (+ real pause processes
-    when a sandbox manager is supplied) — the dockershim of this stack."""
+    when a sandbox manager is supplied, + REAL container processes when a
+    ProcessContainerManager is supplied) — the dockershim of this stack.
 
-    def __init__(self, runtime=None, sandboxes=None):
+    With ``processes`` set, CreateContainer records the spec,
+    StartContainer forks the actual child (fork/exec), StopContainer
+    signals it, ExecSync runs a real command in its context, and
+    ListContainers reports kernel-observed state + pid."""
+
+    def __init__(self, runtime=None, sandboxes=None, processes=None):
         from .runtime import FakeRuntime
 
         self.runtime = runtime or FakeRuntime()
         self.sandboxes = sandboxes  # ProcessSandboxManager | None
+        self.processes = processes  # ProcessContainerManager | None
         self._mu = threading.Lock()
         self._containers: dict[str, dict] = {}  # id -> {sandbox,name,image,state}
         self._images: set[str] = set()
@@ -85,20 +94,30 @@ class LocalCRI(RuntimeService, ImageService):
             return pod_key  # sandbox id IS the pod key at this depth
 
     def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        # signal/wait OUTSIDE the lock: a container trapping SIGTERM can
+        # hold the graceful-stop wait for seconds, and every other CRI
+        # RPC serializes on _mu
+        if self.sandboxes is not None:
+            self.sandboxes.remove(sandbox_id)
+        if self.processes is not None:
+            # containers die with their sandbox (kuberuntime stops
+            # workload containers before the sandbox)
+            self.processes.remove_pod(sandbox_id)
         with self._mu:
-            if self.sandboxes is not None:
-                self.sandboxes.remove(sandbox_id)
             for cid, c in list(self._containers.items()):
                 if c["sandbox"] == sandbox_id:
                     c["state"] = "exited"
 
-    def create_container(self, sandbox_id: str, name: str, image: str) -> str:
+    def create_container(self, sandbox_id: str, name: str, image: str,
+                         command=None, env=None) -> str:
         with self._mu:
             if image not in self._images:
                 raise ValueError(f"image {image!r} not pulled")
             cid = self._new_id("ctr")
             self._containers[cid] = {"sandbox": sandbox_id, "name": name,
-                                     "image": image, "state": "created"}
+                                     "image": image, "state": "created",
+                                     "command": list(command or []),
+                                     "env": dict(env or {})}
             return cid
 
     def start_container(self, container_id: str) -> None:
@@ -106,9 +125,19 @@ class LocalCRI(RuntimeService, ImageService):
             c = self._containers.get(container_id)
             if c is None or c["state"] == "exited":
                 raise ValueError(f"cannot start {container_id}")
+            if self.processes is not None:
+                pid = self.processes.start(
+                    c["sandbox"], c["name"],
+                    command=c["command"] or None, env=c["env"])
+                c["pid"] = pid
             c["state"] = "running"
 
     def stop_container(self, container_id: str) -> None:
+        with self._mu:
+            c = self._containers.get(container_id)
+            ident = None if c is None else (c["sandbox"], c["name"])
+        if ident is not None and self.processes is not None:
+            self.processes.stop(*ident)  # TERM/KILL wait outside the lock
         with self._mu:
             c = self._containers.get(container_id)
             if c is not None:
@@ -116,10 +145,23 @@ class LocalCRI(RuntimeService, ImageService):
 
     def list_containers(self, sandbox_id=None) -> list[dict]:
         with self._mu:
-            return [
-                {"id": cid, **c} for cid, c in self._containers.items()
-                if sandbox_id is None or c["sandbox"] == sandbox_id
-            ]
+            out = []
+            for cid, c in self._containers.items():
+                if sandbox_id is not None and c["sandbox"] != sandbox_id:
+                    continue
+                entry = {"id": cid, **c}
+                if self.processes is not None and c["state"] == "running":
+                    # kernel truth outranks the ledger: a dead process IS
+                    # an exited container, however it died.  The exit code
+                    # persists in the ledger so pollers that miss the
+                    # transition still learn it.
+                    if not self.processes.alive(c["sandbox"], c["name"]):
+                        c["state"] = "exited"
+                        c["exitCode"] = self.processes.exit_code(
+                            c["sandbox"], c["name"])
+                        entry = {"id": cid, **c}
+                out.append(entry)
+            return out
 
     def exec_sync(self, container_id: str, command: list[str]) -> tuple[str, int]:
         with self._mu:
@@ -127,6 +169,8 @@ class LocalCRI(RuntimeService, ImageService):
             if c is None or c["state"] != "running":
                 raise ValueError(f"container {container_id} not running")
             sandbox, name = c["sandbox"], c["name"]
+        if self.processes is not None:
+            return self.processes.exec_sync(sandbox, name, command)
         return self.runtime.exec(sandbox, name, command)
 
     # -- ImageService ------------------------------------------------------
@@ -147,7 +191,8 @@ class LocalCRI(RuntimeService, ImageService):
 _METHODS = {
     "RunPodSandbox": ("run_pod_sandbox", ["pod_key"]),
     "StopPodSandbox": ("stop_pod_sandbox", ["sandbox_id"]),
-    "CreateContainer": ("create_container", ["sandbox_id", "name", "image"]),
+    "CreateContainer": ("create_container", ["sandbox_id", "name", "image",
+                                             "command", "env"]),
     "StartContainer": ("start_container", ["container_id"]),
     "StopContainer": ("stop_container", ["container_id"]),
     "ListContainers": ("list_containers", ["sandbox_id"]),
@@ -244,9 +289,9 @@ class RemoteCRI(RuntimeService, ImageService):
     def stop_pod_sandbox(self, sandbox_id):
         return self._call("StopPodSandbox", sandbox_id=sandbox_id)
 
-    def create_container(self, sandbox_id, name, image):
+    def create_container(self, sandbox_id, name, image, command=None, env=None):
         return self._call("CreateContainer", sandbox_id=sandbox_id,
-                          name=name, image=image)
+                          name=name, image=image, command=command, env=env)
 
     def start_container(self, container_id):
         return self._call("StartContainer", container_id=container_id)

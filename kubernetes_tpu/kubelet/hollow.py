@@ -47,6 +47,7 @@ class HollowKubelet:
         serve: bool = False,
         mount_latency: float = 0.0,
         real_sandboxes: bool = False,
+        real_containers: bool = False,
         system_reserved_cpu: str = "0",
         system_reserved_memory: str = "0",
         kube_reserved_cpu: str = "0",
@@ -69,16 +70,36 @@ class HollowKubelet:
         # probe / restart / eviction machinery (pkg/kubelet prober +
         # eviction manager over a scriptable fake runtime)
         self.runtime = runtime or FakeRuntime()
-        self.pod_manager = PodRuntimeManager(self.runtime, clock)
         # optional REAL per-pod sandbox processes (csrc/pause.c, the
         # reference's pause container): a pause process runs exactly
         # while the pod is Running; teardown on termination or removal
         self.sandboxes = None
-        if real_sandboxes:
+        if real_sandboxes or real_containers:
             from .runtime import ProcessSandboxManager
 
             mgr = ProcessSandboxManager()
             self.sandboxes = mgr if mgr.enabled else None
+        # optional REAL containers: forked child processes with on-disk
+        # volumes (kubelet/containers.py + volumehost.py) — exec, logs
+        # and cp then operate on actual processes/files
+        self.containers = None
+        self.volume_host = None
+        if real_containers:
+            from .containers import ProcessContainerManager
+            from .volumehost import VolumeHost
+
+            self.containers = ProcessContainerManager()
+            self.volume_host = VolumeHost(
+                fetch_configmap=self._fetch_configmap,
+                fetch_secret=self._fetch_secret,
+            )
+            self.runtime.exec_delegate = self.containers.exec_sync
+            self.runtime.log_delegate = self.containers.read_log
+            self.runtime.file_read_delegate = self._read_rootfs_file
+            self.runtime.file_write_delegate = self._write_rootfs_file
+        self.pod_manager = PodRuntimeManager(
+            self.runtime, clock,
+            containers=self.containers, volume_host=self.volume_host)
         from .cm import ContainerManager, ImageManager
         from .pleg import PLEG
 
@@ -116,6 +137,57 @@ class HollowKubelet:
 
             self.server = KubeletServer(self, exec_token=kubelet_exec_token(node_name))
             self.server.start()
+
+    # -- real-container plumbing -------------------------------------------
+    def _fetch_configmap(self, ns: str, name: str):
+        try:
+            return self.clientset.client_for("ConfigMap").get(name, ns).data
+        except Exception:  # noqa: BLE001 — missing source: keep last payload
+            return None
+
+    def _fetch_secret(self, ns: str, name: str):
+        try:
+            return self.clientset.client_for("Secret").get(name, ns).data
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _rootfs_path(self, pod_key: str, container: str, path: str):
+        """Resolve a cp path inside the container's real rootfs; None for
+        escapes (.. traversal must not reach the host)."""
+        import os
+
+        rootfs = self.containers.rootfs(pod_key, container)
+        full = os.path.normpath(os.path.join(rootfs, path.lstrip("/")))
+        # separator-anchored: "../rootfs-evil/x" normalizes to a SIBLING
+        # whose name merely starts with "rootfs" and must not pass
+        if full != rootfs and not full.startswith(rootfs + os.sep):
+            return None
+        return full
+
+    def _read_rootfs_file(self, pod_key: str, container: str, path: str):
+        full = self._rootfs_path(pod_key, container, path)
+        if full is None:
+            return None
+        try:
+            with open(full, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def _write_rootfs_file(self, pod_key: str, container: str, path: str,
+                           data: bytes) -> bool:
+        import os
+
+        full = self._rootfs_path(pod_key, container, path)
+        if full is None:
+            return False
+        try:
+            os.makedirs(os.path.dirname(full), exist_ok=True)
+            with open(full, "wb") as f:
+                f.write(data)
+            return True
+        except OSError:
+            return False
 
     # -- registration (kubelet_node_status.go registerWithApiserver) -------
     def register(self) -> None:

@@ -96,20 +96,40 @@ class FakeRuntime:
         # stand-in backing ``kubectl cp`` (the reference streams tar over
         # exec; the capability is per-container file read/write)
         self._files: dict[tuple[str, str], dict[str, bytes]] = {}
+        # real-container delegates (set by the kubelet when containers are
+        # real processes, kubelet/containers.py): scripted handlers/files
+        # still take precedence so tests keep their override seam
+        self.exec_delegate = None  # fn(pod_key, container, command) -> (out, rc)
+        self.log_delegate = None  # fn(pod_key, container) -> list[str] | None
+        self.file_read_delegate = None  # fn(pod_key, container, path) -> bytes|None
+        self.file_write_delegate = None  # fn(pod_key, container, path, data) -> bool
 
     def write_file(self, pod_key: str, container: str, path: str, data: bytes) -> None:
+        if self.file_write_delegate is not None:
+            if self.file_write_delegate(pod_key, container, path, bytes(data)):
+                return
         self._files.setdefault((pod_key, container), {})[path] = bytes(data)
 
     def read_file(self, pod_key: str, container: str, path: str):
         """Bytes, or None if absent."""
-        return self._files.get((pod_key, container), {}).get(path)
+        data = self._files.get((pod_key, container), {}).get(path)
+        if data is None and self.file_read_delegate is not None:
+            data = self.file_read_delegate(pod_key, container, path)
+        return data
 
     def append_log(self, pod_key: str, container: str, line: str) -> None:
         self._logs.setdefault((pod_key, container), []).append(line)
 
     def read_logs(self, pod_key: str, container: str):
-        """Lines, or None if the container never existed here."""
-        return self._logs.get((pod_key, container))
+        """Lines, or None if the container never existed here.  Real
+        containers contribute their process stdout/stderr after the
+        kubelet's lifecycle lines."""
+        lines = self._logs.get((pod_key, container))
+        if self.log_delegate is not None:
+            real = self.log_delegate(pod_key, container)
+            if real is not None:
+                lines = (lines or []) + real
+        return lines
 
     def drop_logs(self, pod_key: str) -> None:
         for k in [k for k in self._logs if k[0] == pod_key]:
@@ -120,11 +140,14 @@ class FakeRuntime:
         self._exec_handlers[(pod_key, container)] = fn
 
     def exec(self, pod_key: str, container: str, command: list[str]):
-        """Run a command "in" the container (the CRI ExecSync stand-in).
-        Default behavior echoes the command; scripted handlers override."""
+        """Run a command "in" the container (CRI ExecSync).  Scripted
+        handlers override; real containers (delegate) run the command as
+        an actual child process; the fake echoes."""
         fn = self._exec_handlers.get((pod_key, container))
         if fn is not None:
             return fn(command)
+        if self.exec_delegate is not None:
+            return self.exec_delegate(pod_key, container, command)
         return (" ".join(command), 0)
 
     def probe(self, pod_key: str, container: str, kind: str) -> bool:
@@ -141,26 +164,54 @@ class FakeRuntime:
 
 
 class PodRuntimeManager:
-    """Per-kubelet container/probe state machine (one per HollowKubelet)."""
+    """Per-kubelet container/probe state machine (one per HollowKubelet).
 
-    def __init__(self, runtime: FakeRuntime, clock: Callable[[], float]):
+    With ``containers`` (a :class:`~kubernetes_tpu.kubelet.containers.
+    ProcessContainerManager`) and optionally ``volume_host``, containers
+    are REAL child processes: start forks them, sync polls their pids
+    (an out-of-band ``kill -9`` is a container death), restart spawns a
+    fresh process, and exec probes run through CRI ExecSync
+    (``prober/prober.go:80``)."""
+
+    def __init__(self, runtime: FakeRuntime, clock: Callable[[], float],
+                 containers=None, volume_host=None):
         self.runtime = runtime
         self.clock = clock
+        self.containers = containers
+        self.volume_host = volume_host
         self._pods: dict[str, dict[str, _ContainerState]] = {}
+
+    def _spawn(self, pod: api.Pod, c: api.Container) -> str:
+        """Start the real child for container ``c``; returns its
+        "pid://<n>" id.  Volumes are materialized and projected into the
+        rootfs FIRST — the entrypoint may read them immediately."""
+        key = pod.meta.key
+        import os as _os
+
+        rootfs = self.containers.rootfs(key, c.name)
+        _os.makedirs(rootfs, exist_ok=True)
+        if self.volume_host is not None:
+            self.volume_host.sync_pod(pod)
+            self.volume_host.project_into_rootfs(pod, c, rootfs)
+        pid = self.containers.start(key, c.name,
+                                    command=c.command or None, env=c.env)
+        return f"pid://{pid}"
 
     def ensure_running(self, pod: api.Pod) -> None:
         key = pod.meta.key
         if key in self._pods:
             return
         now = self.clock()
-        self._pods[key] = {
-            c.name: _ContainerState(
-                status=api.ContainerStatus(name=c.name, state="running", ready=True),
+        self._pods[key] = {}
+        for c in pod.spec.containers:
+            cid = ""
+            if self.containers is not None:
+                cid = self._spawn(pod, c)
+            self._pods[key][c.name] = _ContainerState(
+                status=api.ContainerStatus(name=c.name, state="running",
+                                           ready=True, container_id=cid),
                 started_at=now,
             )
-            for c in pod.spec.containers
-        }
-        for c in pod.spec.containers:
             self.runtime.append_log(key, c.name, f"container {c.name} started")
 
     def forget(self, pod_key: str) -> None:
@@ -168,6 +219,10 @@ class PodRuntimeManager:
         # a recreated pod under the same key must not inherit old logs,
         # and a churning fleet must not grow buffers without bound
         self.runtime.drop_logs(pod_key)
+        if self.containers is not None:
+            self.containers.remove_pod(pod_key)
+        if self.volume_host is not None:
+            self.volume_host.teardown_pod(pod_key)
 
     def known(self) -> set[str]:
         return set(self._pods)
@@ -183,21 +238,36 @@ class PodRuntimeManager:
         now = self.clock()
         terminal: Optional[str] = None
 
+        if self.volume_host is not None:
+            # mount reconciler pass (reconciler.go:165): configMap/secret
+            # updates re-materialize while the pod runs — the atomic
+            # symlink flip makes the new content visible in-place
+            self.volume_host.sync_pod(pod)
         for c in pod.spec.containers:
             st = states.get(c.name)
             if st is None:
+                cid = self._spawn(pod, c) if self.containers is not None else ""
                 st = states[c.name] = _ContainerState(
-                    status=api.ContainerStatus(name=c.name, state="running", ready=True),
+                    status=api.ContainerStatus(name=c.name, state="running",
+                                               ready=True, container_id=cid),
                     started_at=now,
                 )
-            # scripted exit (the PLEG event)
+            # scripted exit (the PLEG event); under the real runtime the
+            # kernel is the truth — a process that exited or was killed
+            # out-of-band (kill -9) surfaces here via waitpid
             exit_code = self.runtime.take_exit(key, c.name)
+            if (exit_code is None and self.containers is not None
+                    and st.status.state == "running"
+                    and not self.containers.alive(key, c.name)):
+                exit_code = self.containers.exit_code(key, c.name)
+                if exit_code is None:
+                    exit_code = 137  # unknown death: report like SIGKILL
             if exit_code is not None:
                 restart = pod.spec.restart_policy == "Always" or (
                     pod.spec.restart_policy == "OnFailure" and exit_code != 0
                 )
                 if restart:
-                    self._restart(st, now, reason="Error" if exit_code else "Completed", pod_key=key, cname=c.name)
+                    self._restart(st, now, reason="Error" if exit_code else "Completed", pod_key=key, cname=c.name, spec=c)
                 else:
                     st.status.state = "terminated"
                     st.status.ready = False
@@ -211,7 +281,7 @@ class PodRuntimeManager:
             if c.liveness_probe is not None:
                 res = self._run_probe(st, st.liveness, c.liveness_probe, key, c.name, "liveness", now)
                 if res is False and st.liveness.consecutive_failures >= c.liveness_probe.failure_threshold:
-                    self._restart(st, now, reason="Unhealthy", pod_key=key, cname=c.name)
+                    self._restart(st, now, reason="Unhealthy", pod_key=key, cname=c.name, spec=c)
             # readiness: drives the ready bit through both thresholds
             if c.readiness_probe is not None:
                 self._run_probe(st, st.readiness, c.readiness_probe, key, c.name, "readiness", now)
@@ -232,7 +302,24 @@ class PodRuntimeManager:
         if now - pst.last_run < probe.period_seconds:
             return None
         pst.last_run = now
-        ok = self.runtime.probe(pod_key, cname, kind)
+        scripted = self.runtime.probe_results.get((pod_key, cname, kind))
+        if scripted is not None:
+            ok = scripted  # tests' override seam always wins
+        elif self.containers is not None and probe.exec_command:
+            # real exec probe: run the command via ExecSync and judge by
+            # exit code (prober/prober.go:80 runProbe).  The wait is
+            # bounded by the probe's own timeoutSeconds (reference
+            # default 1s) — probes run inline in the serial sync tick, so
+            # a wedged command costs at most that bound per period
+            try:
+                _, rc = self.containers.exec_sync(
+                    pod_key, cname, probe.exec_command,
+                    timeout=max(0.1, float(probe.timeout_seconds)))
+                ok = rc == 0
+            except ValueError:  # container not running
+                ok = False
+        else:
+            ok = self.runtime.probe(pod_key, cname, kind)
         if ok:
             pst.consecutive_successes += 1
             pst.consecutive_failures = 0
@@ -246,7 +333,17 @@ class PodRuntimeManager:
         return ok
 
     def _restart(self, st: _ContainerState, now: float, reason: str,
-                 pod_key: str, cname: str) -> None:
+                 pod_key: str, cname: str,
+                 spec: Optional[api.Container] = None) -> None:
+        if self.containers is not None:
+            # reap the dead (or unhealthy) process and fork a FRESH one —
+            # the restarted container has a genuinely new pid
+            self.containers.remove(pod_key, cname)
+            pid = self.containers.start(
+                pod_key, cname,
+                command=(spec.command or None) if spec is not None else None,
+                env=spec.env if spec is not None else None)
+            st.status.container_id = f"pid://{pid}"
         st.status.restart_count += 1
         st.status.state = "running"
         st.status.ready = True
