@@ -290,6 +290,13 @@ def _make_handler(server: APIServer):
                         # eviction (reference treats pods/eviction as its
                         # own subresource)
                         verb = "evict"
+                elif (rest[0] == "nodes" and len(rest) >= 3
+                        and rest[2] == "proxy"):
+                    # node proxy: RBAC scopes it as the "nodes/proxy"
+                    # subresource (reference node proxy authz) — reading a
+                    # node object must not imply reaching its kubelet
+                    resource = "nodes/proxy"
+                    name = rest[1]
             return verb, resource, ns, name
 
         def _auth_filters(self, method: str) -> bool:
@@ -782,6 +789,37 @@ def _make_handler(server: APIServer):
             self.end_headers()
             self.wfile.write(data)
 
+        def _proxy_node(self, name: str, subpath: str, query: str = "") -> None:
+            """GET proxied verbatim (path + query) to the node's kubelet
+            read API."""
+            import urllib.error
+            import urllib.request as _rq
+
+            try:
+                node = server.store.get("Node", "", name)
+            except NotFoundError:
+                return self._error(404, "NotFound", f'node "{name}" not found')
+            kubelet_url = (node.get("status") or {}).get("kubeletURL") or ""
+            if not kubelet_url:
+                return self._error(
+                    502, "BadGateway", f'node "{name}" has no kubelet endpoint')
+            if query:
+                subpath = f"{subpath}?{query}"
+            try:
+                with _rq.urlopen(f"{kubelet_url}/{subpath}", timeout=10) as resp:
+                    data = resp.read()
+                    ctype = resp.headers.get("Content-Type", "application/json")
+            except urllib.error.HTTPError as e:
+                return self._error(e.code, "KubeletError", e.read().decode()[:200])
+            except Exception as e:  # noqa: BLE001
+                return self._error(502, "BadGateway", f"kubelet proxy failed: {e}")
+            self._last_code = 200
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         # -- chunked framing shared by watch serving and the proxy ---------
         def _write_chunk(self, data: bytes) -> None:
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
@@ -944,6 +982,15 @@ def _make_handler(server: APIServer):
             if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
                 return self._error(404, "NotFound", f"no route for {url.path}")
             parts = parts[2:]
+
+            # node proxy: /api/v1/nodes/{name}/proxy/<kubelet path> — the
+            # metrics-scrape path (the reference's apiserver node proxy,
+            # which heapster/the HPA metrics client ride to reach
+            # kubelet /stats/summary without node-network access)
+            if (len(parts) >= 4 and parts[0] == "nodes"
+                    and parts[2] == "proxy" and method == "GET"):
+                return self._proxy_node(parts[1], "/".join(parts[3:]),
+                                        url.query)
 
             # collection routes: /api/v1/{resource}
             if len(parts) == 1:

@@ -28,10 +28,21 @@ class HorizontalPodAutoscalerController(Controller):
     name = "horizontalpodautoscaler"
 
     def __init__(self, clientset, informers=None,
-                 metrics: Optional[Callable[[api.Pod], float]] = None, **kw):
+                 metrics: Optional[Callable[[api.Pod], float]] = None,
+                 metrics_client=None, **kw):
         super().__init__(clientset, informers, **kw)
-        # metrics source: per-pod CPU as percent of request (heapster stand-in)
-        self.metrics = metrics or (lambda pod: 0.0)
+        # metrics source: per-pod CPU as percent of request.  Default is
+        # the REAL pipeline — kubelet stats-summary scraped by the
+        # MetricsClient (metrics_client.go) — not an injected stub; an
+        # explicit callable still overrides for tests
+        if metrics is None:
+            from .metrics_client import MetricsClient
+
+            self.metrics_client = metrics_client or MetricsClient(clientset)
+            self.metrics = self.metrics_client.utilization
+        else:
+            self.metrics_client = metrics_client
+            self.metrics = metrics
         self.watch("HorizontalPodAutoscaler")
 
     def tick(self) -> None:
@@ -57,21 +68,28 @@ class HorizontalPodAutoscalerController(Controller):
                 if selector.matches(p.meta.labels)
                 and p.status.phase == api.RUNNING]
         current = target.replicas
-        if pods:
-            observed = sum(self.metrics(p) for p in pods) / len(pods)
-        else:
-            observed = 0.0
+        # None = metrics MISSING for that pod (metrics client warming up,
+        # node down) — distinct from an explicit 0.0 (observed idle).
+        # Missing data must never read as "idle": the reference HPA skips
+        # the scaling decision when it cannot get metrics.
+        samples = [self.metrics(p) for p in pods]
+        known = [s for s in samples if s is not None]
+        observed = sum(known) / len(known) if known else 0.0
 
         desired = current
-        if pods and hpa.target_cpu_utilization > 0:
+        if known and hpa.target_cpu_utilization > 0:
             ratio = observed / hpa.target_cpu_utilization
             if abs(ratio - 1.0) > TOLERANCE:  # inside the band: no scale
-                # scale from the READY pod count, not spec.replicas
-                # (replica_calculator.go uses readyPodCount) — repeated
-                # syncs with unchanged metrics then converge instead of
-                # compounding; fully idle (ratio 0) clamps to minReplicas
-                desired = math.ceil(len(pods) * ratio)
-        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+                # scale from the pod count metrics exist for, not
+                # spec.replicas (replica_calculator.go uses
+                # readyPodCount) — repeated syncs with unchanged metrics
+                # then converge instead of compounding; fully idle
+                # (ratio 0) clamps to minReplicas
+                desired = math.ceil(len(known) * ratio)
+            desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        elif not pods:
+            desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        # pods exist but ALL metrics are missing: hold replicas as-is
 
         if desired != current:
             def _scale(obj):

@@ -1,0 +1,149 @@
+"""The HPA's metrics source: node stats scraped into per-pod utilization.
+
+Capability of ``pkg/controller/podautoscaler/metrics/metrics_client.go``
+(the heapster REST client): scrape every node's kubelet stats-summary
+document (``pkg/kubelet/server/stats/summary.go``), keep the last two
+CPU samples per pod, and answer *CPU utilization as percent of request*
+— cumulative CPU deltas over wall time, exactly how a rate is derived
+from cadvisor counters.  The scrape path is the apiserver's node proxy
+(``/api/v1/nodes/<n>/proxy/stats/summary``) when the clientset is
+remote, or the node's kubeletURL directly when in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from ..api import types as api
+
+logger = logging.getLogger("kubernetes_tpu.metrics")
+
+
+class MetricsClient:
+    def __init__(self, clientset, scrape_interval: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall_clock: Callable[[], float] = time.monotonic):
+        self.clientset = clientset
+        self.scrape_interval = scrape_interval
+        self.clock = clock
+        # rates need REAL elapsed time even under a fake test clock
+        self.wall_clock = wall_clock
+        self._last_scrape = -1e18
+        # pod key -> (wall_t, cumulative cpu ms); two generations for rates
+        self._prev: dict[str, tuple[float, float]] = {}
+        self._cur: dict[str, tuple[float, float]] = {}
+        # generations roll only when at least this much wall time passed:
+        # /proc CPU counters tick at ~10ms, so a near-zero window reads a
+        # spurious zero rate
+        self.min_rate_window = 0.25
+        self._memory: dict[str, int] = {}
+        self._pod_node: dict[str, str] = {}  # last node each pod reported from
+        self.stats = {"scrapes": 0, "nodes_ok": 0, "nodes_failed": 0}
+
+    # -- scraping ------------------------------------------------------------
+    def _fetch_summary(self, node: api.Node) -> Optional[dict]:
+        url = node.status.kubelet_url
+        if not url:
+            return None
+        try:
+            with urllib.request.urlopen(f"{url}/stats/summary", timeout=5) as r:
+                return json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — a down node must not stop the sweep
+            logger.debug("stats scrape of %s failed: %s", node.meta.name, e)
+            return None
+
+    def scrape(self, force: bool = False) -> None:
+        """One sweep over every node with a kubelet endpoint; throttled
+        to ``scrape_interval`` unless forced."""
+        now = self.clock()
+        if not force and now - self._last_scrape < self.scrape_interval:
+            return
+        self._last_scrape = now
+        wall = self.wall_clock()
+        self.stats["scrapes"] += 1
+        sample: dict[str, tuple[float, float]] = {}
+        memory: dict[str, int] = {}
+        pod_node: dict[str, str] = {}
+        ok_nodes: set[str] = set()
+        for node in self.clientset.nodes.list()[0]:
+            summary = self._fetch_summary(node)
+            if summary is None:
+                if node.status.kubelet_url:
+                    self.stats["nodes_failed"] += 1
+                continue
+            self.stats["nodes_ok"] += 1
+            ok_nodes.add(node.meta.name)
+            for entry in summary.get("pods", []):
+                ref = entry.get("podRef") or {}
+                key = f"{ref.get('namespace', 'default')}/{ref.get('name', '')}"
+                pod_node[key] = node.meta.name
+                memory[key] = int((entry.get("memory") or {}).get("usageBytes", 0))
+                cpu = entry.get("cpu") or {}
+                if "cumulativeCpuMillis" in cpu:
+                    sample[key] = (wall, float(cpu["cumulativeCpuMillis"]))
+        # generations roll only when the new sweep actually sampled CPU
+        # (a sweep of down nodes must not wipe the rate window) AND the
+        # current generation is old enough to anchor a meaningful rate —
+        # back-to-back scrapes otherwise collapse the window below the
+        # counter tick and read a spurious zero
+        if sample:
+            ref_wall = max((t for t, _ in self._cur.values()), default=None)
+            if ref_wall is None or wall - ref_wall >= self.min_rate_window:
+                self._prev = {k: v for k, v in self._cur.items() if k in sample}
+            self._cur.update(sample)
+        # evict ONLY pods whose node was scraped successfully this sweep
+        # and no longer reports them — a down node's pods keep their rate
+        # window until the node answers again (partial-outage safety)
+        for gone in [k for k in self._cur
+                     if k not in sample and self._pod_node.get(k) in ok_nodes]:
+            self._cur.pop(gone)
+            self._prev.pop(gone, None)
+        for gone in [k for k in self._memory
+                     if k not in memory and self._pod_node.get(k) in ok_nodes]:
+            self._memory.pop(gone)
+            self._pod_node.pop(gone, None)
+        self._pod_node.update(pod_node)
+        self._memory.update(memory)
+
+    # -- queries -------------------------------------------------------------
+    def pod_cpu_millicores(self, pod_key: str) -> Optional[float]:
+        """Observed CPU rate in millicores, from the last two samples;
+        None until two samples exist."""
+        cur = self._cur.get(pod_key)
+        prev = self._prev.get(pod_key)
+        if cur is None or prev is None:
+            return None
+        dt = cur[0] - prev[0]
+        if dt <= 0:
+            return None
+        return max(0.0, (cur[1] - prev[1]) / dt) / 1000.0 * 1000.0  # ms/s = millicores
+
+    def pod_memory_bytes(self, pod_key: str) -> Optional[int]:
+        return self._memory.get(pod_key)
+
+    def utilization(self, pod: api.Pod) -> Optional[float]:
+        """CPU utilization as percent of the pod's CPU request — the
+        number the HPA's replica calculator consumes
+        (``replica_calculator.go GetResourceReplicas``).  Scrapes lazily
+        (throttled) so the HPA needs no separate pump.
+
+        Returns **None** when no rate exists yet (fewer than two samples,
+        node down, or no CPU request): missing data must read as
+        "unknown", never as "idle" — the reference HPA skips scaling on
+        missing metrics rather than scaling to min."""
+        self.scrape()
+        rate = self.pod_cpu_millicores(pod.meta.key)
+        if rate is None:
+            return None
+        request_m = 0
+        for c in pod.spec.containers:
+            q = c.resources.requests.get("cpu")
+            if q is not None:
+                request_m += int(q.milli_value())
+        if request_m <= 0:
+            return None
+        return rate / request_m * 100.0

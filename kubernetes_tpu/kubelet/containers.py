@@ -210,6 +210,35 @@ class ProcessContainerManager:
             return (str(e), 126)
         return (res.stdout.decode(errors="replace"), res.returncode)
 
+    # -- observed usage (the cadvisor slice: /proc is the source) ------------
+    def usage(self, pod_key: str) -> dict:
+        """Kernel-observed usage summed over the pod's live container
+        processes: RSS bytes (``/proc/<pid>/status`` VmRSS) and
+        cumulative CPU milliseconds (``/proc/<pid>/stat`` utime+stime).
+        The stats-summary endpoint serves this; a metrics client turns
+        the cumulative CPU into a rate by sampling twice."""
+        with self._mu:
+            pids = [c["proc"].pid for (k, _), c in self._ctrs.items()
+                    if k == pod_key and c["proc"].poll() is None]
+        rss = 0
+        cpu_ms = 0.0
+        tick = os.sysconf("SC_CLK_TCK") or 100
+        for pid in pids:
+            try:
+                with open(f"/proc/{pid}/status") as f:
+                    for line in f:
+                        if line.startswith("VmRSS:"):
+                            rss += int(line.split()[1]) * 1024
+                            break
+                with open(f"/proc/{pid}/stat") as f:
+                    fields = f.read().rsplit(")", 1)[1].split()
+                    # utime=field 14, stime=15 (1-indexed); after ')' the
+                    # split starts at field 3
+                    cpu_ms += (int(fields[11]) + int(fields[12])) / tick * 1000.0
+            except (OSError, IndexError, ValueError):
+                continue  # raced a death; skip
+        return {"memoryBytes": rss, "cpuMillis": cpu_ms}
+
     def read_log(self, pod_key: str, name: str) -> Optional[list[str]]:
         path = self.log_path(pod_key, name)
         try:

@@ -189,6 +189,28 @@ class HollowKubelet:
         except OSError:
             return False
 
+    # -- stats (pkg/kubelet/server/stats/summary.go) -----------------------
+    def stats_summary(self) -> dict:
+        """The kubelet stats-summary document the metrics pipeline
+        scrapes (HPA metrics client, ``kubectl top``).  Real containers
+        report kernel-observed RSS + cumulative CPU from /proc; hollow
+        pods report the scripted cadvisor signal."""
+        scripted = self.runtime.pod_memory_usage
+        pods = []
+        for p in self._my_pods():
+            key = p.meta.key
+            entry = {
+                "podRef": {"namespace": p.meta.namespace, "name": p.meta.name},
+                "memory": {"usageBytes": scripted.get(key, 0)},
+            }
+            if self.containers is not None:
+                u = self.containers.usage(key)
+                if u["memoryBytes"] or u["cpuMillis"]:
+                    entry["memory"] = {"usageBytes": u["memoryBytes"]}
+                    entry["cpu"] = {"cumulativeCpuMillis": u["cpuMillis"]}
+            pods.append(entry)
+        return {"node": {"nodeName": self.node_name}, "pods": pods}
+
     # -- registration (kubelet_node_status.go registerWithApiserver) -------
     def register(self) -> None:
         labels = dict(self.labels)

@@ -74,13 +74,7 @@ def _make_handler(kubelet, server_ref=None):
             if url.path == "/healthz":
                 return self._send(200, b"ok", "text/plain")
             if url.path == "/stats/summary":
-                usage = kubelet.runtime.pod_memory_usage
-                pods = [
-                    {"podRef": {"namespace": p.meta.namespace, "name": p.meta.name},
-                     "memory": {"usageBytes": usage.get(p.meta.key, 0)}}
-                    for p in kubelet._my_pods()
-                ]
-                return self._send(200, json.dumps({"pods": pods}).encode())
+                return self._send(200, json.dumps(kubelet.stats_summary()).encode())
             if url.path == "/pods":
                 pods = [p.to_dict() for p in kubelet._my_pods()]
                 return self._send(200, json.dumps({"items": pods}).encode())

@@ -189,7 +189,13 @@ class VolumeHost:
             if vol is None or not self.is_local(vol):
                 continue
             target = self.volume_path(pod.meta.key, m.name)
-            link = os.path.join(rootfs, m.mount_path.lstrip("/"))
+            link = os.path.normpath(
+                os.path.join(rootfs, m.mount_path.lstrip("/")))
+            # separator-anchored escape guard: mountPath is API-controlled
+            # spec data and a ".."-bearing path must never reach the host
+            # (same contract as hollow._rootfs_path for kubectl cp)
+            if link == rootfs or not link.startswith(rootfs + os.sep):
+                continue
             os.makedirs(os.path.dirname(link), exist_ok=True)
             if os.path.islink(link):
                 if os.readlink(link) == target:
