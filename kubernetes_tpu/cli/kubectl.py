@@ -638,6 +638,148 @@ class Kubectl:
                     self.out.write(
                         f"{KIND_TO_RESOURCE[kind]}/{obj.meta.name} pruned\n")
 
+    # -- apply *-last-applied (cmd/apply_{view,set,edit}_last_applied.go) --
+    def _get_for_last_applied(self, resource: str, name: str,
+                              namespace: Optional[str]):
+        """(client, obj, display) or None after writing the error."""
+        resource, kind = _resolve(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return None
+        client = self.cs.client_for(kind)
+        try:
+            return client, client.get(name, namespace), f"{resource}/{name}"
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return None
+
+    def apply_view_last_applied(self, resource: str, name: str,
+                                namespace: Optional[str] = None,
+                                output: str = "yaml") -> int:
+        if output not in ("yaml", "json"):
+            self.out.write(f"error: unexpected -o output mode {output!r} "
+                           f"(yaml|json)\n")
+            return 1
+        got = self._get_for_last_applied(resource, name, namespace)
+        if got is None:
+            return 1
+        _, obj, display = got
+        raw = obj.meta.annotations.get(LAST_APPLIED)
+        if raw is None:
+            self.out.write(
+                f"error: no last-applied-configuration annotation found on "
+                f"{display}\n")
+            return 1
+        doc = json.loads(raw)
+        if output == "json":
+            self.out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        else:
+            self.out.write(yaml.safe_dump(doc, sort_keys=False))
+        return 0
+
+    def _write_last_applied(self, client, name: str, ns, manifest: str) -> None:
+        """Annotation write through the no-op guard: an unchanged
+        annotation must not commit a revision and wake every watcher."""
+
+        def _set(live):
+            live.meta.annotations[LAST_APPLIED] = manifest
+            return live
+
+        _update_if_changed(client, name, _set, ns)
+
+    def apply_set_last_applied(self, filename: str,
+                               create_annotation: bool = False) -> int:
+        """Overwrite each manifest object's last-applied annotation with
+        the file's content; absent annotations are an error unless
+        --create-annotation (the reference's guard: set-last-applied on
+        an object apply never owned is usually a mistake)."""
+        try:
+            docs = self._load_manifests(filename)  # scheme-converted, like apply
+        except (OSError, yaml.YAMLError) as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        for doc in docs:
+            kind = doc.get("kind", "")
+            if kind not in KIND_TO_RESOURCE:
+                self.out.write(f"error: unknown kind {kind!r}\n")
+                return 1
+            meta = doc.get("metadata") or {}
+            name = meta.get("name", "")
+            client = self.cs.client_for(kind)
+            ns = meta.get("namespace", client.default_namespace)
+            manifest = json.dumps(doc, sort_keys=True)
+            try:
+                cur = client.get(name, ns)
+            except (NotFoundError, KeyError):
+                self.out.write(f'Error: {KIND_TO_RESOURCE[kind]} "{name}" '
+                               f'not found\n')
+                return 1
+            if LAST_APPLIED not in cur.meta.annotations and not create_annotation:
+                self.out.write(
+                    f"error: {KIND_TO_RESOURCE[kind]}/{name} has no "
+                    f"last-applied-configuration annotation; use "
+                    f"--create-annotation to set one\n")
+                return 1
+            self._write_last_applied(client, name, ns, manifest)
+            self.out.write(f"{KIND_TO_RESOURCE[kind]}/{name} configured\n")
+        return 0
+
+    def apply_edit_last_applied(self, resource: str, name: str,
+                                namespace: Optional[str] = None) -> int:
+        """annotation -> $EDITOR -> annotation (never touches the live
+        spec; the next apply's 3-way merge consumes the edit)."""
+        import os
+        import subprocess
+        import tempfile
+
+        got = self._get_for_last_applied(resource, name, namespace)
+        if got is None:
+            return 1
+        client, obj, display = got
+        raw = obj.meta.annotations.get(LAST_APPLIED)
+        if raw is None:
+            self.out.write(
+                f"error: no last-applied-configuration annotation found on "
+                f"{display}\n")
+            return 1
+        editor = os.environ.get("EDITOR", "vi")
+        with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+            yaml.safe_dump(json.loads(raw), f, sort_keys=False)
+            tmp = f.name
+        try:
+            rc = subprocess.run([*editor.split(), tmp]).returncode
+        except OSError as e:
+            os.unlink(tmp)
+            self.out.write(f"error: cannot run editor {editor!r}: {e}\n")
+            return 1
+        if rc != 0:
+            os.unlink(tmp)
+            self.out.write("Edit cancelled\n")
+            return 1
+        try:
+            edited = yaml.safe_load(open(tmp).read())
+        except yaml.YAMLError as e:
+            self.out.write(f"error: edited file is not valid YAML: {e}\n"
+                           f"your changes are preserved in {tmp}\n")
+            return 1
+        if not isinstance(edited, dict) or not edited:
+            self.out.write(f"error: edited content must be a non-empty "
+                           f"mapping; your changes are preserved in {tmp}\n")
+            return 1
+        original = json.loads(raw)
+        if edited.get("kind") != original.get("kind") or (
+                (edited.get("metadata") or {}).get("name")
+                != (original.get("metadata") or {}).get("name")):
+            self.out.write(
+                f"error: kind and metadata.name may not change in "
+                f"edit-last-applied; your changes are preserved in {tmp}\n")
+            return 1
+        os.unlink(tmp)
+        self._write_last_applied(client, name, obj.meta.namespace,
+                                 json.dumps(edited, sort_keys=True))
+        self.out.write(f"{display} edited\n")
+        return 0
+
     def delete(self, resource: str, name: Optional[str], namespace: Optional[str] = None,
                selector: str = "", cascade: str = "background") -> int:
         if name and selector:
@@ -1455,6 +1597,71 @@ class Kubectl:
         self.out.write(f"{resource}/{name} env updated\n")
         return 0
 
+    def set_selector(self, resource: str, name: str, selector: str,
+                     namespace: Optional[str] = None) -> int:
+        """``kubectl set selector`` (cmd/set/set_selector.go): rewrite a
+        Service's selector (equality map) or a workload's label
+        selector."""
+        resource, kind = _resolve(resource)
+        if kind is None:
+            self.out.write(f"error: unknown resource {resource!r}\n")
+            return 1
+        # equality-only, like the reference ("selector must be
+        # equality-based"): k=v[,k=v...]
+        pairs: Optional[dict] = {}
+        for part in [s.strip() for s in selector.split(",") if s.strip()]:
+            k2, eq, v = part.partition("=")
+            if not eq or not k2 or "!" in k2 or "=" in v:
+                pairs = None
+                break
+            pairs[k2] = v
+        if not pairs:
+            self.out.write(f"error: bad selector {selector!r} "
+                           f"(key=value[,key=value...])\n")
+            return 1
+        from ..api.selectors import LabelSelector
+
+        def _mutate(obj):
+            if kind == "Service":
+                obj.selector = dict(pairs)
+            elif hasattr(obj, "selector"):
+                obj.selector = LabelSelector.from_match_labels(pairs)
+            else:
+                raise KeyError(kind)
+            return obj
+
+        try:
+            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
+        except NotFoundError:
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        except KeyError:
+            self.out.write(f"error: cannot set selector on {resource}/{name}\n")
+            return 1
+        self.out.write(f"{resource}/{name} selector updated\n")
+        return 0
+
+    def set_serviceaccount(self, resource: str, name: str, sa_name: str,
+                           namespace: Optional[str] = None) -> int:
+        """``kubectl set serviceaccount`` (cmd/set/set_serviceaccount.go):
+        point the workload template's serviceAccountName at ``sa_name``."""
+        resource, kind = _resolve(resource)
+        if kind not in ("Deployment", "ReplicaSet", "DaemonSet", "StatefulSet"):
+            self.out.write(f"error: cannot set serviceaccount on {resource}\n")
+            return 1
+
+        def _mutate(obj):
+            obj.template.spec.service_account_name = sa_name
+            return obj
+
+        try:
+            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
+        except (NotFoundError, KeyError):
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} serviceaccount updated\n")
+        return 0
+
     # -- auth can-i (cmd/auth/cani.go) -------------------------------------
     def auth_can_i(self, verb: str, resource: str, name: str = "",
                    namespace: Optional[str] = None) -> int:
@@ -1755,11 +1962,12 @@ class Kubectl:
                         resources: str = "", role: str = "",
                         clusterrole: str = "", users: list[str] = (),
                         groups: list[str] = (), serviceaccounts: list[str] = (),
-                        selector: str = "", min_available: int = 0) -> int:
+                        selector: str = "", min_available: int = 0,
+                        image: str = "", replicas: int = 1) -> int:
         """Imperative object generators: ``kubectl create
         namespace|configmap|secret|serviceaccount|quota|service|role|
-        rolebinding|clusterrole|clusterrolebinding|pdb NAME ...``
-        (reference ``cmd/create_*.go``)."""
+        rolebinding|clusterrole|clusterrolebinding|pdb|deployment NAME
+        ...`` (reference ``cmd/create_*.go``)."""
         import base64
 
         from ..admission.framework import AdmissionDenied
@@ -1908,6 +2116,26 @@ class Kubectl:
                 meta=api.ObjectMeta(name=name),
                 min_available=min_available,
                 selector=want,  # _parse_selector returns a LabelSelector
+            )
+        elif what == "deployment":
+            # cmd/create_deployment.go: app=NAME labels/selector, one
+            # container named after the image's basename
+            if not image:
+                self.out.write("error: --image is required\n")
+                return 1
+            from ..api.selectors import LabelSelector
+
+            # basename, digest/tag stripped ("nginx@sha256:..." -> nginx)
+            cname = image.split("/")[-1].split("@")[0].split(":")[0] or name
+            obj = api.Deployment(
+                meta=api.ObjectMeta(name=name, labels={"app": name}),
+                replicas=replicas,
+                selector=LabelSelector.from_match_labels({"app": name}),
+                template=api.PodTemplateSpec(
+                    labels={"app": name},
+                    spec=api.PodSpec(containers=[
+                        api.Container(name=cname, image=image)]),
+                ),
             )
         else:
             self.out.write(f"error: unknown generator {what!r}\n")
@@ -2474,13 +2702,25 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
                    help="ns:name")
     p.add_argument("--min-available", type=int, default=0)
     p.add_argument("-l", "--selector", default=argparse.SUPPRESS)
+    p.add_argument("--image", default="",
+                   help="container image (create deployment)")
+    p.add_argument("--replicas", type=int, default=1)
     p = sub.add_parser("certificate", parents=[common])
     p.add_argument("action", choices=["approve", "deny"])
     p.add_argument("name")
     p = sub.add_parser("apply", parents=[common])
-    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("subverb", nargs="?", default=None,
+                   help="view-last-applied|set-last-applied|"
+                        "edit-last-applied (default: declarative apply -f)")
+    p.add_argument("target", nargs="?", help="RESOURCE[/NAME]")
+    p.add_argument("target_name", nargs="?", help="NAME (two-token form)")
+    p.add_argument("-f", "--filename", default=None)
     p.add_argument("--prune", action="store_true")
     p.add_argument("-l", "--selector", default="")
+    # -o/--output is inherited from the common parent parser
+    p.add_argument("--create-annotation", action="store_true",
+                   help="set-last-applied: create the annotation when "
+                        "absent instead of erroring")
     p = sub.add_parser("delete", parents=[common])
     p.add_argument("resource")
     p.add_argument("name", nargs="?")
@@ -2554,7 +2794,8 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
     p.add_argument("--max", dest="max_replicas", type=int, required=True)
     p.add_argument("--cpu-percent", type=int, default=80)
     p = sub.add_parser("set", parents=[common])
-    p.add_argument("what", choices=["image", "resources", "env"])
+    p.add_argument("what", choices=["image", "resources", "env",
+                                    "selector", "serviceaccount", "sa"])
     p.add_argument("resource")  # "deployment" or "deployment/NAME"
     p.add_argument("name", nargs="?")
     p.add_argument("pairs", nargs="*", help="container=image pairs (set image)")
@@ -2647,7 +2888,8 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
         if not what or not name:
             k.out.write("error: create needs -f FILE or a generator "
                         "(namespace|configmap|secret|serviceaccount|quota|"
-                        "service) and a name\n")
+                        "service|deployment|role|rolebinding|clusterrole|"
+                        "clusterrolebinding|pdb) and a name\n")
             return 1
         svc_type = "ClusterIP"
         if what == "secret":
@@ -2682,10 +2924,40 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
                                  users=args.user, groups=args.group,
                                  serviceaccounts=args.serviceaccount,
                                  selector=getattr(args, "selector", ""),
-                                 min_available=args.min_available)
+                                 min_available=args.min_available,
+                                 image=args.image, replicas=args.replicas)
     if args.verb == "certificate":
         return k.certificate(args.action, args.name)
     if args.verb == "apply":
+        sv = getattr(args, "subverb", None)
+        if sv in ("view-last-applied", "set-last-applied", "edit-last-applied"):
+            if sv == "set-last-applied":
+                if not args.filename:
+                    k.out.write("error: set-last-applied requires -f FILE\n")
+                    return 1
+                return k.apply_set_last_applied(args.filename,
+                                                args.create_annotation)
+            target = args.target or ""
+            tname = args.target_name
+            if "/" in target:
+                target, tname = target.split("/", 1)
+            if not target or not tname:
+                k.out.write(f"error: {sv} requires RESOURCE/NAME\n")
+                return 1
+            if sv == "view-last-applied":
+                return k.apply_view_last_applied(
+                    target, tname, namespace,
+                    getattr(args, "output", None) or "yaml")
+            return k.apply_edit_last_applied(target, tname, namespace)
+        if sv is not None:
+            # a typo'd subverb must NEVER fall through to a live apply,
+            # -f or not — that would mutate objects the user only meant
+            # to annotate
+            k.out.write(f"error: unknown apply subcommand {sv!r}\n")
+            return 1
+        if args.filename is None:
+            k.out.write("error: apply requires -f FILE\n")
+            return 1
         return k.apply(args.filename, getattr(args, "prune", False),
                        getattr(args, "selector", ""))
     if args.verb == "delete":
@@ -2770,6 +3042,16 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
             return k.set_image(res, name, pairs, namespace)
         if args.what == "env":
             return k.set_env(res, name, pairs, namespace)
+        if args.what == "selector":
+            if not pairs:
+                k.out.write("error: set selector requires key=value[,...]\n")
+                return 1
+            return k.set_selector(res, name, ",".join(pairs), namespace)
+        if args.what in ("serviceaccount", "sa"):
+            if not pairs:
+                k.out.write("error: set serviceaccount requires a name\n")
+                return 1
+            return k.set_serviceaccount(res, name, pairs[0], namespace)
         return k.set_resources(res, name, args.requests, args.limits, namespace)
     if args.verb == "auth":
         return k.auth_can_i(args.auth_verb, args.auth_resource, args.auth_name, namespace)

@@ -1330,3 +1330,168 @@ def test_apply_prune_scoped_to_manifest_namespaces(cs, tmp_path):
     rc, out = run(cs, "apply", "-f", str(only_a), "--prune", "-l", "app=web")
     assert rc == 0 and "configmaps/b pruned" in out
     assert cs.configmaps.get("other", "ns2").data == {"k": "other"}
+
+
+def test_create_deployment_generator(cs):
+    """create deployment NAME --image IMG --replicas N
+    (cmd/create_deployment.go): app=NAME labels/selector, container
+    named after the image basename."""
+    rc, out = run(cs, "create", "deployment", "web",
+                  "--image", "registry.local/nginx:1.25", "--replicas", "3")
+    assert rc == 0 and "deployments/web created" in out
+    dep = cs.deployments.get("web")
+    assert dep.replicas == 3
+    assert dep.selector.match_labels == {"app": "web"}
+    assert dep.template.labels == {"app": "web"}
+    c = dep.template.spec.containers[0]
+    assert c.name == "nginx" and c.image == "registry.local/nginx:1.25"
+    rc, out = run(cs, "create", "deployment", "bad")
+    assert rc == 1 and "--image" in out
+
+
+def test_apply_view_and_set_last_applied(cs, tmp_path):
+    """apply view-last-applied prints the annotation; set-last-applied
+    rewrites it (guarded by --create-annotation when absent)."""
+    import yaml as _yaml
+
+    doc = {"kind": "ConfigMap", "metadata": {"name": "c1"},
+           "data": {"k": "v1"}}
+    f = tmp_path / "cm.yaml"
+    f.write_text(_yaml.safe_dump(doc))
+    rc, _ = run(cs, "apply", "-f", str(f))
+    assert rc == 0
+
+    rc, out = run(cs, "apply", "view-last-applied", "configmap/c1")
+    assert rc == 0 and _yaml.safe_load(out)["data"] == {"k": "v1"}
+    rc, out = run(cs, "apply", "view-last-applied", "configmap", "c1",
+                  "-o", "json")
+    assert rc == 0
+    import json as _json
+
+    assert _json.loads(out)["data"] == {"k": "v1"}
+
+    # set-last-applied rewrites the annotation without touching the spec
+    doc2 = {"kind": "ConfigMap", "metadata": {"name": "c1"},
+            "data": {"k": "v2"}}
+    f2 = tmp_path / "cm2.yaml"
+    f2.write_text(_yaml.safe_dump(doc2))
+    rc, out = run(cs, "apply", "set-last-applied", "-f", str(f2))
+    assert rc == 0 and "configured" in out
+    assert cs.configmaps.get("c1").data == {"k": "v1"}  # live spec untouched
+    rc, out = run(cs, "apply", "view-last-applied", "configmap/c1")
+    assert _yaml.safe_load(out)["data"] == {"k": "v2"}
+
+    # absent annotation: refused without --create-annotation
+    from kubernetes_tpu.api import ConfigMap
+    from kubernetes_tpu.api.meta import ObjectMeta
+    cs.configmaps.create(ConfigMap(meta=ObjectMeta(name="manual"),
+                                   data={"x": "1"}))
+    rc, out = run(cs, "apply", "view-last-applied", "configmap/manual")
+    assert rc == 1 and "no last-applied" in out
+    doc3 = {"kind": "ConfigMap", "metadata": {"name": "manual"},
+            "data": {"x": "1"}}
+    f3 = tmp_path / "cm3.yaml"
+    f3.write_text(_yaml.safe_dump(doc3))
+    rc, out = run(cs, "apply", "set-last-applied", "-f", str(f3))
+    assert rc == 1 and "--create-annotation" in out
+    rc, out = run(cs, "apply", "set-last-applied", "-f", str(f3),
+                  "--create-annotation")
+    assert rc == 0
+    rc, out = run(cs, "apply", "view-last-applied", "configmap/manual")
+    assert rc == 0
+
+
+def test_apply_edit_last_applied(cs, tmp_path, monkeypatch):
+    """edit-last-applied: annotation -> $EDITOR -> annotation; the live
+    spec is untouched until the next apply consumes the edit."""
+    import sys as _sys
+
+    import yaml as _yaml
+
+    doc = {"kind": "ConfigMap", "metadata": {"name": "c1"},
+           "data": {"k": "v1"}}
+    f = tmp_path / "cm.yaml"
+    f.write_text(_yaml.safe_dump(doc))
+    assert run(cs, "apply", "-f", str(f))[0] == 0
+    editor = tmp_path / "ed.py"
+    editor.write_text(
+        "import sys, yaml\n"
+        "d = yaml.safe_load(open(sys.argv[1]))\n"
+        "d['data']['k'] = 'edited'\n"
+        "yaml.safe_dump(d, open(sys.argv[1], 'w'))\n")
+    monkeypatch.setenv("EDITOR", f"{_sys.executable} {editor}")
+    rc, out = run(cs, "apply", "edit-last-applied", "configmap/c1")
+    assert rc == 0 and "edited" in out
+    rc, out = run(cs, "apply", "view-last-applied", "configmap/c1")
+    assert _yaml.safe_load(out)["data"] == {"k": "edited"}
+    assert cs.configmaps.get("c1").data == {"k": "v1"}  # spec untouched
+
+
+def test_set_selector_and_serviceaccount(cs):
+    """set selector rewires a Service (and workload selectors); set
+    serviceaccount points the workload template at an SA."""
+    from kubernetes_tpu.api import (Container, Deployment, ObjectMeta,
+                                    PodSpec, PodTemplateSpec, Service)
+    from kubernetes_tpu.api.selectors import LabelSelector
+
+    cs.services.create(Service(meta=ObjectMeta(name="web"),
+                               selector={"app": "old"}))
+    rc, out = run(cs, "set", "selector", "service/web", "app=new,tier=fe")
+    assert rc == 0 and "selector updated" in out
+    assert cs.services.get("web").selector == {"app": "new", "tier": "fe"}
+
+    cs.deployments.create(Deployment(
+        meta=ObjectMeta(name="api"), replicas=1,
+        selector=LabelSelector.from_match_labels({"app": "api"}),
+        template=PodTemplateSpec(labels={"app": "api"},
+                                 spec=PodSpec(containers=[Container(name="c")])),
+    ))
+    rc, out = run(cs, "set", "serviceaccount", "deployment/api", "robot")
+    assert rc == 0 and "serviceaccount updated" in out
+    assert cs.deployments.get("api").template.spec.service_account_name == "robot"
+    # sa alias + bad targets
+    rc, out = run(cs, "set", "sa", "deployment/api", "robot2")
+    assert rc == 0
+    rc, out = run(cs, "set", "serviceaccount", "service/web", "x")
+    assert rc == 1 and "cannot set serviceaccount" in out
+    rc, out = run(cs, "set", "selector", "service/web", "no-good!!")
+    assert rc == 1 and "bad selector" in out
+
+
+def test_apply_subverb_guards(cs, tmp_path):
+    """A typo'd apply subcommand must never fall through to a live
+    apply; view-last-applied rejects unsupported -o modes; image digests
+    yield valid container names."""
+    import yaml as _yaml
+
+    f = tmp_path / "cm.yaml"
+    f.write_text(_yaml.safe_dump({"kind": "ConfigMap",
+                                  "metadata": {"name": "g1"},
+                                  "data": {"k": "v"}}))
+    rc, out = run(cs, "apply", "set-lastapplied", "-f", str(f))  # typo
+    assert rc == 1 and "unknown apply subcommand" in out
+    from kubernetes_tpu.store import NotFoundError
+    import pytest as _pytest
+    with _pytest.raises(NotFoundError):
+        cs.configmaps.get("g1")  # the typo did NOT apply the manifest
+
+    assert run(cs, "apply", "-f", str(f))[0] == 0
+    rc, out = run(cs, "apply", "view-last-applied", "configmap/g1",
+                  "-o", "wide")
+    assert rc == 1 and "unexpected -o" in out
+
+    # set-last-applied twice: second write is a no-op (no new revision)
+    rc, _ = run(cs, "apply", "set-last-applied", "-f", str(f))
+    assert rc == 0
+    rv1 = cs.configmaps.get("g1").meta.resource_version
+    rc, _ = run(cs, "apply", "set-last-applied", "-f", str(f))
+    assert rc == 0
+    assert cs.configmaps.get("g1").meta.resource_version == rv1
+
+    rc, out = run(cs, "create", "deployment", "pinned",
+                  "--image", "reg.io/app/nginx@sha256:deadbeef")
+    assert rc == 0
+    assert cs.deployments.get("pinned").template.spec.containers[0].name == "nginx"
+
+    rc, out = run(cs, "set", "selector", "service/ghost", "a=b")
+    assert rc == 1 and "not found" in out
