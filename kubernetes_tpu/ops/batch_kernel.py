@@ -353,14 +353,21 @@ def make_step(
         safe = jnp.maximum(chosen, 0)
         onehot = (jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe) & landed
         oh_i = onehot.astype(jnp.int32)
+        # the chosen node's column, extracted by onehot CONTRACTION, never
+        # by dynamic slice: a traced index into the SHARDED node axis makes
+        # GSPMD all-gather the whole [T, N]/[W, N] plane every step (the
+        # exact regression assert_collective_structure guards against); the
+        # contraction is elementwise on the shard + an O(T) all-reduce
+        safe_onehot = jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe
         if use_terms:
             # affinity domain counters, expanded over nodes: the landed pod
             # counts toward every node sharing the chosen node's topology
             # domain for each term it matches/owns — a scatter-free
             # elementwise same-domain mask (no-op when the chosen node lacks
             # the key, mirroring the old trash-slot semantics)
-            d_at_safe = dev.node_domain[:, safe]  # [T]
-            valid_at_safe = dev.dom_valid[:, safe]  # [T]
+            d_at_safe = (dev.node_domain
+                         * safe_onehot[None, :].astype(jnp.int32)).sum(axis=1)  # [T]
+            valid_at_safe = (dev.dom_valid & safe_onehot[None, :]).any(axis=1)  # [T]
             same_dom = (
                 (dev.node_domain == d_at_safe[:, None])
                 & dev.dom_valid
@@ -379,7 +386,8 @@ def make_step(
             # sentinel row, which must stay empty — mask them to write False,
             # a no-op under max)
             vol_upd = (vol_valid & ~vol_count_only & landed)[:, None] & onehot[None, :]  # [W, N]
-            newv_chosen = (vol_valid & new_v[:, safe] & landed).astype(jnp.int32)  # [W]
+            newv_at_safe = (new_v & safe_onehot[None, :]).any(axis=1)  # [W]
+            newv_chosen = (vol_valid & newv_at_safe & landed).astype(jnp.int32)  # [W]
             vol_any = state.vol_any.at[vol_ids].max(vol_upd)
             vol_ns = state.vol_ns.at[vol_ids].max(vol_upd & ~vol_ro_ok[:, None])
             nk = state.nk + (k_onehot @ newv_chosen)[:, None] * oh_i[None, :]

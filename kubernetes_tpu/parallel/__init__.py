@@ -1,3 +1,12 @@
 """Device mesh / sharding utilities (the ICI-collective layer)."""
 
-from .mesh import NODE_AXIS, make_mesh, schedule_batch_sharded, shard_state, shard_static
+from .mesh import (
+    NODE_AXIS,
+    assert_collective_structure,
+    make_mesh,
+    schedule_batch_sharded,
+    schedule_batch_sharded_verified,
+    shard_state,
+    shard_static,
+    sharded_hlo,
+)

@@ -107,6 +107,16 @@ def shard_state(state: ScanState, mesh: Mesh) -> ScanState:
     )
 
 
+def _prepare(static: BatchStatic, init: InitialState, mesh: Mesh):
+    """Shared setup for every sharded entry point — one place to change
+    placement/xs policy so the asserted HLO can never diverge from the
+    executed program."""
+    dev = shard_static(to_device(static), mesh)
+    state = shard_state(state_to_device(init), mesh)
+    xs = batch_xs(static)  # per-pod inputs replicate (scan slices [W] rows)
+    return _runner_for(static), dev, xs, state
+
+
 def schedule_batch_sharded(
     static: BatchStatic, init: InitialState, mesh: Mesh
 ) -> tuple[np.ndarray, int]:
@@ -114,9 +124,74 @@ def schedule_batch_sharded(
 
     The padded node count must divide evenly by the mesh size (the
     tensorizer's ``pad_multiple`` should be a multiple of it)."""
-    dev = shard_static(to_device(static), mesh)
-    state = shard_state(state_to_device(init), mesh)
-    xs = batch_xs(static)  # per-pod inputs replicate (scan slices [W] rows)
-    run = _runner_for(static)
+    run, dev, xs, state = _prepare(static, init, mesh)
     final_state, chosen = run(dev, xs, state)
     return np.asarray(chosen)[: len(static.group_of_pod)], int(final_state.round_robin)
+
+
+def sharded_hlo(static: BatchStatic, init: InitialState, mesh: Mesh) -> str:
+    """Optimized (post-GSPMD) HLO of the sharded scan — the collective
+    structure the mesh layout implies.  Tests and the multichip dryrun
+    assert over this text that no per-step all-gather of sharded
+    [G, N] / [T, N] state crept in (SURVEY §2.13 P1 / §5.8: per-step
+    traffic must be O(log chips) reductions, never a full node-axis
+    re-materialization)."""
+    run, dev, xs, state = _prepare(static, init, mesh)
+    return run.lower(dev, xs, state).compile().as_text()
+
+
+def schedule_batch_sharded_verified(
+    static: BatchStatic, init: InitialState, mesh: Mesh
+) -> tuple[np.ndarray, int, dict]:
+    """Compile ONCE, assert the collective structure over the compiled
+    text, then execute that same executable — the multichip dryrun path
+    (avoids paying the scan's XLA compile twice per workload)."""
+    run, dev, xs, state = _prepare(static, init, mesh)
+    compiled = run.lower(dev, xs, state).compile()
+    counts = assert_collective_structure(compiled.as_text(), static)
+    final_state, chosen = compiled(dev, xs, state)
+    return (np.asarray(chosen)[: len(static.group_of_pod)],
+            int(final_state.round_robin), counts)
+
+
+def assert_collective_structure(hlo: str, static: BatchStatic) -> dict:
+    """Fail if the sharded program all-gathers node-axis state.
+
+    Allowed collectives: all-reduce / reduce-scatter / collective-permute
+    of any size (score normalization, cumsum tie-break) and SMALL
+    all-gathers (boundary exchanges, scalars).  Forbidden: an all-gather
+    whose result is on the order of a full [G, N] or [T, N] array — the
+    signature of a sharding regression that re-materializes the sharded
+    state on every step.  Returns collective counts for reporting."""
+    import re
+
+    n_pad = int(static.n_pad)
+    g = int(static.static_ok.shape[0])
+    t = int(static.term_matches_sig.shape[0])
+    # threshold: half a [G,N] (or [T,N]) plane — generous room for
+    # legitimate small gathers, far below full-state re-materialization
+    limit = max(g, t, 2) * n_pad // 2
+    counts = {"all-gather": 0, "all-reduce": 0, "reduce-scatter": 0,
+              "collective-permute": 0}
+    offending = []
+    for line in hlo.splitlines():
+        for op in counts:
+            if f" {op}(" in line or f"{op}-start(" in line:
+                counts[op] += 1
+                if op == "all-gather":
+                    # async pairs report tuple results whose FIRST shape
+                    # is the pre-gather shard — take the LARGEST shape on
+                    # the line so the full gathered plane can't hide in a
+                    # (shard, full) tuple on a wide mesh
+                    elems = 1
+                    for dims in re.findall(r"\[([\d,]+)\]", line):
+                        cur = 1
+                        for d in dims.split(","):
+                            cur *= int(d)
+                        elems = max(elems, cur)
+                    if elems >= limit:
+                        offending.append(line.strip()[:200])
+    assert not offending, (
+        f"sharded scan all-gathers node-axis state (>{limit} elems): "
+        + "; ".join(offending[:3]))
+    return counts

@@ -104,3 +104,35 @@ def test_sharded_phase_b_volume_conflicts_respected():
     for n_dev in (2, 8):
         got, _ = schedule_batch_sharded(static, init, make_mesh(n_dev))
         assert (want == got).all(), f"volume-conflict mismatch at mesh {n_dev}"
+
+
+def test_sharded_scan_collective_structure(mesh):
+    """The sharded program's collectives must be reductions/permutes —
+    never a per-step all-gather of the [G,N]/[T,N] node-axis state (a
+    silent sharding regression that re-materializes sharded state every
+    step; r3 VERDICT Weak #7).  Exercises phase B (terms + volumes),
+    whose chosen-column extraction is the tempting place to regress."""
+    from kubernetes_tpu.parallel import assert_collective_structure, sharded_hlo
+
+    static, init = _build(21, 32, 96)
+    hlo = sharded_hlo(static, init, mesh)
+    counts = assert_collective_structure(hlo, static)  # must not raise
+    # the mesh is genuinely communicating: score normalization and the
+    # cumsum tie-break need cross-shard reductions
+    assert counts["all-reduce"] > 0, counts
+
+
+def test_collective_structure_gate_rejects_state_allgather():
+    """The gate itself has teeth: a synthetic HLO carrying a full-plane
+    all-gather of [T, N] state must fail the assertion."""
+    from kubernetes_tpu.parallel import assert_collective_structure
+
+    static, _ = _build(22, 32, 32)
+    t = int(static.term_matches_sig.shape[0])
+    n = int(static.n_pad)
+    bad_hlo = (
+        "ENTRY %main {\n"
+        f"  %ag = s32[{max(t, 2)},{n}]{{1,0}} all-gather(%x), dimensions={{1}}\n"
+        "}\n")
+    with pytest.raises(AssertionError, match="all-gathers node-axis state"):
+        assert_collective_structure(bad_hlo, static)
