@@ -13,6 +13,8 @@ SIGALRM raises in the main thread, so the test FAILS and the run
 continues; helper daemon threads are daemonic and die with the process.
 """
 
+import os
+import random
 import signal
 import threading
 
@@ -21,6 +23,20 @@ import pytest
 from kubernetes_tpu.utils.platform import force_virtual_cpu
 
 force_virtual_cpu(8)
+
+
+def pytest_collection_modifyitems(config, items):
+    """TEST_SHUFFLE=<seed> runs the suite in a randomized order (the
+    reference CI's randomized-order bar without a plugin dependency):
+    order-coupling between tests is a flake class of its own."""
+    seed = os.environ.get("TEST_SHUFFLE")
+    if seed:
+        try:
+            rng = random.Random(int(seed))
+        except ValueError:
+            raise pytest.UsageError(
+                f"TEST_SHUFFLE must be an integer seed, got {seed!r}")
+        rng.shuffle(items)
 
 
 def pytest_configure(config):
