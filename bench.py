@@ -554,15 +554,15 @@ def main() -> None:
     if args.workload:
         workload = args.workload
 
+    if args.trials is not None and args.trials < 1:
+        parser.error("--trials must be >= 1")  # before the minutes-long warm-up
+    trials = args.trials or (3 if args.preset == "north" and not args.oracle else 1)
+
     # warm-up at the same scale (different seed): triggers XLA compilation of
     # every segment-shape bucket the timed run will hit, so the timed run
     # measures steady-state throughput (first TPU compile is ~5s per bucket)
     if not args.oracle:
         run_once(n_nodes, n_pods, use_backend=True, workload=workload, seed=1)
-
-    if args.trials is not None and args.trials < 1:
-        parser.error("--trials must be >= 1")
-    trials = args.trials or (3 if args.preset == "north" and not args.oracle else 1)
     runs = []
     for t in range(trials):
         runs.append(run_once(

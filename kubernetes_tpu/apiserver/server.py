@@ -115,9 +115,11 @@ class APIServer:
         auditor=None,
         tls: Optional["TLSConfig"] = None,
         max_in_flight: int = 0,  # 0 = unlimited (reference default 400)
+        tunneler=None,  # master↔node secure channel (tunneler.Tunneler)
     ):
         self.store = store
         self.tls = tls
+        self.tunneler = tunneler
         # max-in-flight filter (server/filters/maxinflight.go): a
         # semaphore, never a queue — overload answers 429 immediately
         self._inflight = (threading.Semaphore(max_in_flight)
@@ -791,7 +793,8 @@ def _make_handler(server: APIServer):
 
         def _proxy_node(self, name: str, subpath: str, query: str = "") -> None:
             """GET proxied verbatim (path + query) to the node's kubelet
-            read API."""
+            read API — over the node's tunnel when a tunneler holds one
+            (pkg/master/tunneler: nodes may not be directly routable)."""
             import urllib.error
             import urllib.request as _rq
 
@@ -799,12 +802,37 @@ def _make_handler(server: APIServer):
                 node = server.store.get("Node", "", name)
             except NotFoundError:
                 return self._error(404, "NotFound", f'node "{name}" not found')
+            if query:
+                subpath = f"{subpath}?{query}"
+            tun = server.tunneler
+            if tun is not None and tun.has(name):
+                if not tun.healthy(name):
+                    return self._error(
+                        502, "BadGateway", f'tunnel to node "{name}" is down')
+                import http.client as _http_client
+
+                try:
+                    status, data, ctype = tun.request(name, "GET", f"/{subpath}")
+                except (OSError, _http_client.HTTPException) as e:
+                    # a kubelet dying mid-response (IncompleteRead /
+                    # BadStatusLine) is a gateway failure, not a handler
+                    # crash — same 502 contract as the direct-dial path
+                    return self._error(502, "BadGateway",
+                                       f"tunnel request failed: {e}")
+                if status != 200:
+                    return self._error(status, "KubeletError",
+                                       data.decode(errors="replace")[:200])
+                self._last_code = 200
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             kubelet_url = (node.get("status") or {}).get("kubeletURL") or ""
             if not kubelet_url:
                 return self._error(
                     502, "BadGateway", f'node "{name}" has no kubelet endpoint')
-            if query:
-                subpath = f"{subpath}?{query}"
             try:
                 with _rq.urlopen(f"{kubelet_url}/{subpath}", timeout=10) as resp:
                     data = resp.read()

@@ -426,7 +426,13 @@ def _unb64(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
-def kubelet_exec_token(node_name: str, key: bytes = b"cluster-signing-key") -> str:
+# the cluster's signing key: ONE definition — every node-scoped HMAC
+# credential (exec, tunnel, SA tokens) defaults to it, so a configurable
+# key can never drift between the minting sites
+CLUSTER_SIGNING_KEY = b"cluster-signing-key"
+
+
+def kubelet_exec_token(node_name: str, key: bytes = CLUSTER_SIGNING_KEY) -> str:
     """The control plane's credential for a node's exec endpoint: HMAC of
     the node name under the cluster signing key.  Only components holding
     the key (apiserver, kubectl pointed at the in-proc store) can mint it
@@ -440,7 +446,7 @@ class ServiceAccountTokenMinter:
     ``pkg/serviceaccount`` TokenGenerator; the controller writes them into
     token Secrets)."""
 
-    def __init__(self, signing_key: bytes = b"cluster-signing-key"):
+    def __init__(self, signing_key: bytes = CLUSTER_SIGNING_KEY):
         self.key = signing_key
 
     def mint(self, namespace: str, name: str) -> str:
