@@ -356,13 +356,21 @@ def test_unrunnable_entrypoint_does_not_abort_the_sync_tick(world):
     cs, clock, k = world
     cs.pods.create(real_pod("bad", command=["/no/such/binary"]))
     cs.pods.create(real_pod("good", command=["/bin/sleep", "1000"]))
-    for _ in range(4):
+    # the 127-exit fallback child needs real milliseconds to die; drive
+    # ticks (which must never raise) until a restart is observed
+    deadline = time.monotonic() + 10
+    bad = None
+    while time.monotonic() < deadline:
         clock.advance(2.0)
         k.tick()  # must never raise
+        bad = cs.pods.get("bad", "default")
+        if (bad.status.container_statuses
+                and bad.status.container_statuses[0].restart_count >= 1):
+            break
+        time.sleep(0.05)
     good = cs.pods.get("good", "default")
     assert good.status.phase == "Running"
     assert _alive(_pid(good))
-    bad = cs.pods.get("bad", "default")
     # the failure is visible: restart cycling with the 127 exit recorded
     assert bad.status.container_statuses[0].restart_count >= 1
     lines = k.runtime.read_logs("default/bad", "c") or []

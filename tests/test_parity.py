@@ -604,3 +604,39 @@ def test_prefix_parity_gate_small_scale():
         backend_res, 80, 600, workload="mixed", seed=3, k=150)
     assert gate["checked"] == 150
     assert gate["mismatches"] == 0, gate["sample"]
+
+
+def test_build_static_row_cache_equivalence(monkeypatch):
+    """The interaction-key row cache must be invisible: build_static with
+    the cache ON produces arrays IDENTICAL to a full per-signature sweep
+    (cache OFF) — including prefer-avoid controller refs and annotated
+    nodes, the fragmentation-prone corner (r4 review)."""
+    import numpy as np
+
+    import kubernetes_tpu.models.snapshot as snap
+    from kubernetes_tpu.scheduler.priorities import PREFER_AVOID_PODS_ANNOTATION
+
+    rng = random.Random(11)
+    m = build_cluster(rng, 40, zones=3)
+    # one node prefers to avoid pods of controller "rs-avoided"
+    first = m[sorted(m)[0]].node
+    first.meta.annotations[PREFER_AVOID_PODS_ANNOTATION] = "uid-avoided"
+    pods = make_batch(rng, 200)
+    # owner refs: one avoided controller, several benign distinct ones
+    for i, p in enumerate(pods[:40]):
+        uid = "uid-avoided" if i % 4 == 0 else f"uid-{i}"
+        p.meta.owner_references = [OwnerReference(
+            kind="ReplicaSet", name=f"rs{i}", uid=uid, controller=True)]
+    pctx = PriorityContext(m)
+    tz = Tensorizer(pad_multiple=64)
+
+    monkeypatch.setattr(snap, "_DISABLE_ROW_CACHE", True)
+    plain = tz.build_static(pods, m, pctx, prefer_avoid_weight=10000)
+    monkeypatch.setattr(snap, "_DISABLE_ROW_CACHE", False)
+    cached = tz.build_static(pods, m, pctx, prefer_avoid_weight=10000)
+
+    for fieldname in ("static_ok", "node_aff_raw", "taint_intol_raw",
+                      "static_score", "interpod_raw"):
+        a = getattr(plain, fieldname)
+        b = getattr(cached, fieldname)
+        assert np.array_equal(a, b), f"{fieldname} diverged under the cache"
