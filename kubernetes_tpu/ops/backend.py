@@ -73,6 +73,24 @@ _PRIORITY_WEIGHT_KEY = {
 }
 
 
+def _segment_vecs(static):
+    """Per-signature ResourceVecs for the commit path (once per segment,
+    G <= max_groups): the full request vector, and the nonzero variant
+    (cpu/mem replaced by the per-container-defaulted values; other slots
+    are identical by construction — see units.pod_nonzero_request_vec)."""
+    from ..scheduler.units import CPU_MILLI, MEM_MIB, ResourceVec
+
+    req_vecs, nz_vecs = [], []
+    for g in range(len(static.g_request)):
+        units = [int(x) for x in static.g_request[g]]
+        req_vecs.append(ResourceVec(units))
+        nz_units = list(units)
+        nz_units[CPU_MILLI] = int(static.g_nonzero[g][0])
+        nz_units[MEM_MIB] = int(static.g_nonzero[g][1])
+        nz_vecs.append(ResourceVec(nz_units))
+    return req_vecs, nz_vecs
+
+
 class TPUBatchBackend:
     def __init__(
         self,
@@ -266,12 +284,18 @@ class TPUBatchBackend:
         host_state = HostBatchState(work_map) if weights is not None else None
         mounted_disks = host_state.mounted_disks if host_state is not None else set()
 
-        def apply(pod: api.Pod, node_name: Optional[str], i: int) -> None:
+        def apply(pod: api.Pod, node_name: Optional[str], i: int,
+                  req_vec=None, nz_vec=None) -> None:
             assignments[i] = node_name
             if node_name is not None:
                 info = work_map.get(node_name)
                 if info is not None:
-                    info.add_pod(pod)
+                    if req_vec is not None:
+                        # kernel path: the segment's per-signature vectors
+                        # spare a quantity re-parse per placed pod
+                        info.add_pod_counted(pod, req_vec, nz_vec)
+                    else:
+                        info.add_pod(pod)
                 if host_state is not None:
                     host_state.add_pod(pod, node_name)
 
@@ -362,9 +386,12 @@ class TPUBatchBackend:
 
                     chosen, final_rr = finalize_batch_arrays(static, *fut)
                 self.algorithm._round_robin = final_rr
-                for (i, pod), idx in zip(segment, chosen):
+                req_vecs, nz_vecs = _segment_vecs(static)
+                group_of_pod = static.group_of_pod
+                for k, ((i, pod), idx) in enumerate(zip(segment, chosen)):
                     node_name = static.node_names[int(idx)] if int(idx) >= 0 else None
-                    apply(pod, node_name, i)
+                    g = int(group_of_pod[k])
+                    apply(pod, node_name, i, req_vecs[g], nz_vecs[g])
                 self.stats["kernel_pods"] += len(segment)
                 self.stats["segments"] += 1
                 return [(pod, assignments[i]) for i, pod in segment]
