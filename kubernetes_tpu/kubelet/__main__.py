@@ -33,6 +33,10 @@ def main(argv=None) -> int:
     ap.add_argument("--real-containers", action="store_true",
                     help="run containers as real child processes with "
                     "on-disk volumes (single-node depth; not for fleets)")
+    ap.add_argument("--container-root", default=None,
+                    help="persistent container/checkpoint root: a "
+                    "restarted kubelet adopts still-live containers "
+                    "(dockershim checkpoint recovery)")
     ap.add_argument("--feature-gates", default="",
                     help="A=true,B=false (e.g. DynamicKubeletConfig=true)")
     args = ap.parse_args(argv)
@@ -56,7 +60,8 @@ def main(argv=None) -> int:
     else:
         k = HollowKubelet(cs, args.name, cpu=args.cpu, memory=args.memory,
                           serve=args.serve_logs,
-                          real_containers=args.real_containers)
+                          real_containers=args.real_containers,
+                          container_root=args.container_root)
         k.register()
         kubelets = [k]
         tick = k.tick

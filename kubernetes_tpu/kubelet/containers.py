@@ -1,5 +1,5 @@
 """Real containers: one child process per container, under the pod's
-pause sandbox.
+pause sandbox — with on-disk checkpoints for kubelet restart recovery.
 
 Capability of the reference's runtime manager + dockershim slice that is
 feasible on one unprivileged machine (``pkg/kubelet/kuberuntime/
@@ -23,10 +23,23 @@ There is no namespace/cgroup isolation here (unprivileged box); what IS
 real: pids, the process tree, exit codes, signals, the filesystem, and
 exec.  The pod's pause process (``csrc/pause.c``) still anchors the
 sandbox; containers are tracked per sandbox and die with it.
+
+**Checkpoints** (reference ``pkg/kubelet/dockershim/checkpoint_store.go``
+/ ``docker_checkpoint.go``, exercised by
+``e2e_node/dockershim_checkpoint_test.go``): every started container
+writes ``checkpoint.json`` (pid + /proc start time + command/env) next
+to its rootfs.  A manager constructed over the SAME root adopts the
+still-live processes — a restarted kubelet resumes managing running
+containers instead of orphaning them.  Adopted entries carry no Popen
+handle (the new process cannot waitpid another's child), so liveness is
+judged by /proc with the start-time pinned against pid reuse, and an
+adopted death reports 137 (unknown), like a runtime that lost the wait
+status.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import signal
@@ -34,6 +47,22 @@ import subprocess
 import tempfile
 import threading
 from typing import Optional
+
+
+def _proc_stat(pid: int) -> tuple[Optional[str], Optional[str]]:
+    """(state, starttime) from /proc/<pid>/stat — the birth stamp guards
+    against pid reuse; the state char distinguishes a live process from a
+    zombie (an unreaped dead child still has a /proc entry)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(")", 1)[1].split()
+            return fields[0], fields[19]
+    except (OSError, IndexError):
+        return None, None
+
+
+def _proc_starttime(pid: int) -> Optional[str]:
+    return _proc_stat(pid)[1]
 
 # default entrypoint: a quiet long sleep (the "image default" — pause-like)
 _DEFAULT_COMMAND = ["/bin/sh", "-c", "exec sleep 1000000"]
@@ -47,12 +76,24 @@ class ProcessContainerManager:
         self._own_root = root is None
         self.root = root or tempfile.mkdtemp(prefix="ktpu-containers-")
         self._mu = threading.Lock()
-        # (pod_key, name) -> {"proc": Popen, "rootfs": str, "env": dict,
-        #                     "log": str, "command": list}
+        # (pod_key, name) -> {"proc": Popen|None, "pid": int,
+        #   "starttime": str|None, "rootfs": str, "env": dict,
+        #   "log": str, "command": list}
+        # proc is None for ADOPTED containers (checkpoint recovery): the
+        # restarted manager watches them through /proc instead of waitpid
         self._ctrs: dict[tuple[str, str], dict] = {}
+        self.stats = {"adopted": 0}
         import atexit
 
-        atexit.register(self.remove_all)
+        atexit.register(self._atexit_cleanup)
+
+    def _atexit_cleanup(self) -> None:
+        """Ephemeral roots tear everything down; a PERSISTENT root leaves
+        live containers and their checkpoints in place — that is the
+        whole point of checkpoint recovery (a graceful kubelet exit must
+        not kill the workloads a restart would re-adopt)."""
+        if self._own_root:
+            self.remove_all()
 
     # -- paths ---------------------------------------------------------------
     def pod_dir(self, pod_key: str) -> str:
@@ -64,6 +105,10 @@ class ProcessContainerManager:
     def log_path(self, pod_key: str, name: str) -> str:
         return os.path.join(self.pod_dir(pod_key), "containers", name, "log")
 
+    def checkpoint_path(self, pod_key: str, name: str) -> str:
+        return os.path.join(self.pod_dir(pod_key), "containers", name,
+                            "checkpoint.json")
+
     # -- lifecycle -----------------------------------------------------------
     def start(self, pod_key: str, name: str, command: Optional[list[str]] = None,
               env: Optional[dict] = None) -> int:
@@ -72,8 +117,8 @@ class ProcessContainerManager:
         running (idempotent sync)."""
         with self._mu:
             cur = self._ctrs.get((pod_key, name))
-            if cur is not None and cur["proc"].poll() is None:
-                return cur["proc"].pid
+            if cur is not None and self._alive_locked(cur):
+                return cur["pid"]
             rootfs = self.rootfs(pod_key, name)
             os.makedirs(rootfs, exist_ok=True)
             log = self.log_path(pod_key, name)
@@ -107,61 +152,165 @@ class ProcessContainerManager:
                     )
             finally:
                 logf.close()  # the child holds its own fd now
-            self._ctrs[(pod_key, name)] = {
-                "proc": proc, "rootfs": rootfs, "env": dict(env or {}),
+            entry = {
+                "proc": proc, "pid": proc.pid,
+                "starttime": _proc_starttime(proc.pid),
+                "rootfs": rootfs, "env": dict(env or {}),
                 "log": log, "command": cmd,
             }
+            self._ctrs[(pod_key, name)] = entry
+            # checkpoint for restart recovery (dockershim checkpoint_store)
+            try:
+                with open(self.checkpoint_path(pod_key, name), "w") as f:
+                    json.dump({"pod": pod_key, "name": name,
+                               "pid": entry["pid"],
+                               "starttime": entry["starttime"],
+                               "command": cmd, "env": dict(env or {})}, f)
+            except OSError:
+                pass  # a missing checkpoint only degrades restart adoption
             return proc.pid
+
+    @staticmethod
+    def _alive_locked(c: dict) -> bool:
+        if c["proc"] is not None:
+            return c["proc"].poll() is None
+        # adopted: /proc liveness with the start time pinned (pid reuse)
+        # and zombies excluded (dead-but-unreaped is DEAD to the runtime)
+        state, starttime = _proc_stat(c["pid"])
+        return (c["starttime"] is not None
+                and starttime == c["starttime"]
+                and state not in ("Z", "X", None))
 
     def pid(self, pod_key: str, name: str) -> Optional[int]:
         with self._mu:
             c = self._ctrs.get((pod_key, name))
-            return None if c is None else c["proc"].pid
+            return None if c is None else c["pid"]
 
     def alive(self, pod_key: str, name: str) -> bool:
         with self._mu:
             c = self._ctrs.get((pod_key, name))
-            return c is not None and c["proc"].poll() is None
+            return c is not None and self._alive_locked(c)
 
     def exit_code(self, pod_key: str, name: str) -> Optional[int]:
-        """None while running (or unknown); the real wait status once
-        dead.  A kill by signal N reports 128+N like a shell would."""
+        """None while running (or unknown — adopted containers have no
+        waitable status, like a runtime that lost the wait); the real
+        wait status once dead.  A kill by signal N reports 128+N like a
+        shell would."""
         with self._mu:
             c = self._ctrs.get((pod_key, name))
-            if c is None:
+            if c is None or c["proc"] is None:
                 return None
             rc = c["proc"].poll()
             if rc is None:
                 return None
             return 128 - rc if rc < 0 else rc
 
+    # -- restart recovery ----------------------------------------------------
+    def adopt_checkpoints(self) -> int:
+        """Scan the root for checkpoints of still-live processes and take
+        them over (dockershim checkpoint recovery: a restarted kubelet
+        resumes managing running containers).  Stale checkpoints (dead or
+        reused pids) are deleted.  Returns how many were adopted."""
+        adopted = 0
+        try:
+            pod_dirs = os.listdir(self.root)
+        except OSError:
+            return 0
+        for pd in pod_dirs:
+            cdir = os.path.join(self.root, pd, "containers")
+            if not os.path.isdir(cdir):
+                continue
+            for cname in os.listdir(cdir):
+                cp = os.path.join(cdir, cname, "checkpoint.json")
+                try:
+                    with open(cp) as f:
+                        doc = json.load(f)
+                    key = (doc.get("pod", ""), doc.get("name", ""))
+                    pid = int(doc.get("pid", 0))
+                    starttime = doc.get("starttime")
+                except (OSError, ValueError, TypeError, AttributeError):
+                    # a corrupt checkpoint degrades adoption for that
+                    # container only — it must never stop the kubelet
+                    try:
+                        os.unlink(cp)
+                    except OSError:
+                        pass
+                    continue
+                state, cur_start = _proc_stat(pid) if pid > 0 else (None, None)
+                live = (pid > 0 and starttime is not None
+                        and cur_start == starttime
+                        and state not in ("Z", "X", None))
+                with self._mu:
+                    if not live or key in self._ctrs:
+                        if not live:
+                            try:
+                                os.unlink(cp)
+                            except OSError:
+                                pass
+                        continue
+                    self._ctrs[key] = {
+                        "proc": None, "pid": pid, "starttime": starttime,
+                        "rootfs": os.path.join(cdir, cname, "rootfs"),
+                        "env": dict(doc.get("env") or {}),
+                        "log": os.path.join(cdir, cname, "log"),
+                        "command": list(doc.get("command") or []),
+                    }
+                    self.stats["adopted"] += 1
+                    adopted += 1
+        return adopted
+
     def stop(self, pod_key: str, name: str, timeout: float = 5.0) -> None:
+        import time as _time
+
         with self._mu:
             c = self._ctrs.get((pod_key, name))
-        if c is None:
+            live = c is not None and self._alive_locked(c)
+        if c is None or not live:
             return
-        proc = c["proc"]
-        if proc.poll() is None:
-            try:  # signal the whole process group (shell + children)
-                os.killpg(proc.pid, signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                proc.terminate()
-            try:
-                proc.wait(timeout=timeout)
-            except subprocess.TimeoutExpired:
+        proc, pid = c["proc"], c["pid"]
+
+        def _wait(t: float) -> bool:
+            if proc is not None:
                 try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    proc.kill()
-                try:
-                    proc.wait(timeout=timeout)
+                    proc.wait(timeout=t)
+                    return True
                 except subprocess.TimeoutExpired:
-                    pass  # D-state straggler; never block the sweep
+                    return False
+            deadline = _time.monotonic() + t  # adopted: poll /proc
+            while _time.monotonic() < deadline:
+                state, starttime = _proc_stat(pid)
+                # starttime change = gone/reused; Z/X = dead-but-unreaped
+                # (a zombie must not stall the sweep for the full timeout)
+                if starttime != c["starttime"] or state in ("Z", "X", None):
+                    return True
+                _time.sleep(0.02)
+            return False
+
+        try:  # signal the whole process group (shell + children)
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                return
+        if not _wait(timeout):
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    return
+            _wait(timeout)  # D-state straggler; never block the sweep
 
     def remove(self, pod_key: str, name: str) -> None:
         self.stop(pod_key, name)
         with self._mu:
             self._ctrs.pop((pod_key, name), None)
+        try:
+            os.unlink(self.checkpoint_path(pod_key, name))
+        except OSError:
+            pass
 
     def remove_pod(self, pod_key: str) -> None:
         with self._mu:
@@ -190,7 +339,7 @@ class ProcessContainerManager:
         dead container is an error (ValueError -> the server's 4xx)."""
         with self._mu:
             c = self._ctrs.get((pod_key, name))
-            if c is None or c["proc"].poll() is not None:
+            if c is None or not self._alive_locked(c):
                 raise ValueError(f"container {pod_key}/{name} is not running")
             rootfs, env = c["rootfs"], dict(c["env"])
         full_env = dict(os.environ)
@@ -218,8 +367,8 @@ class ProcessContainerManager:
         The stats-summary endpoint serves this; a metrics client turns
         the cumulative CPU into a rate by sampling twice."""
         with self._mu:
-            pids = [c["proc"].pid for (k, _), c in self._ctrs.items()
-                    if k == pod_key and c["proc"].poll() is None]
+            pids = [c["pid"] for (k, _), c in self._ctrs.items()
+                    if k == pod_key and self._alive_locked(c)]
         rss = 0
         cpu_ms = 0.0
         tick = os.sysconf("SC_CLK_TCK") or 100

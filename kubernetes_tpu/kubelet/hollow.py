@@ -48,6 +48,7 @@ class HollowKubelet:
         mount_latency: float = 0.0,
         real_sandboxes: bool = False,
         real_containers: bool = False,
+        container_root: Optional[str] = None,
         system_reserved_cpu: str = "0",
         system_reserved_memory: str = "0",
         kube_reserved_cpu: str = "0",
@@ -88,7 +89,12 @@ class HollowKubelet:
             from .containers import ProcessContainerManager
             from .volumehost import VolumeHost
 
-            self.containers = ProcessContainerManager()
+            self.containers = ProcessContainerManager(root=container_root)
+            if container_root is not None:
+                # restart recovery: adopt still-live containers from the
+                # previous kubelet process's checkpoints (dockershim
+                # checkpoint_store) instead of orphaning them
+                self.containers.adopt_checkpoints()
             self.volume_host = VolumeHost(
                 fetch_configmap=self._fetch_configmap,
                 fetch_secret=self._fetch_secret,

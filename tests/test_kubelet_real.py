@@ -418,3 +418,104 @@ def test_list_containers_keeps_exit_code_for_late_pollers():
     finally:
         procs.remove_all()
         cri.stop_pod_sandbox("default/p")
+
+
+def test_kubelet_restart_adopts_running_containers(tmp_path):
+    """Checkpoint recovery (dockershim checkpoint_store.go): a restarted
+    kubelet over the same container root ADOPTS the still-live container
+    processes — same pid, no respawn — keeps exec working, and still
+    restarts them with a fresh pid when they die."""
+    root = str(tmp_path / "containers")
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k1 = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock,
+                       real_containers=True, container_root=root)
+    k1.register()
+    start(cs, k1, real_pod(
+        "p", command=["/bin/sh", "-c", "echo survivor > mark; exec sleep 1000"]))
+    pod = cs.pods.get("p", "default")
+    pid1 = _pid(pod)
+    assert _alive(pid1)
+
+    # "restart": a brand-new kubelet process over the same root (the old
+    # manager's Popen handles are gone; only checkpoints + live pids remain)
+    k2 = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=FakeClock(),
+                       real_containers=True, container_root=root)
+    assert k2.containers.stats["adopted"] == 1
+    try:
+        for _ in range(3):
+            k2.tick()
+        pod = cs.pods.get("p", "default")
+        assert pod.status.phase == "Running"
+        assert _pid(pod) == pid1, "adoption must not respawn a live container"
+        assert _alive(pid1)
+        # exec still reaches the adopted container's rootfs
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            out, rc = k2.runtime.exec("default/p", "c", ["/bin/cat", "mark"])
+            if rc == 0:
+                break
+            time.sleep(0.05)
+        assert rc == 0 and out.strip() == "survivor"
+
+        # an adopted container's death is still observed (via /proc) and
+        # restartPolicy forks a FRESH child
+        os.kill(pid1, signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        while _alive(pid1) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        restarted = False
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            k2.tick()
+            pod = cs.pods.get("p", "default")
+            st = pod.status.container_statuses[0]
+            if st.restart_count >= 1 and _pid(pod) != pid1:
+                restarted = True
+                break
+            time.sleep(0.05)
+        assert restarted
+        assert _alive(_pid(pod))
+        # stale checkpoints of dead processes are pruned on the NEXT adopt
+        k3 = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=FakeClock(),
+                           real_containers=True, container_root=root)
+        assert k3.containers.stats["adopted"] == 1  # only the live child
+    finally:
+        k2.containers.remove_all()
+        if k2.volume_host is not None:
+            k2.volume_host.teardown_all()
+
+
+def test_graceful_exit_preserves_persistent_containers(tmp_path):
+    """A persistent container root survives GRACEFUL kubelet exit: the
+    atexit path must not kill workloads a restart would re-adopt (only
+    ephemeral roots tear down).  Corrupt checkpoints degrade adoption
+    for that container only — never the kubelet start."""
+    from kubernetes_tpu.kubelet.containers import ProcessContainerManager
+
+    root = str(tmp_path / "ctrs")
+    m1 = ProcessContainerManager(root=root)
+    pid = m1.start("default/p", "c", command=["/bin/sleep", "100"])
+    ckpt = m1.checkpoint_path("default/p", "c")
+    assert os.path.exists(ckpt)
+
+    m1._atexit_cleanup()  # graceful exit: persistent root is left alone
+    assert _alive(pid)
+    assert os.path.exists(ckpt)
+
+    # a corrupt sibling checkpoint must not break adoption of the rest
+    os.makedirs(os.path.join(root, "default_q", "containers", "c"),
+                exist_ok=True)
+    bad = os.path.join(root, "default_q", "containers", "c",
+                       "checkpoint.json")
+    open(bad, "w").write('["not", "a", "dict"]')
+
+    m2 = ProcessContainerManager(root=root)
+    try:
+        assert m2.adopt_checkpoints() == 1
+        assert m2.alive("default/p", "c")
+        assert m2.pid("default/p", "c") == pid
+        assert not os.path.exists(bad)  # corrupt checkpoint pruned
+    finally:
+        m2.remove_all()
+        m1.remove_all()
