@@ -69,6 +69,38 @@ def _update_if_changed(client, name, mutate, namespace):
         return False
 
 
+def _build_subjects(users, groups, serviceaccounts):
+    """RBAC subject list from --user/--group/--serviceaccount flags,
+    deduplicated, ns:name both halves required (shared by create
+    rolebinding/clusterrolebinding and set subject — upstream
+    set_subject.go validates identically).  Returns (subjects, error):
+    exactly one is non-None."""
+    from ..api.rbac import Subject
+
+    subjects: list = []
+    seen: set = set()
+
+    def _add(s):
+        ident = (s.kind, s.name, s.namespace)
+        if ident not in seen:
+            seen.add(ident)
+            subjects.append(s)
+
+    for u in users:
+        _add(Subject(kind="User", name=u))
+    for g in groups:
+        _add(Subject(kind="Group", name=g))
+    for sa in serviceaccounts:
+        sa_ns, _, sa_name = sa.partition(":")
+        if not sa_ns or not sa_name:
+            return None, f"error: --serviceaccount wants ns:name, got {sa!r}\n"
+        _add(Subject(kind="ServiceAccount", name=sa_name, namespace=sa_ns))
+    if not subjects:
+        return None, ("error: at least one of --user/--group/"
+                      "--serviceaccount is required\n")
+    return subjects, None
+
+
 def _parse_selector(spec: str):
     """kubectl's selector grammar — the SAME parser the wire API uses
     (``api.selectors.parse_selector_string``: equality, set-based ``in``/
@@ -1641,6 +1673,37 @@ class Kubectl:
         self.out.write(f"{resource}/{name} selector updated\n")
         return 0
 
+    def set_subject(self, resource: str, name: str, users: list[str],
+                    groups: list[str], serviceaccounts: list[str],
+                    namespace: Optional[str] = None) -> int:
+        """``kubectl set subject`` (cmd/set/set_subject.go): append
+        users/groups/serviceaccounts to a (Cluster)RoleBinding's subject
+        list, deduplicated (within the flags AND against the binding)."""
+        resource, kind = _resolve(resource)
+        if kind not in ("RoleBinding", "ClusterRoleBinding"):
+            self.out.write(f"error: cannot set subject on {resource}\n")
+            return 1
+        want, err = _build_subjects(users, groups, serviceaccounts)
+        if err is not None:
+            self.out.write(err)
+            return 1
+
+        def _mutate(obj):
+            have = {(s.kind, s.name, s.namespace) for s in obj.subjects}
+            for s in want:
+                if (s.kind, s.name, s.namespace) not in have:
+                    have.add((s.kind, s.name, s.namespace))
+                    obj.subjects.append(s)
+            return obj
+
+        try:
+            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
+        except NotFoundError:
+            self.out.write(f'Error: {resource} "{name}" not found\n')
+            return 1
+        self.out.write(f"{resource}/{name} subjects updated\n")
+        return 0
+
     def set_serviceaccount(self, resource: str, name: str, sa_name: str,
                            namespace: Optional[str] = None) -> int:
         """``kubectl set serviceaccount`` (cmd/set/set_serviceaccount.go):
@@ -1985,7 +2048,6 @@ class Kubectl:
             PolicyRule,
             Role,
             RoleBinding,
-            Subject,
         )
         from ..client.remote import ForbiddenError
 
@@ -2088,19 +2150,9 @@ class Kubectl:
                 self.out.write("error: exactly one of --role/--clusterrole "
                                "is required\n")
                 return 1
-            subjects = ([Subject(kind="User", name=u) for u in users]
-                        + [Subject(kind="Group", name=g) for g in groups])
-            for sa in serviceaccounts:
-                sa_ns, _, sa_name = sa.partition(":")
-                if not sa_name:
-                    self.out.write(f"error: --serviceaccount wants ns:name, "
-                                   f"got {sa!r}\n")
-                    return 1
-                subjects.append(Subject(kind="ServiceAccount", name=sa_name,
-                                        namespace=sa_ns))
-            if not subjects:
-                self.out.write("error: at least one of --user/--group/"
-                               "--serviceaccount is required\n")
+            subjects, err = _build_subjects(users, groups, serviceaccounts)
+            if err is not None:
+                self.out.write(err)
                 return 1
             cls = RoleBinding if what == "rolebinding" else ClusterRoleBinding
             obj = cls(meta=api.ObjectMeta(name=name), subjects=subjects,
@@ -2795,12 +2847,17 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
     p.add_argument("--cpu-percent", type=int, default=80)
     p = sub.add_parser("set", parents=[common])
     p.add_argument("what", choices=["image", "resources", "env",
-                                    "selector", "serviceaccount", "sa"])
+                                    "selector", "serviceaccount", "sa",
+                                    "subject"])
     p.add_argument("resource")  # "deployment" or "deployment/NAME"
     p.add_argument("name", nargs="?")
     p.add_argument("pairs", nargs="*", help="container=image pairs (set image)")
     p.add_argument("--requests", default="")
     p.add_argument("--limits", default="")
+    p.add_argument("--user", action="append", default=[])
+    p.add_argument("--group", action="append", default=[])
+    p.add_argument("--serviceaccount", action="append", default=[],
+                   help="ns:name (set subject)")
     p = sub.add_parser("auth", parents=[common])
     p.add_argument("action", choices=["can-i"])
     p.add_argument("auth_verb")
@@ -3052,6 +3109,9 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
                 k.out.write("error: set serviceaccount requires a name\n")
                 return 1
             return k.set_serviceaccount(res, name, pairs[0], namespace)
+        if args.what == "subject":
+            return k.set_subject(res, name, args.user, args.group,
+                                 args.serviceaccount, namespace)
         return k.set_resources(res, name, args.requests, args.limits, namespace)
     if args.verb == "auth":
         return k.auth_can_i(args.auth_verb, args.auth_resource, args.auth_name, namespace)

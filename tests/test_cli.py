@@ -1495,3 +1495,42 @@ def test_apply_subverb_guards(cs, tmp_path):
 
     rc, out = run(cs, "set", "selector", "service/ghost", "a=b")
     assert rc == 1 and "not found" in out
+
+
+def test_set_subject_on_role_bindings(cs):
+    """set subject appends deduplicated users/groups/serviceaccounts to
+    a (Cluster)RoleBinding (cmd/set/set_subject.go)."""
+    rc, out = run(cs, "create", "rolebinding", "rb",
+                  "--role", "viewer", "--user", "alice")
+    assert rc == 0
+    rc, out = run(cs, "set", "subject", "rolebinding/rb",
+                  "--user", "bob", "--group", "devs",
+                  "--serviceaccount", "kube-system:robot")
+    assert rc == 0 and "subjects updated" in out
+    rb = cs.client_for("RoleBinding").get("rb")
+    got = {(s.kind, s.name, s.namespace) for s in rb.subjects}
+    assert ("User", "alice", "") in got and ("User", "bob", "") in got
+    assert ("Group", "devs", "") in got
+    assert ("ServiceAccount", "robot", "kube-system") in got
+    # idempotent: repeating adds nothing and commits no revision
+    rv = rb.meta.resource_version
+    rc, _ = run(cs, "set", "subject", "rolebinding/rb", "--user", "bob")
+    assert rc == 0
+    assert cs.client_for("RoleBinding").get("rb").meta.resource_version == rv
+    # guards
+    rc, out = run(cs, "set", "subject", "rolebinding/rb")
+    assert rc == 1 and "at least one" in out
+    rc, out = run(cs, "set", "subject", "deployment/x", "--user", "u")
+    assert rc == 1 and "cannot set subject" in out
+    rc, out = run(cs, "set", "subject", "rolebinding/rb",
+                  "--serviceaccount", "nocolon")
+    assert rc == 1 and "ns:name" in out
+    rc, out = run(cs, "set", "subject", "rolebinding/rb",
+                  "--serviceaccount", "ns-only:")
+    assert rc == 1 and "ns:name" in out
+    # duplicates WITHIN one invocation collapse too
+    rc, _ = run(cs, "set", "subject", "rolebinding/rb",
+                "--user", "carol", "--user", "carol")
+    assert rc == 0
+    rb = cs.client_for("RoleBinding").get("rb")
+    assert sum(1 for s in rb.subjects if s.name == "carol") == 1
