@@ -37,6 +37,10 @@ def main(argv=None) -> int:
                     help="persistent container/checkpoint root: a "
                     "restarted kubelet adopts still-live containers "
                     "(dockershim checkpoint recovery)")
+    ap.add_argument("--static-pod-dir", default=None,
+                    help="directory of pod manifests to run WITHOUT a "
+                    "scheduler, mirrored into the API (kubeadm-style "
+                    "static pods)")
     ap.add_argument("--feature-gates", default="",
                     help="A=true,B=false (e.g. DynamicKubeletConfig=true)")
     args = ap.parse_args(argv)
@@ -48,6 +52,10 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     cs = remote_clientset(args.apiserver, args.token)
+    if args.count > 1 and (args.static_pod_dir or args.real_containers
+                           or args.container_root):
+        logging.warning("--static-pod-dir/--real-containers/--container-root "
+                        "are single-node options; a --count fleet ignores them")
     if args.count > 1:
         fleet = HollowFleet(cs, args.count, cpu=args.cpu, memory=args.memory,
                             serve=args.serve_logs)
@@ -61,7 +69,8 @@ def main(argv=None) -> int:
         k = HollowKubelet(cs, args.name, cpu=args.cpu, memory=args.memory,
                           serve=args.serve_logs,
                           real_containers=args.real_containers,
-                          container_root=args.container_root)
+                          container_root=args.container_root,
+                          static_pod_dir=args.static_pod_dir)
         k.register()
         kubelets = [k]
         tick = k.tick

@@ -49,6 +49,7 @@ class HollowKubelet:
         real_sandboxes: bool = False,
         real_containers: bool = False,
         container_root: Optional[str] = None,
+        static_pod_dir: Optional[str] = None,
         system_reserved_cpu: str = "0",
         system_reserved_memory: str = "0",
         kube_reserved_cpu: str = "0",
@@ -106,6 +107,13 @@ class HollowKubelet:
         self.pod_manager = PodRuntimeManager(
             self.runtime, clock,
             containers=self.containers, volume_host=self.volume_host)
+        # static pods (pkg/kubelet/config file source + mirror pods):
+        # manifests in this directory run on the node WITHOUT a scheduler
+        # — how kubeadm self-hosts the control plane.  The kubelet
+        # mirrors them into the API for visibility; the FILE is the
+        # source of truth (API deletion of a mirror is undone next tick).
+        self.static_pod_dir = static_pod_dir
+        self._static_seen: dict[str, tuple[str, str]] = {}  # path -> (content hash, pod key)
         from .cm import ContainerManager, ImageManager
         from .pleg import PLEG
 
@@ -265,6 +273,122 @@ class HollowKubelet:
             p for p in self.clientset.pods.list()[0] if p.spec.node_name == self.node_name
         ]
 
+    # -- static pods (pkg/kubelet/config file source + mirror pods) --------
+    def _sync_static_pods(self, existing_keys: set) -> bool:
+        """Manifests in ``static_pod_dir`` run on this node without a
+        scheduler (how kubeadm self-hosts the control plane): each file
+        becomes a pod named ``<name>-<node>`` bound here and MIRRORED
+        into the API (``kubernetes.io/config.mirror``) for visibility.
+        The file is the source of truth — edits recreate the pod (change
+        detection by CONTENT hash, never mtime: the reference hashes the
+        manifest, and mtime granularity would miss same-second rewrites),
+        file removal removes it, and a deleted mirror is re-created.
+        ``existing_keys`` is this tick's node pod listing, so steady
+        state costs no extra API reads.  Returns True when anything
+        changed (the caller refetches its pod list)."""
+        import hashlib
+        import logging
+        import os
+
+        import yaml as _yaml
+
+        d = self.static_pod_dir
+        log = logging.getLogger("kubernetes_tpu.kubelet")
+        present: dict[str, tuple[str, str]] = {}  # path -> (content hash, key)
+        changed = False
+        try:
+            entries = sorted(os.listdir(d))
+        except OSError:
+            return False
+        for fname in entries:
+            if not fname.endswith((".yaml", ".yml", ".json")):
+                continue
+            path = os.path.join(d, fname)
+            prev = self._static_seen.get(path)
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                # a write-rename race or transient permission error must
+                # not read as "manifest removed" — keep the incarnation
+                if prev is not None:
+                    present[path] = prev
+                continue
+            digest = hashlib.sha256(raw).hexdigest()
+            if prev is not None and prev[0] == digest:
+                if prev[1] in existing_keys:
+                    present[path] = prev
+                    continue
+                # mirror deleted out from under us: the FILE outranks the
+                # API — forget the runtime incarnation and recreate
+                self.pod_manager.forget(prev[1])
+                prev = None
+            try:
+                pod = api.Pod.from_dict(_yaml.safe_load(raw.decode()))
+                if not pod.meta.name:
+                    raise ValueError("manifest has no metadata.name")
+            except Exception as e:  # noqa: BLE001 — a bad manifest must
+                # not take down the sync loop; keep any prior incarnation
+                log.warning("static pod manifest %s unreadable: %s", path, e)
+                if prev is not None:
+                    present[path] = prev
+                continue
+            # the reference's static-pod identity: <name>-<nodename>
+            pod.meta.name = f"{pod.meta.name}-{self.node_name}"
+            pod.spec.node_name = self.node_name
+            pod.meta.annotations["kubernetes.io/config.mirror"] = "true"
+            pod.meta.annotations["kubernetes.io/config.source"] = "file"
+            key = pod.meta.key
+            if prev is not None and prev[1] != key:
+                self._delete_mirror(prev[1])  # renamed in the file
+                changed = True
+            if prev is not None and prev[1] == key:
+                # changed manifest: recreate with the new spec
+                self._delete_mirror(key)
+                self.pod_manager.forget(key)
+            try:
+                self.clientset.pods.create(pod)
+                changed = True
+            except AlreadyExistsError:
+                # NEVER steal a non-mirror pod: a user pod that happens to
+                # share the name keeps running and the manifest is skipped
+                # (real mirror-pod handling verifies the annotation too)
+                if not self._is_our_mirror(key):
+                    log.warning(
+                        "static pod %s collides with an existing non-static "
+                        "pod; manifest %s skipped", key, path)
+                    continue
+                self._delete_mirror(key)
+                self.pod_manager.forget(key)
+                try:
+                    self.clientset.pods.create(pod)
+                    changed = True
+                except AlreadyExistsError:
+                    pass
+            present[path] = (digest, key)
+        for path, (_, key) in self._static_seen.items():
+            if path not in present and key:
+                self._delete_mirror(key)  # manifest removed
+                changed = True
+        self._static_seen = present
+        return changed
+
+    def _is_our_mirror(self, pod_key: str) -> bool:
+        ns, name = pod_key.split("/", 1)
+        try:
+            cur = self.clientset.pods.get(name, ns)
+        except NotFoundError:
+            return False
+        return (cur.meta.annotations.get("kubernetes.io/config.mirror") == "true"
+                and cur.spec.node_name == self.node_name)
+
+    def _delete_mirror(self, pod_key: str) -> None:
+        ns, name = pod_key.split("/", 1)
+        try:
+            self.clientset.pods.delete(name, ns)
+        except NotFoundError:
+            pass
+
     # -- the sync tick -----------------------------------------------------
     def tick(self) -> dict:
         """One syncLoop iteration: heartbeat if due, admit newly-bound pods,
@@ -276,6 +400,9 @@ class HollowKubelet:
         self._heartbeat()
 
         mine = self._my_pods()
+        if self.static_pod_dir is not None:
+            if self._sync_static_pods({p.meta.key for p in mine}):
+                mine = self._my_pods()  # mirrors changed: refresh the view
         live = {p.meta.key for p in mine}
         # volume manager pass (reconciler.go:165): pods with PVC-backed
         # volumes may only start once attach + mount complete

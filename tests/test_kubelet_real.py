@@ -519,3 +519,74 @@ def test_graceful_exit_preserves_persistent_containers(tmp_path):
     finally:
         m2.remove_all()
         m1.remove_all()
+
+
+def test_static_pods_from_manifest_dir(tmp_path):
+    """The file pod source + mirror pods (pkg/kubelet/config file.go,
+    kubeadm's self-hosting mechanism): manifests run on the node WITHOUT
+    a scheduler as <name>-<node>, mirrored into the API; the FILE is the
+    source of truth — API deletion is undone, edits recreate, removal
+    stops the pod."""
+    import yaml as _yaml
+
+    mdir = tmp_path / "manifests"
+    mdir.mkdir()
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock,
+                      real_containers=True, static_pod_dir=str(mdir))
+    k.register()
+
+    manifest = mdir / "web.yaml"
+    manifest.write_text(_yaml.safe_dump({
+        "kind": "Pod", "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "img",
+                                 "command": ["/bin/sleep", "1000"]}]}}))
+    try:
+        for _ in range(3):
+            k.tick()
+        pod = cs.pods.get("web-n1", "default")
+        assert pod.status.phase == "Running"
+        assert pod.meta.annotations["kubernetes.io/config.mirror"] == "true"
+        assert pod.spec.node_name == "n1"
+        pid1 = _pid(pod)
+        assert _alive(pid1)
+
+        # the file outranks the API: a deleted mirror comes back
+        cs.pods.delete("web-n1", "default")
+        for _ in range(4):
+            k.tick()
+        pod = cs.pods.get("web-n1", "default")
+        assert pod.status.phase in ("Pending", "Running")
+
+        # an edited manifest recreates the pod with the new spec (change
+        # detection is by CONTENT hash — same-second rewrites count)
+        manifest.write_text(_yaml.safe_dump({
+            "kind": "Pod", "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img",
+                                     "command": ["/bin/sleep", "999"]}]}}))
+        for _ in range(4):
+            k.tick()
+        pod = cs.pods.get("web-n1", "default")
+        assert pod.spec.containers[0].command == ["/bin/sleep", "999"]
+
+        # a pre-existing NON-static pod with a colliding name is never
+        # stolen: the manifest is skipped, the user pod keeps running
+        cs.pods.create(real_pod("db-n1", command=["/bin/sleep", "1000"]))
+        (mdir / "db.yaml").write_text(_yaml.safe_dump({
+            "kind": "Pod", "metadata": {"name": "db", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img"}]}}))
+        for _ in range(3):
+            k.tick()
+        db = cs.pods.get("db-n1", "default")
+        assert "kubernetes.io/config.mirror" not in db.meta.annotations
+
+        # removing the manifest removes the mirror
+        manifest.unlink()
+        k.tick()
+        with pytest.raises(Exception):
+            cs.pods.get("web-n1", "default")
+    finally:
+        k.containers.remove_all()
+        if k.volume_host is not None:
+            k.volume_host.teardown_all()
