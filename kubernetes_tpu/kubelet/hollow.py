@@ -50,6 +50,7 @@ class HollowKubelet:
         real_containers: bool = False,
         container_root: Optional[str] = None,
         static_pod_dir: Optional[str] = None,
+        manifest_url: Optional[str] = None,
         system_reserved_cpu: str = "0",
         system_reserved_memory: str = "0",
         kube_reserved_cpu: str = "0",
@@ -113,7 +114,15 @@ class HollowKubelet:
         # mirrors them into the API for visibility; the FILE is the
         # source of truth (API deletion of a mirror is undone next tick).
         self.static_pod_dir = static_pod_dir
-        self._static_seen: dict[str, tuple[str, str]] = {}  # path -> (content hash, pod key)
+        # the http pod source (config/http.go): one URL serving a single
+        # pod manifest, merged with the file source through the same
+        # static-pod machinery; polled at its own cadence (the
+        # reference's --http-check-frequency), never per tick
+        self.manifest_url = manifest_url
+        self.http_check_frequency = 20.0
+        self._last_url_fetch = -1e18
+        self._last_url_body: Optional[bytes] = None
+        self._static_seen: dict[str, tuple[str, str]] = {}  # source -> (content hash, pod key)
         from .cm import ContainerManager, ImageManager
         from .pleg import PLEG
 
@@ -275,42 +284,73 @@ class HollowKubelet:
 
     # -- static pods (pkg/kubelet/config file source + mirror pods) --------
     def _sync_static_pods(self, existing_keys: set) -> bool:
-        """Manifests in ``static_pod_dir`` run on this node without a
-        scheduler (how kubeadm self-hosts the control plane): each file
-        becomes a pod named ``<name>-<node>`` bound here and MIRRORED
-        into the API (``kubernetes.io/config.mirror``) for visibility.
-        The file is the source of truth — edits recreate the pod (change
-        detection by CONTENT hash, never mtime: the reference hashes the
-        manifest, and mtime granularity would miss same-second rewrites),
-        file removal removes it, and a deleted mirror is re-created.
-        ``existing_keys`` is this tick's node pod listing, so steady
-        state costs no extra API reads.  Returns True when anything
-        changed (the caller refetches its pod list)."""
+        """Manifests from ``static_pod_dir`` and/or ``manifest_url`` run
+        on this node without a scheduler (how kubeadm self-hosts the
+        control plane): each one becomes a pod named ``<name>-<node>``
+        bound here and MIRRORED into the API
+        (``kubernetes.io/config.mirror``) for visibility.  The source is
+        the truth — edits recreate the pod (change detection by CONTENT
+        hash, never mtime: the reference hashes the manifest, and mtime
+        granularity would miss same-second rewrites), removal stops it,
+        and a deleted mirror is re-created.  ``existing_keys`` is this
+        tick's node pod listing, so steady state costs no extra API
+        reads.  Returns True when anything changed (the caller refetches
+        its pod list)."""
         import hashlib
         import logging
         import os
 
         import yaml as _yaml
 
-        d = self.static_pod_dir
         log = logging.getLogger("kubernetes_tpu.kubelet")
-        present: dict[str, tuple[str, str]] = {}  # path -> (content hash, key)
+        present: dict[str, tuple[str, str]] = {}  # source -> (content hash, key)
         changed = False
-        try:
-            entries = sorted(os.listdir(d))
-        except OSError:
-            return False
-        for fname in entries:
-            if not fname.endswith((".yaml", ".yml", ".json")):
-                continue
-            path = os.path.join(d, fname)
-            prev = self._static_seen.get(path)
+        # sources: every manifest file in the dir, plus the manifest URL
+        # (config/file.go + config/http.go merged into one update stream)
+        sources: list[tuple[str, Optional[bytes]]] = []
+        if self.static_pod_dir is not None:
             try:
-                with open(path, "rb") as f:
-                    raw = f.read()
+                entries = sorted(os.listdir(self.static_pod_dir))
             except OSError:
-                # a write-rename race or transient permission error must
-                # not read as "manifest removed" — keep the incarnation
+                # a transiently unreadable DIR must not read as "every
+                # manifest removed": carry all previously-seen file
+                # sources unchanged (same contract as a per-file race)
+                entries = None
+            if entries is None:
+                sources.extend(
+                    (p, None) for p in self._static_seen
+                    if p != self.manifest_url)
+            else:
+                for fname in entries:
+                    if not fname.endswith((".yaml", ".yml", ".json")):
+                        continue
+                    path = os.path.join(self.static_pod_dir, fname)
+                    try:
+                        with open(path, "rb") as f:
+                            sources.append((path, f.read()))
+                    except OSError:
+                        # a write-rename race or transient permission
+                        # error must not read as "manifest removed"
+                        sources.append((path, None))
+        if self.manifest_url:
+            # poll at http_check_frequency, not per tick: a slow or
+            # blackholed URL must not stall probes/restarts every cycle
+            now = self._clock()
+            if now - self._last_url_fetch >= self.http_check_frequency:
+                self._last_url_fetch = now
+                import urllib.request
+
+                try:
+                    with urllib.request.urlopen(self.manifest_url,
+                                                timeout=5) as r:
+                        self._last_url_body = r.read()
+                except Exception:  # noqa: BLE001 — an unreachable URL
+                    # keeps the last incarnation, like an unreadable file
+                    self._last_url_body = None
+            sources.append((self.manifest_url, self._last_url_body))
+        for path, raw in sources:
+            prev = self._static_seen.get(path)
+            if raw is None:
                 if prev is not None:
                     present[path] = prev
                 continue
@@ -337,7 +377,8 @@ class HollowKubelet:
             pod.meta.name = f"{pod.meta.name}-{self.node_name}"
             pod.spec.node_name = self.node_name
             pod.meta.annotations["kubernetes.io/config.mirror"] = "true"
-            pod.meta.annotations["kubernetes.io/config.source"] = "file"
+            pod.meta.annotations["kubernetes.io/config.source"] = (
+                "http" if path == self.manifest_url else "file")
             key = pod.meta.key
             if prev is not None and prev[1] != key:
                 self._delete_mirror(prev[1])  # renamed in the file
@@ -400,7 +441,7 @@ class HollowKubelet:
         self._heartbeat()
 
         mine = self._my_pods()
-        if self.static_pod_dir is not None:
+        if self.static_pod_dir is not None or self.manifest_url:
             if self._sync_static_pods({p.meta.key for p in mine}):
                 mine = self._my_pods()  # mirrors changed: refresh the view
         live = {p.meta.key for p in mine}

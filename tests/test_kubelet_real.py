@@ -590,3 +590,112 @@ def test_static_pods_from_manifest_dir(tmp_path):
         k.containers.remove_all()
         if k.volume_host is not None:
             k.volume_host.teardown_all()
+
+
+def test_http_manifest_pod_source(tmp_path):
+    """The http pod source (pkg/kubelet/config/http.go): a manifest
+    served over HTTP runs like a file static pod; content changes at the
+    URL recreate it; an unreachable URL keeps the last incarnation."""
+    import http.server
+    import threading
+
+    import yaml as _yaml
+
+    doc = {"kind": "Pod", "metadata": {"name": "remote", "namespace": "default"},
+           "spec": {"containers": [{"name": "c", "image": "img",
+                                    "command": ["/bin/sleep", "1000"]}]}}
+    body = {"data": _yaml.safe_dump(doc).encode()}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body["data"])))
+            self.end_headers()
+            self.wfile.write(body["data"])
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_port}/manifest.yaml"
+
+    cs = Clientset(Store())
+    clock = FakeClock()
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=clock,
+                      real_containers=True, manifest_url=url)
+    k.register()
+    try:
+        for _ in range(3):
+            k.tick()
+        pod = cs.pods.get("remote-n1", "default")
+        assert pod.status.phase == "Running"
+        assert pod.meta.annotations["kubernetes.io/config.source"] == "http"
+        pid1 = _pid(pod)
+        assert _alive(pid1)
+
+        # content change at the URL -> recreate with the new spec once
+        # the http-check cadence (reference --http-check-frequency) fires
+        doc["spec"]["containers"][0]["command"] = ["/bin/sleep", "999"]
+        body["data"] = _yaml.safe_dump(doc).encode()
+        k.tick()  # within the check window: fetch is SKIPPED
+        assert cs.pods.get(
+            "remote-n1", "default").spec.containers[0].command == ["/bin/sleep", "1000"]
+        for _ in range(4):
+            clock.advance(25.0)
+            k.tick()
+        pod = cs.pods.get("remote-n1", "default")
+        assert pod.spec.containers[0].command == ["/bin/sleep", "999"]
+
+        # an unreachable URL must keep the last incarnation running
+        srv.shutdown()
+        srv.server_close()
+        for _ in range(3):
+            clock.advance(25.0)
+            k.tick()
+        assert cs.pods.get("remote-n1", "default").status.phase == "Running"
+    finally:
+        k.containers.remove_all()
+        if k.volume_host is not None:
+            k.volume_host.teardown_all()
+
+
+def test_transient_manifest_dir_failure_keeps_static_pods(tmp_path, monkeypatch):
+    """A momentarily unreadable manifest DIR must not read as 'every
+    manifest removed' — running static pods survive the glitch."""
+    import yaml as _yaml
+
+    mdir = tmp_path / "manifests"
+    mdir.mkdir()
+    (mdir / "web.yaml").write_text(_yaml.safe_dump({
+        "kind": "Pod", "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "img",
+                                 "command": ["/bin/sleep", "1000"]}]}}))
+    cs = Clientset(Store())
+    k = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=FakeClock(),
+                      real_containers=True, static_pod_dir=str(mdir))
+    k.register()
+    try:
+        for _ in range(3):
+            k.tick()
+        assert cs.pods.get("web-n1", "default").status.phase == "Running"
+
+        real_listdir = os.listdir
+
+        def flaky(path):
+            if str(path) == str(mdir):
+                raise OSError("transient I/O error")
+            return real_listdir(path)
+
+        monkeypatch.setattr(os, "listdir", flaky)
+        for _ in range(2):
+            k.tick()
+        monkeypatch.setattr(os, "listdir", real_listdir)
+        pod = cs.pods.get("web-n1", "default")  # still here
+        assert pod.status.phase == "Running"
+        k.tick()
+        assert cs.pods.get("web-n1", "default").status.phase == "Running"
+    finally:
+        k.containers.remove_all()
+        if k.volume_host is not None:
+            k.volume_host.teardown_all()
