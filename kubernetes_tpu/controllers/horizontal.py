@@ -86,10 +86,10 @@ class HorizontalPodAutoscalerController(Controller):
                 # then converge instead of compounding; fully idle
                 # (ratio 0) clamps to minReplicas
                 desired = math.ceil(len(known) * ratio)
-            desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
-        elif not pods:
-            desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
-        # pods exist but ALL metrics are missing: hold replicas as-is
+        # pods exist but ALL metrics are missing (or target<=0): hold the
+        # metric-driven decision as-is — but the reference always bounds
+        # desiredReplicas, so the [min,max] clamp is unconditional
+        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
 
         if desired != current:
             def _scale(obj):

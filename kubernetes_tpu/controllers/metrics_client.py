@@ -5,9 +5,10 @@ Capability of ``pkg/controller/podautoscaler/metrics/metrics_client.go``
 document (``pkg/kubelet/server/stats/summary.go``), keep the last two
 CPU samples per pod, and answer *CPU utilization as percent of request*
 — cumulative CPU deltas over wall time, exactly how a rate is derived
-from cadvisor counters.  The scrape path is the apiserver's node proxy
-(``/api/v1/nodes/<n>/proxy/stats/summary``) when the clientset is
-remote, or the node's kubeletURL directly when in-process.
+from cadvisor counters.  The scrape dials the node's kubeletURL
+directly, falling back to the apiserver's node proxy
+(``/api/v1/nodes/<n>/proxy/stats/summary``) when the direct dial fails
+or no kubeletURL is published — so tunnel-only nodes still feed the HPA.
 """
 
 from __future__ import annotations
@@ -42,19 +43,57 @@ class MetricsClient:
         self.min_rate_window = 0.25
         self._memory: dict[str, int] = {}
         self._pod_node: dict[str, str] = {}  # last node each pod reported from
+        # node -> scrape counter at demotion: scrape via proxy only until
+        # DIRECT_RETRY_SWEEPS pass, then retry the direct dial (a node
+        # that recovers gets its direct path back; entries for deleted
+        # nodes are pruned each sweep)
+        self._direct_bad: dict[str, int] = {}
         self.stats = {"scrapes": 0, "nodes_ok": 0, "nodes_failed": 0}
+
+    # how many sweeps a node stays demoted to the proxy before the
+    # direct dial is retried (~1 min at the default 5s interval)
+    DIRECT_RETRY_SWEEPS = 12
 
     # -- scraping ------------------------------------------------------------
     def _fetch_summary(self, node: api.Node) -> Optional[dict]:
         url = node.status.kubelet_url
-        if not url:
+        raw = getattr(self.clientset.store, "raw", None)
+        demoted_at = self._direct_bad.get(node.meta.name)
+        if demoted_at is not None and (
+                self.stats["scrapes"] - demoted_at >= self.DIRECT_RETRY_SWEEPS):
+            self._direct_bad.pop(node.meta.name)
+            demoted_at = None
+        # a node whose direct dial recently failed goes straight to the
+        # proxy — otherwise every sweep pays the full direct timeout per
+        # tunnel-only node before the call that actually works
+        if url and demoted_at is None:
+            try:
+                with urllib.request.urlopen(f"{url}/stats/summary", timeout=5) as r:
+                    return json.loads(r.read())
+            except Exception as e:  # noqa: BLE001 — a down node must not stop the sweep
+                logger.debug("direct stats scrape of %s failed: %s",
+                             node.meta.name, e)
+                if raw is not None:  # demote only when a proxy path exists
+                    self._direct_bad[node.meta.name] = self.stats["scrapes"]
+        # fall back to the apiserver node proxy when the clientset is
+        # remote (RemoteStore carries .raw): nodes reachable only through
+        # the tunneler still feed the HPA pipeline
+        if raw is None:
             return None
         try:
-            with urllib.request.urlopen(f"{url}/stats/summary", timeout=5) as r:
-                return json.loads(r.read())
-        except Exception as e:  # noqa: BLE001 — a down node must not stop the sweep
-            logger.debug("stats scrape of %s failed: %s", node.meta.name, e)
+            body = raw("GET",
+                       f"/api/v1/nodes/{node.meta.name}/proxy/stats/summary")
+            return json.loads(body)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("proxied stats scrape of %s failed: %s",
+                         node.meta.name, e)
+            # both paths down: let the next sweep retry the direct dial
+            self._direct_bad.pop(node.meta.name, None)
             return None
+
+    def _scrapeable(self, node: api.Node) -> bool:
+        return bool(node.status.kubelet_url
+                    or getattr(self.clientset.store, "raw", None))
 
     def scrape(self, force: bool = False) -> None:
         """One sweep over every node with a kubelet endpoint; throttled
@@ -69,10 +108,12 @@ class MetricsClient:
         memory: dict[str, int] = {}
         pod_node: dict[str, str] = {}
         ok_nodes: set[str] = set()
+        all_nodes: set[str] = set()
         for node in self.clientset.nodes.list()[0]:
+            all_nodes.add(node.meta.name)
             summary = self._fetch_summary(node)
             if summary is None:
-                if node.status.kubelet_url:
+                if self._scrapeable(node):
                     self.stats["nodes_failed"] += 1
                 continue
             self.stats["nodes_ok"] += 1
@@ -108,6 +149,9 @@ class MetricsClient:
             self._pod_node.pop(gone, None)
         self._pod_node.update(pod_node)
         self._memory.update(memory)
+        # deleted nodes must not accumulate in the demotion ledger
+        for gone in [n for n in self._direct_bad if n not in all_nodes]:
+            self._direct_bad.pop(gone)
 
     # -- queries -------------------------------------------------------------
     def pod_cpu_millicores(self, pod_key: str) -> Optional[float]:

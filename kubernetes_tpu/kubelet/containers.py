@@ -39,6 +39,7 @@ status.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import shutil
@@ -67,6 +68,23 @@ def _proc_starttime(pid: int) -> Optional[str]:
 # default entrypoint: a quiet long sleep (the "image default" — pause-like)
 _DEFAULT_COMMAND = ["/bin/sh", "-c", "exec sleep 1000000"]
 
+# ONE module-level atexit hook over a strong set of managers:
+# per-instance atexit.register pinned every manager (fleets, test
+# suites) alive until interpreter exit even after remove_all.  The set
+# must hold strong refs — a weak set would let a manager dropped
+# WITHOUT remove_all be collected mid-run, orphaning its children
+# forever; here it stays pinned until exit cleanup kills them, and
+# remove_all() unpins the well-behaved ones.
+_LIVE_MANAGERS: "set[ProcessContainerManager]" = set()
+
+
+def _atexit_cleanup_all() -> None:
+    for mgr in list(_LIVE_MANAGERS):
+        mgr._atexit_cleanup()
+
+
+atexit.register(_atexit_cleanup_all)
+
 
 class ProcessContainerManager:
     """Real child processes playing the container role (one per
@@ -83,9 +101,7 @@ class ProcessContainerManager:
         # restarted manager watches them through /proc instead of waitpid
         self._ctrs: dict[tuple[str, str], dict] = {}
         self.stats = {"adopted": 0}
-        import atexit
-
-        atexit.register(self._atexit_cleanup)
+        _LIVE_MANAGERS.add(self)
 
     def _atexit_cleanup(self) -> None:
         """Ephemeral roots tear everything down; a PERSISTENT root leaves
@@ -326,6 +342,7 @@ class ProcessContainerManager:
             self.remove(k, n)
         if self._own_root:
             shutil.rmtree(self.root, ignore_errors=True)
+        _LIVE_MANAGERS.discard(self)
 
     def known_pods(self) -> set[str]:
         with self._mu:

@@ -24,6 +24,7 @@ paths — the unprivileged stand-in for a bind mount).
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import tempfile
@@ -31,6 +32,18 @@ import threading
 from typing import Callable, Optional
 
 from ..api import types as api
+
+log = logging.getLogger("kubernetes_tpu.kubelet")
+
+
+def _valid_payload_key(k: str) -> bool:
+    """``atomic_writer.go validatePayload``: a key names ONE file in the
+    volume dir — reject empty, ``.``/``..``, anything ``..``-prefixed
+    (collides with the atomic writer's internal ``..data``/``..<ts>``
+    namespace), and any path separator (this flat layout projects each
+    key as a single symlink, so traversal and nesting are both out)."""
+    return bool(k) and k not in (".", "..") and not k.startswith("..") \
+        and "/" not in k and os.sep not in k and not os.path.isabs(k)
 
 
 class VolumeHost:
@@ -46,6 +59,7 @@ class VolumeHost:
         self.fetch_secret = fetch_secret or (lambda ns, n: None)
         self._mu = threading.Lock()
         self._ts = 0  # monotonic payload-dir counter (the ..<ts> names)
+        self._warned_keys: dict[str, frozenset] = {}  # vol_dir -> last bad set
         self.stats = {"mounts": 0, "updates": 0, "unmounts": 0}
 
     def pod_volumes_dir(self, pod_key: str) -> str:
@@ -130,6 +144,13 @@ class VolumeHost:
     def _atomic_write(self, vol_dir: str, payload: dict[str, bytes]) -> bool:
         """atomic_writer.go: write ``..<ts>``, flip ``..data``, project
         keys as symlinks.  Returns True when content actually changed."""
+        bad = frozenset(k for k in payload if not _valid_payload_key(k))
+        if bad:
+            if self._warned_keys.get(vol_dir) != bad:  # once per key set,
+                self._warned_keys[vol_dir] = bad       # not per sync tick
+                log.warning("volume %s: skipping invalid payload key(s) %s",
+                            vol_dir, sorted(bad))
+            payload = {k: v for k, v in payload.items() if k not in bad}
         with self._mu:
             os.makedirs(vol_dir, exist_ok=True)
             data_link = os.path.join(vol_dir, "..data")

@@ -699,3 +699,43 @@ def test_transient_manifest_dir_failure_keeps_static_pods(tmp_path, monkeypatch)
         k.containers.remove_all()
         if k.volume_host is not None:
             k.volume_host.teardown_all()
+
+
+def test_traversal_payload_keys_never_escape_the_volume_root(tmp_path):
+    """atomic_writer.go validatePayload: a configMap key carrying '..'
+    or a path separator is API-controlled data and must neither write
+    outside the volume root nor crash the sync tick — it is skipped
+    with a warning while the well-formed keys still project."""
+    from kubernetes_tpu.kubelet.volumehost import VolumeHost
+
+    root = tmp_path / "volroot"
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    evil = {
+        "../../../outside/pwned": "boom",
+        "/abs/path": "boom",
+        "nested/key": "boom",
+        "..": "boom",
+        "..data": "boom",
+        "..evil": "boom",
+        "ok": "fine",
+    }
+    vh = VolumeHost(root=str(root),
+                    fetch_configmap=lambda ns, n: dict(evil))
+    pod = Pod(meta=ObjectMeta(name="p", namespace="default"),
+              spec=PodSpec(
+                  node_name="n1",
+                  containers=[Container(name="c")],
+                  volumes=[Volume(name="cfg", config_map_name="cm")]))
+    # must not raise, and must write only the valid key
+    assert vh.sync_pod(pod) == 1
+    vol_dir = vh.volume_path("default/p", "cfg")
+    assert os.path.islink(os.path.join(vol_dir, "ok"))
+    with open(os.path.join(vol_dir, "ok")) as f:
+        assert f.read() == "fine"
+    # nothing escaped the volume root
+    assert list(outside.iterdir()) == []
+    assert not os.path.exists(os.path.join(str(root), "abs"))
+    # idempotent: a second sync sees unchanged content, no rewrite
+    assert vh.sync_pod(pod) == 0
+    vh.teardown_all()
