@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import re
 import sys
 from typing import Optional
 
@@ -118,6 +119,16 @@ def _parse_selector(spec: str):
 
 def _labels_match(obj, want) -> bool:
     return want.matches(obj.meta.labels)
+
+
+_LABEL_VALUE_RE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+
+
+def _valid_label_value(v: str) -> bool:
+    """``validation.IsValidLabelValue``: ≤63 chars, empty allowed,
+    alphanumeric ends with -_. allowed in the middle (label.go
+    validates values at parse time; annotate does not)."""
+    return len(v) <= 63 and bool(_LABEL_VALUE_RE.match(v))
 REVISION_ANNOTATION = api.DEPLOYMENT_REVISION_ANNOTATION
 
 
@@ -1248,11 +1259,19 @@ class Kubectl:
         return 0
 
     # -- label / annotate (cmd/label.go, cmd/annotate.go) ------------------
-    def _set_map(self, resource: str, name: str, pairs: list[str], which: str,
-                 namespace: Optional[str], overwrite: bool) -> int:
-        """Shared engine for label/annotate: "k=v" sets, "k-" removes;
-        setting an existing key without --overwrite is an error (the
-        reference refuses to clobber silently)."""
+    def _set_map(self, resource: str, name: Optional[str], pairs: list[str],
+                 which: str, namespace: Optional[str], overwrite: bool,
+                 resource_version: str = "", selector: str = "",
+                 all_resources: bool = False) -> int:
+        """Shared engine for label/annotate: "k=v" sets, "k-" removes.
+        Reference semantics (``label.go:99 RunLabel`` /
+        ``annotate.go:180 RunAnnotate``): setting an existing key without
+        --overwrite is an error; removing an absent key prints
+        ``label %q not found.`` but succeeds; the same key may not be
+        both set and removed; --resource-version makes the update
+        conditional on the object being at exactly that version (and is
+        only valid against a single resource); --all / -l select every
+        matching object of the type."""
         resource, kind = _resolve(resource)
         if kind is None:
             self.out.write(f"error: unknown resource {resource!r}\n")
@@ -1263,43 +1282,122 @@ class Kubectl:
                 removes.append(p[:-1])
             elif "=" in p:
                 k, v = p.split("=", 1)
+                if which == "labels" and not _valid_label_value(v):
+                    self.out.write(f"error: invalid label value: {p!r}\n")
+                    return 1
                 sets[k] = v
             else:
                 self.out.write(f"error: expected KEY=VALUE or KEY-, got {p!r}\n")
                 return 1
-        err = []
+        both = [k for k in removes if k in sets]
+        if both:
+            noun = "a label" if which == "labels" else "an annotation"
+            self.out.write(f"error: can not both modify and remove {noun} "
+                           f"in the same command\n")
+            return 1
+        if not sets and not removes:
+            self.out.write(f"error: at least one {which[:-1]} update is required\n")
+            return 1
 
-        def _mutate(obj):
-            m = getattr(obj.meta, which)
-            if not overwrite:
-                clobbered = [k for k, v in sets.items() if k in m and m[k] != v]
-                if clobbered:
-                    err.append(clobbered)
+        client = self.cs.client_for(kind)
+        if all_resources or selector:
+            if name:
+                # the reference rejects a name combined with --all/-l
+                # rather than silently fanning out past it
+                self.out.write("error: a resource name may not be specified "
+                               "together with --all or a selector\n")
+                return 1
+            if resource_version:
+                self.out.write("error: --resource-version may only be used "
+                               "with a single resource\n")
+                return 1
+            want = None
+            if selector:
+                want = _parse_selector(selector)
+                if want is None:
+                    self.out.write(f"error: bad selector {selector!r}\n")
+                    return 1
+            ns_scope = namespace if namespace is not None else client.default_namespace
+            objs, _ = client.list(ns_scope)
+            if want is not None:
+                objs = [o for o in objs if _labels_match(o, want)]
+            names = [o.meta.name for o in objs]
+        elif name:
+            names = [name]
+        else:
+            self.out.write("error: one or more resources must be specified "
+                           "as <resource> <name> or <resource>/<name>\n")
+            return 1
+
+        verbed = "labeled" if which == "labels" else "annotated"
+        failed = 0  # the reference visitor continues over the remaining
+        # objects on a per-object error and aggregates — bulk runs must
+        # not stop half-written
+        for nm in names:
+            err = []
+            absent: set = set()  # collected here: guaranteed_update may
+            # retry _mutate on a CAS conflict, and the message must not
+            # print once per attempt
+
+            def _mutate(obj):
+                if resource_version and \
+                        str(obj.meta.resource_version) != str(resource_version):
+                    err.append(("conflict", obj.meta.resource_version))
                     raise _AbortMutation
-            m.update(sets)
-            for k in removes:
-                m.pop(k, None)
-            return obj
+                m = getattr(obj.meta, which)
+                if not overwrite:
+                    clobbered = [k for k, v in sets.items()
+                                 if k in m and m[k] != v]
+                    if clobbered:
+                        err.append(("overwrite", clobbered[0]))
+                        raise _AbortMutation
+                absent.clear()
+                absent.update(k for k in removes if k not in m)
+                m.update(sets)
+                for k in removes:
+                    m.pop(k, None)
+                return obj
 
-        try:
-            _update_if_changed(self.cs.client_for(kind), name, _mutate, namespace)
-        except _AbortMutation:
-            self.out.write(
-                f"error: {err[0][0]!r} already has a value; use --overwrite\n")
-            return 1
-        except (NotFoundError, KeyError):
-            self.out.write(f'Error: {resource} "{name}" not found\n')
-            return 1
-        self.out.write(f"{resource}/{name} {'labeled' if which == 'labels' else 'annotated'}\n")
-        return 0
+            try:
+                wrote = _update_if_changed(client, nm, _mutate, namespace)
+                for k in sorted(absent):
+                    self.out.write(f"{which[:-1]} \"{k}\" not found.\n")
+            except _AbortMutation:
+                why, detail = err[0]
+                if why == "conflict":
+                    self.out.write(
+                        f"Error from server (Conflict): {resource} \"{nm}\" "
+                        f"has been modified (resource version {detail}, "
+                        f"requested {resource_version})\n")
+                else:
+                    self.out.write(
+                        f"error: {resource} \"{nm}\": {detail!r} already has "
+                        f"a value; use --overwrite\n")
+                failed += 1
+                continue
+            except (NotFoundError, KeyError):
+                self.out.write(f'Error: {resource} "{nm}" not found\n')
+                failed += 1
+                continue
+            self.out.write(f"{resource}/{nm} "
+                           f"{verbed if wrote else 'not ' + verbed}\n")
+        return 1 if failed else 0
 
-    def label(self, resource: str, name: str, pairs: list[str],
-              namespace: Optional[str] = None, overwrite: bool = False) -> int:
-        return self._set_map(resource, name, pairs, "labels", namespace, overwrite)
+    def label(self, resource: str, name: Optional[str], pairs: list[str],
+              namespace: Optional[str] = None, overwrite: bool = False,
+              resource_version: str = "", selector: str = "",
+              all_resources: bool = False) -> int:
+        return self._set_map(resource, name, pairs, "labels", namespace,
+                             overwrite, resource_version, selector,
+                             all_resources)
 
-    def annotate(self, resource: str, name: str, pairs: list[str],
-                 namespace: Optional[str] = None, overwrite: bool = False) -> int:
-        return self._set_map(resource, name, pairs, "annotations", namespace, overwrite)
+    def annotate(self, resource: str, name: Optional[str], pairs: list[str],
+                 namespace: Optional[str] = None, overwrite: bool = False,
+                 resource_version: str = "", selector: str = "",
+                 all_resources: bool = False) -> int:
+        return self._set_map(resource, name, pairs, "annotations", namespace,
+                             overwrite, resource_version, selector,
+                             all_resources)
 
     # -- patch (cmd/patch.go) ----------------------------------------------
     def patch(self, resource: str, name: str, patch: str,
@@ -2812,10 +2910,13 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
     p.add_argument("--to-revision", type=int, default=0)
     for verb in ("label", "annotate"):
         p = sub.add_parser(verb, parents=[common])
-        p.add_argument("resource")
-        p.add_argument("name")
-        p.add_argument("pairs", nargs="+", help="KEY=VALUE or KEY- to remove")
+        p.add_argument("resource")  # "pods" or "pods/NAME"
+        p.add_argument("name", nargs="?")
+        p.add_argument("pairs", nargs="*", help="KEY=VALUE or KEY- to remove")
         p.add_argument("--overwrite", action="store_true")
+        p.add_argument("--resource-version", dest="resource_version", default="")
+        p.add_argument("-l", "--selector", default="")
+        p.add_argument("--all", dest="all_resources", action="store_true")
     p = sub.add_parser("patch", parents=[common])
     p.add_argument("resource")
     p.add_argument("name")
@@ -3066,7 +3167,19 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
         return k.rollout_undo(name, namespace, args.to_revision)
     if args.verb in ("label", "annotate"):
         fn = k.label if args.verb == "label" else k.annotate
-        return fn(args.resource, args.name, args.pairs, namespace, args.overwrite)
+        res, name, pairs = args.resource, args.name, list(args.pairs)
+        if "/" in res:  # TYPE/NAME form: the name slot holds a pair
+            res, _, name2 = res.partition("/")
+            if name is not None:
+                pairs.insert(0, name)
+            name = name2
+        elif name is not None and (args.all_resources or args.selector) \
+                and ("=" in name or name.endswith("-")):
+            # bulk form: every positional after TYPE is a pair
+            pairs.insert(0, name)
+            name = None
+        return fn(res, name, pairs, namespace, args.overwrite,
+                  args.resource_version, args.selector, args.all_resources)
     if args.verb == "patch":
         return k.patch(args.resource, args.name, args.patch, namespace, args.patch_type)
     if args.verb == "taint":

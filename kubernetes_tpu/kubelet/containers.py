@@ -131,6 +131,8 @@ class ProcessContainerManager:
         """CreateContainer + StartContainer: fork the real child; returns
         its pid.  A container already alive under this identity is left
         running (idempotent sync)."""
+        _LIVE_MANAGERS.add(self)  # a manager reused after remove_all()
+        # must regain exit cleanup for its new children
         with self._mu:
             cur = self._ctrs.get((pod_key, name))
             if cur is not None and self._alive_locked(cur):

@@ -151,6 +151,9 @@ class VolumeHost:
                 log.warning("volume %s: skipping invalid payload key(s) %s",
                             vol_dir, sorted(bad))
             payload = {k: v for k, v in payload.items() if k not in bad}
+        else:
+            # a payload gone clean re-arms the warning for this dir
+            self._warned_keys.pop(vol_dir, None)
         with self._mu:
             os.makedirs(vol_dir, exist_ok=True)
             data_link = os.path.join(vol_dir, "..data")
@@ -231,7 +234,11 @@ class VolumeHost:
         if os.path.isdir(pod_dir):
             shutil.rmtree(pod_dir, ignore_errors=True)
             self.stats["unmounts"] += 1
+        for d in [d for d in self._warned_keys
+                  if d.startswith(pod_dir + os.sep)]:
+            self._warned_keys.pop(d)
 
     def teardown_all(self) -> None:
         if self._own_root:
             shutil.rmtree(self.root, ignore_errors=True)
+        self._warned_keys.clear()

@@ -1534,3 +1534,114 @@ def test_set_subject_on_role_bindings(cs):
     assert rc == 0
     rb = cs.client_for("RoleBinding").get("rb")
     assert sum(1 for s in rb.subjects if s.name == "carol") == 1
+
+
+def test_label_annotate_reference_semantics(cs):
+    """label.go/annotate.go depth: removal of an absent key warns but
+    succeeds, modify+remove of one key is an error, label values are
+    validated, --resource-version guards the update, --all and -l fan
+    out over the collection."""
+    cs.pods.create(make_pod("p1", labels={"app": "web"}))
+    cs.pods.create(make_pod("p2", labels={"app": "web"}))
+    cs.pods.create(make_pod("p3", labels={"app": "db"}))
+
+    # removing an absent key: reference prints `label "x" not found.`
+    # and the command still exits 0 ("not labeled" — nothing changed)
+    rc, out = run(cs, "label", "pod", "p1", "ghost-")
+    assert rc == 0 and 'label "ghost" not found.' in out and "not labeled" in out
+
+    # one key both set and removed is refused at parse time
+    rc, out = run(cs, "label", "pod", "p1", "x=1", "x-")
+    assert rc == 1 and "can not both modify and remove" in out
+
+    # label VALUES are validated (IsValidLabelValue); annotate is not
+    rc, out = run(cs, "label", "pod", "p1", "k=bad value!")
+    assert rc == 1 and "invalid label value" in out
+    rc, out = run(cs, "annotate", "pod", "p1", "k=any value! ok")
+    assert rc == 0
+    assert cs.pods.get("p1").meta.annotations["k"] == "any value! ok"
+
+    # --resource-version: succeeds only at exactly that version
+    rv = cs.pods.get("p1").meta.resource_version
+    rc, out = run(cs, "label", "pod", "p1", "pin=yes",
+                  "--resource-version", str(rv))
+    assert rc == 0
+    assert cs.pods.get("p1").meta.labels["pin"] == "yes"
+    rc, out = run(cs, "label", "pod", "p1", "pin=no", "--overwrite",
+                  "--resource-version", str(rv))
+    assert rc == 1 and "Conflict" in out
+    assert cs.pods.get("p1").meta.labels["pin"] == "yes"
+
+    # TYPE/NAME form
+    rc, out = run(cs, "label", "pod/p2", "slash=ok")
+    assert rc == 0
+    assert cs.pods.get("p2").meta.labels["slash"] == "ok"
+
+    # --all fans out over the namespace's collection
+    rc, out = run(cs, "label", "pods", "--all", "swept=yes")
+    assert rc == 0 and out.count("labeled") == 3
+    for name in ("p1", "p2", "p3"):
+        assert cs.pods.get(name).meta.labels["swept"] == "yes"
+
+    # -l selects a subset
+    rc, out = run(cs, "label", "pods", "-l", "app=web", "team=a")
+    assert rc == 0
+    assert cs.pods.get("p1").meta.labels["team"] == "a"
+    assert cs.pods.get("p2").meta.labels["team"] == "a"
+    assert "team" not in cs.pods.get("p3").meta.labels
+
+    # --resource-version is single-resource only
+    rc, out = run(cs, "label", "pods", "--all", "z=1",
+                  "--resource-version", "5")
+    assert rc == 1 and "single resource" in out
+
+
+def test_label_annotate_over_the_wire():
+    """The same verbs driving the real HTTP apiserver (the reference's
+    patch path rides the wire; here guaranteed_update does)."""
+    from kubernetes_tpu.apiserver import APIServer
+
+    store = Store()
+    server = APIServer(store)
+    server.start()
+    try:
+        cs_local = Clientset(store)
+        cs_local.pods.create(make_pod("w1", labels={"app": "web"}))
+        k = ["--server", server.url]
+        out = io.StringIO()
+        rc = kubectl_main([*k, "label", "pod", "w1", "tier=frontend"], out=out)
+        assert rc == 0 and "labeled" in out.getvalue()
+        assert cs_local.pods.get("w1").meta.labels["tier"] == "frontend"
+        out = io.StringIO()
+        rc = kubectl_main([*k, "label", "pod", "w1", "tier=back"], out=out)
+        assert rc == 1 and "overwrite" in out.getvalue()
+        out = io.StringIO()
+        rc = kubectl_main([*k, "annotate", "pod", "w1", "note=x",
+                           "--resource-version", "999999"], out=out)
+        assert rc == 1 and "Conflict" in out.getvalue()
+        out = io.StringIO()
+        rc = kubectl_main([*k, "label", "pod", "w1", "tier-"], out=out)
+        assert rc == 0
+        assert "tier" not in cs_local.pods.get("w1").meta.labels
+    finally:
+        server.stop()
+
+
+def test_label_bulk_continues_past_per_object_errors(cs):
+    """Bulk label (--all / -l) keeps visiting remaining objects after a
+    per-object failure and exits 1 with the failing object named; a
+    name combined with --all or -l is rejected outright."""
+    cs.pods.create(make_pod("a1", labels={"claimed": "x"}))
+    cs.pods.create(make_pod("a2"))
+    rc, out = run(cs, "label", "pods", "--all", "claimed=mine")
+    assert rc == 1
+    assert '"a1"' in out and "already has a value" in out
+    # a2 was still labeled despite a1's failure
+    assert cs.pods.get("a2").meta.labels["claimed"] == "mine"
+    assert cs.pods.get("a1").meta.labels["claimed"] == "x"
+    # name + --all / -l is an error, not a silent fan-out
+    rc, out = run(cs, "label", "pods", "a1", "--all", "z=1")
+    assert rc == 1 and "may not be specified together" in out
+    rc, out = run(cs, "label", "pods", "a1", "-l", "claimed=x", "z=1")
+    assert rc == 1 and "may not be specified together" in out
+    assert "z" not in cs.pods.get("a1").meta.labels
