@@ -341,5 +341,6 @@ def default_chain() -> AdmissionChain:
         # inert until PodSecurityPolicy objects exist (opt-in like the
         # reference's plugin enablement)
         _PluginsExt.PodSecurityPolicyPlugin(),
+        _PluginsExt.NetworkPolicyValidation(),
         ResourceQuota(),
     ])

@@ -623,3 +623,80 @@ class PodSecurityPolicyPlugin(AdmissionPlugin):
             failures[pname] = bad[0]
         detail = "; ".join(f"{n}: {m}" for n, m in failures.items())
         self.deny(f"no PodSecurityPolicy admits this pod ({detail})")
+
+
+class NetworkPolicyValidation(AdmissionPlugin):
+    """Validation for the networking group (reference
+    ``pkg/apis/networking/validation/validation.go``): the podSelector
+    must parse as a label selector, each port needs a TCP/UDP protocol
+    and a numeric port in 1-65535 or a named port, and each peer must
+    carry exactly one of podSelector / namespaceSelector."""
+
+    name = "NetworkPolicyValidation"
+    operations = (CREATE, UPDATE)
+
+    def handles(self, attrs: Attributes) -> bool:
+        return attrs.kind == "NetworkPolicy" and super().handles(attrs)
+
+    def _check_selector(self, d, path: str) -> None:
+        from ..api import selectors as _sel
+
+        try:
+            sel = LabelSelector.from_dict(d)
+        except (ValueError, TypeError, KeyError, AttributeError) as e:
+            self.deny(f"{path}: invalid selector: {e}")
+            return
+        ops = (_sel.IN, _sel.NOT_IN, _sel.EXISTS, _sel.DOES_NOT_EXIST,
+               _sel.GT, _sel.LT)
+        for r in sel.match_expressions:
+            if r.operator not in ops:
+                self.deny(f"{path}: unknown operator {r.operator!r}")
+
+    def validate(self, attrs: Attributes) -> None:
+        spec = (attrs.obj or {}).get("spec") or {}
+        if not isinstance(spec, dict):
+            self.deny("spec: must be an object")
+        self._check_selector(spec.get("podSelector"), "spec.podSelector")
+        ingress = spec.get("ingress") or []
+        if not isinstance(ingress, list):
+            self.deny("spec.ingress: must be a list")
+        for i, rule in enumerate(ingress):
+            if not isinstance(rule, dict):
+                self.deny(f"spec.ingress[{i}]: must be an object")
+            ports = rule.get("ports") or []
+            peers = rule.get("from") or []
+            if not isinstance(ports, list):
+                self.deny(f"spec.ingress[{i}].ports: must be a list")
+            if not isinstance(peers, list):
+                self.deny(f"spec.ingress[{i}].from: must be a list")
+            for j, port in enumerate(ports):
+                if not isinstance(port, dict):
+                    self.deny(f"spec.ingress[{i}].ports[{j}]: "
+                              f"must be an object")
+                proto = port.get("protocol", "TCP")
+                if proto not in ("TCP", "UDP"):
+                    self.deny(f"spec.ingress[{i}].ports[{j}].protocol: "
+                              f"unsupported value {proto!r}")
+                p = port.get("port")
+                if p is not None:
+                    if isinstance(p, bool) or not isinstance(p, (int, str)):
+                        self.deny(f"spec.ingress[{i}].ports[{j}].port: "
+                                  f"must be a number or named port")
+                    if isinstance(p, int) and not (1 <= p <= 65535):
+                        self.deny(f"spec.ingress[{i}].ports[{j}].port: "
+                                  f"must be between 1 and 65535")
+                    if isinstance(p, str) and not p:
+                        self.deny(f"spec.ingress[{i}].ports[{j}].port: "
+                                  f"named port must not be empty")
+            for j, peer in enumerate(peers):
+                if not isinstance(peer, dict):
+                    self.deny(f"spec.ingress[{i}].from[{j}]: "
+                              f"must be an object")
+                has_pod = "podSelector" in peer
+                has_ns = "namespaceSelector" in peer
+                if has_pod == has_ns:  # both or neither
+                    self.deny(f"spec.ingress[{i}].from[{j}]: exactly one "
+                              f"of podSelector or namespaceSelector "
+                              f"is required")
+                sel = peer.get("podSelector") if has_pod else peer.get("namespaceSelector")
+                self._check_selector(sel, f"spec.ingress[{i}].from[{j}]")

@@ -786,3 +786,150 @@ class PodSecurityPolicy:
 
 register_kind(PodSecurityPolicy, cluster_scoped=True,
               plural="podsecuritypolicies")
+
+
+@dataclass
+class NetworkPolicyPort:
+    """Port a rule allows traffic on (reference
+    ``pkg/apis/networking/types.go:80 NetworkPolicyPort``): protocol
+    defaults to TCP; port may be numeric, a named container port, or
+    absent (all ports)."""
+
+    protocol: str = "TCP"
+    port: Optional[object] = None  # int | str (named) | None = all
+
+    def to_dict(self) -> dict:
+        d: dict = {"protocol": self.protocol}
+        if self.port is not None:
+            d["port"] = self.port
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkPolicyPort":
+        return cls(protocol=d.get("protocol", "TCP"), port=d.get("port"))
+
+
+@dataclass
+class NetworkPolicyPeer:
+    """Traffic source (``types.go:94 NetworkPolicyPeer``): exactly one of
+    podSelector (same namespace) or namespaceSelector."""
+
+    pod_selector: Optional[LabelSelector] = None
+    namespace_selector: Optional[LabelSelector] = None
+
+    def to_dict(self) -> dict:
+        d: dict = {}
+        if self.pod_selector is not None:
+            d["podSelector"] = self.pod_selector.to_dict()
+        if self.namespace_selector is not None:
+            d["namespaceSelector"] = self.namespace_selector.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkPolicyPeer":
+        return cls(
+            pod_selector=(LabelSelector.from_dict(d["podSelector"])
+                          if "podSelector" in d else None),
+            namespace_selector=(LabelSelector.from_dict(d["namespaceSelector"])
+                                if "namespaceSelector" in d else None),
+        )
+
+
+@dataclass
+class NetworkPolicyIngressRule:
+    """One allowed-traffic rule (``types.go:60``): empty ports = all
+    ports; empty from = all sources; a rule matches ports AND from."""
+
+    ports: list = field(default_factory=list)   # [NetworkPolicyPort]
+    from_peers: list = field(default_factory=list)  # [NetworkPolicyPeer]
+
+    def to_dict(self) -> dict:
+        d: dict = {}
+        if self.ports:
+            d["ports"] = [p.to_dict() for p in self.ports]
+        if self.from_peers:
+            d["from"] = [p.to_dict() for p in self.from_peers]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkPolicyIngressRule":
+        return cls(
+            ports=[NetworkPolicyPort.from_dict(x) for x in d.get("ports") or []],
+            from_peers=[NetworkPolicyPeer.from_dict(x) for x in d.get("from") or []],
+        )
+
+
+@dataclass
+class NetworkPolicy:
+    """Pod-traffic isolation policy (reference
+    ``pkg/apis/networking/types.go:29``; REST storage
+    ``pkg/registry/networking/networkpolicy``).  Like the reference era,
+    the API object is the contract — enforcement was CNI-plugin-side
+    there and is the kubenet layer's concern here; selection semantics
+    (podSelector picks the isolated pods; ingress rules are additive
+    across policies; a selected pod with zero rules accepts nothing)
+    are what the type carries."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_selector: LabelSelector = field(default_factory=LabelSelector)
+    ingress: list = field(default_factory=list)  # [NetworkPolicyIngressRule]
+
+    KIND = "NetworkPolicy"
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "podSelector": self.pod_selector.to_dict(),
+                "ingress": [r.to_dict() for r in self.ingress],
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkPolicy":
+        spec = d.get("spec") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            pod_selector=LabelSelector.from_dict(spec.get("podSelector")),
+            ingress=[NetworkPolicyIngressRule.from_dict(x)
+                     for x in spec.get("ingress") or []],
+        )
+
+    # -- selection semantics (consumed by kubenet / tests) ----------------
+    def selects(self, pod) -> bool:
+        return self.pod_selector.matches(pod.meta.labels)
+
+    def allows(self, from_pod, from_namespace_labels: dict,
+               to_port: Optional[int] = None,
+               to_port_name: str = "",
+               protocol: str = "TCP") -> bool:
+        """Does any ingress rule admit ``protocol`` traffic from
+        ``from_pod``?  (``from_namespace_labels``: labels of the source
+        namespace.)  A podSelector peer only selects pods in the
+        policy's OWN namespace — cross-namespace sources must match a
+        namespaceSelector peer."""
+        for rule in self.ingress:
+            if rule.ports:
+                port_ok = any(
+                    p.protocol == protocol
+                    and ((p.port is None)
+                         or (isinstance(p.port, int) and p.port == to_port)
+                         or (isinstance(p.port, str) and p.port == to_port_name))
+                    for p in rule.ports)
+                if not port_ok:
+                    continue
+            if not rule.from_peers:
+                return True
+            for peer in rule.from_peers:
+                if peer.pod_selector is not None:
+                    if (from_pod.meta.namespace == self.meta.namespace
+                            and peer.pod_selector.matches(from_pod.meta.labels)):
+                        return True
+                elif peer.namespace_selector is not None:
+                    if peer.namespace_selector.matches(from_namespace_labels):
+                        return True
+        return False
+
+
+register_kind(NetworkPolicy, plural="networkpolicies")

@@ -1004,6 +1004,63 @@ class ReplicaSet:
 
 
 @dataclass
+class ReplicationController:
+    """The original replica-keeper (reference ``pkg/api/types.go:2533``).
+    Semantically ReplicaSet with a plain map selector (no set-based
+    expressions); era tooling (``kubectl rolling-update``) was RC-based.
+    Defaulting mirrors v1: an empty selector falls back to the template
+    labels."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    replicas: int = 1
+    selector_labels: dict = field(default_factory=dict)  # spec.selector map
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status_replicas: int = 0
+    status_ready_replicas: int = 0
+    status_observed_generation: int = 0
+
+    KIND = "ReplicationController"
+
+    @property
+    def selector(self) -> LabelSelector:
+        """Map selector as a LabelSelector, with the v1 default-to-
+        template-labels rule — lets RC share the ReplicaSet controller
+        and kubectl machinery."""
+        return LabelSelector.from_match_labels(
+            self.selector_labels or self.template.labels)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.meta.to_dict(),
+            "spec": {
+                "replicas": self.replicas,
+                "selector": dict(self.selector_labels),
+                "template": self.template.to_dict(),
+            },
+            "status": {
+                "replicas": self.status_replicas,
+                "readyReplicas": self.status_ready_replicas,
+                "observedGeneration": self.status_observed_generation,
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicationController":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata") or {}),
+            replicas=int(spec.get("replicas", 1)),
+            selector_labels=dict(spec.get("selector") or {}),
+            template=PodTemplateSpec.from_dict(spec.get("template")),
+            status_replicas=int(status.get("replicas", 0)),
+            status_ready_replicas=int(status.get("readyReplicas", 0)),
+            status_observed_generation=int(status.get("observedGeneration", 0)),
+        )
+
+
+@dataclass
 class Deployment:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     replicas: int = 1
@@ -1149,7 +1206,8 @@ def register_cluster_scoped(cls):
     return register_kind(cls, cluster_scoped=True)
 
 
-for _cls in (Pod, Service, ReplicaSet, Deployment, Event):
+for _cls in (Pod, Service, ReplicaSet, ReplicationController, Deployment,
+             Event):
     register_kind(_cls)
 register_kind(Node, cluster_scoped=True)
 

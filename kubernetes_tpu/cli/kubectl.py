@@ -169,7 +169,9 @@ _SHORT_NAMES = {
     "no": "nodes",
     "svc": "services",
     "rs": "replicasets",
+    "rc": "replicationcontrollers",
     "deploy": "deployments",
+    "netpol": "networkpolicies",
     "ev": "events",
     "ns": "namespaces",
     "ds": "daemonsets",
@@ -1171,7 +1173,10 @@ class Kubectl:
     # -- scale / cordon / drain -------------------------------------------
     def scale(self, resource: str, name: str, replicas: int, namespace: Optional[str] = None) -> int:
         resource, kind = _resolve(resource)
-        if kind not in ("Deployment", "ReplicaSet"):
+        # the reference scaler set (kubectl/scale.go): Deployment, RS,
+        # RC, StatefulSet (Job scales by parallelism, not supported here)
+        if kind not in ("Deployment", "ReplicaSet", "ReplicationController",
+                        "StatefulSet"):
             self.out.write(f"error: cannot scale {resource}\n")
             return 1
 

@@ -14,7 +14,8 @@ from .manager import ControllerManager, DEFAULT_CONTROLLERS
 from .namespace import NamespaceController
 from .node_lifecycle import NodeLifecycleController, RateLimiter
 from .podgc import PodGCController
-from .replicaset import Expectations, ReplicaSetController
+from .replicaset import (Expectations, ReplicaSetController,
+                         ReplicationControllerController)
 from .resourcequota import ResourceQuotaController
 from .serviceaccounts import ServiceAccountController
 from .statefulset import StatefulSetController

@@ -32,7 +32,8 @@ from .job import JobController
 from .namespace import NamespaceController
 from .node_lifecycle import NodeLifecycleController
 from .podgc import PodGCController
-from .replicaset import ReplicaSetController
+from .replicaset import (ReplicaSetController,
+                         ReplicationControllerController)
 from .resourcequota import ResourceQuotaController
 from .serviceaccounts import ServiceAccountController
 from .statefulset import StatefulSetController
@@ -44,6 +45,7 @@ from .volume import AttachDetachController, PersistentVolumeController
 DEFAULT_CONTROLLERS: dict[str, Callable] = {
     "deployment": DeploymentController,
     "replicaset": ReplicaSetController,
+    "replication": ReplicationControllerController,
     "garbagecollector": GarbageCollector,
     "node-lifecycle": NodeLifecycleController,
     "job": JobController,

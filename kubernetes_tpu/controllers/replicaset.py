@@ -54,12 +54,15 @@ class Expectations:
 
 class ReplicaSetController(Controller):
     name = "replicaset"
+    KIND = "ReplicaSet"  # subclassed for ReplicationController, whose
+    # semantics are this controller with a map selector (pkg/controller/
+    # replication is the same code pattern in the reference)
 
     def __init__(self, clientset, informers=None, burst_replicas: int = 500, **kw):
         super().__init__(clientset, informers, **kw)
         self.expectations = Expectations()
         self.burst_replicas = burst_replicas
-        self.watch("ReplicaSet")
+        self.watch(self.KIND)
         from ..client.informer import Handler, PodOwnerIndex
 
         self.pod_index = PodOwnerIndex(self.informers.informer("Pod"))
@@ -85,11 +88,11 @@ class ReplicaSetController(Controller):
     def _rs_key_for_pod(self, pod: api.Pod) -> Optional[str]:
         ref = pod.meta.controller_ref()
         if ref is not None:
-            if ref.kind != "ReplicaSet":
+            if ref.kind != self.KIND:
                 return None
             return f"{pod.meta.namespace}/{ref.name}"
         # orphan: wake every RS in the namespace whose selector matches
-        for rs in self.informer("ReplicaSet").list():
+        for rs in self.informer(self.KIND).list():
             if rs.meta.namespace == pod.meta.namespace and rs.selector.matches(pod.meta.labels):
                 return rs.meta.key
         return None
@@ -115,7 +118,7 @@ class ReplicaSetController(Controller):
     def sync(self, key: str) -> None:
         namespace, name = key.split("/", 1)
         try:
-            rs = self.clientset.replicasets.get(name, namespace)
+            rs = self.clientset.client_for(self.KIND).get(name, namespace)
         except NotFoundError:
             self.expectations.forget(key)
             return
@@ -165,12 +168,12 @@ class ReplicaSetController(Controller):
                 cur.status_observed_generation = cur.meta.generation
                 return cur
 
-            self.clientset.replicasets.guaranteed_update(name, _status, namespace)
+            self.clientset.client_for(self.KIND).guaranteed_update(name, _status, namespace)
 
     def _stamp_owner(self, pod: api.Pod, rs: api.ReplicaSet) -> api.Pod:
         if pod.meta.controller_ref() is None:
             pod.meta.owner_references.append(
-                OwnerReference(kind="ReplicaSet", name=rs.meta.name, uid=rs.meta.uid, controller=True)
+                OwnerReference(kind=self.KIND, name=rs.meta.name, uid=rs.meta.uid, controller=True)
             )
         return pod
 
@@ -181,7 +184,7 @@ class ReplicaSetController(Controller):
                 namespace=rs.meta.namespace,
                 labels=dict(rs.template.labels),
                 owner_references=[
-                    OwnerReference(kind="ReplicaSet", name=rs.meta.name, uid=rs.meta.uid, controller=True)
+                    OwnerReference(kind=self.KIND, name=rs.meta.name, uid=rs.meta.uid, controller=True)
                 ],
             ),
             spec=api.PodSpec.from_dict(rs.template.spec.to_dict()),
@@ -190,3 +193,11 @@ class ReplicaSetController(Controller):
             self.clientset.pods.create(pod)
         except AlreadyExistsError:
             self.expectations.observe_create(rs.meta.key)
+
+
+class ReplicationControllerController(ReplicaSetController):
+    """``pkg/controller/replication``: identical reconcile over the RC
+    kind (map selector; ``ReplicationController.selector`` adapts)."""
+
+    name = "replication"
+    KIND = "ReplicationController"

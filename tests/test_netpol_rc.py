@@ -1,0 +1,193 @@
+"""NetworkPolicy + ReplicationController: era-fidelity kinds.
+
+Behavioral spec: ``pkg/apis/networking/types.go:29`` (+ its validation)
+and ``pkg/api/types.go:2533`` with the v1 selector-defaulting rule and
+the ``pkg/controller/replication`` reconcile."""
+
+import io
+
+import pytest
+
+from kubernetes_tpu.api import (
+    LabelSelector,
+    NetworkPolicy,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicationController,
+)
+from kubernetes_tpu.cli.kubectl import main as kubectl_main
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.controllers import ReplicationControllerController
+from kubernetes_tpu.store import Store
+
+
+@pytest.fixture
+def cs():
+    return Clientset(Store())
+
+
+def kubectl(cs, *argv):
+    out = io.StringIO()
+    rc = kubectl_main(list(argv), clientset=cs, out=out)
+    return rc, out.getvalue()
+
+
+# -- NetworkPolicy ----------------------------------------------------------
+
+
+def test_networkpolicy_crud_and_wire_roundtrip(cs):
+    np = NetworkPolicy(
+        meta=ObjectMeta(name="allow-web", namespace="default"),
+        pod_selector=LabelSelector.from_match_labels({"app": "db"}),
+        ingress=[NetworkPolicyIngressRule(
+            ports=[NetworkPolicyPort(protocol="TCP", port=5432)],
+            from_peers=[NetworkPolicyPeer(
+                pod_selector=LabelSelector.from_match_labels({"app": "web"}))],
+        )])
+    cs.networkpolicies.create(np)
+    got = cs.networkpolicies.get("allow-web")
+    assert got.pod_selector.match_labels == {"app": "db"}
+    assert got.ingress[0].ports[0].port == 5432
+    assert got.ingress[0].from_peers[0].pod_selector.match_labels == {"app": "web"}
+    # kubectl sees the new resource through the shared registry
+    rc, out = kubectl(cs, "get", "networkpolicies")
+    assert rc == 0 and "allow-web" in out
+    rc, out = kubectl(cs, "label", "networkpolicy/allow-web", "tier=data")
+    assert rc == 0
+    assert cs.networkpolicies.get("allow-web").meta.labels["tier"] == "data"
+
+
+def test_networkpolicy_selection_semantics(cs):
+    """podSelector picks the isolated pods; empty from = all sources;
+    zero rules = isolate completely; ports AND from."""
+    db = Pod(meta=ObjectMeta(name="db", labels={"app": "db"}), spec=PodSpec())
+    web = Pod(meta=ObjectMeta(name="web", labels={"app": "web"}), spec=PodSpec())
+    other = Pod(meta=ObjectMeta(name="o", labels={"app": "o"}), spec=PodSpec())
+    np = NetworkPolicy(
+        meta=ObjectMeta(name="p"),
+        pod_selector=LabelSelector.from_match_labels({"app": "db"}),
+        ingress=[NetworkPolicyIngressRule(
+            ports=[NetworkPolicyPort(port=5432)],
+            from_peers=[NetworkPolicyPeer(
+                pod_selector=LabelSelector.from_match_labels({"app": "web"}))],
+        )])
+    assert np.selects(db) and not np.selects(web)
+    assert np.allows(web, {}, to_port=5432)
+    assert not np.allows(web, {}, to_port=80)       # wrong port
+    assert not np.allows(other, {}, to_port=5432)   # wrong source
+    assert not np.allows(web, {}, to_port=5432, protocol="UDP")  # wrong proto
+    # a podSelector peer only selects pods in the policy's own namespace
+    foreign = Pod(meta=ObjectMeta(name="web2", namespace="dev",
+                                  labels={"app": "web"}), spec=PodSpec())
+    assert not np.allows(foreign, {}, to_port=5432)
+    # namespaceSelector peer
+    np2 = NetworkPolicy(
+        meta=ObjectMeta(name="p2"),
+        pod_selector=LabelSelector(),
+        ingress=[NetworkPolicyIngressRule(from_peers=[NetworkPolicyPeer(
+            namespace_selector=LabelSelector.from_match_labels({"env": "prod"}))])])
+    assert np2.allows(other, {"env": "prod"})
+    assert not np2.allows(other, {"env": "dev"})
+    # a selected pod with zero rules accepts nothing
+    np3 = NetworkPolicy(meta=ObjectMeta(name="p3"),
+                        pod_selector=LabelSelector.from_match_labels({"app": "db"}))
+    assert np3.selects(db) and not np3.allows(web, {}, to_port=5432)
+
+
+def test_networkpolicy_validation_denies_malformed():
+    """validation.go: protocol TCP/UDP only; numeric ports 1-65535;
+    peers carry exactly one selector; operators must be known."""
+    from kubernetes_tpu.admission import AdmissionDenied, AdmittedStore, default_chain
+
+    cs = Clientset(AdmittedStore(default_chain()))
+
+    def make(**kw):
+        d = {"kind": "NetworkPolicy",
+             "metadata": {"name": kw.pop("name"), "namespace": "default"},
+             "spec": {"podSelector": {}, **kw}}
+        return d
+
+    def create(d):
+        return cs.store.create("NetworkPolicy", d)
+
+    with pytest.raises(AdmissionDenied) as e:
+        create(make(name="badproto",
+                    ingress=[{"ports": [{"protocol": "ICMP"}]}]))
+    assert "unsupported value" in str(e.value)
+    with pytest.raises(AdmissionDenied) as e:
+        create(make(name="badport", ingress=[{"ports": [{"port": 99999}]}]))
+    assert "between 1 and 65535" in str(e.value)
+    with pytest.raises(AdmissionDenied) as e:
+        create(make(name="badpeer", ingress=[{"from": [{}]}]))
+    assert "exactly one" in str(e.value)
+    with pytest.raises(AdmissionDenied) as e:
+        create(make(name="bothpeer", ingress=[{"from": [
+            {"podSelector": {}, "namespaceSelector": {}}]}]))
+    assert "exactly one" in str(e.value)
+    with pytest.raises(AdmissionDenied) as e:
+        create(make(name="badop", podSelector={
+            "matchExpressions": [{"key": "k", "operator": "Near"}]}))
+    assert "unknown operator" in str(e.value)
+    # a well-formed one passes the same chain
+    create(make(name="ok", ingress=[{"ports": [{"port": 80}],
+                                     "from": [{"podSelector": {}}]}]))
+
+
+# -- ReplicationController --------------------------------------------------
+
+
+def make_rc(name, replicas, selector=None, labels=None):
+    labels = labels or {"app": name}
+    return ReplicationController(
+        meta=ObjectMeta(name=name, namespace="default"),
+        replicas=replicas,
+        selector_labels=selector or {},
+        template=PodTemplateSpec(labels=labels, spec=PodSpec()),
+    )
+
+
+def test_rc_selector_defaults_to_template_labels():
+    rc = make_rc("web", 2)
+    assert rc.selector.match_labels == {"app": "web"}
+    rc2 = make_rc("web", 2, selector={"x": "y"})
+    assert rc2.selector.match_labels == {"x": "y"}
+
+
+def test_rc_controller_reconciles(cs):
+    rcc = ReplicationControllerController(cs)
+    rcc.informers.start_all_manual()
+    cs.replicationcontrollers.create(make_rc("web", 3))
+    rcc.reconcile_all()
+    pods, _ = cs.pods.list()
+    assert len(pods) == 3
+    assert all(p.meta.controller_ref().kind == "ReplicationController"
+               for p in pods)
+    got = cs.replicationcontrollers.get("web")
+    assert got.status_replicas == 3
+    # scale down through kubectl (the RC client is registry-derived)
+    rc, out = kubectl(cs, "scale", "replicationcontrollers", "web",
+                      "--replicas", "1")
+    assert rc == 0, out
+    rcc.reconcile_all()
+    pods, _ = cs.pods.list()
+    assert len(pods) == 1
+
+
+def test_rc_adopts_matching_orphans(cs):
+    rcc = ReplicationControllerController(cs)
+    rcc.informers.start_all_manual()
+    cs.pods.create(Pod(meta=ObjectMeta(name="stray", namespace="default",
+                                       labels={"app": "web"}),
+                       spec=PodSpec()))
+    cs.replicationcontrollers.create(make_rc("web", 1))
+    rcc.reconcile_all()
+    pod = cs.pods.get("stray")
+    ref = pod.meta.controller_ref()
+    assert ref is not None and ref.kind == "ReplicationController"
+    pods, _ = cs.pods.list()
+    assert len(pods) == 1  # adopted stray satisfies replicas=1
