@@ -656,6 +656,12 @@ class NetworkPolicyValidation(AdmissionPlugin):
         spec = (attrs.obj or {}).get("spec") or {}
         if not isinstance(spec, dict):
             self.deny("spec: must be an object")
+        # podSelector is REQUIRED (types.go:46 "This field is NOT
+        # optional"): an omitted selector must not silently decode to
+        # the empty selector and isolate every pod in the namespace
+        if not isinstance(spec.get("podSelector"), dict):
+            self.deny("spec.podSelector: required field (an explicit {} "
+                      "selects all pods in the namespace)")
         self._check_selector(spec.get("podSelector"), "spec.podSelector")
         ingress = spec.get("ingress") or []
         if not isinstance(ingress, list):

@@ -157,38 +157,133 @@ _DISABLE_ROW_CACHE = False
 _NS_KEY = "\x00ns"  # namespace rides the label space as a reserved key
 
 
+def _pod_content_key(pod: api.Pod) -> tuple:
+    """Content identity of a pod AS THE HOST STATE SEES IT (labels +
+    namespace + disk refs) — what decides whether a same-key pod must be
+    re-ingested on reconcile.  Memoized on the pod object under the same
+    immutability contract as ``pod_signature_key``."""
+    cached = getattr(pod, "_hbs_key", None)
+    if cached is not None:
+        return cached
+    disks = None
+    if pod.spec.volumes:
+        disks = tuple(sorted(
+            (v.disk_kind, v.disk_id, v.read_only)
+            for v in pod.spec.volumes if v.disk_id))
+    key = (pod.meta.namespace, tuple(sorted(pod.meta.labels.items())), disks)
+    try:
+        object.__setattr__(pod, "_hbs_key", key)
+    except AttributeError:
+        pass
+    return key
+
+
 class HostBatchState:
     """Incremental host-side cluster state shared by every kernel segment
-    of one batch.
+    of one batch — and, via ``reconcile``, ACROSS batches.
 
     Without it, ``initial_state`` rebuilds its selector-match corpus and
     volume occupancy by scanning EVERY pod on EVERY node once per
     segment — O(existing-pods × segments), the dominant host cost at
-    150k-pod scale.  This object is built once per batch (O(existing
-    pods), usually zero) and updated per placed pod; segments then pay
-    only O(new selectors × corpus) native matching and O(vocab) fills.
+    150k-pod scale.  Within a batch it is updated per placed pod;
+    between batches ``reconcile`` diffs only the nodes whose NodeInfo
+    generation moved (the copy-on-write counters of ``cache.go:79``
+    carried through the snapshot clones), so a steady-state churn wave
+    pays O(pods on touched nodes), not O(cluster).
+
+    Pod label content and spread/term selectors are content-interned:
+    wave after wave of template-stamped pods reuses the same native
+    labelmap/selector ids, which both bounds engine growth and removes
+    the per-pod ctypes marshalling that dominated ingest at scale.
 
     The node order is the same sorted order ``build_static`` uses, so
     node indices agree across the batch."""
 
+    # engine compaction threshold: rebuild the native corpus when more
+    # than this many interned labelmaps have no live pod AND the dead
+    # outnumber the live (churn with per-rollout-unique labels would
+    # otherwise grow the engine for the process lifetime)
+    MAX_DEAD_CONTENT = 4096
+
     def __init__(self, node_info_map: dict[str, "NodeInfo"]):
+        self.eng = MatchEngine()
+        self._lid_memo: dict[tuple, int] = {}
+        self._sel_memo: dict[tuple, int] = {}
+        self._content_rc: dict[tuple, int] = {}  # live pods per labelmap
+        self._kind_pos = {k: i for i, k in enumerate(_VOL_KINDS)}
+        self._rebuild(node_info_map)
+
+    def _rebuild(self, node_info_map: dict[str, "NodeInfo"]) -> None:
+        # live-content refcounts restart with the pod arrays (interned
+        # labelmaps persist in the engine; rc==0 entries are the garbage
+        # the compaction threshold watches)
+        self._content_rc = {}
         self.node_names = sorted(
             n for n, i in node_info_map.items() if i.node is not None
         )
         self.node_index = {n: j for j, n in enumerate(self.node_names)}
-        self.eng = MatchEngine()
+        self.node_gen: dict[str, int] = {}
         self.pod_lids: list[int] = []
         self.pod_node_j: list[int] = []
+        self.pod_keys: list[str] = []
+        self.pod_content: list[tuple] = []
+        self.pod_disks: list[Optional[list]] = []
+        # per node_j: pod key -> index into the parallel arrays
+        self.node_pods: list[dict[str, int]] = [
+            {} for _ in self.node_names
+        ]
         self._node_j_cache: Optional[np.ndarray] = None
-        # (kind, id) -> {node_j: non-sharable instance present}
-        self.disk_locations: dict[tuple, dict[int, bool]] = {}
-        self._kind_pos = {k: i for i, k in enumerate(_VOL_KINDS)}
+        # (kind, id) -> {node_j: [refcount, non-sharable refcount]}
+        self.disk_locations: dict[tuple, dict[int, list]] = {}
         # distinct limited-kind disks per node: [K, N_real]
-        self.nk_counts = np.zeros((len(_VOL_KINDS), len(self.node_names)), dtype=np.int32)
+        self.nk_counts = np.zeros(
+            (len(_VOL_KINDS), len(self.node_names)), dtype=np.int32)
         for name in self.node_names:
             j = self.node_index[name]
-            for q in node_info_map[name].pods:
+            info = node_info_map[name]
+            for q in info.pods:
                 self._ingest(q, j)
+            self.node_gen[name] = info.generation
+
+    def reconcile(self, node_info_map: dict[str, "NodeInfo"]) -> None:
+        """Bring the state up to date with a fresh snapshot: nodes whose
+        generation is unchanged are skipped wholesale; changed nodes are
+        diffed by pod key + content.  A changed node SET falls back to a
+        full rebuild (node add/remove is rare and re-indexes the axis)."""
+        names = sorted(
+            n for n, i in node_info_map.items() if i.node is not None
+        )
+        dead = sum(1 for rc in self._content_rc.values() if rc <= 0)
+        if dead > self.MAX_DEAD_CONTENT and dead > len(self._content_rc) - dead:
+            # compact: the native engine has no labelmap removal, so a
+            # corpus dominated by dead content is rebuilt from scratch
+            self.eng.close()
+            self.eng = MatchEngine()
+            self._lid_memo.clear()
+            self._sel_memo.clear()
+            self._content_rc.clear()
+            self._rebuild(node_info_map)
+            return
+        if names != self.node_names:
+            self._rebuild(node_info_map)
+            return
+        for name in names:
+            info = node_info_map[name]
+            if self.node_gen.get(name) == info.generation:
+                continue
+            j = self.node_index[name]
+            mine = self.node_pods[j]
+            current: dict[str, api.Pod] = {q.meta.key: q for q in info.pods}
+            for key in [k for k in mine if k not in current]:
+                self._remove(mine[key])
+            for key, q in current.items():
+                idx = mine.get(key)
+                if idx is None:
+                    self._ingest(q, j)
+                elif self.pod_content[idx] != _pod_content_key(q):
+                    self._remove(idx)
+                    self._ingest(q, j)
+            self.node_gen[name] = info.generation
 
     @property
     def mounted_disks(self):
@@ -197,33 +292,108 @@ class HostBatchState:
 
     def add_pod(self, pod: api.Pod, node_name: str) -> None:
         j = self.node_index.get(node_name)
-        if j is not None:
+        if j is not None and pod.meta.key not in self.node_pods[j]:
             self._ingest(pod, j)
 
+    def selector_id(self, reqs: list[tuple]) -> int:
+        """Content-interned ``eng.add_selector``: per-segment spread and
+        term selectors repeat across segments and batches (same services/
+        controllers), so the native selector corpus stays bounded."""
+        key = tuple((k, op, tuple(vs)) for k, op, vs in reqs)
+        sid = self._sel_memo.get(key)
+        if sid is None:
+            sid = self.eng.add_selector(reqs)
+            self._sel_memo[key] = sid
+        return sid
+
     def _ingest(self, pod: api.Pod, j: int) -> None:
-        self.pod_lids.append(
-            self.eng.add_labelmap({**pod.meta.labels, _NS_KEY: pod.meta.namespace})
-        )
+        content = _pod_content_key(pod)
+        lid = self._lid_memo.get(content[:2])
+        if lid is None:
+            lid = self.eng.add_labelmap(
+                {**pod.meta.labels, _NS_KEY: pod.meta.namespace})
+            self._lid_memo[content[:2]] = lid
+        self._content_rc[content[:2]] = self._content_rc.get(content[:2], 0) + 1
+        idx = len(self.pod_lids)
+        self.pod_lids.append(lid)
         self.pod_node_j.append(j)
+        self.pod_keys.append(pod.meta.key)
+        self.pod_content.append(content)
+        self.node_pods[j][pod.meta.key] = idx
         self._node_j_cache = None
-        if not pod.spec.volumes:
+        disks = None
+        if pod.spec.volumes:
+            per_pod: dict[tuple, bool] = {}  # all-refs-read-only per disk
+            for vol in pod.spec.volumes:
+                if not vol.disk_id:
+                    continue
+                key = (vol.disk_kind, vol.disk_id)
+                per_pod[key] = per_pod.get(key, True) and vol.read_only
+            if per_pod:
+                disks = []
+                for key, all_ro in per_pod.items():
+                    ns = not (key[0] in _READONLY_SHARED_KINDS and all_ro)
+                    disks.append((key, ns))
+                    self._disk_add(key, j, ns)
+        self.pod_disks.append(disks)
+
+    def _disk_add(self, key: tuple, j: int, ns: bool) -> None:
+        locs = self.disk_locations.setdefault(key, {})
+        rc = locs.get(j)
+        if rc is None:
+            locs[j] = [1, 1 if ns else 0]
+            pos = self._kind_pos.get(key[0])
+            if pos is not None:
+                self.nk_counts[pos, j] += 1
+        else:
+            rc[0] += 1
+            if ns:
+                rc[1] += 1
+
+    def _disk_sub(self, key: tuple, j: int, ns: bool) -> None:
+        locs = self.disk_locations.get(key)
+        if locs is None:
             return
-        per_pod: dict[tuple, bool] = {}  # all-refs-read-only per disk
-        for vol in pod.spec.volumes:
-            if not vol.disk_id:
-                continue
-            key = (vol.disk_kind, vol.disk_id)
-            per_pod[key] = per_pod.get(key, True) and vol.read_only
-        for key, all_ro in per_pod.items():
-            locs = self.disk_locations.setdefault(key, {})
-            ns = not (key[0] in _READONLY_SHARED_KINDS and all_ro)
-            if j not in locs:
-                locs[j] = ns
-                pos = self._kind_pos.get(key[0])
-                if pos is not None:
-                    self.nk_counts[pos, j] += 1
-            elif ns:
-                locs[j] = True
+        rc = locs.get(j)
+        if rc is None:
+            return
+        rc[0] -= 1
+        if ns:
+            rc[1] -= 1
+        if rc[0] <= 0:
+            del locs[j]
+            pos = self._kind_pos.get(key[0])
+            if pos is not None:
+                self.nk_counts[pos, j] -= 1
+            if not locs:
+                del self.disk_locations[key]
+
+    def _remove(self, idx: int) -> None:
+        """Swap-remove entry ``idx`` so the parallel arrays stay dense
+        (matching never needs an alive mask)."""
+        j = self.pod_node_j[idx]
+        del self.node_pods[j][self.pod_keys[idx]]
+        content2 = self.pod_content[idx][:2]
+        rc = self._content_rc.get(content2, 0) - 1
+        self._content_rc[content2] = rc  # rc==0 marks engine garbage
+        disks = self.pod_disks[idx]
+        if disks:
+            for key, ns in disks:
+                self._disk_sub(key, j, ns)
+        last = len(self.pod_lids) - 1
+        if idx != last:
+            self.pod_lids[idx] = self.pod_lids[last]
+            self.pod_node_j[idx] = self.pod_node_j[last]
+            self.pod_keys[idx] = self.pod_keys[last]
+            self.pod_content[idx] = self.pod_content[last]
+            self.pod_disks[idx] = self.pod_disks[last]
+            self.node_pods[self.pod_node_j[idx]][self.pod_keys[idx]] = idx
+        self.pod_lids.pop()
+        self.pod_node_j.pop()
+        self.pod_keys.pop()
+        self.pod_content.pop()
+        self.pod_disks.pop()
+        self._node_j_cache = None
 
     def node_j_array(self) -> np.ndarray:
         if self._node_j_cache is None:
@@ -970,8 +1140,10 @@ class Tensorizer:
             # way); scratch-built and torn down otherwise
             if host_state is not None:
                 eng = host_state.eng
+                add_selector = host_state.selector_id
             else:
                 eng = MatchEngine()
+                add_selector = eng.add_selector
             NS_KEY = _NS_KEY
             sel_ids: dict[int, list[int]] = {}
             for g, sels in groups_with_sels.items():
@@ -986,7 +1158,7 @@ class Tensorizer:
                             + [(k, "Eq", [str(v)]) for k, v in sel.match_labels.items()]
                             + [(r.key, r.operator, list(r.values)) for r in sel.match_expressions]
                         )
-                    ids.append(eng.add_selector(reqs))
+                    ids.append(add_selector(reqs))
                 sel_ids[g] = ids
             # one selector per affinity term: namespace-scope ∈ term
             # namespaces (empty → owner's namespace) AND the term selector
@@ -999,7 +1171,7 @@ class Tensorizer:
                     + [(k, "Eq", [str(v)]) for k, v in sel.match_labels.items()]
                     + [(r.key, r.operator, list(r.values)) for r in sel.match_expressions]
                 )
-                term_sids.append(eng.add_selector(reqs))
+                term_sids.append(add_selector(reqs))
             if host_state is not None:
                 pod_lids = host_state.pod_lids
                 node_j = host_state.node_j_array()
@@ -1014,13 +1186,19 @@ class Tensorizer:
                         pod_node_j.append(j)
                 node_j = np.asarray(pod_node_j, dtype=np.int64)
             if pod_lids:
+                # content-interned lids repeat heavily (template-stamped
+                # pods share one labelmap), so match each DISTINCT lid
+                # once and broadcast: native probes go from O(L × sels)
+                # to O(distinct × sels) + numpy O(L)
+                lids_arr = np.asarray(pod_lids, dtype=np.int64)
+                uniq, inverse = np.unique(lids_arr, return_inverse=True)
                 for g, ids in sel_ids.items():
-                    hits = eng.match_any(ids, pod_lids)
+                    hits = eng.match_any(ids, uniq)[inverse]
                     np.add.at(spread_counts[g], node_j[hits], 1)
                 if matchable_terms:
-                    tm = eng.match_matrix(term_sids, pod_lids)  # [T_real, L]
+                    tm = eng.match_matrix(term_sids, uniq)  # [T_real, U]
                     for row, (t, _at) in enumerate(matchable_terms):
-                        hits = tm[row]
+                        hits = tm[row][inverse]
                         total_match[t] = int(hits.sum())
                         np.add.at(dom_match, static.node_domain[t, node_j[hits]], 1)
             if host_state is None:
@@ -1039,9 +1217,9 @@ class Tensorizer:
         if host_state is not None:
             # O(vocab): the disk-location dicts already aggregate the world
             for v, key in enumerate(static.vol_vocab):
-                for j, ns_present in host_state.disk_locations.get(key, {}).items():
+                for j, rc in host_state.disk_locations.get(key, {}).items():
                     vol_any[v, j] = True
-                    if ns_present:
+                    if rc[1] > 0:
                         vol_ns[v, j] = True
             nk[:, : host_state.nk_counts.shape[1]] = host_state.nk_counts
         else:

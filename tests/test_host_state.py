@@ -1,0 +1,228 @@
+"""Cross-batch incremental host state (SURVEY §7.4.5).
+
+The backend keeps its HostBatchState across ``schedule_batch`` calls and
+reconciles it against each batch's snapshot via the NodeInfo generation
+counters (the CoW discipline of ``schedulercache/cache.go:79``) — these
+tests pin that the reconciled state is indistinguishable from a fresh
+rebuild under pod churn, label changes, volume churn, and node set
+changes, with binding parity as the referee."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import Volume
+from kubernetes_tpu.models.snapshot import HostBatchState, _pod_content_key
+from kubernetes_tpu.ops import TPUBatchBackend
+from kubernetes_tpu.scheduler import GenericScheduler
+from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+from kubernetes_tpu.scheduler.priorities import PriorityContext
+from kubernetes_tpu.testutil import make_node, make_pod
+
+from tests.test_parity import build_cluster, make_batch, oracle_batch
+
+
+def _assert_state_equiv(a: HostBatchState, b: HostBatchState) -> None:
+    """Two host states are equivalent when every derived view agrees
+    (engine ids differ; content must not)."""
+    assert a.node_names == b.node_names
+    assert sorted(a.pod_keys) == sorted(b.pod_keys)
+    key_node_a = {k: a.pod_node_j[i] for i, k in enumerate(a.pod_keys)}
+    key_node_b = {k: b.pod_node_j[i] for i, k in enumerate(b.pod_keys)}
+    assert key_node_a == key_node_b
+    key_content_a = {k: a.pod_content[i] for i, k in enumerate(a.pod_keys)}
+    key_content_b = {k: b.pod_content[i] for i, k in enumerate(b.pod_keys)}
+    assert key_content_a == key_content_b
+    assert set(a.disk_locations) == set(b.disk_locations)
+    for key in a.disk_locations:
+        assert {j: tuple(rc) for j, rc in a.disk_locations[key].items()} == \
+               {j: tuple(rc) for j, rc in b.disk_locations[key].items()}, key
+    assert np.array_equal(a.nk_counts, b.nk_counts)
+
+
+def _mutate_cluster(rng, node_info_map, placed):
+    """Simulate inter-batch churn directly on the NodeInfo map: delete a
+    third of the placed pods, add a few externally-bound pods, relabel
+    one pod (remove + add, like cache.update_pod)."""
+    deleted = 0
+    for name, info in node_info_map.items():
+        for pod in list(info.pods):
+            if pod.meta.name.startswith("pend-") and rng.random() < 0.33:
+                info.remove_pod(pod)
+                deleted += 1
+    names = list(node_info_map)
+    added = []
+    for i in range(17):
+        node = rng.choice(names)
+        p = make_pod(f"ext-{i}", cpu="100m", memory="64Mi",
+                     labels={"app": rng.choice(["web", "db", "ext"])},
+                     node_name=node)
+        node_info_map[node].add_pod(p)
+        added.append(p)
+    # label change: same key, new object (informer update semantics)
+    for name, info in node_info_map.items():
+        if info.pods:
+            old = info.pods[0]
+            new = make_pod(old.meta.name.split("/")[-1], cpu="100m",
+                           memory="64Mi", labels={"app": "relabeled"},
+                           node_name=name)
+            new.meta.namespace = old.meta.namespace
+            info.remove_pod(old)
+            info.add_pod(new)
+            break
+    return deleted, added
+
+
+def test_reconcile_equals_rebuild_under_churn():
+    rng = random.Random(7)
+    node_info_map = build_cluster(rng, 40, existing_per_node=3)
+    state = HostBatchState(node_info_map)
+    # place a wave of pods like a batch would
+    batch = make_batch(rng, 120)
+    names = list(node_info_map)
+    for i, pod in enumerate(batch):
+        node = names[i % len(names)]
+        node_info_map[node].add_pod(pod)
+        state.add_pod(pod, node)
+    # inter-batch churn, then reconcile vs a from-scratch rebuild
+    _mutate_cluster(rng, node_info_map, batch)
+    state.reconcile(node_info_map)
+    fresh = HostBatchState(node_info_map)
+    _assert_state_equiv(state, fresh)
+    fresh.close()
+    state.close()
+
+
+def test_reconcile_volume_refcounts():
+    """Two pods sharing a disk on one node: deleting one must keep the
+    mount; deleting both must clear it (refcounted, not boolean)."""
+    info = NodeInfo(make_node("n1", cpu="8", memory="16Gi"))
+    vols = [Volume(name="v", disk_kind="aws-ebs", disk_id="d1")]
+    p1 = make_pod("p1", cpu="100m", memory="64Mi", node_name="n1", volumes=vols)
+    p2 = make_pod("p2", cpu="100m", memory="64Mi", node_name="n1", volumes=vols)
+    info.add_pod(p1)
+    info.add_pod(p2)
+    m = {"n1": info}
+    state = HostBatchState(m)
+    key = ("aws-ebs", "d1")
+    assert state.disk_locations[key][0][0] == 2
+    assert state.nk_counts.sum() == 1  # ONE distinct ebs disk
+    info.remove_pod(p2)
+    state.reconcile(m)
+    assert state.disk_locations[key][0][0] == 1
+    assert state.nk_counts.sum() == 1
+    info.remove_pod(p1)
+    state.reconcile(m)
+    assert key not in state.disk_locations
+    assert state.nk_counts.sum() == 0
+    state.close()
+
+
+def test_reconcile_node_set_change_rebuilds():
+    rng = random.Random(3)
+    node_info_map = build_cluster(rng, 10, existing_per_node=2)
+    state = HostBatchState(node_info_map)
+    node_info_map["node-new"] = NodeInfo(make_node("node-new", cpu="8", memory="16Gi"))
+    state.reconcile(node_info_map)
+    fresh = HostBatchState(node_info_map)
+    _assert_state_equiv(state, fresh)
+    # and removal
+    del node_info_map["node-0003"]
+    state.reconcile(node_info_map)
+    fresh2 = HostBatchState(node_info_map)
+    _assert_state_equiv(state, fresh2)
+    for s in (state, fresh, fresh2):
+        s.close()
+
+
+def test_multi_batch_parity_with_interleaved_churn():
+    """THE referee: three consecutive batches through ONE backend with
+    cluster churn between them must bind exactly like the oracle run
+    fresh on each batch's state."""
+    rng = random.Random(11)
+    node_info_map = build_cluster(rng, 60, existing_per_node=2)
+    algo_b = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo_b)
+    rr_oracle = 0
+    for wave in range(3):
+        pctx = PriorityContext(node_info_map, services=[], replicasets=[])
+        batch = make_batch(rng, 150)
+        for p in batch:
+            p.meta.name = f"w{wave}-{p.meta.name}"
+            object.__setattr__(p.meta, "_key", None) if hasattr(p.meta, "_key") else None
+        algo_a = GenericScheduler()
+        algo_a._round_robin = rr_oracle
+        want = oracle_batch(batch, node_info_map, pctx, algo_a)
+        got = backend.schedule_batch(batch, node_info_map, pctx)
+        rr_oracle = algo_a._round_robin
+        mismatch = [(p.meta.name, w, g)
+                    for p, w, g in zip(batch, want, got) if w != g]
+        assert not mismatch, f"wave {wave}: {mismatch[:5]}"
+        # apply the wave to the shared cluster state (bind confirmation)
+        for p, node in zip(batch, got):
+            if node is not None:
+                node_info_map[node].add_pod(p)
+        _mutate_cluster(rng, node_info_map, batch)
+    assert backend.stats["host_state_rebuilds"] == 1
+    assert backend.stats["host_state_reconciles"] == 2
+    assert backend.stats["kernel_pods"] > 0
+
+
+def test_engine_compaction_under_unique_label_churn():
+    """Pods with per-wave-unique labels would grow the native corpus
+    forever; once dead interned content crosses the threshold the
+    reconcile rebuilds the engine and the state stays correct."""
+    info = NodeInfo(make_node("n1", cpu="64", memory="256Gi", pods=10000))
+    m = {"n1": info}
+    state = HostBatchState(m)
+    state.MAX_DEAD_CONTENT = 50  # shrink the threshold for the test
+    for wave in range(30):
+        pods = [make_pod(f"w{wave}-p{i}", cpu="1m", memory="1Mi",
+                         labels={"rollout": f"sha-{wave}-{i}"},
+                         node_name="n1") for i in range(5)]
+        for p in pods:
+            info.add_pod(p)
+        state.reconcile(m)
+        for p in pods:
+            info.remove_pod(p)
+        state.reconcile(m)
+    # 150 distinct label sets went through; compaction kept the memo
+    # bounded near the live set instead of 150+
+    assert len(state._lid_memo) <= state.MAX_DEAD_CONTENT + 10
+    assert len(state.pod_keys) == 0
+    # still consistent with a fresh build
+    fresh = HostBatchState(m)
+    _assert_state_equiv(state, fresh)
+    fresh.close()
+    state.close()
+
+
+def test_batch_exception_drops_persistent_state():
+    """A commit callback that raises mid-batch must invalidate the
+    cross-batch host state: the aborted batch's speculative placements
+    have no cache generation to reconcile them away."""
+    rng = random.Random(5)
+    node_info_map = build_cluster(rng, 20, existing_per_node=1)
+    algo = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo)
+    pctx = PriorityContext(node_info_map, services=[], replicasets=[])
+    batch = make_batch(rng, 40)
+
+    class Boom(Exception):
+        pass
+
+    def exploding(entries):
+        raise Boom()
+
+    with pytest.raises(Boom):
+        backend.schedule_batch(batch, node_info_map, pctx,
+                               on_segment=exploding)
+    assert backend._host_state is None
+    # the next batch rebuilds and binds exactly like the oracle
+    algo_a = GenericScheduler()
+    algo_a._round_robin = algo._round_robin
+    want = oracle_batch(batch, node_info_map, pctx, algo_a)
+    got = backend.schedule_batch(batch, node_info_map, pctx)
+    assert want == got
+    assert backend.stats["host_state_rebuilds"] == 2
