@@ -50,6 +50,12 @@ def main(argv=None) -> int:
                          "restart recovers the cluster — the etcd analogue)")
     ap.add_argument("--fsync", action="store_true",
                     help="fsync every WAL append (durability over latency)")
+    ap.add_argument("--tls-cert-file", default=None)
+    ap.add_argument("--tls-private-key-file", default=None)
+    ap.add_argument("--client-ca-file", default=None,
+                    help="verify client certificates against this CA; a "
+                    "verified peer Subject becomes the request identity "
+                    "(CN = user, O = groups)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -78,8 +84,44 @@ def main(argv=None) -> int:
 
         auditor = Auditor(backends=[LogBackend(args.audit_log)])
 
+    if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
+        ap.error("--tls-cert-file and --tls-private-key-file go together")
+    if args.client_ca_file and not args.tls_cert_file:
+        ap.error("--client-ca-file requires --tls-cert-file "
+                 "(client certificates ride the TLS handshake)")
+    tls = None
+    authenticator = None
+    if args.tls_cert_file:
+        from .server import TLSConfig
+
+        tls = TLSConfig(args.tls_cert_file, args.tls_private_key_file,
+                        client_ca=args.client_ca_file)
+        if args.client_ca_file:
+            # cert-authenticated control plane: peer certs carry identity,
+            # static tokens (if any) and bootstrap tokens still work, and
+            # anonymous stays ON so `join` can fetch the signed
+            # cluster-info discovery document without credentials
+            # (kubeadm's bootstrap contract) — but anonymous is then
+            # AUTHORIZED only for that discovery surface unless an
+            # explicit --authorization-mode overrides
+            from ..auth import (
+                AuthenticatedOrDiscovery,
+                BootstrapTokenAuthenticator,
+                TokenFileAuthenticator,
+                UnionAuthenticator,
+            )
+
+            chain = []
+            if tokens is not None:
+                chain.append(TokenFileAuthenticator(tokens))
+            chain.append(BootstrapTokenAuthenticator(store))
+            authenticator = UnionAuthenticator(*chain, allow_anonymous=True)
+            if authorizer is None and args.authorization_mode is None:
+                authorizer = AuthenticatedOrDiscovery()
+
     server = APIServer(store, host=args.host, port=args.port, tokens=tokens,
-                       authorizer=authorizer, auditor=auditor)
+                       authenticator=authenticator,
+                       authorizer=authorizer, auditor=auditor, tls=tls)
     server.start()
     print(f"apiserver serving on {server.url}", flush=True)
     stop = install_signal_stop()

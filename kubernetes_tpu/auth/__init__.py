@@ -29,6 +29,7 @@ from .authz import (
     NO_OPINION,
     ABACAuthorizer,
     AlwaysAllow,
+    AuthenticatedOrDiscovery,
     AuthzAttributes,
     Authorizer,
     BootstrapPolicyAuthorizer,

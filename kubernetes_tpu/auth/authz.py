@@ -244,3 +244,22 @@ class BootstrapPolicyAuthorizer(Authorizer):
         if MASTERS_GROUP in attrs.user.groups:
             return ALLOW, "system:masters"
         return NO_OPINION, "not a master"
+
+
+class AuthenticatedOrDiscovery(Authorizer):
+    """The cert-mode default for a self-hosted control plane: any
+    AUTHENTICATED identity (client cert, token) is allowed; anonymous
+    requests are scoped to exactly the join-discovery surface — reading
+    kube-public configmaps (cluster-info) and /healthz — the effective
+    grant kubeadm's RBAC bootstrap gives ``system:unauthenticated``."""
+
+    def authorize(self, attrs: AuthzAttributes) -> tuple[str, str]:
+        if attrs.user.authenticated:
+            return ALLOW, "authenticated"
+        if (attrs.verb in ("get", "list")
+                and attrs.resource == "configmaps"
+                and attrs.namespace == "kube-public"):
+            return ALLOW, "anonymous discovery (cluster-info)"
+        if attrs.verb == "get" and attrs.path in ("/healthz", "/version"):
+            return ALLOW, "anonymous health"
+        return DENY, "anonymous access is limited to join discovery"

@@ -2813,6 +2813,13 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--server", default=argparse.SUPPRESS)
     common.add_argument("--token", default=argparse.SUPPRESS)
+    common.add_argument("--kubeconfig", default=argparse.SUPPRESS)
+    common.add_argument("--certificate-authority", dest="ca_file",
+                        default=argparse.SUPPRESS)
+    common.add_argument("--client-certificate", dest="client_cert",
+                        default=argparse.SUPPRESS)
+    common.add_argument("--client-key", dest="client_key",
+                        default=argparse.SUPPRESS)
     common.add_argument("-n", "--namespace", default=argparse.SUPPRESS)
     common.add_argument("-o", "--output", default=argparse.SUPPRESS)  # ""|json|yaml|jsonpath=...
 
@@ -2986,7 +2993,6 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
     p.add_argument("shell", choices=["bash", "zsh"])
     p = sub.add_parser("config", parents=[common])
     p.add_argument("config_args", nargs="*")
-    p.add_argument("--kubeconfig", default=None)
     p = sub.add_parser("wait", parents=[common])
     p.add_argument("resource")  # "pod/NAME" or "pod NAME"
     p.add_argument("name", nargs="?")
@@ -3031,7 +3037,31 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
     token = getattr(args, "token", None)
     namespace = getattr(args, "namespace", None)
     output = getattr(args, "output", "")
-    cs = clientset or Clientset(RemoteStore(server, token=token))
+    if clientset is not None:
+        cs = clientset
+    elif getattr(args, "kubeconfig", None) and args.verb != "config":
+        # ("config" manages a kubectl-format kubeconfig FILE; its
+        # --kubeconfig names the file to edit, not a connection.)
+        # The kubeadm kubeconfig-phase artifact: server + CA pin +
+        # client cert; EVERY explicit flag overrides its field
+        from ..pki import load_kubeconfig
+
+        doc = load_kubeconfig(args.kubeconfig)
+        cs = Clientset(RemoteStore(
+            getattr(args, "server", None) or doc["server"],
+            token=token or doc.get("token"),
+            ca_file=getattr(args, "ca_file", None)
+            or doc.get("certificate-authority"),
+            client_cert=getattr(args, "client_cert", None)
+            or doc.get("client-certificate"),
+            client_key=getattr(args, "client_key", None)
+            or doc.get("client-key")))
+    else:
+        cs = Clientset(RemoteStore(
+            server, token=token,
+            ca_file=getattr(args, "ca_file", None),
+            client_cert=getattr(args, "client_cert", None),
+            client_key=getattr(args, "client_key", None)))
     k = Kubectl(cs, out=out)
     if args.verb == "get":
         if getattr(args, "watch", False):
@@ -3250,7 +3280,8 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
     if args.verb == "completion":
         return k.completion(args.shell)
     if args.verb == "config":
-        return k.config(args.config_args, args.kubeconfig)
+        return k.config(args.config_args,
+                        getattr(args, "kubeconfig", None))
     if args.verb == "wait":
         res, name = args.resource, args.name
         if name is None and "/" in res:

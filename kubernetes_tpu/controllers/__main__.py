@@ -23,8 +23,12 @@ from .manager import DEFAULT_CONTROLLERS, ControllerManager
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubernetes_tpu.controllers")
-    ap.add_argument("--apiserver", required=True)
+    ap.add_argument("--apiserver", default=None)
     ap.add_argument("--token", default=None)
+    ap.add_argument("--kubeconfig", default=None,
+                    help="connection document from the kubeadm kubeconfig "
+                    "phase (server + CA pin + client cert); --apiserver/"
+                    "--token override its fields")
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--controllers", default="*",
                     help="comma list or * (default set: %s)" % ",".join(DEFAULT_CONTROLLERS))
@@ -41,7 +45,10 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
-    cs = remote_clientset(args.apiserver, args.token)
+    if not args.apiserver and not args.kubeconfig:
+        ap.error("one of --apiserver or --kubeconfig is required")
+    cs = remote_clientset(args.apiserver, args.token,
+                          kubeconfig=args.kubeconfig)
     names = None if args.controllers == "*" else args.controllers.split(",")
 
     def run(payload_stop: threading.Event) -> None:

@@ -29,7 +29,23 @@ from .client.remote import RemoteStore
 logger = logging.getLogger("kubernetes_tpu.daemon")
 
 
-def remote_clientset(apiserver: str, token: Optional[str] = None) -> Clientset:
+def remote_clientset(apiserver: Optional[str] = None,
+                     token: Optional[str] = None,
+                     kubeconfig: Optional[str] = None) -> Clientset:
+    """Wire clientset from a server URL + token, or from a kubeconfig
+    document (the kubeadm ``phases/kubeconfig`` artifact: server, CA pin,
+    client cert/key, optional token).  Explicit args override the file."""
+    if kubeconfig:
+        from .pki import load_kubeconfig
+
+        doc = load_kubeconfig(kubeconfig)
+        return Clientset(RemoteStore(
+            apiserver or doc["server"],
+            token=token or doc.get("token"),
+            ca_file=doc.get("certificate-authority"),
+            client_cert=doc.get("client-certificate"),
+            client_key=doc.get("client-key"),
+        ))
     return Clientset(RemoteStore(apiserver, token=token))
 
 

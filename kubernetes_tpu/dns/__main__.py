@@ -12,20 +12,24 @@ import time
 
 def main() -> None:
     parser = argparse.ArgumentParser(prog="kube-dns")
-    parser.add_argument("--apiserver", required=True)
+    parser.add_argument("--apiserver", default=None)
     parser.add_argument("--token", default=None)
+    parser.add_argument("--kubeconfig", default=None)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=10053)
     parser.add_argument("--zone", default="cluster.local")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    from ..client import Clientset
-    from ..client.remote import RemoteStore
     from .records import DNSRecordStore
     from .server import DNSServer
 
-    cs = Clientset(RemoteStore(args.apiserver, token=args.token))
+    from ..daemon import remote_clientset
+
+    if not args.apiserver and not args.kubeconfig:
+        parser.error("one of --apiserver or --kubeconfig is required")
+    cs = remote_clientset(args.apiserver, args.token,
+                          kubeconfig=args.kubeconfig)
     records = DNSRecordStore(cs, zone=args.zone)
     records.start(manual=False)  # threaded informer watch loops
     server = DNSServer(records, host=args.host, port=args.port)

@@ -20,8 +20,12 @@ from .hollow import HollowFleet, HollowKubelet
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubernetes_tpu.kubelet")
-    ap.add_argument("--apiserver", required=True)
+    ap.add_argument("--apiserver", default=None)
     ap.add_argument("--token", default=None)
+    ap.add_argument("--kubeconfig", default=None,
+                    help="connection document from the kubeadm kubeconfig "
+                    "phase (server + CA pin + client cert); --apiserver/"
+                    "--token override its fields")
     ap.add_argument("--name", default="hollow")
     ap.add_argument("--count", type=int, default=1)
     ap.add_argument("--proxy", action="store_true")
@@ -51,7 +55,10 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
-    cs = remote_clientset(args.apiserver, args.token)
+    if not args.apiserver and not args.kubeconfig:
+        ap.error("one of --apiserver or --kubeconfig is required")
+    cs = remote_clientset(args.apiserver, args.token,
+                          kubeconfig=args.kubeconfig)
     if args.count > 1 and (args.static_pod_dir or args.real_containers
                            or args.container_root):
         logging.warning("--static-pod-dir/--real-containers/--container-root "
@@ -71,9 +78,37 @@ def main(argv=None) -> int:
                           real_containers=args.real_containers,
                           container_root=args.container_root,
                           static_pod_dir=args.static_pod_dir)
-        k.register()
         kubelets = [k]
-        tick = k.tick
+        if args.static_pod_dir:
+            # kubeadm bootstrap: the control-plane kubelet comes up BEFORE
+            # its own static-pod apiserver — run manifests standalone and
+            # keep retrying registration until the API answers
+            state = {"registered": False}
+            base_tick = k.tick
+
+            def tick() -> None:
+                if not state["registered"]:
+                    k.standalone_static_tick()
+                    try:
+                        k.register()
+                        state["registered"] = True
+                        logging.info("apiserver reachable: node registered; "
+                                     "static pods will be mirrored")
+                    except Exception as e:  # noqa: BLE001 — stay standalone
+                        # log on CHANGE so "API still coming up" is quiet
+                        # but a persistent credential failure (401, bad
+                        # CA) is diagnosable
+                        msg = f"{type(e).__name__}: {e}"
+                        if msg != state.get("last_err"):
+                            state["last_err"] = msg
+                            logging.warning(
+                                "registration failed (still standalone, "
+                                "will retry): %s", msg)
+                        return
+                base_tick()
+        else:
+            k.register()
+            tick = k.tick
 
     proxies = []
     if args.proxy:

@@ -298,9 +298,6 @@ class HollowKubelet:
         its pod list)."""
         import hashlib
         import logging
-        import os
-
-        import yaml as _yaml
 
         log = logging.getLogger("kubernetes_tpu.kubelet")
         present: dict[str, tuple[str, str]] = {}  # source -> (content hash, key)
@@ -309,29 +306,16 @@ class HollowKubelet:
         # (config/file.go + config/http.go merged into one update stream)
         sources: list[tuple[str, Optional[bytes]]] = []
         if self.static_pod_dir is not None:
-            try:
-                entries = sorted(os.listdir(self.static_pod_dir))
-            except OSError:
+            dir_sources = self._static_dir_sources()
+            if dir_sources is None:
                 # a transiently unreadable DIR must not read as "every
                 # manifest removed": carry all previously-seen file
                 # sources unchanged (same contract as a per-file race)
-                entries = None
-            if entries is None:
                 sources.extend(
                     (p, None) for p in self._static_seen
                     if p != self.manifest_url)
             else:
-                for fname in entries:
-                    if not fname.endswith((".yaml", ".yml", ".json")):
-                        continue
-                    path = os.path.join(self.static_pod_dir, fname)
-                    try:
-                        with open(path, "rb") as f:
-                            sources.append((path, f.read()))
-                    except OSError:
-                        # a write-rename race or transient permission
-                        # error must not read as "manifest removed"
-                        sources.append((path, None))
+                sources.extend(dir_sources)
         if self.manifest_url:
             # poll at http_check_frequency, not per tick: a slow or
             # blackholed URL must not stall probes/restarts every cycle
@@ -363,22 +347,13 @@ class HollowKubelet:
                 # API — forget the runtime incarnation and recreate
                 self.pod_manager.forget(prev[1])
                 prev = None
-            try:
-                pod = api.Pod.from_dict(_yaml.safe_load(raw.decode()))
-                if not pod.meta.name:
-                    raise ValueError("manifest has no metadata.name")
-            except Exception as e:  # noqa: BLE001 — a bad manifest must
-                # not take down the sync loop; keep any prior incarnation
-                log.warning("static pod manifest %s unreadable: %s", path, e)
+            pod = self._parse_static_manifest(
+                raw, "http" if path == self.manifest_url else "file",
+                origin=path)
+            if pod is None:
                 if prev is not None:
                     present[path] = prev
                 continue
-            # the reference's static-pod identity: <name>-<nodename>
-            pod.meta.name = f"{pod.meta.name}-{self.node_name}"
-            pod.spec.node_name = self.node_name
-            pod.meta.annotations["kubernetes.io/config.mirror"] = "true"
-            pod.meta.annotations["kubernetes.io/config.source"] = (
-                "http" if path == self.manifest_url else "file")
             key = pod.meta.key
             if prev is not None and prev[1] != key:
                 self._delete_mirror(prev[1])  # renamed in the file
@@ -413,6 +388,82 @@ class HollowKubelet:
                 changed = True
         self._static_seen = present
         return changed
+
+    def _parse_static_manifest(self, raw: bytes, source: str,
+                               origin: str = ""):
+        """Manifest bytes -> the static pod with the reference identity
+        (``<name>-<nodename>``, bound here, mirror annotations); None on
+        a bad manifest (warned with the parse error — during self-hosted
+        bootstrap these manifests ARE the control plane)."""
+        import logging
+
+        import yaml as _yaml
+
+        try:
+            pod = api.Pod.from_dict(_yaml.safe_load(raw.decode()))
+            if not pod.meta.name:
+                raise ValueError("manifest has no metadata.name")
+        except Exception as e:  # noqa: BLE001 — a bad manifest must not
+            # take down the sync loop
+            logging.getLogger("kubernetes_tpu.kubelet").warning(
+                "static pod manifest %s unreadable: %s", origin or source, e)
+            return None
+        pod.meta.name = f"{pod.meta.name}-{self.node_name}"
+        pod.spec.node_name = self.node_name
+        pod.meta.annotations["kubernetes.io/config.mirror"] = "true"
+        pod.meta.annotations["kubernetes.io/config.source"] = source
+        return pod
+
+    def _static_dir_sources(self) -> list:
+        """The file half of the static-pod source walk: every manifest
+        file as ``(path, bytes | None)`` — None marks a transiently
+        unreadable file (callers must carry the prior incarnation, never
+        treat it as removed).  An unreadable DIR yields None so callers
+        can apply the same carry-over rule to every known file source."""
+        import os
+
+        try:
+            entries = sorted(os.listdir(self.static_pod_dir))
+        except OSError:
+            return None
+        sources = []
+        for fname in entries:
+            if not fname.endswith((".yaml", ".yml", ".json")):
+                continue
+            path = os.path.join(self.static_pod_dir, fname)
+            try:
+                with open(path, "rb") as f:
+                    sources.append((path, f.read()))
+            except OSError:
+                # a write-rename race or transient permission error must
+                # not read as "manifest removed"
+                sources.append((path, None))
+        return sources
+
+    def standalone_static_tick(self) -> int:
+        """Static pods WITHOUT an apiserver: the kubeadm bootstrap state,
+        where the control-plane kubelet must run its manifest dir (the
+        apiserver's own pod included) before any API exists (reference
+        kubelet standalone mode, ``config/file.go`` with no api source).
+        Containers start through the same runtime manager the API path
+        uses, so when the API comes up the mirror-pod flow ADOPTS the
+        already-running processes instead of restarting them.  Returns
+        how many manifests are being enforced."""
+        if self.static_pod_dir is None:
+            return 0
+        n = 0
+        for path, raw in (self._static_dir_sources() or []):
+            if raw is None:
+                continue
+            pod = self._parse_static_manifest(raw, "file", origin=path)
+            if pod is None:
+                continue
+            # sync_pod starts the containers and restarts dead ones per
+            # restartPolicy — the standalone crash-loop that keeps the
+            # apiserver container retrying until it binds its port
+            self.pod_manager.sync_pod(pod)
+            n += 1
+        return n
 
     def _is_our_mirror(self, pod_key: str) -> bool:
         ns, name = pod_key.split("/", 1)

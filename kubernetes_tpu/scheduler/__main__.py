@@ -19,8 +19,12 @@ from ..daemon import install_signal_stop, remote_clientset, run_with_leader_elec
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubernetes_tpu.scheduler")
-    ap.add_argument("--apiserver", required=True)
+    ap.add_argument("--apiserver", default=None)
     ap.add_argument("--token", default=None)
+    ap.add_argument("--kubeconfig", default=None,
+                    help="connection document from the kubeadm kubeconfig "
+                    "phase (server + CA pin + client cert); --apiserver/"
+                    "--token override its fields")
     ap.add_argument("--leader-elect", action="store_true")
     # SUPPRESS so explicit flags can be told apart from defaults when a
     # --config file is layered underneath (flag > file > default)
@@ -56,7 +60,10 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
-    cs = remote_clientset(args.apiserver, args.token)
+    if not args.apiserver and not args.kubeconfig:
+        ap.error("one of --apiserver or --kubeconfig is required")
+    cs = remote_clientset(args.apiserver, args.token,
+                          kubeconfig=args.kubeconfig)
 
     # health BEFORE leader election: a standby must still answer its
     # liveness probe or the supervisor kills a healthy HA peer.  The
