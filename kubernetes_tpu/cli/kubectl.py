@@ -3043,19 +3043,21 @@ def _main(argv: Optional[list[str]] = None, clientset: Optional[Clientset] = Non
         # ("config" manages a kubectl-format kubeconfig FILE; its
         # --kubeconfig names the file to edit, not a connection.)
         # The kubeadm kubeconfig-phase artifact: server + CA pin +
-        # client cert; EVERY explicit flag overrides its field
-        from ..pki import load_kubeconfig
+        # client cert; EVERY explicit flag overrides its field.  The
+        # merge itself lives in daemon.remote_clientset — one copy.
+        from ..daemon import remote_clientset
 
-        doc = load_kubeconfig(args.kubeconfig)
-        cs = Clientset(RemoteStore(
-            getattr(args, "server", None) or doc["server"],
-            token=token or doc.get("token"),
-            ca_file=getattr(args, "ca_file", None)
-            or doc.get("certificate-authority"),
-            client_cert=getattr(args, "client_cert", None)
-            or doc.get("client-certificate"),
-            client_key=getattr(args, "client_key", None)
-            or doc.get("client-key")))
+        try:
+            cs = remote_clientset(
+                getattr(args, "server", None),
+                token=token,
+                kubeconfig=args.kubeconfig,
+                ca_file=getattr(args, "ca_file", None),
+                client_cert=getattr(args, "client_cert", None),
+                client_key=getattr(args, "client_key", None))
+        except (ValueError, OSError) as e:
+            (out or sys.stdout).write(f"error: --kubeconfig: {e}\n")
+            return 1
     else:
         cs = Clientset(RemoteStore(
             server, token=token,

@@ -31,10 +31,15 @@ logger = logging.getLogger("kubernetes_tpu.daemon")
 
 def remote_clientset(apiserver: Optional[str] = None,
                      token: Optional[str] = None,
-                     kubeconfig: Optional[str] = None) -> Clientset:
+                     kubeconfig: Optional[str] = None,
+                     ca_file: Optional[str] = None,
+                     client_cert: Optional[str] = None,
+                     client_key: Optional[str] = None) -> Clientset:
     """Wire clientset from a server URL + token, or from a kubeconfig
     document (the kubeadm ``phases/kubeconfig`` artifact: server, CA pin,
-    client cert/key, optional token).  Explicit args override the file."""
+    client cert/key, optional token).  Explicit args override the file.
+    The single merge point for connection wiring — kubectl and every
+    daemon share it, so a new kubeconfig field threads through once."""
     if kubeconfig:
         from .pki import load_kubeconfig
 
@@ -42,11 +47,13 @@ def remote_clientset(apiserver: Optional[str] = None,
         return Clientset(RemoteStore(
             apiserver or doc["server"],
             token=token or doc.get("token"),
-            ca_file=doc.get("certificate-authority"),
-            client_cert=doc.get("client-certificate"),
-            client_key=doc.get("client-key"),
+            ca_file=ca_file or doc.get("certificate-authority"),
+            client_cert=client_cert or doc.get("client-certificate"),
+            client_key=client_key or doc.get("client-key"),
         ))
-    return Clientset(RemoteStore(apiserver, token=token))
+    return Clientset(RemoteStore(apiserver, token=token, ca_file=ca_file,
+                                 client_cert=client_cert,
+                                 client_key=client_key))
 
 
 def install_signal_stop() -> threading.Event:

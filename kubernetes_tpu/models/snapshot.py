@@ -28,7 +28,6 @@ The produced ``BatchStatic`` (numpy, host) feeds ``ops.batch_kernel``;
 
 from __future__ import annotations
 
-import json
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -60,15 +59,26 @@ _MIN_IMG_MIB = 23
 _MAX_IMG_MIB = 1000
 
 
-def pod_signature_key(pod: api.Pod) -> str:
+def _freeze(x):
+    """Recursively convert dict/list structures into hashable tuples
+    (dicts as sorted item tuples)."""
+    if isinstance(x, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in x.items()))
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze(v) for v in x)
+    return x
+
+
+def pod_signature_key(pod: api.Pod) -> tuple:
     """Canonical scheduling-equivalence key (the ecache hash analogue:
     reference ``equivalence_cache.go:98 getEquivalenceHash`` uses the
     controller ref; this key is exact over everything predicates and
-    priorities read, so it is strictly safer).
+    priorities read, so it is strictly safer).  An opaque hashable — a
+    nested tuple, NOT a string: serializing to json cost more than every
+    consumer's dict lookups combined at 150k-pod scale.
 
     Memoized on the pod object: the backend's segmenter and build_static
-    both key every pod of every segment, and the json serialization is the
-    dominant host cost at 150k-pod scale.  Safe because batch pods are
+    both key every pod of every segment.  Safe because batch pods are
     immutable while in flight (informer objects; mutation is a bug the
     cache mutation detector exists to catch) — a spec patch produces a new
     object and therefore a fresh key."""
@@ -76,30 +86,29 @@ def pod_signature_key(pod: api.Pod) -> str:
     if cached is not None:
         return cached
     ref = pod.meta.controller_ref()
-    parts = {
-        "ns": pod.meta.namespace,
-        "labels": sorted(pod.meta.labels.items()),
-        "nodeSelector": sorted(pod.spec.node_selector.items()),
-        "nodeName": pod.spec.node_name,
-        "affinity": pod.spec.affinity.to_dict() if pod.spec.affinity else None,
-        "tolerations": [t.to_dict() for t in pod.spec.tolerations],
+    key = (
+        pod.meta.namespace,
+        tuple(sorted(pod.meta.labels.items())),
+        tuple(sorted(pod.spec.node_selector.items())),
+        pod.spec.node_name,
+        _freeze(pod.spec.affinity.to_dict()) if pod.spec.affinity else None,
+        tuple(_freeze(t.to_dict()) for t in pod.spec.tolerations),
         # direct-disk volumes are deliberately EXCLUDED: their identity lives
         # on the per-pod volume-slot axis (pod_vol_ids), not the signature
         # axis — otherwise every distinct disk id would mint a new signature
         # and G would grow with the batch.  PVC-backed and other volumes stay
         # in the key (their constraints fold into the static [G, N] masks).
-        "volumes": [v.to_dict() for v in pod.spec.volumes if not v.disk_id],
-        "owner": (ref.kind, ref.uid) if ref else None,
-        "containers": [
+        tuple(_freeze(v.to_dict()) for v in pod.spec.volumes if not v.disk_id),
+        (ref.kind, ref.uid) if ref else None,
+        tuple(
             (
                 c.image,
-                sorted((k, str(v)) for k, v in c.resources.requests.items()),
-                sorted((p.protocol, p.host_port) for p in c.ports if p.host_port > 0),
+                tuple(sorted((k, str(v)) for k, v in c.resources.requests.items())),
+                tuple(sorted((p.protocol, p.host_port) for p in c.ports if p.host_port > 0)),
             )
             for c in pod.spec.containers
-        ],
-    }
-    key = json.dumps(parts, sort_keys=True, default=str)
+        ),
+    )
     try:
         object.__setattr__(pod, "_sig_key", key)
     except AttributeError:
@@ -292,8 +301,11 @@ class HostBatchState:
 
     def add_pod(self, pod: api.Pod, node_name: str) -> None:
         j = self.node_index.get(node_name)
-        if j is not None and pod.meta.key not in self.node_pods[j]:
-            self._ingest(pod, j)
+        if j is None:
+            return
+        key = pod.meta.key
+        if key not in self.node_pods[j]:
+            self._ingest(pod, j, key)
 
     def selector_id(self, reqs: list[tuple]) -> int:
         """Content-interned ``eng.add_selector``: per-segment spread and
@@ -306,7 +318,9 @@ class HostBatchState:
             self._sel_memo[key] = sid
         return sid
 
-    def _ingest(self, pod: api.Pod, j: int) -> None:
+    def _ingest(self, pod: api.Pod, j: int, key: "str | None" = None) -> None:
+        if key is None:
+            key = pod.meta.key
         content = _pod_content_key(pod)
         lid = self._lid_memo.get(content[:2])
         if lid is None:
@@ -317,9 +331,9 @@ class HostBatchState:
         idx = len(self.pod_lids)
         self.pod_lids.append(lid)
         self.pod_node_j.append(j)
-        self.pod_keys.append(pod.meta.key)
+        self.pod_keys.append(key)
         self.pod_content.append(content)
-        self.node_pods[j][pod.meta.key] = idx
+        self.node_pods[j][key] = idx
         self._node_j_cache = None
         disks = None
         if pod.spec.volumes:

@@ -259,12 +259,16 @@ class TPUBatchBackend:
         pctx: PriorityContext,
         on_segment=None,
     ) -> list[Optional[str]]:
-        """``on_segment`` (optional): called with ``[(pod, node_name|None),
-        ...]`` per completed segment, AFTER the NEXT segment's device scan
-        has been dispatched — the caller's commit work (cache assume,
-        bind txn, events) runs on host while the TPU executes, hiding most
-        of the commit cost behind device time.  Entry order across calls
-        equals pod order, so sequential semantics are unchanged; with
+        """``on_segment`` (optional): called with ``[(pod, node_name|None,
+        req_vec|None, nz_vec|None), ...]`` per completed segment, AFTER the
+        NEXT segment's device scan has been dispatched — the caller's
+        commit work (cache assume, bind txn, events) runs on host while
+        the TPU executes, hiding most of the commit cost behind device
+        time.  Kernel-path entries carry the segment's per-signature
+        request vectors (the ``add_pod_counted`` contract) so the caller's
+        cache assume can skip its per-pod quantity parse; oracle-path
+        entries carry ``None``.  Entry order across calls equals pod
+        order, so sequential semantics are unchanged; with
         ``on_segment=None`` behavior is exactly the unpipelined batch."""
         weights = self._config_supported()
         # working state: clones so neither the scheduler's CoW snapshot nor
@@ -410,13 +414,17 @@ class TPUBatchBackend:
                 self.algorithm._round_robin = final_rr
                 req_vecs, nz_vecs = _segment_vecs(static)
                 group_of_pod = static.group_of_pod
+                entries = []
                 for k, ((i, pod), idx) in enumerate(zip(segment, chosen)):
                     node_name = static.node_names[int(idx)] if int(idx) >= 0 else None
                     g = int(group_of_pod[k])
                     apply(pod, node_name, i, req_vecs[g], nz_vecs[g])
+                    # the segment's per-signature vectors ride along so the
+                    # caller's cache assume can skip its own quantity parse
+                    entries.append((pod, node_name, req_vecs[g], nz_vecs[g]))
                 self.stats["kernel_pods"] += len(segment)
                 self.stats["segments"] += 1
-                return [(pod, assignments[i]) for i, pod in segment]
+                return entries
 
             return finish
 
@@ -429,7 +437,7 @@ class TPUBatchBackend:
             for i, pod in enumerate(pods):
                 run_oracle(pod, i)
             if on_segment is not None and pods:
-                on_segment([(pod, assignments[i])
+                on_segment([(pod, assignments[i], None, None)
                             for i, pod in enumerate(pods)])
             return assignments
         pending: list = []  # prior segments' entries awaiting commit
@@ -445,14 +453,14 @@ class TPUBatchBackend:
                 if kind == "oracle":
                     for i, pod in segment:
                         run_oracle(pod, i)
-                    pending.extend((pod, assignments[i]) for i, pod in segment)
+                    pending.extend((pod, assignments[i], None, None) for i, pod in segment)
                     continue
                 finish = dispatch_kernel_segment(segment)
                 if finish is None:
                     # budget reject (rare): sync safety-net split path
                     flush_pending()
                     run_kernel_segment(segment)
-                    pending.extend((pod, assignments[i]) for i, pod in segment)
+                    pending.extend((pod, assignments[i], None, None) for i, pod in segment)
                     continue
                 # the device is executing THIS segment: commit everything
                 # earlier on host in the shadow of the scan

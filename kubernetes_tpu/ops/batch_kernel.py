@@ -445,6 +445,9 @@ def dispatch_batch_arrays(static: BatchStatic, init: InitialState):
     xs = batch_xs(static)
     run = _runner_for(static)
     final_state, chosen = run(dev, xs, state)
+    # enqueue the D2H transfer behind the scan (see dispatch_batch_pallas)
+    chosen.copy_to_host_async()
+    final_state.round_robin.copy_to_host_async()
     return chosen, final_state.round_robin
 
 

@@ -642,7 +642,14 @@ def dispatch_batch_pallas(static: BatchStatic, init: InitialState):
         bool(static.terms),
         bool(static.use_vols),
     )
-    return run(*scalars, *ins)
+    out = run(*scalars, *ins)
+    # enqueue the D2H transfer behind the kernel NOW: by finalize time the
+    # chosen indices are already host-side (the copy rides the device's
+    # shadow with the commit work instead of serializing after it — the
+    # transfer is latency-bound through the device tunnel, not size-bound)
+    for a in out:
+        a.copy_to_host_async()
+    return out
 
 
 def finalize_batch_pallas(static: BatchStatic, chosen2d, rr):

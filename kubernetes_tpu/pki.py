@@ -27,10 +27,15 @@ CERT_DAYS = 365
 
 
 def _write(path: str, data: bytes, private: bool = False) -> str:
+    if private:
+        # 0600 from birth — chmod-after-write leaves the key world-readable
+        # for a window (and forever, if interrupted between the two calls)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        return path
     with open(path, "wb") as f:
         f.write(data)
-    if private:
-        os.chmod(path, 0o600)
     return path
 
 
@@ -174,12 +179,28 @@ def write_kubeconfig(cluster_dir: str, component: str, server: str,
         doc["client-key"] = os.path.abspath(client_key)
     if token:
         doc["token"] = token
-    with open(path, "w") as f:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
         json.dump(doc, f, indent=2)
-    os.chmod(path, 0o600)
     return path
 
 
 def load_kubeconfig(path: str) -> dict:
+    """Parse a connection kubeconfig document.  Raises ValueError (not a
+    raw json/KeyError traceback) on files that are not this format —
+    e.g. the YAML clusters/contexts file ``kubectl config`` maintains."""
     with open(path) as f:
-        return json.load(f)
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"{path} is not a connection kubeconfig (JSON): {e}. "
+            "Files written by 'kubectl config set-*' are a different "
+            "format; pass a kubeconfig generated by 'cluster up' / "
+            "kubeadm-style init instead.") from e
+    if not isinstance(doc, dict) or "server" not in doc:
+        raise ValueError(
+            f"{path}: connection kubeconfig must be a JSON object with "
+            "a 'server' field")
+    return doc

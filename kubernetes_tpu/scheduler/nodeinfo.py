@@ -196,14 +196,25 @@ class SchedulerCache:
     def assume_many(self, pairs: list) -> None:
         """Batch assume under ONE lock acquisition + deadline read — the
         TPU path lands 150k assumptions at once and per-pod locking is
-        measurable at that scale.  Same semantics as assume_pod per pair."""
+        measurable at that scale.  Same semantics as assume_pod per pair.
+
+        Entries are (pod, node_name) or (pod, node_name, req_vec, nz_vec);
+        the 4-tuple form carries the batch backend's per-signature request
+        vectors so the aggregation skips the per-pod quantity parse (they
+        MUST equal ``pod_request_vec(pod)``/``pod_nonzero_request_vec``,
+        the ``add_pod_counted`` contract)."""
         deadline = self._clock() + self._ttl
         with self._mu:
-            for pod, node_name in pairs:
+            for entry in pairs:
+                pod, node_name = entry[0], entry[1]
                 key = pod.meta.key
                 if key in self._pod_states:
                     raise ValueError(f"pod {key} already assumed/added")
-                self._node_info(node_name).add_pod(pod)
+                info = self._node_info(node_name)
+                if len(entry) >= 4 and entry[2] is not None:
+                    info.add_pod_counted(pod, entry[2], entry[3])
+                else:
+                    info.add_pod(pod)
                 self._pod_states[key] = (pod, node_name, "assumed")
                 self._assume_deadlines[key] = deadline
 

@@ -328,13 +328,16 @@ class Scheduler:
             this while the device executes the NEXT segment, so the
             commit cost hides in the scan's shadow)."""
             to_bind: list[tuple[api.Pod, api.Binding]] = []
-            to_assume: list[tuple[api.Pod, str]] = []
-            for pod, node_name in entries:
+            to_assume: list[tuple] = []
+            for pod, node_name, req_vec, nz_vec in entries:
                 if node_name is None:
                     self.handle_schedule_failure(pod, FitError(pod, {}), ev_batch)
                     totals["failed"] += 1
                     continue
-                to_assume.append((pod, node_name))
+                # per-signature request vectors from the backend (when the
+                # kernel path produced this entry) spare the cache assume
+                # a per-pod quantity re-parse
+                to_assume.append((pod, node_name, req_vec, nz_vec))
                 self.backoff.forget(pod.meta.key)
                 to_bind.append(
                     (

@@ -171,10 +171,10 @@ def test_pallas_dispatch_failure_falls_back_to_xla(monkeypatch):
     assert backend.stats["pallas_segments"] == 0
     assert backend.stats["kernel_pods"] == len(pods)  # XLA scan served it
     # streamed commits cover every pod exactly once, in pod order
-    assert [p.meta.key for p, _ in committed] == [p.meta.key for p in pods]
+    assert [e[0].meta.key for e in committed] == [p.meta.key for p in pods]
     # and the bindings still match the sequential oracle
     want = oracle_batch(pods, m, pctx, GenericScheduler())
-    assert [n for _, n in committed] == want
+    assert [e[1] for e in committed] == want
 
 
 def test_pallas_one_shot_failure_recovers_next_segment(interpret_pallas, monkeypatch):
@@ -220,7 +220,7 @@ def test_pallas_one_shot_failure_recovers_next_segment(interpret_pallas, monkeyp
     assert calls["n"] >= 2
     # parity survives the mid-batch fallback
     want = oracle_batch(pods, m, PriorityContext(m), GenericScheduler())
-    assert [n for _, n in committed] == want
+    assert [e[1] for e in committed] == want
 
 
 def test_pallas_shape_blacklist_after_repeated_failures(interpret_pallas, monkeypatch):
