@@ -318,13 +318,25 @@ def run_parity(backend_res: dict, n_nodes: int, n_pods: int, workload: str, seed
     }
 
 
-def run_churn(n_nodes: int = 1_000, total_pods: int = 20_000, waves: int = 10,
+CHURN_SLO_P99_MS = 5_000.0  # reference pod-startup SLO (metrics_util.go:46)
+# regression floor for the NORTH-scale churn preset (5k nodes): the gate
+# fails a round that loses more than ~1/3 of the recorded round-5 median
+# (see BENCH_AB_* ledgers); raise it as the measured number improves
+CHURN_FLOOR_PODS_PER_SEC = 700.0
+
+
+def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               workload: str = "mixed", seed: int = 0, warmup: bool = True) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive in waves against the RUNNING
     scheduler instead of pre-filling the queue, so per-pod e2e scheduling
     latency is measured under continuous creation (enqueue→segment-commit,
-    distinct p50/p99) along with saturation throughput."""
+    distinct p50/p99) along with saturation throughput.
+
+    The default preset is NORTH-scale churn (5,000 nodes — VERDICT r4
+    directive 4): the returned dict carries an SLO verdict
+    (``slo_pass``) gating e2e p99 ≤ 5s (the reference pod-startup SLO)
+    and throughput ≥ the recorded floor; ``main`` exits 1 on failure."""
     from kubernetes_tpu.client import Clientset
     from kubernetes_tpu.ops import TPUBatchBackend
     from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
@@ -371,17 +383,97 @@ def run_churn(n_nodes: int = 1_000, total_pods: int = 20_000, waves: int = 10,
         v = h.quantile(q)
         return round(v / 1e3, 3) if v != float("inf") else None
 
+    pps = round(bound / elapsed, 1) if elapsed > 0 else 0.0
+    p99 = _pq(m.e2e_scheduling_latency, 0.99)
     return {
         "nodes": n_nodes,
         "pods": total_pods,
         "waves": waves,
         "bound": bound,
         "unbound": unbound,
-        "pods_per_sec": round(bound / elapsed, 1) if elapsed > 0 else 0.0,
+        "pods_per_sec": pps,
         "e2e_scheduling_ms": {"p50": _pq(m.e2e_scheduling_latency, 0.5),
-                              "p99": _pq(m.e2e_scheduling_latency, 0.99)},
+                              "p99": p99},
         "binding_ms": {"p50": _pq(m.binding_latency, 0.5),
                        "p99": _pq(m.binding_latency, 0.99)},
+        "slo_p99_ms": CHURN_SLO_P99_MS,
+        "floor_pods_per_sec": CHURN_FLOOR_PODS_PER_SEC,
+        "slo_pass": bool(p99 is not None and p99 <= CHURN_SLO_P99_MS
+                         and pps >= CHURN_FLOOR_PODS_PER_SEC),
+    }
+
+
+def run_preemption(n_nodes: int = 2_000) -> dict:
+    """Priority-preemption workload (VERDICT r4 directive 6: measure
+    preemption cost at all).  Saturate every node's CPU with priority-0
+    fillers, then flood one batch of priority-100 preemptors that each
+    need a victim evicted: the batch fails wholesale, the cohort
+    PostFilter (scheduler._preempt_cohort — prefilter kernel + exact
+    reprieve on the survivors) evicts minimal victim sets, and the next
+    batch binds every preemptor into the freed space.
+
+    Reports preemption throughput and per-attempt latency; parity of the
+    decisions themselves is pinned by tests/test_preemption_batch.py's
+    oracle table."""
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.ops import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+    from kubernetes_tpu.store import Store
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    n_fillers = 4 * n_nodes  # 4 x 2cpu fills each 8-cpu node
+    n_preemptors = n_nodes // 2
+    cs = Clientset(Store(event_log_window=max(200_000, 4 * (n_nodes + n_fillers))))
+    for i in range(n_nodes):
+        cs.nodes.create(make_node(
+            f"node-{i:05d}", cpu="8", memory="32Gi", pods=110,
+            labels={"kubernetes.io/hostname": f"node-{i:05d}",
+                    ZONE: f"zone-{i % 3}"}))
+    algo = GenericScheduler()
+    sched = Scheduler(cs, algorithm=algo,
+                      backend=TPUBatchBackend(algorithm=algo),
+                      emit_events=True)
+    sched.start()
+    sched.broadcaster.start()
+    for i in range(n_fillers):
+        cs.pods.create(make_pod(f"filler-{i:06d}", cpu="2", memory="256Mi",
+                                labels={"app": "filler"}))
+    sched.pump()
+    sched.schedule_pending_batch()
+    for i in range(n_preemptors):
+        p = make_pod(f"vip-{i:06d}", cpu="2", memory="256Mi",
+                     labels={"app": "vip"})
+        p.spec.priority = 100
+        cs.pods.create(p)
+    sched.pump()
+    t0 = time.perf_counter()
+    sched.schedule_pending_batch()  # fails -> cohort preemption
+    preempt_elapsed = time.perf_counter() - t0
+    m = sched.metrics
+    # snapshot the counters HERE: the freed-space batch may run its own
+    # cohort for stragglers, and those attempts are outside the window
+    attempts = m.preemption_attempts.value
+    victims = m.preemption_victims.value
+    sched.pump()
+    bound_after, _ = sched.schedule_pending_batch()  # into freed space
+    total_elapsed = time.perf_counter() - t0
+    sched.broadcaster.stop(drain=True)
+
+    def _pq(h, q):
+        v = h.quantile(q)
+        return round(v / 1e3, 3) if v != float("inf") else None
+
+    return {
+        "nodes": n_nodes,
+        "preemptors": n_preemptors,
+        "attempts": attempts,
+        "victims": victims,
+        "preemptor_bound_after": bound_after,
+        "preemptions_per_sec": round(attempts / preempt_elapsed, 1)
+        if preempt_elapsed > 0 else 0.0,
+        "e2e_preempt_and_bind_s": round(total_elapsed, 3),
+        "preemption_latency_ms": {"p50": _pq(m.preemption_latency, 0.5),
+                                  "p99": _pq(m.preemption_latency, 0.99)},
     }
 
 
@@ -515,6 +607,10 @@ def main() -> None:
                         default=True,
                         help="skip the steady-state churn measurement that "
                         "rides along with the north preset")
+    parser.add_argument("--no-preempt", dest="preempt", action="store_false",
+                        default=True,
+                        help="skip the priority-preemption workload that "
+                        "rides along with the north preset")
     parser.add_argument("--no-certify", dest="certify", action="store_false",
                         default=True,
                         help="skip the default parity certification sub-run "
@@ -632,10 +728,28 @@ def main() -> None:
     if not args.oracle and args.preset == "north" and args.churn:
         churn = run_churn(seed=0)
         print(
-            f"# churn: {churn['bound']} bound / {churn['unbound']} unbound over "
+            f"# churn[{churn['nodes']} nodes]: {churn['bound']} bound / "
+            f"{churn['unbound']} unbound over "
             f"{churn['waves']} waves at {churn['pods_per_sec']} pods/s, "
             f"e2e p50={churn['e2e_scheduling_ms']['p50']}ms "
-            f"p99={churn['e2e_scheduling_ms']['p99']}ms",
+            f"p99={churn['e2e_scheduling_ms']['p99']}ms, "
+            f"SLO(p99<={churn['slo_p99_ms']:.0f}ms, "
+            f">={churn['floor_pods_per_sec']:.0f} pods/s): "
+            f"{'PASS' if churn['slo_pass'] else 'FAIL'}",
+            file=sys.stderr,
+        )
+
+    preemption = None
+    if not args.oracle and args.preset == "north" and args.preempt:
+        preemption = run_preemption()
+        print(
+            f"# preemption: {preemption['attempts']} attempts -> "
+            f"{preemption['victims']} victims, "
+            f"{preemption['preemptor_bound_after']}/{preemption['preemptors']} "
+            f"preemptors bound, {preemption['preemptions_per_sec']} "
+            f"preemptions/s, latency p50="
+            f"{preemption['preemption_latency_ms']['p50']}ms p99="
+            f"{preemption['preemption_latency_ms']['p99']}ms",
             file=sys.stderr,
         )
 
@@ -686,6 +800,8 @@ def main() -> None:
             (vals[-1] - vals[0]) / max(vals[len(vals) // 2], 1e-9) * 100, 1)
     if churn is not None:
         line["churn"] = churn
+    if preemption is not None:
+        line["preemption"] = preemption
     if "event_stats" in result:
         line["event_stats"] = result["event_stats"]
     if "failure_reasons" in result:
@@ -718,6 +834,19 @@ def main() -> None:
             line["prefix_mismatches"] = prefix["mismatches"]
     print(json.dumps(line))
     mism = [p["mismatches"] for p in (parity, certify, prefix) if p is not None]
+    if churn is not None and not churn["slo_pass"]:
+        # the reference's pod-startup SLO, enforced at north scale — a
+        # round that regresses past the floor must FAIL loudly
+        print("# churn SLO gate FAILED", file=sys.stderr)
+        sys.exit(1)
+    if preemption is not None and (
+            preemption["preemptor_bound_after"] < preemption["preemptors"]):
+        # the workload is constructed so every preemptor has a victim set;
+        # anything unbound means the PostFilter lost someone — gate on it
+        print("# preemption gate FAILED: "
+              f"{preemption['preemptor_bound_after']} of "
+              f"{preemption['preemptors']} preemptors bound", file=sys.stderr)
+        sys.exit(1)
     if any(mism):
         sys.exit(1)
 

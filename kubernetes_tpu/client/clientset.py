@@ -58,6 +58,12 @@ class TypedClient:
     def create(self, obj):
         return self._cls.from_dict(self._store.create(self.kind, self._to_wire(obj)))
 
+    def create_nowait(self, obj) -> None:
+        """``create`` without decoding the stored object back — for
+        fire-and-forget writers (the event sink) where the return decode
+        is pure overhead on a contended thread."""
+        self._store.create(self.kind, self._to_wire(obj))
+
     def get(self, name: str, namespace: Optional[str] = None):
         return self._cls.from_dict(self._store.get(self.kind, self._ns(namespace), name))
 

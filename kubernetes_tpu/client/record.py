@@ -234,7 +234,8 @@ class EventBroadcaster:
         action, payload, namespace = decision
         try:
             if action == "create":
-                self.clientset.events.create(payload)
+                # no return decode: the sink never reads the stored copy
+                self.clientset.events.create_nowait(payload)
             elif action == "patch":
                 def _bump(cur: api.Event) -> api.Event:
                     cur.count += 1

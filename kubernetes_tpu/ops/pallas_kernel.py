@@ -188,6 +188,7 @@ def _pallas_runner(
     weights: tuple,
     use_terms: bool,
     use_vols: bool,
+    k_unroll: int = 1,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -284,7 +285,15 @@ def _pallas_runner(
                 off *= 2
             return x
 
-        def body(i, rr):
+        def body(i, rr, step_valid=None):
+            # ``step_valid`` (trace-time None = unconditionally valid):
+            # the super-step loop (k_unroll > 1) runs fixed K sub-steps
+            # per iteration, so tail sub-steps past p_real execute with
+            # step_valid=False — they commit nothing and never bump rr,
+            # keeping the arithmetic stream identical to the K=1 program.
+            # (NB the name: the volume-slot loop below binds a local
+            # ``valid`` — the per-slot validity bit — which must not
+            # shadow this parameter.)
             gid = gids_ref[i]
             e_gid = (giota == gid).astype(jnp.float32)  # [G, 1]
 
@@ -496,10 +505,14 @@ def _pallas_runner(
                 jnp.int32(-1),
                 jnp.where(n_feasible == 1, only, pick_among).astype(jnp.int32),
             )
-            rr_new = rr + (n_feasible >= 2).astype(jnp.int32)
+            if step_valid is None:
+                rr_new = rr + (n_feasible >= 2).astype(jnp.int32)
+            else:
+                rr_new = rr + ((n_feasible >= 2) & step_valid).astype(jnp.int32)
 
             # ---- commit ----
-            landed = chosen >= 0
+            landed = (chosen >= 0) if step_valid is None \
+                else (chosen >= 0) & step_valid
             safe = jnp.maximum(chosen, 0)
             oh = ((lane == safe) & landed).astype(jnp.int32)  # [1, N]
             req_s[:] = req_s[:] + g_req_c * oh
@@ -554,7 +567,27 @@ def _pallas_runner(
             chosen_out[pl.ds(row_i, 1), :] = jnp.where(lane128 == col_i, chosen, crow)
             return rr_new
 
-        rr_final = jax.lax.fori_loop(0, p_real_ref[0], body, rr0_ref[0])
+        if k_unroll <= 1:
+            rr_final = jax.lax.fori_loop(0, p_real_ref[0], body, rr0_ref[0])
+        else:
+            # super-steps (SURVEY §7.4.1): K sequential sub-steps per loop
+            # iteration.  Same dependent chain per pod, but Mosaic gets a
+            # K×-larger straightline window to overlap pod i+1's gathers
+            # and static reads with pod i's commit, and pays the loop
+            # bookkeeping once per K pods.  k_unroll divides p_pad (both
+            # powers of two), so sub-step indices never exceed the arrays;
+            # tail sub-steps carry valid=False and are inert.
+            p_real = p_real_ref[0]
+            n_iters = (p_real + (k_unroll - 1)) // k_unroll
+
+            def super_body(io, rr):
+                base = io * k_unroll
+                for kk in range(k_unroll):
+                    i = base + kk
+                    rr = body(i, rr, step_valid=i < p_real)
+                return rr
+
+            rr_final = jax.lax.fori_loop(0, n_iters, super_body, rr0_ref[0])
         rr_out[0, 0] = rr_final
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -595,6 +628,24 @@ def _pallas_runner(
     return jax.jit(fn)
 
 
+def _superstep_k() -> int:
+    """Sub-steps per kernel loop iteration: the PallasSuperSteps gate
+    picks the default (8); ``KTPU_SUPERSTEP_K`` overrides for tuning.
+    Must divide 128 (the p_pad granule) — enforced by rounding down to a
+    power of two."""
+    import os
+
+    from ..utils.features import DEFAULT_FEATURE_GATES
+
+    if not DEFAULT_FEATURE_GATES.enabled("PallasSuperSteps"):
+        return 1
+    k = int(os.environ.get("KTPU_SUPERSTEP_K", "8"))
+    k = max(1, min(128, k))
+    while k & (k - 1):
+        k -= 1
+    return k
+
+
 def schedule_batch_pallas(static: BatchStatic, init: InitialState):
     """Drop-in replacement for ``schedule_batch_arrays`` on TPU."""
     chosen2d, rr = dispatch_batch_pallas(static, init)
@@ -620,6 +671,7 @@ def shape_key(static: BatchStatic) -> tuple:
         tuple(int(static.weights.get(kk, 0)) for kk in WEIGHT_KEYS),
         bool(static.terms),
         bool(static.use_vols),
+        _superstep_k(),
     )
 
 
@@ -641,6 +693,7 @@ def dispatch_batch_pallas(static: BatchStatic, init: InitialState):
         weights,
         bool(static.terms),
         bool(static.use_vols),
+        _superstep_k(),
     )
     out = run(*scalars, *ins)
     # enqueue the D2H transfer behind the kernel NOW: by finalize time the

@@ -107,6 +107,28 @@ class NodeInfo:
             self.used_ports.add(port)
         self.generation += 1
 
+    def replace_pod(self, old_pod: api.Pod, new_pod: api.Pod) -> bool:
+        """Swap one resident pod object for a content-equivalent newer
+        version WITHOUT re-aggregating (same requests/ports/affinity —
+        the caller asserts equivalence, e.g. via pod_signature_key).
+        The assume→watch-confirm swap is the hot caller: the confirmed
+        API object differs from the assumed one only by nodeName and
+        resourceVersion, and the remove+add path's port-set rebuild is
+        O(pods-on-node) for nothing."""
+        key = new_pod.meta.key
+        for i, p in enumerate(self.pods):
+            if p is old_pod or p.meta.key == key:
+                self.pods[i] = new_pod
+                break
+        else:
+            return False
+        for i, p in enumerate(self.pods_with_affinity):
+            if p is old_pod or p.meta.key == key:
+                self.pods_with_affinity[i] = new_pod
+                break
+        self.generation += 1
+        return True
+
     def remove_pod(self, pod: api.Pod) -> bool:
         for i, p in enumerate(self.pods):
             if p.meta.key == pod.meta.key:
@@ -249,9 +271,19 @@ class SchedulerCache:
             if st is not None and st[2] == "assumed":
                 assumed_pod, node_name, _ = st
                 if node_name == pod.spec.node_name:
-                    # confirm: swap the assumed object for the API truth
-                    self._nodes[node_name].remove_pod(assumed_pod)
-                    self._nodes[node_name].add_pod(pod)
+                    # confirm: swap the assumed object for the API truth.
+                    # Every NodeInfo aggregate derives from
+                    # spec.containers (requests, ports) and the affinity
+                    # flag; when those are unchanged (the normal bind —
+                    # only nodeName/resourceVersion moved) swap identity
+                    # without re-aggregating.  A concurrent spec change
+                    # falls back to remove+add.
+                    info = self._nodes[node_name]
+                    if not (assumed_pod.spec.containers == pod.spec.containers
+                            and pod_has_affinity(assumed_pod) == pod_has_affinity(pod)
+                            and info.replace_pod(assumed_pod, pod)):
+                        info.remove_pod(assumed_pod)
+                        info.add_pod(pod)
                     self._pod_states[key] = (pod, node_name, "bound")
                     self._assume_deadlines.pop(key, None)
                     return

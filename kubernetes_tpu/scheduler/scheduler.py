@@ -202,7 +202,8 @@ class Scheduler:
         return True
 
     def handle_schedule_failure(self, pod: api.Pod, err: Exception,
-                                ev_batch: Optional[list] = None) -> None:
+                                ev_batch: Optional[list] = None,
+                                preempt_cohort: Optional[list] = None) -> None:
         """MakeDefaultErrorFunc (factory.go:718): re-enqueue with backoff.
 
         Re-enqueues the *latest* version from the informer cache, not the
@@ -215,7 +216,10 @@ class Scheduler:
 
         ``ev_batch``: batch callers pass a list to collect the
         FailedScheduling event instead of enqueueing (and waking the sink)
-        per pod mid-batch."""
+        per pod mid-batch.  ``preempt_cohort``: batch callers pass a list
+        to DEFER priority pods' preemption to one cohort pass after the
+        drain (``_preempt_cohort``) — the prefilter kernel then amortizes
+        over the whole cohort instead of sweeping every node per pod."""
         self.metrics.schedule_failures.inc()
         if ev_batch is not None and self.emit_events:
             ev_batch.append((pod, "Warning", "FailedScheduling", str(err)))
@@ -226,32 +230,148 @@ class Scheduler:
             return  # deleted while we were scheduling it
         if latest.spec.node_name or not _is_scheduler_pod(latest, self.scheduler_name):
             return  # bound by someone else, or became terminal
-        if self.enable_preemption and latest.spec.priority > 0 and self._try_preempt(latest):
-            self.queue.add(latest)  # victims evicted; retry immediately
-            return
+        if self.enable_preemption and latest.spec.priority > 0:
+            if preempt_cohort is not None:
+                preempt_cohort.append(latest)  # requeue decided at cohort time
+                return
+            if self._try_preempt(latest):
+                self.queue.add(latest)  # victims evicted; retry immediately
+                return
         delay = self.backoff.get_backoff(pod.meta.key)
         self.queue.add_after(latest, delay)
+
+    def _evict_victims(self, pod: api.Pod, target, ev_batch: Optional[list] = None) -> None:
+        for victim in target.victims:
+            try:
+                self.clientset.pods.delete(victim.meta.name, victim.meta.namespace)
+                self.metrics.preemption_victims.inc()
+                msg = (f"Preempted by {pod.meta.key} (priority "
+                       f"{pod.spec.priority}) on {target.node_name}")
+                if ev_batch is not None and self.emit_events:
+                    ev_batch.append((victim, "Normal", "Preempted", msg))
+                else:
+                    self._event(victim, "Normal", "Preempted", msg)
+            except NotFoundError:
+                continue
 
     def _try_preempt(self, pod: api.Pod) -> bool:
         from .preemption import find_preemption_target
 
+        start = self._clock()
+        self.metrics.preemption_attempts.inc()
         pvs, pvcs = self._volume_listers()
         target = find_preemption_target(
             pod, self.snapshot(), self.algorithm.predicates, pvcs=pvcs, pvs=pvs
         )
         if target is None:
+            self.metrics.preemption_latency.observe((self._clock() - start) * 1e6)
             return False
-        for victim in target.victims:
-            try:
-                self.clientset.pods.delete(victim.meta.name, victim.meta.namespace)
-                self._event(
-                    victim, "Normal", "Preempted",
-                    f"Preempted by {pod.meta.key} (priority {pod.spec.priority}) on {target.node_name}",
-                )
-            except NotFoundError:
-                continue
+        self._evict_victims(pod, target)
         self.pump()  # observe the deletions so the next attempt sees freed space
+        self.metrics.preemption_latency.observe((self._clock() - start) * 1e6)
         return True
+
+    def _preempt_cohort(self, cohort: list, ev_batch: Optional[list] = None) -> int:
+        """Batch-path PostFilter (SURVEY §7.4.7): one prefilter-kernel call
+        bounds every (preemptor, node) pair's victim cost; the exact
+        reprieve evaluation then runs only on nodes whose bound can win
+        (``find_preemption_target_fast`` — decisions identical to the
+        per-pod oracle on the same state by construction).  Preemptors are
+        processed in batch order; each eviction updates the state columns
+        of the touched node so later preemptors see the new truth.
+        Returns the number of successful preemptions; every cohort pod is
+        requeued (immediately on success, with backoff otherwise)."""
+        from ..ops.preemption_kernel import PreemptionState
+        from .preemption import _fast_eligible, find_preemption_target_fast
+        from .units import pod_request_vec
+
+        if not cohort:
+            return 0
+        from ..models.snapshot import pod_signature_key
+
+        snapshot = self.snapshot()
+        pvs, pvcs = self._volume_listers()
+        state = PreemptionState(snapshot)
+        touched: set[str] = set()
+        # node-static predicate gate memo per preemptor SIGNATURE (the
+        # gate is victim-independent and generation-checked inside
+        # find_preemption_target_fast, so same-template preemptors pay
+        # it once per node across the whole cohort)
+        static_caches: dict = {}
+        preempted = 0
+        # fits-now recheck state: shadow clones of earlier-eviction
+        # targets (the ONLY nodes that can have become feasible since the
+        # batch proved these pods unschedulable).  ``claims`` carries
+        # every cohort member already promised capacity on a node —
+        # evictors and fits-now grantees alike — and shadows are rebuilt
+        # as fresh-state-plus-claims, so a SECOND eviction on the same
+        # node never drops earlier claimants.  Capped: a huge touched
+        # set degrades the recheck to best-effort-off.
+        recheck_shadow: dict[str, NodeInfo] = {}
+        claims: dict[str, list] = {}
+        recheck_cap = 64
+        for pod in cohort:
+            start = self._clock()
+            self.metrics.preemption_attempts.inc()
+            latest = self.informers.informer("Pod").get(pod.meta.key)
+            if latest is None:
+                continue  # deleted while deferred
+            if latest.spec.node_name or not _is_scheduler_pod(latest, self.scheduler_name):
+                continue
+            cands: list = []
+            if not _fast_eligible(latest, self.algorithm.predicates):
+                # odd preemptors (ports/volumes/own required affinity /
+                # custom predicate set) take the branch-and-bound path,
+                # which needs the prefilter bounds; the fast vectorized
+                # path derives everything from `state` directly
+                cands = state.candidates_for(
+                    pod_request_vec(latest).units, latest.spec.priority)
+            target = find_preemption_target_fast(
+                latest, snapshot, cands, self.algorithm.predicates,
+                pvcs=pvcs, pvs=pvs,
+                static_cache=static_caches.setdefault(
+                    pod_signature_key(latest), {}),
+                state=state,
+                recheck_nodes=sorted(recheck_shadow.items())
+                if 0 < len(recheck_shadow) <= recheck_cap else None)
+            if target is None:
+                self.metrics.preemption_latency.observe(
+                    (self._clock() - start) * 1e6)
+                delay = self.backoff.get_backoff(pod.meta.key)
+                self.queue.add_after(latest, delay)
+                continue
+            if not target.victims:
+                # an earlier cohort eviction already freed space this pod
+                # provably fits into — no eviction, retry immediately;
+                # record the claim so later cohort members see it taken
+                claims.setdefault(target.node_name, []).append(latest)
+                shadow = recheck_shadow.get(target.node_name)
+                if shadow is not None:
+                    shadow.add_pod(latest)
+                self.queue.add(latest)
+                self.metrics.preemption_latency.observe(
+                    (self._clock() - start) * 1e6)
+                continue
+            self._evict_victims(latest, target, ev_batch)
+            self.pump()  # observe deletions: cache + informers advance
+            snapshot = self.snapshot()
+            fresh = snapshot.get(target.node_name)
+            state.update_node(target.node_name, fresh)
+            claims.setdefault(target.node_name, []).append(latest)
+            if fresh is not None:
+                # shadow = post-eviction state PLUS every outstanding
+                # claim on this node (earlier grantees/evictors retry
+                # into this space next batch) — later cohort members
+                # must not be granted already-promised capacity
+                shadow = fresh.clone()
+                for claimant in claims[target.node_name]:
+                    shadow.add_pod(claimant)
+                recheck_shadow[target.node_name] = shadow
+            touched.add(target.node_name)
+            preempted += 1
+            self.queue.add(latest)  # retry immediately into the freed space
+            self.metrics.preemption_latency.observe((self._clock() - start) * 1e6)
+        return preempted
 
     # -- the per-pod oracle loop (scheduler.go:253) ------------------------
     def schedule_one(self, timeout: Optional[float] = 0.0, async_bind: bool = False) -> bool:
@@ -320,6 +440,9 @@ class Scheduler:
         # its correlation/store writes would steal the GIL from the host
         # phases that are NOT in the device's shadow (tensorize/apply)
         ev_batch: list = []
+        # priority pods whose scheduling failed: preemption is deferred to
+        # ONE cohort pass after the drain (see _preempt_cohort)
+        preempt_cohort: list = [] if self.enable_preemption else None
 
         def commit_segment(entries: list) -> None:
             """Assume + bind + record one segment's results (the batch
@@ -331,7 +454,8 @@ class Scheduler:
             to_assume: list[tuple] = []
             for pod, node_name, req_vec, nz_vec in entries:
                 if node_name is None:
-                    self.handle_schedule_failure(pod, FitError(pod, {}), ev_batch)
+                    self.handle_schedule_failure(pod, FitError(pod, {}), ev_batch,
+                                                 preempt_cohort=preempt_cohort)
                     totals["failed"] += 1
                     continue
                 # per-signature request vectors from the backend (when the
@@ -398,6 +522,10 @@ class Scheduler:
             self.metrics.batch_device_latency.observe(
                 (self._clock() - algo_start) * 1e6)
             self.metrics.schedule_attempts.inc(len(pods))
+            if preempt_cohort:
+                # PostFilter: one prefilter-kernel pass over the failed
+                # priority pods, exact victim selection on the survivors
+                self._preempt_cohort(preempt_cohort, ev_batch)
             bound, failed = totals["bound"], totals["failed"]
         finally:
             if gc_was_enabled:

@@ -165,3 +165,10 @@ class SchedulerMetrics:
             "scheduler_pallas_fallback_total",
             "pallas dispatch/finalize failures that fell back to the XLA scan",
         ))
+        # preemption (the PostFilter phase)
+        self.preemption_attempts = r.register(Counter(
+            "scheduler_preemption_attempts_total"))
+        self.preemption_victims = r.register(Counter(
+            "scheduler_preemption_victims_total"))
+        self.preemption_latency = r.register(Histogram(
+            "scheduler_preemption_latency_microseconds"))
