@@ -739,3 +739,181 @@ def test_traversal_payload_keys_never_escape_the_volume_root(tmp_path):
     # idempotent: a second sync sees unchanged content, no rewrite
     assert vh.sync_pod(pod) == 0
     vh.teardown_all()
+
+
+# -- scale/race coverage for the real-container path (r4 VERDICT Weak #5) ----
+
+
+def test_two_kubelets_share_a_manifest_dir(tmp_path):
+    """kubeadm's self-hosting layout on a multi-master cluster: TWO
+    kubelets watch the SAME static-pod manifest directory.  Each must run
+    its OWN real copy (`<name>-<node>`, distinct pids, distinct mirror
+    pods) without stealing or clobbering the other's; removing the file
+    stops both."""
+    import yaml as _yaml
+
+    mdir = tmp_path / "manifests"
+    mdir.mkdir()
+    cs = Clientset(Store())
+    ks = []
+    for n in ("n1", "n2"):
+        k = HollowKubelet(cs, n, pod_start_latency=0.0, clock=FakeClock(),
+                          real_containers=True, static_pod_dir=str(mdir),
+                          container_root=str(tmp_path / f"ctrs-{n}"))
+        k.register()
+        ks.append(k)
+
+    (mdir / "cp.yaml").write_text(_yaml.safe_dump({
+        "kind": "Pod", "metadata": {"name": "cp", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "img",
+                                 "command": ["/bin/sleep", "1000"]}]}}))
+    try:
+        for _ in range(4):
+            for k in ks:
+                k.tick()
+        pids = {}
+        for n in ("n1", "n2"):
+            pod = cs.pods.get(f"cp-{n}", "default")
+            assert pod.status.phase == "Running"
+            assert pod.spec.node_name == n
+            assert pod.meta.annotations["kubernetes.io/config.mirror"] == "true"
+            pids[n] = _pid(pod)
+            assert _alive(pids[n])
+        assert pids["n1"] != pids["n2"], "each node must fork its own copy"
+
+        # one node's container dying must restart ONLY that node's copy
+        os.kill(pids["n1"], signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        new_pid = None
+        while time.monotonic() < deadline:
+            for k in ks:
+                k.tick()
+            pod = cs.pods.get("cp-n1", "default")
+            st = pod.status.container_statuses[0]
+            if st.restart_count >= 1 and _pid(pod) != pids["n1"]:
+                new_pid = _pid(pod)
+                break
+            time.sleep(0.05)
+        assert new_pid is not None and _alive(new_pid)
+        assert _pid(cs.pods.get("cp-n2", "default")) == pids["n2"]
+        assert _alive(pids["n2"])
+
+        # removing the manifest stops BOTH copies and their mirrors
+        (mdir / "cp.yaml").unlink()
+        for _ in range(3):
+            for k in ks:
+                k.tick()
+        for n in ("n1", "n2"):
+            with pytest.raises(Exception):
+                cs.pods.get(f"cp-{n}", "default")
+        assert not _alive(new_pid) and not _alive(pids["n2"])
+    finally:
+        for k in ks:
+            k.containers.remove_all()
+            if k.volume_host is not None:
+                k.volume_host.teardown_all()
+
+
+def test_adoption_races_a_relist_storm(tmp_path):
+    """Checkpoint adoption vs an immediate PLEG relist storm: the
+    restarted kubelet adopts a live container, the container is killed
+    BEFORE the first tick, and a burst of relists must observe the death
+    exactly once and restart with a fresh pid — no crash, no double
+    restart, no lost container."""
+    root = str(tmp_path / "containers")
+    cs = Clientset(Store())
+    k1 = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=FakeClock(),
+                       real_containers=True, container_root=root)
+    k1.register()
+    start(cs, k1, real_pod("p", command=["/bin/sleep", "1000"]))
+    pid1 = _pid(cs.pods.get("p", "default"))
+
+    # new kubelet adopts, then the adopted pid dies before ANY tick
+    k2 = HollowKubelet(cs, "n1", pod_start_latency=0.0, clock=FakeClock(),
+                       real_containers=True, container_root=root)
+    assert k2.containers.stats["adopted"] == 1
+    os.kill(pid1, signal.SIGKILL)
+    deadline = time.monotonic() + 10
+    while _alive(pid1) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    try:
+        # relist storm: many back-to-back ticks while the death is fresh
+        for _ in range(12):
+            k2.tick()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            k2.tick()
+            pod = cs.pods.get("p", "default")
+            st = pod.status.container_statuses[0]
+            if st.restart_count >= 1 and _pid(pod) != pid1:
+                break
+            time.sleep(0.05)
+        pod = cs.pods.get("p", "default")
+        st = pod.status.container_statuses[0]
+        # a death that precedes the kubelet's FIRST observation may count
+        # as a fresh start (0) rather than a restart (1): the process was
+        # never this kubelet's child, so no kernel exit status exists to
+        # attribute.  Either way it must never double-count.
+        assert st.restart_count <= 1
+        pid2 = _pid(pod)
+        assert pid2 != pid1 and _alive(pid2)
+        # the storm settles: many more relists change nothing
+        count_after = st.restart_count
+        for _ in range(8):
+            k2.tick()
+        pod = cs.pods.get("p", "default")
+        assert pod.status.container_statuses[0].restart_count == count_after
+        assert _pid(pod) == pid2
+    finally:
+        k2.containers.remove_all()
+        if k2.volume_host is not None:
+            k2.volume_host.teardown_all()
+
+
+def test_real_container_fleet_across_nodes(tmp_path):
+    """Multi-node real containers through the REAL scheduling path: pods
+    flow store -> scheduler -> bind -> per-node kubelets, every container
+    is a live process on the node that was assigned, and teardown reaps
+    everything."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    cs = Clientset(Store())
+    ks = []
+    for i in range(3):
+        k = HollowKubelet(cs, f"n{i}", pod_start_latency=0.0,
+                          clock=FakeClock(), real_containers=True,
+                          container_root=str(tmp_path / f"ctrs-{i}"))
+        k.register()
+        ks.append(k)
+    sched = Scheduler(cs, emit_events=False)
+    sched.start()
+    for i in range(6):
+        p = real_pod(f"w{i}", command=["/bin/sleep", "1000"])
+        p.spec.node_name = ""  # let the scheduler place it
+        cs.pods.create(p)
+    sched.pump()
+    assert sched.run_pending() == 6
+    try:
+        pids = {}
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and len(pids) < 6:
+            for k in ks:
+                k.tick()
+            for i in range(6):
+                pod = cs.pods.get(f"w{i}", "default")
+                if pod.status.phase == "Running" and pod.status.container_statuses:
+                    pids[f"w{i}"] = (_pid(pod), pod.spec.node_name)
+            time.sleep(0.02)
+        assert len(pids) == 6
+        by_node: dict = {}
+        for name, (pid, node) in pids.items():
+            assert _alive(pid)
+            by_node.setdefault(node, []).append(pid)
+        assert len(by_node) >= 2, f"spreading should use >1 node: {by_node}"
+    finally:
+        for k in ks:
+            k.containers.remove_all()
+            if k.volume_host is not None:
+                k.volume_host.teardown_all()
+    for name, (pid, _) in pids.items():
+        assert not _alive(pid), f"{name} leaked pid {pid}"
