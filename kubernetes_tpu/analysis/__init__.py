@@ -1,0 +1,34 @@
+"""ktpu-analyze: project-native static analysis.
+
+Three AST/call-graph passes guard the two silent-failure classes this
+codebase is most exposed to (ISSUE 1):
+
+- ``trace_safety`` (TS1xx): host Python semantics leaking into traced
+  JAX/Pallas code under ``ops/`` — Python branching on kernel-derived
+  values, host escapes (``float()``, ``.item()``, ``np.`` calls) inside
+  jitted bodies, and nondeterministic set iteration feeding tensor
+  builders.
+- ``parity`` (PC2xx): every predicate/priority registered in the host
+  oracle (``scheduler/predicates.py`` / ``scheduler/priorities.py``)
+  must either carry a ``# kernel: implements <Name>`` marker at its
+  kernel implementation site or an explicit
+  ``# kernel: host-fallback — <why>`` marker at its oracle definition,
+  so oracle↔kernel drift fails loudly instead of surfacing as a parity
+  mismatch at 5k-node scale.
+- ``races`` (RL3xx): ``threading.Thread`` target call graphs over
+  ``controllers/`` and ``kubelet/`` — instance attributes written from
+  worker threads without holding the owning object's lock, and
+  lock-acquisition-order cycles.
+
+Run ``python -m kubernetes_tpu.analysis`` (exits nonzero on unbaselined
+findings); suppressions live in ``analysis/baseline.json`` and each
+requires a justification string.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Report,
+    load_baseline,
+    repo_root,
+    run_analysis,
+)

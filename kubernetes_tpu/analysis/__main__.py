@@ -1,0 +1,100 @@
+"""ktpu-analyze CLI.
+
+    python -m kubernetes_tpu.analysis [--json] [--pass NAME]...
+                                      [--baseline PATH | --no-baseline]
+                                      [--root DIR] [--list-passes]
+
+Exit codes: 0 = clean (all findings baselined), 1 = unbaselined findings,
+2 = usage/baseline error.  Nonzero-on-findings is the commit-gate
+contract: `python -m kubernetes_tpu.analysis && git commit …`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (
+    PASS_NAMES,
+    BaselineError,
+    default_baseline_path,
+    load_baseline,
+    repo_root,
+    run_analysis,
+)
+
+PASS_DESCRIPTIONS = {
+    "trace": "trace-safety over ops/ (TS1xx: host escapes, Python branches on traced values, set-order nondeterminism)",
+    "parity": "oracle↔kernel parity coverage (PC2xx: unmapped predicates/priorities, stale markers)",
+    "races": "controller/kubelet race lint (RL3xx: unlocked cross-thread writes, lock-order cycles)",
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="Project-native static analysis: trace-safety, parity coverage, race lint.",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+    parser.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=PASS_NAMES,
+        help="run only the named pass (repeatable; default: all)",
+    )
+    baseline_group = parser.add_mutually_exclusive_group()
+    baseline_group.add_argument(
+        "--baseline", default=None, help="baseline suppression file (JSON)"
+    )
+    baseline_group.add_argument(
+        "--no-baseline", action="store_true", help="report every finding, suppressing nothing"
+    )
+    parser.add_argument("--root", default=None, help="repo root (default: autodetected)")
+    parser.add_argument(
+        "--strict-baseline",
+        action="store_true",
+        help="also fail (exit 1) on stale baseline entries",
+    )
+    parser.add_argument("--list-passes", action="store_true", help="list passes and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for name in PASS_NAMES:
+            print(f"{name:8s} {PASS_DESCRIPTIONS[name]}")
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        path = args.baseline or default_baseline_path()
+        try:
+            baseline = load_baseline(path)
+        except FileNotFoundError:
+            print(f"baseline file not found: {path}", file=sys.stderr)
+            return 2
+        except BaselineError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+
+    try:
+        report = run_analysis(
+            root=args.root or repo_root(), passes=args.passes, baseline=baseline
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.format_text())
+    if report.findings:
+        return 1
+    if args.strict_baseline and report.stale_suppressions:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""Analyzer spine: findings, baseline suppressions, reporters, pass driver.
+
+A :class:`Finding` is keyed by ``code:path:symbol`` — deliberately NOT by
+line number, so a baseline entry survives unrelated edits above the
+finding.  ``symbol`` is the dotted enclosing-scope path plus the offending
+name (e.g. ``make_step.step.gid`` or ``Controller._worker_loop.counter``),
+which moves only when the code it names moves.
+
+The baseline file is a checked-in JSON document; every suppression MUST
+carry a non-empty ``reason`` (enforced at load time) so nothing is ever
+waved through silently.  Stale entries (keys matching no current finding)
+are reported so the baseline can only shrink, never rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+PASS_NAMES = ("trace", "parity", "races")
+
+
+def repo_root() -> str:
+    """The directory containing the ``kubernetes_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str  # e.g. "TS101"
+    path: str  # repo-relative posix path
+    line: int  # 1-based, for humans; not part of the key
+    symbol: str  # stable anchor (scope path + name)
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.code}:{self.path}:{self.symbol}"
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "key": self.key,
+        }
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} [{self.symbol}] {self.message}"
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, missing/empty reason)."""
+
+
+def load_baseline(path: str) -> dict[str, str]:
+    """key -> justification.  Every entry must justify itself."""
+    with open(path, "r", encoding="utf-8") as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"{path}: invalid JSON: {e}") from e
+    entries = doc.get("suppressions")
+    if not isinstance(entries, list):
+        raise BaselineError(f"{path}: expected a top-level 'suppressions' list")
+    out: dict[str, str] = {}
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict) or "key" not in entry:
+            raise BaselineError(f"{path}: suppression #{i} has no 'key'")
+        reason = entry.get("reason")
+        if not isinstance(reason, str) or not reason.strip():
+            raise BaselineError(
+                f"{path}: suppression {entry['key']!r} has no justification "
+                f"('reason' must be a non-empty string)"
+            )
+        if entry["key"] in out:
+            raise BaselineError(f"{path}: duplicate suppression {entry['key']!r}")
+        out[entry["key"]] = reason.strip()
+    return out
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    stale_suppressions: list[str] = field(default_factory=list)
+    passes_run: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "passes": self.passes_run,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "stale_suppressions": self.stale_suppressions,
+        }
+
+    def format_text(self) -> str:
+        lines: list[str] = []
+        by_file: dict[str, list[Finding]] = {}
+        for f in self.findings:
+            by_file.setdefault(f.path, []).append(f)
+        for path in sorted(by_file):
+            lines.append(path)
+            for f in sorted(by_file[path], key=lambda x: (x.line, x.code)):
+                lines.append(f"  {f.line}: {f.code} [{f.symbol}] {f.message}")
+        lines.append(
+            f"{len(self.findings)} finding(s), {len(self.suppressed)} baselined, "
+            f"passes: {', '.join(self.passes_run)}"
+        )
+        if self.stale_suppressions:
+            lines.append(
+                f"warning: {len(self.stale_suppressions)} stale baseline entr"
+                f"{'y' if len(self.stale_suppressions) == 1 else 'ies'} "
+                f"(matched nothing — prune them):"
+            )
+            for key in self.stale_suppressions:
+                lines.append(f"  {key}")
+        return "\n".join(lines)
+
+
+# finding-code prefix -> the pass that can produce it (stale-entry
+# detection must not call a races suppression "stale" in a parity-only run)
+_CODE_PREFIX_PASS = {"TS": "trace", "PC": "parity", "RL": "races"}
+
+
+def _split_baseline(
+    findings: list[Finding], baseline: dict[str, str], passes: list[str]
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    live: list[Finding] = []
+    suppressed: list[Finding] = []
+    used: set[str] = set()
+    for f in findings:
+        if f.key in baseline:
+            suppressed.append(f)
+            used.add(f.key)
+        else:
+            live.append(f)
+    stale = sorted(
+        key
+        for key in set(baseline) - used
+        if _CODE_PREFIX_PASS.get(key[:2], passes[0] if passes else "") in passes
+    )
+    return live, suppressed, stale
+
+
+def run_analysis(
+    root: Optional[str] = None,
+    passes: Optional[list[str]] = None,
+    baseline: Optional[dict[str, str]] = None,
+    scopes: Optional[dict[str, dict]] = None,
+) -> Report:
+    """Run the requested passes over the tree at ``root``.
+
+    ``scopes`` overrides per-pass file scopes (used by the fixture tests to
+    aim a pass at seeded-violation files): ``{"trace": {"paths": [...]},
+    "parity": {"oracle_paths": [...], "kernel_paths": [...]},
+    "races": {"paths": [...]}}``.
+    """
+    from . import parity, races, trace_safety
+
+    root = root or repo_root()
+    passes = list(passes) if passes else list(PASS_NAMES)
+    scopes = scopes or {}
+    unknown = [p for p in passes if p not in PASS_NAMES]
+    if unknown:
+        raise ValueError(f"unknown pass(es): {unknown}; valid: {list(PASS_NAMES)}")
+
+    runners: dict[str, Callable[[], list[Finding]]] = {
+        "trace": lambda: trace_safety.run(root, **scopes.get("trace", {})),
+        "parity": lambda: parity.run(root, **scopes.get("parity", {})),
+        "races": lambda: races.run(root, **scopes.get("races", {})),
+    }
+    findings: list[Finding] = []
+    for name in passes:
+        findings.extend(runners[name]())
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
+
+    report = Report(passes_run=passes)
+    if baseline:
+        report.findings, report.suppressed, report.stale_suppressions = _split_baseline(
+            findings, baseline, passes
+        )
+    else:
+        report.findings = findings
+    return report
+
+
+def iter_py_files(root: str, rel_paths: list[str]) -> list[tuple[str, str]]:
+    """Expand repo-relative files/directories into (abs_path, rel_path)
+    pairs, sorted for deterministic finding order.
+
+    A scope path that matches nothing is a hard error: a typo'd or renamed
+    entry must not silently shrink the gate's coverage to zero files."""
+    out: list[tuple[str, str]] = []
+    for rel in rel_paths:
+        abs_p = os.path.join(root, rel)
+        if not os.path.exists(abs_p):
+            raise ValueError(
+                f"analysis scope path does not exist: {rel!r} (under {root}) — "
+                f"fix the scope list rather than scanning nothing"
+            )
+        if os.path.isdir(abs_p):
+            for dirpath, dirnames, filenames in os.walk(abs_p):
+                dirnames.sort()
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        full = os.path.join(dirpath, fn)
+                        out.append((full, os.path.relpath(full, root).replace(os.sep, "/")))
+        elif os.path.isfile(abs_p):
+            out.append((abs_p, rel.replace(os.sep, "/")))
+    return out

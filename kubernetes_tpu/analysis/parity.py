@@ -1,0 +1,236 @@
+"""Pass 2 — oracle↔kernel parity coverage (PC2xx).
+
+The host oracle (``scheduler/predicates.py`` / ``scheduler/priorities.py``)
+is the behavioral spec the TPU kernels must reproduce bit-for-bit.  The
+drift mode that hurts is silent: a predicate or priority added to the
+oracle with no matching kernel mask schedules correctly in unit tests and
+diverges only as a parity mismatch at scale (the backend falls back to
+all-oracle when the *configured* set is unsupported — but a new entry in
+DEFAULT_PREDICATES silently widens what "supported" claims to mean).
+
+The contract this pass enforces:
+
+- every registered oracle entity (a ``DEFAULT_PREDICATES`` key, a
+  ``make_*`` predicate factory, a priority class carrying a ``name``
+  attribute) must either
+  (a) appear in a ``# kernel: implements <Name>[, <Name>…]`` marker in a
+  kernel file (``ops/batch_kernel.py``, ``ops/pallas_kernel.py``,
+  ``ops/backend.py``, ``models/snapshot.py`` — the mask may live in the
+  tensorizer), or
+  (b) carry an explicit ``# kernel: host-fallback — <why>`` marker inside
+  its oracle definition block.
+
+Findings:
+
+- PC201 unmapped predicate (neither implemented nor marked host-fallback)
+- PC202 unmapped priority
+- PC203 ``implements`` marker names an unknown oracle entity (a rename or
+  removal on the oracle side left a stale kernel claim — exactly the
+  drift this pass exists to catch, in the other direction)
+- PC204 entity both kernel-implemented and marked host-fallback (stale
+  fallback marker: the kernel caught up, the oracle annotation didn't)
+- PC205 host-fallback marker with no justification text
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .core import Finding, iter_py_files
+
+DEFAULT_ORACLE_PATHS = [
+    "kubernetes_tpu/scheduler/predicates.py",
+    "kubernetes_tpu/scheduler/priorities.py",
+]
+DEFAULT_KERNEL_PATHS = [
+    "kubernetes_tpu/ops/batch_kernel.py",
+    "kubernetes_tpu/ops/pallas_kernel.py",
+    "kubernetes_tpu/ops/backend.py",
+    "kubernetes_tpu/models/snapshot.py",
+]
+
+_IMPLEMENTS_RE = re.compile(r"#\s*kernel:\s*implements\s+(?P<names>[A-Za-z0-9_,\s]+)")
+_FALLBACK_RE = re.compile(r"#\s*kernel:\s*host-fallback\s*(?:[-—–:]+\s*(?P<reason>.*))?$")
+
+
+class OracleEntity:
+    def __init__(self, name: str, kind: str, path: str, line: int, end_line: int):
+        self.name = name
+        self.kind = kind  # "predicate" | "priority"
+        self.path = path
+        self.line = line
+        self.end_line = end_line
+        self.fallback_line: Optional[int] = None
+        self.fallback_reason: Optional[str] = None
+
+
+def _collect_oracle_entities(abs_path: str, rel: str) -> list[OracleEntity]:
+    with open(abs_path, "r", encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=rel)
+    entities: list[OracleEntity] = []
+    for node in tree.body:
+        # registry dicts: DEFAULT_PREDICATES = {"Name": fn, …}
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            if (
+                isinstance(value, ast.Dict)
+                and any(
+                    isinstance(t, ast.Name) and "PREDICATES" in t.id for t in targets
+                )
+            ):
+                for key in value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        entities.append(
+                            OracleEntity(key.value, "predicate", rel, key.lineno, key.lineno)
+                        )
+        elif isinstance(node, ast.FunctionDef) and node.name.startswith("make_"):
+            entities.append(
+                OracleEntity(
+                    node.name, "predicate", rel, node.lineno,
+                    node.end_lineno or node.lineno,
+                )
+            )
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if (
+                    isinstance(item, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "name" for t in item.targets)
+                    and isinstance(item.value, ast.Constant)
+                    and isinstance(item.value.value, str)
+                ):
+                    entities.append(
+                        OracleEntity(
+                            node.name, "priority", rel, node.lineno,
+                            node.end_lineno or node.lineno,
+                        )
+                    )
+                    break
+    _attach_fallback_markers(src, entities)
+    return entities
+
+
+def _attach_fallback_markers(src: str, entities: list[OracleEntity]) -> None:
+    for lineno, line in enumerate(src.splitlines(), start=1):
+        m = _FALLBACK_RE.search(line)
+        if not m:
+            continue
+        reason = (m.group("reason") or "").strip()
+        # attach to the innermost (smallest) enclosing entity block
+        best: Optional[OracleEntity] = None
+        for e in entities:
+            if e.line <= lineno <= e.end_line:
+                if best is None or (e.end_line - e.line) < (best.end_line - best.line):
+                    best = e
+        if best is not None:
+            best.fallback_line = lineno
+            best.fallback_reason = reason
+
+
+def _collect_implements(
+    abs_path: str, rel: str
+) -> list[tuple[str, str, int]]:
+    """(name, path, line) per implements-marker mention."""
+    out: list[tuple[str, str, int]] = []
+    with open(abs_path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            m = _IMPLEMENTS_RE.search(line)
+            if not m:
+                continue
+            for name in m.group("names").split(","):
+                name = name.strip()
+                if name:
+                    out.append((name, rel, lineno))
+    return out
+
+
+def run(
+    root: str,
+    oracle_paths: Optional[list[str]] = None,
+    kernel_paths: Optional[list[str]] = None,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    entities: list[OracleEntity] = []
+    for abs_path, rel in iter_py_files(root, oracle_paths or DEFAULT_ORACLE_PATHS):
+        try:
+            entities.extend(_collect_oracle_entities(abs_path, rel))
+        except SyntaxError as e:
+            findings.append(
+                Finding("PC200", rel, e.lineno or 1, "syntax", f"unparseable oracle file: {e.msg}")
+            )
+    implements: list[tuple[str, str, int]] = []
+    for abs_path, rel in iter_py_files(root, kernel_paths or DEFAULT_KERNEL_PATHS):
+        implements.extend(_collect_implements(abs_path, rel))
+
+    by_name: dict[str, OracleEntity] = {}
+    for e in entities:
+        # a name registered twice (dict entry + factory) keeps the first
+        by_name.setdefault(e.name, e)
+    implemented: dict[str, tuple[str, int]] = {}
+    for name, rel, lineno in implements:
+        implemented.setdefault(name, (rel, lineno))
+        if name not in by_name:
+            findings.append(
+                Finding(
+                    code="PC203",
+                    path=rel,
+                    line=lineno,
+                    symbol=f"implements.{name}",
+                    message=(
+                        f"kernel claims to implement {name!r} but no such "
+                        f"predicate/priority is registered in the oracle — "
+                        f"renamed or removed without updating the marker?"
+                    ),
+                )
+            )
+
+    for e in by_name.values():
+        is_impl = e.name in implemented
+        has_fb = e.fallback_line is not None
+        if is_impl and has_fb:
+            findings.append(
+                Finding(
+                    code="PC204",
+                    path=e.path,
+                    line=e.fallback_line,
+                    symbol=f"fallback.{e.name}",
+                    message=(
+                        f"{e.name} is marked host-fallback but a kernel implements "
+                        f"marker exists at {implemented[e.name][0]}:"
+                        f"{implemented[e.name][1]} — remove the stale marker"
+                    ),
+                )
+            )
+        elif has_fb and not (e.fallback_reason or "").strip():
+            findings.append(
+                Finding(
+                    code="PC205",
+                    path=e.path,
+                    line=e.fallback_line,
+                    symbol=f"fallback.{e.name}",
+                    message=(
+                        f"host-fallback marker on {e.name} has no justification — "
+                        f"write why the kernel path doesn't cover it "
+                        f"(`# kernel: host-fallback — <why>`)"
+                    ),
+                )
+            )
+        elif not is_impl and not has_fb:
+            code = "PC201" if e.kind == "predicate" else "PC202"
+            findings.append(
+                Finding(
+                    code=code,
+                    path=e.path,
+                    line=e.line,
+                    symbol=f"unmapped.{e.name}",
+                    message=(
+                        f"registered {e.kind} {e.name!r} has no kernel implementation "
+                        f"marker and no `# kernel: host-fallback` annotation — the "
+                        f"batch path will silently diverge from the oracle"
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,572 @@
+"""Pass 3 — controller/kubelet race lint (RL3xx).
+
+The controller runtime's production shape is threaded (``run_workers``,
+``controllers/base.py:110``): informer handlers enqueue on the event
+thread, N workers pop keys and run ``sync``.  The reference survives this
+because every mutable map it touches is lock-guarded; a Python port loses
+that discipline one convenience attribute at a time.  This pass walks the
+``threading.Thread`` target call graph and reports:
+
+- RL301: an instance attribute *assigned* (``self.x = …`` / ``self.x += …``)
+  inside a worker-thread-reachable method without holding one of the
+  object's own locks.  Lock attributes are those assigned
+  ``threading.Lock()/RLock()/Condition()`` anywhere in the class (MRO
+  included); a write is "held" when lexically inside ``with self.<lock>:``.
+- RL302: a lock-acquisition-order cycle — method A acquires lock1 then
+  (directly or via one self-call) lock2, while method B acquires them in
+  the opposite order.
+- RL303: a *plain-container* instance attribute (one assigned a
+  dict/list/set/deque literal or constructor in this class) mutated from
+  a worker-reachable method without a lock — subscript writes/deletes,
+  mutator method calls (``.append``/``.pop``/``.update``/…), and
+  ``heapq.heappush/heappop`` on the attribute.  Restricting to
+  known-plain containers is what keeps internally-locked objects
+  (``WorkQueue``, informer stores) from false-positiving.
+
+Resolution is name-based MRO over the scanned packages: thread entry
+points found in a base class (``Controller._worker_loop``) make the
+*subclass* ``sync`` overrides worker-reachable, which is exactly where
+convenience writes accumulate.  Informer-handler callbacks
+(``Handler(on_add=self.m)``, ``watch(kind, key_fn=self.m)``) count as
+thread entries too — they fire on the informer's ``_run_loop`` thread in
+the production shape; lambdas in those slots are unwrapped
+(``on_update=lambda old, new: self._move(old, new)`` marks ``_move``).
+HTTP handler ``do_*`` methods are deliberately NOT entry points — there
+is no special-case code, they simply match none of the entry heuristics
+— because the stdlib server builds a NEW handler instance per
+connection, so ``self`` is thread-confined and per-request attribute
+writes are not races.  (A handler class that ALSO spawns a thread over
+shared state is analyzed through that thread entry like any other
+class.)  Lock-order cycles are checked for every class that defines
+locks, entries or not.
+
+Known blind spots (documented, deliberate): mutations through aliases
+(``p = self._pending; p[k] = v``) and locks held by callers across
+method boundaries are not tracked (a method that writes under "caller
+holds the lock" convention baselines with that as its justification).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, iter_py_files
+
+DEFAULT_PATHS = [
+    "kubernetes_tpu/controllers",
+    "kubernetes_tpu/kubelet",
+    "kubernetes_tpu/client",
+    "kubernetes_tpu/scheduler",
+    "kubernetes_tpu/apiserver",
+    "kubernetes_tpu/auth",
+    "kubernetes_tpu/dns",
+    "kubernetes_tpu/proxy",
+    "kubernetes_tpu/store",
+]
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+CONTAINER_FACTORIES = {
+    "dict",
+    "list",
+    "set",
+    "deque",
+    "defaultdict",
+    "OrderedDict",
+    "Counter",
+}
+MUTATOR_METHODS = {
+    "append",
+    "appendleft",
+    "extend",
+    "add",
+    "remove",
+    "discard",
+    "pop",
+    "popleft",
+    "popitem",
+    "update",
+    "setdefault",
+    "clear",
+    "insert",
+}
+HEAP_FUNCS = {"heappush", "heappop", "heappushpop", "heapreplace", "heapify"}
+
+
+class ClassInfo:
+    def __init__(self, name: str, node: ast.ClassDef, path: str):
+        self.name = name
+        self.node = node
+        self.path = path
+        self.bases = [_base_name(b) for b in node.bases]
+        self.methods: dict[str, ast.FunctionDef] = {
+            item.name: item
+            for item in node.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+
+def _base_name(expr: ast.expr) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+def _is_self_attr(expr: ast.expr) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+class _ClassIndex:
+    def __init__(self, files: list[tuple[str, str]]):
+        self.classes: dict[str, ClassInfo] = {}
+        self.parse_errors: list[Finding] = []
+        for abs_path, rel in files:
+            with open(abs_path, "r", encoding="utf-8") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError as e:
+                self.parse_errors.append(
+                    Finding("RL300", rel, e.lineno or 1, "syntax", f"unparseable file: {e.msg}")
+                )
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    # same-named classes across modules: last wins is wrong;
+                    # key by (module, name) and by bare name for base lookup
+                    info = ClassInfo(node.name, node, rel)
+                    self.classes.setdefault(node.name, info)
+                    self.classes[f"{rel}::{node.name}"] = info
+
+    def mro(self, info: ClassInfo) -> list[ClassInfo]:
+        """Name-based linearization (left-to-right DFS, dedup)."""
+        out: list[ClassInfo] = []
+        seen: set[int] = set()
+
+        def visit(ci: ClassInfo) -> None:
+            if id(ci) in seen:
+                return
+            seen.add(id(ci))
+            out.append(ci)
+            for b in ci.bases:
+                base = self.classes.get(b)
+                if base is not None:
+                    visit(base)
+
+        visit(info)
+        return out
+
+
+def _method_table(index: _ClassIndex, info: ClassInfo) -> dict[str, tuple[ClassInfo, ast.FunctionDef]]:
+    table: dict[str, tuple[ClassInfo, ast.FunctionDef]] = {}
+    for ci in reversed(index.mro(info)):
+        for name, fn in ci.methods.items():
+            table[name] = (ci, fn)
+    return table
+
+
+def _lock_attrs(index: _ClassIndex, info: ClassInfo) -> set[str]:
+    locks: set[str] = set()
+    for ci in index.mro(info):
+        for fn in ci.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    callee = node.value.func
+                    factory = (
+                        callee.attr if isinstance(callee, ast.Attribute)
+                        else callee.id if isinstance(callee, ast.Name) else ""
+                    )
+                    if factory in LOCK_FACTORIES:
+                        for t in node.targets:
+                            attr = _is_self_attr(t)
+                            if attr:
+                                locks.add(attr)
+    return locks
+
+
+def _container_attrs(index: _ClassIndex, info: ClassInfo) -> set[str]:
+    """Attributes assigned a plain dict/list/set/deque (literal or
+    constructor) anywhere in the class — the objects with no interior
+    locking of their own."""
+    out: set[str] = set()
+    for ci in index.mro(info):
+        for fn in ci.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if value is None:
+                    continue
+                is_container = isinstance(
+                    value, (ast.Dict, ast.List, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+                )
+                if not is_container and isinstance(value, ast.Call):
+                    callee = value.func
+                    name = (
+                        callee.attr if isinstance(callee, ast.Attribute)
+                        else callee.id if isinstance(callee, ast.Name) else ""
+                    )
+                    is_container = name in CONTAINER_FACTORIES
+                if not is_container:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    attr = _is_self_attr(t)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+def _thread_entries(index: _ClassIndex, info: ClassInfo) -> list[str]:
+    """Method names of ``info`` (via its table) that run on worker threads
+    against a SHARED instance (HTTP handler ``do_*`` methods are excluded:
+    one instance per connection means no cross-thread instance state)."""
+    entries: set[str] = set()
+    table = _method_table(index, info)
+    for _ci, fn in table.values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            cname = (
+                callee.attr if isinstance(callee, ast.Attribute)
+                else callee.id if isinstance(callee, ast.Name) else ""
+            )
+            if cname not in ("Thread", "Timer"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = _is_self_attr(kw.value)
+                    if attr and attr in table:
+                        entries.add(attr)
+            # Timer(interval, self.m)
+            if cname == "Timer" and len(node.args) >= 2:
+                attr = _is_self_attr(node.args[1])
+                if attr and attr in table:
+                    entries.add(attr)
+    # informer-handler convention: callbacks registered via
+    # Handler(on_add=self.m, …) or watch(kind, key_fn=self.m) run on the
+    # informer's _run_loop thread in the production shape
+    for _ci, fn in table.values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg in ("on_add", "on_update", "on_delete", "key_fn"):
+                    attr = _is_self_attr(kw.value)
+                    if attr and attr in table:
+                        entries.add(attr)
+                    elif isinstance(kw.value, ast.Lambda):
+                        # on_update=lambda old, new: self._move(old, new)
+                        for n in ast.walk(kw.value.body):
+                            attr = _is_self_attr(n) if isinstance(n, ast.Attribute) else None
+                            if attr and attr in table:
+                                entries.add(attr)
+    return sorted(entries)
+
+
+def _reachable(table: dict, entries: list[str]) -> set[str]:
+    seen: set[str] = set()
+    stack = list(entries)
+    while stack:
+        m = stack.pop()
+        if m in seen or m not in table:
+            continue
+        seen.add(m)
+        _ci, fn = table[m]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                attr = _is_self_attr(node.func)
+                if attr and attr in table and attr not in seen:
+                    stack.append(attr)
+    return seen
+
+
+def _subscript_self_attr(target: ast.expr) -> Optional[str]:
+    """`self.x[k]` (possibly nested subscripts) -> "x"."""
+    while isinstance(target, ast.Subscript):
+        target = target.value
+    return _is_self_attr(target)
+
+
+class _WriteVisitor(ast.NodeVisitor):
+    """Find self-attribute writes/mutations and the lock context they run
+    under.  ``writes`` are rebinding assignments (RL301); ``mutations``
+    are container-interior writes (RL303)."""
+
+    def __init__(self, locks: set[str], containers: set[str]):
+        self.locks = locks
+        self.containers = containers
+        self.held: list[str] = []
+        self.writes: list[tuple[str, int, frozenset]] = []  # (attr, line, held)
+        self.mutations: list[tuple[str, int, frozenset, str]] = []  # +what
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            ctx = item.context_expr
+            attr = _is_self_attr(ctx)
+            if attr is None and isinstance(ctx, ast.Call):
+                attr = _is_self_attr(ctx.func)  # with self._mu: vs self._cond:
+            if attr in self.locks:
+                acquired.append(attr)
+        self.held.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.held.pop()
+
+    def _record(self, target: ast.expr, line: int) -> None:
+        attr = _is_self_attr(target)
+        if attr is not None:
+            self.writes.append((attr, line, frozenset(self.held)))
+            return
+        attr = _subscript_self_attr(target)
+        if attr is not None and attr in self.containers:
+            self.mutations.append((attr, line, frozenset(self.held), "subscript write"))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            attr = _subscript_self_attr(t)
+            if attr is not None and attr in self.containers:
+                self.mutations.append((attr, node.lineno, frozenset(self.held), "del"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATOR_METHODS:
+            attr = _is_self_attr(fn.value)
+            if attr is not None and attr in self.containers:
+                self.mutations.append(
+                    (attr, node.lineno, frozenset(self.held), f".{fn.attr}()")
+                )
+        else:
+            hname = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if hname in HEAP_FUNCS and node.args:
+                attr = _is_self_attr(node.args[0])
+                if attr is not None and attr in self.containers:
+                    self.mutations.append(
+                        (attr, node.lineno, frozenset(self.held), f"{hname}()")
+                    )
+        self.generic_visit(node)
+
+    # nested defs (callbacks) execute elsewhere; analyzed separately
+    def visit_FunctionDef(self, node) -> None:
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _lock_order_edges(
+    table: dict, locks: set[str]
+) -> dict[tuple[str, str], tuple[str, str, int]]:
+    """(lockA, lockB) -> (class, method, line) where A is held when B is
+    acquired, expanding one level of self-calls."""
+    # first: per-method, top-level acquisitions + (held -> acquired) pairs
+    method_acquires: dict[str, list[str]] = {}
+    edges: dict[tuple[str, str], tuple[str, str, int]] = {}
+
+    class V(ast.NodeVisitor):
+        def __init__(self, cls_name: str, meth: str):
+            self.cls = cls_name
+            self.meth = meth
+            self.held: list[str] = []
+            self.calls_under: list[tuple[str, frozenset, int]] = []
+
+        def visit_With(self, node: ast.With) -> None:
+            acquired = []
+            for item in node.items:
+                ctx = item.context_expr
+                attr = _is_self_attr(ctx)
+                if attr is None and isinstance(ctx, ast.Call):
+                    attr = _is_self_attr(ctx.func)
+                if attr in locks:
+                    acquired.append(attr)
+                    if not self.held:
+                        method_acquires.setdefault(self.meth, []).append(attr)
+                    for h in self.held:
+                        if h != attr:
+                            edges.setdefault((h, attr), (self.cls, self.meth, node.lineno))
+            self.held.extend(acquired)
+            self.generic_visit(node)
+            for _ in acquired:
+                self.held.pop()
+
+        def visit_Call(self, node: ast.Call) -> None:
+            attr = _is_self_attr(node.func)
+            if attr and self.held:
+                self.calls_under.append((attr, frozenset(self.held), node.lineno))
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node) -> None:
+            return
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    visitors: list[V] = []
+    for meth, (ci, fn) in table.items():
+        v = V(ci.name, meth)
+        for stmt in fn.body:
+            v.visit(stmt)
+        visitors.append(v)
+    # one level of call expansion: caller holds H, callee acquires A at top
+    for v in visitors:
+        for callee, held, line in v.calls_under:
+            for a in method_acquires.get(callee, ()):
+                for h in held:
+                    if h != a:
+                        edges.setdefault((h, a), (v.cls, f"{v.meth}->{callee}", line))
+    return edges
+
+
+def _find_cycles(edges: dict) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles: list[list[str]] = []
+    seen_cycles: set[frozenset] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(path + [start])
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def run(root: str, paths: Optional[list[str]] = None) -> list[Finding]:
+    files = iter_py_files(root, paths or DEFAULT_PATHS)
+    index = _ClassIndex(files)
+    findings: list[Finding] = list(index.parse_errors)
+    reported: set[str] = set()
+
+    class_infos = [
+        info for key, info in sorted(index.classes.items()) if "::" in key
+    ]
+    for info in class_infos:
+        table = _method_table(index, info)
+        entries = _thread_entries(index, info)
+        locks = _lock_attrs(index, info)
+        if not entries:
+            if locks:
+                _report_lock_cycles(info, table, locks, findings, reported)
+            continue
+        containers = _container_attrs(index, info)
+        reachable = _reachable(table, entries)
+        for meth in sorted(reachable):
+            ci, fn = table[meth]
+            if meth == "__init__":
+                continue  # runs on the constructing (main) thread
+            visitor = _WriteVisitor(locks, containers)
+            for stmt in fn.body:
+                visitor.visit(stmt)
+            for attr, line, held in visitor.writes:
+                if attr in locks or held:
+                    continue
+                # report at the DEFINING class so subclasses don't duplicate
+                symbol = f"{ci.name}.{meth}.{attr}"
+                key = f"RL301:{ci.path}:{symbol}"
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(
+                    Finding(
+                        code="RL301",
+                        path=ci.path,
+                        line=line,
+                        symbol=symbol,
+                        message=(
+                            f"`self.{attr}` assigned in worker-thread-reachable "
+                            f"method `{meth}` (entry: {'/'.join(entries)}) without "
+                            f"holding any of the object's locks "
+                            f"({', '.join(sorted(locks)) or 'none defined'})"
+                        ),
+                    )
+                )
+            for attr, line, held, what in visitor.mutations:
+                if held:
+                    continue
+                symbol = f"{ci.name}.{meth}.{attr}"
+                key = f"RL303:{ci.path}:{symbol}"
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(
+                    Finding(
+                        code="RL303",
+                        path=ci.path,
+                        line=line,
+                        symbol=symbol,
+                        message=(
+                            f"container `self.{attr}` mutated ({what}) in "
+                            f"worker-thread-reachable method `{meth}` (entry: "
+                            f"{'/'.join(entries)}) without holding any of the "
+                            f"object's locks "
+                            f"({', '.join(sorted(locks)) or 'none defined'})"
+                        ),
+                    )
+                )
+        # lock-order cycles (per concrete class; report at defining site)
+        _report_lock_cycles(info, table, locks, findings, reported)
+    return findings
+
+
+def _report_lock_cycles(
+    info: ClassInfo,
+    table: dict,
+    locks: set[str],
+    findings: list[Finding],
+    reported: set[str],
+) -> None:
+    edges = _lock_order_edges(table, locks)
+    for cycle in _find_cycles(edges):
+        a, b = cycle[0], cycle[1]
+        cls, meth, line = edges[(a, b)]
+        symbol = f"{cls}.lockcycle.{'-'.join(cycle[:-1])}"
+        key = f"RL302:{info.path}:{symbol}"
+        if key in reported:
+            continue
+        reported.add(key)
+        findings.append(
+            Finding(
+                code="RL302",
+                path=info.path,
+                line=line,
+                symbol=symbol,
+                message=(
+                    f"lock-acquisition-order cycle {' -> '.join(cycle)} "
+                    f"(first edge in {cls}.{meth}): two threads taking these "
+                    f"locks in opposite orders deadlock"
+                ),
+            )
+        )

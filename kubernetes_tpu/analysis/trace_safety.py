@@ -1,0 +1,476 @@
+"""Pass 1 — trace-safety over the kernel packages (TS1xx).
+
+Host Python leaking into traced JAX/Pallas code fails in one of two ways:
+loudly at trace time (ConcretizationError) on the paths tests exercise, or
+*silently* on paths they don't — a ``float()`` on a traced value bakes one
+trace-time constant into the compiled program forever.  This pass finds
+both shapes before they compile.
+
+What counts as *traced* (the call-graph part):
+
+- a function decorated ``@jax.jit`` / ``@jit`` / ``partial(jax.jit, …)``;
+- a function passed by name into a tracing consumer
+  (``lax.scan/fori_loop/while_loop/cond/switch``, ``pl.pallas_call``,
+  ``jax.vmap/pmap/grad/remat/checkpoint/shard_map``);
+- transitively: any function called by simple name from a traced
+  function, and any function *defined inside* a traced function (factory
+  bodies like ``make_step`` run under trace).
+
+What counts as *kernel-derived* (the taint part): the traced function's
+own parameters plus anything dataflow-derived from them or from a
+``jnp.``/``jax.``/``pl.``/``pltpu.`` expression.  Free (closure)
+variables are NOT tainted — they are the standard way static
+configuration reaches a traced body — and neither are parameters
+annotated ``bool`` or defaulted to a bool/None literal, the project's
+static-flag idiom (``use_terms: bool``, ``most: bool``).
+
+Findings:
+
+- TS101 host escape: ``float()/int()/bool()`` on a tainted value,
+  ``.item()/.tolist()`` on a tainted value, or any ``np.``/``numpy.``
+  call inside a traced body.
+- TS102 Python branch on a traced value: ``if``/``while`` whose test
+  reads a tainted name.  Pure ``is``/``is not`` tests are exempt
+  (identity never concretizes a tracer).
+- TS103 nondeterministic set iteration feeding tensor builders: a
+  ``for`` (or comprehension) over a set display/comprehension/``set()``
+  result, not wrapped in ``sorted()``, in a function that also builds
+  tensors (``np/jnp .array/asarray/zeros/full/stack/…``).  Scanned in
+  ALL functions, not just traced ones — the host-side tensorizer is
+  where iteration order becomes device-visible data.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, iter_py_files
+
+DEFAULT_PATHS = [
+    "kubernetes_tpu/ops",
+    "kubernetes_tpu/models",
+    "kubernetes_tpu/parallel",
+]
+
+TRACING_CONSUMERS = {
+    "scan",
+    "fori_loop",
+    "while_loop",
+    "cond",
+    "switch",
+    "pallas_call",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "remat",
+    "checkpoint",
+    "shard_map",
+    "associative_scan",
+}
+JIT_NAMES = {"jit"}
+DEVICE_MODULES = {"jnp", "jax", "lax", "pl", "pltpu"}
+HOST_CAST_CALLS = {"float", "int", "bool", "complex"}
+HOST_ATTR_CALLS = {"item", "tolist", "numpy"}
+NP_MODULES = {"np", "numpy", "onp"}
+TENSOR_BUILDER_ATTRS = {
+    "array",
+    "asarray",
+    "stack",
+    "concatenate",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "frombuffer",
+    "fromiter",
+}
+
+FuncNode = "ast.FunctionDef | ast.AsyncFunctionDef"
+
+
+def _func_defs(tree: ast.AST) -> list[tuple[ast.AST, tuple[str, ...]]]:
+    """All function defs with their dotted scope path (classes included)."""
+    out: list[tuple[ast.AST, tuple[str, ...]]] = []
+
+    def walk(node: ast.AST, scope: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, scope + (child.name,)))
+                walk(child, scope + (child.name,))
+            elif isinstance(child, ast.ClassDef):
+                walk(child, scope + (child.name,))
+            else:
+                walk(child, scope)
+
+    walk(tree, ())
+    return out
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Name) and dec.id in JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Attribute) and dec.attr in JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(static_argnums=…) and @partial(jax.jit, …)
+        if _is_jit_decorator(dec.func):
+            return True
+        fn = dec.func
+        if (isinstance(fn, ast.Name) and fn.id == "partial") or (
+            isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        ):
+            return any(_is_jit_decorator(a) for a in dec.args)
+    return False
+
+
+def _call_target_attr(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _is_static_flag_param(arg: ast.arg, default: Optional[ast.expr]) -> bool:
+    """bool-annotated or bool/None-defaulted parameters are the static-flag
+    idiom — excluded from taint."""
+    ann = arg.annotation
+    if isinstance(ann, ast.Name) and ann.id == "bool":
+        return True
+    if isinstance(ann, ast.Constant) and ann.value == "bool":
+        return True
+    if isinstance(default, ast.Constant) and (
+        default.value is None or isinstance(default.value, bool)
+    ):
+        return True
+    return False
+
+
+class _ModuleTraceIndex:
+    """Which functions in one module execute under trace."""
+
+    def __init__(self, tree: ast.AST):
+        self.defs = _func_defs(tree)
+        self.by_node: dict[ast.AST, tuple[str, ...]] = {
+            node: path for node, path in self.defs
+        }
+        self.by_name: dict[str, list[ast.AST]] = {}
+        for node, path in self.defs:
+            self.by_name.setdefault(path[-1], []).append(node)
+        self.traced: set[ast.AST] = set()
+        self._seed_roots(tree)
+        self._closure()
+
+    def _seed_roots(self, tree: ast.AST) -> None:
+        for node, _path in self.defs:
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                self.traced.add(node)
+        for call in (n for n in ast.walk(tree) if isinstance(n, ast.Call)):
+            if _call_target_attr(call) in TRACING_CONSUMERS:
+                for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                    if isinstance(arg, ast.Name):
+                        for fn in self.by_name.get(arg.id, ()):
+                            self.traced.add(fn)
+
+    def _closure(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for node in list(self.traced):
+                # nested defs run at trace time
+                for child, _ in self.defs:
+                    if child not in self.traced and self._encloses(node, child):
+                        self.traced.add(child)
+                        changed = True
+                # simple-name calls out of a traced body
+                for call in (n for n in ast.walk(node) if isinstance(n, ast.Call)):
+                    if isinstance(call.func, ast.Name):
+                        for fn in self.by_name.get(call.func.id, ()):
+                            if fn not in self.traced:
+                                self.traced.add(fn)
+                                changed = True
+
+    def _encloses(self, outer: ast.AST, inner: ast.AST) -> bool:
+        return inner is not outer and any(
+            n is inner
+            for n in ast.walk(outer)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _has_device_call(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Attribute, ast.Call)):
+            root = n
+            while isinstance(root, ast.Call):
+                root = root.func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in DEVICE_MODULES:
+                return True
+    return False
+
+
+def _tainted_params(fn) -> set[str]:
+    args = fn.args
+    pos = list(args.posonlyargs) + list(args.args)
+    defaults = list(args.defaults)
+    # right-align defaults with positional args
+    pad: list[Optional[ast.expr]] = [None] * (len(pos) - len(defaults)) + defaults
+    tainted: set[str] = set()
+    for arg, default in zip(pos, pad):
+        if arg.arg == "self":
+            continue
+        if not _is_static_flag_param(arg, default):
+            tainted.add(arg.arg)
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if not _is_static_flag_param(arg, default):
+            tainted.add(arg.arg)
+    if args.vararg:
+        tainted.add(args.vararg.arg)
+    if args.kwarg:
+        tainted.add(args.kwarg.arg)
+    return tainted
+
+
+def _assigned_names(target: ast.expr) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for el in target.elts:
+            out.extend(_assigned_names(el))
+        return out
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    return []
+
+
+class _TraceBodyChecker(ast.NodeVisitor):
+    """TS101/TS102 inside one traced function (nested defs are analyzed in
+    their own right and skipped here)."""
+
+    def __init__(self, fn, qual: str, rel: str, findings: list[Finding]):
+        self.fn = fn
+        self.qual = qual
+        self.rel = rel
+        self.findings = findings
+        self.tainted = _tainted_params(fn)
+        # dataflow fixpoint: two forward passes over the body cover the
+        # loop-carried case (a name tainted later in a loop body)
+        for _ in range(2):
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    value = stmt.value
+                    if value is None:
+                        continue
+                    targets = (
+                        stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                    )
+                    if self._expr_tainted(value):
+                        for t in targets:
+                            self.tainted.update(_assigned_names(t))
+                elif isinstance(stmt, ast.For):
+                    if self._expr_tainted(stmt.iter):
+                        self.tainted.update(_assigned_names(stmt.target))
+
+    def _expr_tainted(self, expr: ast.expr) -> bool:
+        return bool(_names_in(expr) & self.tainted) or _has_device_call(expr)
+
+    def _emit(self, code: str, node: ast.AST, symbol_tail: str, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                code=code,
+                path=self.rel,
+                line=node.lineno,
+                symbol=f"{self.qual}.{symbol_tail}",
+                message=msg,
+            )
+        )
+
+    # nested functions get their own checker
+    def visit_FunctionDef(self, node) -> None:
+        if node is not self.fn:
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in HOST_CAST_CALLS:
+            if any(self._expr_tainted(a) for a in node.args):
+                self._emit(
+                    "TS101",
+                    node,
+                    fn.id,
+                    f"host escape: `{fn.id}()` on a traced value concretizes at "
+                    f"trace time (bakes a constant into the compiled program)",
+                )
+        elif isinstance(fn, ast.Attribute):
+            if fn.attr in HOST_ATTR_CALLS and self._expr_tainted(fn.value):
+                self._emit(
+                    "TS101",
+                    node,
+                    fn.attr,
+                    f"host escape: `.{fn.attr}()` on a traced value forces a "
+                    f"device→host sync inside a traced body",
+                )
+            else:
+                root = fn
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in NP_MODULES:
+                    self._emit(
+                        "TS101",
+                        node,
+                        f"{root.id}.{fn.attr}",
+                        f"host escape: `{root.id}.{fn.attr}()` call inside a traced "
+                        f"body runs on host at trace time, not on the device",
+                    )
+        self.generic_visit(node)
+
+    def _check_branch(self, node, kind: str) -> None:
+        test = node.test
+        if _is_identity_only(test):
+            return
+        hit = _names_in(test) & self.tainted
+        if hit:
+            self._emit(
+                "TS102",
+                node,
+                f"{kind}.{'.'.join(sorted(hit))}",
+                f"Python `{kind}` on traced value(s) {sorted(hit)}: use "
+                f"`jnp.where`/`lax.cond` (host branching concretizes the tracer)",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+
+def _is_identity_only(test: ast.expr) -> bool:
+    """`x is None` / `x is not None` never concretizes a tracer."""
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+def _set_typed_names(fn) -> dict[str, int]:
+    """Local names assigned a set display/comprehension/`set()` call."""
+    out: dict[str, int] = {}
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            if stmt.value is None:
+                continue
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            if _is_set_expr(stmt.value):
+                for t in targets:
+                    for name in _assigned_names(t):
+                        out[name] = stmt.lineno
+            else:
+                for t in targets:
+                    for name in _assigned_names(t):
+                        out.pop(name, None)
+    return out
+
+
+def _is_set_expr(expr: ast.expr) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return True
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        return _is_set_expr(expr.left) or _is_set_expr(expr.right)
+    return False
+
+
+def _check_set_iteration(fn, qual: str, rel: str, findings: list[Finding]) -> None:
+    has_builder = any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr in TENSOR_BUILDER_ATTRS
+        and isinstance(n.func.value, ast.Name)
+        and n.func.value.id in (NP_MODULES | {"jnp"})
+        for n in ast.walk(fn)
+    )
+    if not has_builder:
+        return
+    set_names = _set_typed_names(fn)
+
+    def iter_expr_is_set(it: ast.expr) -> bool:
+        if _is_set_expr(it):
+            return True
+        return isinstance(it, ast.Name) and it.id in set_names
+
+    loops: list[tuple[ast.AST, ast.expr]] = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.For):
+            loops.append((n, n.iter))
+        elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in n.generators:
+                loops.append((n, gen.iter))
+    for node, it in loops:
+        if iter_expr_is_set(it):
+            findings.append(
+                Finding(
+                    code="TS103",
+                    path=rel,
+                    line=node.lineno,
+                    symbol=f"{qual}.set-iter",
+                    message=(
+                        "iteration over a set in a tensor-building function: set "
+                        "order is nondeterministic across processes — sort "
+                        "(`sorted(...)`) before it can reach array contents"
+                    ),
+                )
+            )
+
+
+def run(root: str, paths: Optional[list[str]] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for abs_path, rel in iter_py_files(root, paths or DEFAULT_PATHS):
+        with open(abs_path, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    code="TS100",
+                    path=rel,
+                    line=e.lineno or 1,
+                    symbol="syntax",
+                    message=f"unparseable file: {e.msg}",
+                )
+            )
+            continue
+        index = _ModuleTraceIndex(tree)
+        for fn, path in index.defs:
+            qual = ".".join(path)
+            if fn in index.traced:
+                checker = _TraceBodyChecker(fn, qual, rel, findings)
+                for stmt in fn.body:
+                    checker.visit(stmt)
+            _check_set_iteration(fn, qual, rel, findings)
+    # one symbol can only anchor one finding per line (dedupe repeated walks)
+    seen: set[tuple] = set()
+    out: list[Finding] = []
+    for f in findings:
+        k = (f.code, f.path, f.line, f.symbol)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

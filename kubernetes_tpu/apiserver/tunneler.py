@@ -54,7 +54,14 @@ class NodeTunnelAgent:
         self.port = self._srv.getsockname()[1]
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
+        # counters bumped from the accept loop AND per-connection threads;
+        # unguarded += loses updates under concurrent dials (RL303)
+        self._stats_mu = threading.Lock()
         self.stats = {"accepted": 0, "relayed": 0, "rejected": 0}
+
+    def _bump(self, key: str) -> None:
+        with self._stats_mu:
+            self.stats[key] += 1
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -85,7 +92,7 @@ class NodeTunnelAgent:
                 conn, _ = self._srv.accept()
             except OSError:
                 return  # listener closed
-            self.stats["accepted"] += 1
+            self._bump("accepted")
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -104,7 +111,7 @@ class NodeTunnelAgent:
             line = self._read_line(conn)
             if not (line.startswith("TUNNEL ")
                     and hmac.compare_digest(line[len("TUNNEL "):], self._token)):
-                self.stats["rejected"] += 1
+                self._bump("rejected")
                 conn.close()
                 return
             conn.sendall(b"OK\n")
@@ -114,7 +121,7 @@ class NodeTunnelAgent:
         except OSError:
             conn.close()
             return
-        self.stats["relayed"] += 1
+        self._bump("relayed")
         # real byte splicing, one thread per direction (the tunnel IS the
         # transport — HTTP, chunked streams, anything rides it verbatim)
         t = threading.Thread(target=self._pump, args=(conn, upstream),
@@ -196,7 +203,8 @@ class Tunneler:
             addr = self._agents.get(node_name)
         if addr is None:
             raise OSError(f"no tunnel agent registered for node {node_name!r}")
-        self.stats["dials"] += 1
+        with self._mu:
+            self.stats["dials"] += 1
         try:
             sock = socket.create_connection(addr, timeout=timeout)
             sock.sendall(f"TUNNEL {tunnel_token(node_name, self._key)}\n".encode())
@@ -215,8 +223,8 @@ class Tunneler:
                 self._health[node_name] = (self._clock(), True)
             return sock
         except OSError:
-            self.stats["dial_failures"] += 1
             with self._mu:
+                self.stats["dial_failures"] += 1
                 self._health[node_name] = (self._clock(), False)
             raise
 
@@ -247,7 +255,8 @@ class Tunneler:
         """HTTP over the tunnel: (status, body, content-type)."""
         sock = self.dial(node_name, timeout=timeout)
         sock.settimeout(timeout)
-        self.stats["requests"] += 1
+        with self._mu:
+            self.stats["requests"] += 1
         conn = _TunnelHTTPConnection(sock)
         try:
             conn.request(method, path, body=body, headers=headers or {})

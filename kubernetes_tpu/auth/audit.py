@@ -129,6 +129,9 @@ class WebhookBackend(Backend):
         self.flush_interval = flush_interval
         self.timeout = timeout
         self._q: "queue.Queue[AuditEvent]" = queue.Queue(maxsize=max_buffer)
+        # `dropped` is bumped from request threads (process) AND the flush
+        # thread (_post); += is a lost-update race without this (RL301)
+        self._drop_mu = threading.Lock()
         self.dropped = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -138,7 +141,8 @@ class WebhookBackend(Backend):
         try:
             self._q.put_nowait(event)
         except Exception:  # queue full: shed, never block the request
-            self.dropped += 1
+            with self._drop_mu:
+                self.dropped += 1
 
     def _loop(self) -> None:
         import queue as _queue
@@ -174,7 +178,8 @@ class WebhookBackend(Backend):
         try:
             urllib.request.urlopen(req, timeout=self.timeout).read()
         except Exception:  # noqa: BLE001 - a dead collector loses batches
-            self.dropped += len(batch)
+            with self._drop_mu:
+                self.dropped += len(batch)
 
     def stop(self, drain_timeout: float = 2.0) -> None:
         import time as _t

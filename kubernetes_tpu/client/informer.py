@@ -209,9 +209,13 @@ class InformerFactory:
 
 
 class PodNodeIndex:
-    """By-node pod index over a shared informer (fieldSelector analogue)."""
+    """By-node pod index over a shared informer (fieldSelector analogue).
+
+    Mutated on the informer's run-loop thread, read from controller worker
+    threads (``pods_on``) — both sides hold ``_mu`` (ktpu-analyze RL303)."""
 
     def __init__(self, informer: "SharedInformer"):
+        self._mu = threading.Lock()
         self._by_node: dict[str, dict[str, "api.Pod"]] = {}
         informer.add_handler(
             Handler(on_add=self._upsert, on_update=lambda old, new: self._move(old, new),
@@ -220,19 +224,27 @@ class PodNodeIndex:
 
     def _upsert(self, pod: "api.Pod") -> None:
         if pod.spec.node_name:
-            self._by_node.setdefault(pod.spec.node_name, {})[pod.meta.key] = pod
+            with self._mu:
+                self._by_node.setdefault(pod.spec.node_name, {})[pod.meta.key] = pod
 
     def _move(self, old: Optional["api.Pod"], new: "api.Pod") -> None:
-        if old is not None and old.spec.node_name and old.spec.node_name != new.spec.node_name:
-            self._by_node.get(old.spec.node_name, {}).pop(old.meta.key, None)
-        self._upsert(new)
+        # pop + insert under ONE lock hold: releasing between them leaves a
+        # window where the pod is indexed on no node and a concurrent
+        # pods_on() reader misses it entirely
+        with self._mu:
+            if old is not None and old.spec.node_name and old.spec.node_name != new.spec.node_name:
+                self._by_node.get(old.spec.node_name, {}).pop(old.meta.key, None)
+            if new.spec.node_name:
+                self._by_node.setdefault(new.spec.node_name, {})[new.meta.key] = new
 
     def _drop(self, pod: "api.Pod") -> None:
         if pod.spec.node_name:
-            self._by_node.get(pod.spec.node_name, {}).pop(pod.meta.key, None)
+            with self._mu:
+                self._by_node.get(pod.spec.node_name, {}).pop(pod.meta.key, None)
 
     def pods_on(self, node_name: str) -> list:
-        return list(self._by_node.get(node_name, {}).values())
+        with self._mu:
+            return list(self._by_node.get(node_name, {}).values())
 
 
 class PodOwnerIndex:
@@ -241,6 +253,8 @@ class PodOwnerIndex:
     O(cluster-pods) (client-go keeps the same index inside its Indexer)."""
 
     def __init__(self, informer: "SharedInformer"):
+        # informer-thread writers vs worker-thread readers (RL303)
+        self._mu = threading.Lock()
         self._by_owner: dict[str, dict[str, object]] = {}
         self._orphans: dict[str, dict[str, object]] = {}  # namespace -> key -> pod
         informer.add_handler(
@@ -252,24 +266,30 @@ class PodOwnerIndex:
         )
 
     def _slot(self, pod):
+        # caller holds _mu
         ref = pod.meta.controller_ref()
         if ref is not None:
             return self._by_owner.setdefault(ref.uid, {})
         return self._orphans.setdefault(pod.meta.namespace, {})
 
     def _upsert(self, pod) -> None:
-        self._slot(pod)[pod.meta.key] = pod
+        with self._mu:
+            self._slot(pod)[pod.meta.key] = pod
 
     def _move(self, old, new) -> None:
-        if old is not None:
-            self._slot(old).pop(old.meta.key, None)
-        self._upsert(new)
+        with self._mu:
+            if old is not None:
+                self._slot(old).pop(old.meta.key, None)
+            self._slot(new)[new.meta.key] = new
 
     def _drop(self, pod) -> None:
-        self._slot(pod).pop(pod.meta.key, None)
+        with self._mu:
+            self._slot(pod).pop(pod.meta.key, None)
 
     def owned_by(self, uid: str) -> list:
-        return list(self._by_owner.get(uid, {}).values())
+        with self._mu:
+            return list(self._by_owner.get(uid, {}).values())
 
     def orphans_in(self, namespace: str) -> list:
-        return list(self._orphans.get(namespace, {}).values())
+        with self._mu:
+            return list(self._orphans.get(namespace, {}).values())

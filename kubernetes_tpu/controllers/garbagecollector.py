@@ -66,9 +66,14 @@ class GarbageCollector(Controller):
         so late-registered kinds join the owner graph."""
         wanted = self._fixed_kinds if self._fixed_kinds is not None else list(api.KINDS)
         for kind in wanted:
-            if kind in self.kinds or kind in _EXCLUDED_KINDS:
-                continue
-            self.kinds.append(kind)
+            # membership check + append under the graph lock: this runs
+            # from informer callbacks (CRD establishment) as well as the
+            # constructing thread, and a check-then-act race would wire
+            # duplicate handlers (= duplicate graph events per object)
+            with self._graph_mu:
+                if kind in self.kinds or kind in _EXCLUDED_KINDS:
+                    continue
+                self.kinds.append(kind)
             self.informers.informer(kind).add_handler(Handler(
                 on_add=lambda obj, k=kind: self._observe(k, obj),
                 on_update=lambda old, new, k=kind: self._observe(k, new),

@@ -19,6 +19,7 @@ import hashlib
 import hmac
 import ipaddress
 import logging
+import threading
 
 from ..api import types as api
 from ..api.cluster import ConfigMap, Secret
@@ -46,7 +47,10 @@ class NodeIpamController(Controller):
         self.node_cidr_mask = node_cidr_mask
         # in-flight allocations (the reference's CidrSet): the informer
         # cache lags our own writes within a sync burst, so the
-        # controller's view of "used" must include what IT just assigned
+        # controller's view of "used" must include what IT just assigned.
+        # Guarded by _mu: sync() runs on worker threads while _release()
+        # fires on the informer thread (ktpu-analyze RL303).
+        self._mu = threading.Lock()
         self._allocated: set[str] = set()
         from ..client.informer import Handler
 
@@ -59,10 +63,13 @@ class NodeIpamController(Controller):
     def _release(self, node: api.Node) -> None:
         # node gone: its range returns to the pool (docstring contract)
         if node.spec.pod_cidr:
-            self._allocated.discard(node.spec.pod_cidr)
+            with self._mu:
+                self._allocated.discard(node.spec.pod_cidr)
 
     def _used(self) -> set[str]:
-        return self._allocated | {
+        with self._mu:
+            allocated = set(self._allocated)
+        return allocated | {
             n.spec.pod_cidr for n in self.informer("Node").list() if n.spec.pod_cidr
         }
 
@@ -84,7 +91,8 @@ class NodeIpamController(Controller):
             try:
                 got = self.clientset.nodes.guaranteed_update(key, _assign, "")
                 if got.spec.pod_cidr == cidr:  # lost races must not leak
-                    self._allocated.add(cidr)
+                    with self._mu:
+                        self._allocated.add(cidr)
             except NotFoundError:
                 pass
             return

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import heapq
 import logging
+import threading
 from typing import Callable, Optional
 
 from ..api import types as api
@@ -77,7 +78,10 @@ class NoExecuteTaintManager(Controller):
         from ..client.informer import PodNodeIndex
 
         self._pod_index = PodNodeIndex(self.informers.informer("Pod"))
-        # pod key -> (deadline, node_name); a heap mirrors the deadlines
+        # pod key -> (deadline, node_name); a heap mirrors the deadlines.
+        # Guarded by _mu: sync() runs on run_workers() threads while tick()
+        # pumps the heap from the manager loop (ktpu-analyze RL303).
+        self._mu = threading.Lock()
         self._pending: dict[str, tuple[float, str]] = {}
         self._heap: list[tuple[float, str]] = []
         self.stats = {"evicted_now": 0, "evicted_timed": 0, "cancelled": 0}
@@ -98,10 +102,11 @@ class NoExecuteTaintManager(Controller):
         taints = _no_execute_taints(node) if node is not None else []
         if not taints:
             # taint gone (or node gone): cancel every timer for this node
-            for pod_key, (_, node_name) in list(self._pending.items()):
-                if node_name == name:
-                    del self._pending[pod_key]
-                    self.stats["cancelled"] += 1
+            with self._mu:
+                for pod_key, (_, node_name) in list(self._pending.items()):
+                    if node_name == name:
+                        del self._pending[pod_key]
+                        self.stats["cancelled"] += 1
             return
         for pod in self._pod_index.pods_on(name):
             self._process(pod, taints)
@@ -109,8 +114,9 @@ class NoExecuteTaintManager(Controller):
     def _sync_pod(self, pod_key: str) -> None:
         pod = self.informer("Pod").get(pod_key)
         if pod is None or not pod.spec.node_name:
-            if self._pending.pop(pod_key, None) is not None:
-                self.stats["cancelled"] += 1
+            with self._mu:
+                if self._pending.pop(pod_key, None) is not None:
+                    self.stats["cancelled"] += 1
             return
         node = self.informer("Node").get(pod.spec.node_name)
         taints = _no_execute_taints(node) if node is not None else []
@@ -120,19 +126,22 @@ class NoExecuteTaintManager(Controller):
         key = pod.meta.key
         wait = min_toleration_seconds(pod, taints)
         if wait is None:
-            self._pending.pop(key, None)
+            with self._mu:
+                self._pending.pop(key, None)
             self._evict(pod.meta.name, pod.meta.namespace, timed=False)
             return
         if wait == float("inf"):
-            if self._pending.pop(key, None) is not None:
-                self.stats["cancelled"] += 1
+            with self._mu:
+                if self._pending.pop(key, None) is not None:
+                    self.stats["cancelled"] += 1
             return
         deadline = self.clock() + wait
-        cur = self._pending.get(key)
-        if cur is not None and cur[1] == pod.spec.node_name:
-            return  # timer already armed from first observation; keep it
-        self._pending[key] = (deadline, pod.spec.node_name)
-        heapq.heappush(self._heap, (deadline, key))
+        with self._mu:
+            cur = self._pending.get(key)
+            if cur is not None and cur[1] == pod.spec.node_name:
+                return  # timer already armed from first observation; keep it
+            self._pending[key] = (deadline, pod.spec.node_name)
+            heapq.heappush(self._heap, (deadline, key))
 
     # -- the timer pump ----------------------------------------------------
     def tick(self) -> int:
@@ -141,24 +150,41 @@ class NoExecuteTaintManager(Controller):
         while self.sync_once():
             pass
         now = self.clock()
+        # drain due keys under the lock, evict outside it (the delete is an
+        # API round-trip; holding _mu across it would stall sync workers)
+        due: list[tuple[str, str]] = []  # (pod key, node name)
+        with self._mu:
+            while self._heap and self._heap[0][0] <= now:
+                deadline, key = heapq.heappop(self._heap)
+                cur = self._pending.get(key)
+                if cur is None or cur[0] != deadline:
+                    continue  # cancelled or re-armed
+                del self._pending[key]
+                due.append((key, cur[1]))
         fired = 0
-        while self._heap and self._heap[0][0] <= now:
-            deadline, key = heapq.heappop(self._heap)
-            cur = self._pending.get(key)
-            if cur is None or cur[0] != deadline:
-                continue  # cancelled or re-armed
-            del self._pending[key]
+        for key, node_name in due:
             ns, _, name = key.partition("/")
-            self._evict(name, ns, timed=True)
-            fired += 1
+            try:
+                self._evict(name, ns, timed=True)
+            except Exception:  # noqa: BLE001 - transient API failure
+                # re-arm as already-due so the NEXT tick retries; without
+                # this a failed delete mid-batch would silently drop every
+                # drained timer (they are gone from _pending and _heap)
+                with self._mu:
+                    self._pending[key] = (now, node_name)
+                    heapq.heappush(self._heap, (now, key))
+            else:
+                fired += 1
         return fired
 
     def _evict(self, name: str, namespace: str, timed: bool) -> None:
         try:
             self.clientset.pods.delete(name, namespace)
-            self.stats["evicted_timed" if timed else "evicted_now"] += 1
+            with self._mu:
+                self.stats["evicted_timed" if timed else "evicted_now"] += 1
         except NotFoundError:
             pass
 
     def pending_count(self) -> int:
-        return len(self._pending)
+        with self._mu:
+            return len(self._pending)

@@ -662,6 +662,12 @@ class Tensorizer:
         # north scale ~512 signatures × 5k nodes collapses from 2.5M
         # Python iterations per segment to a handful of [N] sweeps —
         # the dominant host cost of build_static (r4 profile)
+        # kernel: implements CheckNodeSchedulable, CheckNodeCondition,
+        # kernel: implements PodToleratesNodeTaints, CheckNodeMemoryPressure
+        # kernel: implements CheckNodeDiskPressure
+        # (node-static predicate verdicts folded into the [G, N] mask the
+        # device step ANDs in — the host/selector half of GeneralPredicates
+        # lands here too; ktpu-analyze parity pass reads these markers)
         static_ok = np.zeros((G, n_pad), dtype=bool)
         node_aff_raw = np.zeros((G, n_pad), dtype=np.int32)
         taint_intol_raw = np.zeros((G, n_pad), dtype=np.int32)
@@ -975,6 +981,7 @@ class Tensorizer:
         # per (signature, node) — PVC↔PV bindings do not change mid-batch —
         # so they fold into static_ok (oracle: no_volume_zone_conflict /
         # no_volume_node_conflict, predicates.go:402,1323)
+        # kernel: implements NoVolumeZoneConflict, NoVolumeNodeConflict
         for g, rep in enumerate(reps):
             pvc_vols = [v for v in rep.spec.volumes if v.pvc_name]
             if not pvc_vols:

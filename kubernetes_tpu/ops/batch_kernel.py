@@ -221,6 +221,9 @@ def make_step(
         g_ports = dev.g_ports[gid]  # [Pv]
 
         # -- feasibility (filters) ------------------------------------
+        # kernel: implements GeneralPredicates
+        # (resources/pod-count/ports live here; the host/selector parts and
+        # the node-condition predicates ride static_ok — models/snapshot.py)
         fit = jnp.all(
             jnp.where(g_req > 0, state.requested + g_req <= dev.node_alloc, True), axis=1
         )
@@ -230,6 +233,7 @@ def make_step(
         feasible = dev.static_ok[gid] & fit & pods_ok & ports_ok & dev.node_exists
 
         if use_terms:
+            # kernel: implements MatchInterPodAffinity
             # inter-pod affinity vs ALREADY-PLACED batch pods (the static_ok
             # mask covers existing pods; these domain counters cover the scan
             # carry — the batch generalization of the oracle's work_map feedback)
@@ -250,7 +254,8 @@ def make_step(
             feasible = feasible & ~sym_anti_bad & ~own_ra_bad & ~own_raa_bad
 
         if use_vols:
-            # volumes: NoDiskConflict + MaxVolumeCount against placed state.
+            # kernel: implements NoDiskConflict, MaxVolumeCount
+            # volumes checked against placed state.
             # Only the pod's own <= W slots are touched: gather their [W, N]
             # occupancy rows instead of sweeping the whole [V, N] state.
             rows_any = state.vol_any[vol_ids]  # [W, N]

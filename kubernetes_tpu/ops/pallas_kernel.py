@@ -406,7 +406,7 @@ def _pallas_runner(
             mem_cap = alloc_t[pl.ds(1, 1), :]
             total = score_raw[pl.ds(gid, 1), :]
 
-            def usage(requested, capacity, most):
+            def usage(requested, capacity, most: bool):
                 safe_cap = jnp.maximum(capacity, 1)
                 if most:
                     raw = (requested * MAX_PRIORITY) // safe_cap

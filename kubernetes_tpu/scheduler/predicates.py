@@ -547,7 +547,12 @@ def make_check_node_label_presence(labels: list, presence: bool) -> PredicateFn:
     """``CheckNodeLabelPresence`` factory (predicates.go:737): with
     presence=True every listed label must EXIST on the node; with
     presence=False none may (value-agnostic — used to steer off/onto
-    labeled pools)."""
+    labeled pools).
+
+    No kernel mask: policy-file-only predicate, and any config whose
+    predicate set differs from DEFAULT_PREDICATES already takes the
+    all-oracle path (``ops/backend._config_supported``)."""
+    # kernel: host-fallback — policy-only; non-default predicate configs run all-oracle (backend._config_supported)
 
     def check_node_label_presence(pod, meta, info: NodeInfo, ctx):
         node_labels = info.node.meta.labels if info.node else {}
@@ -564,7 +569,13 @@ def make_check_service_affinity(labels: list) -> PredicateFn:
     """``CheckServiceAffinity`` factory (predicates.go:821): pods of one
     Service co-locate on nodes sharing the same VALUES for the given
     label set — the first scheduled pod of a service pins those values
-    (e.g. all of service S in one region)."""
+    (e.g. all of service S in one region).
+
+    No kernel mask: the pinned values depend on which pod of the service
+    lands first, a cross-pod dynamic the batch tensorizer does not model;
+    non-default predicate configs run all-oracle anyway
+    (``ops/backend._config_supported``)."""
+    # kernel: host-fallback — first-pod-pins-values dynamic not tensorized; non-default configs run all-oracle
 
     def _pinned_values(pod, ctx) -> dict:
         """Node-independent: the label values this pod must match —

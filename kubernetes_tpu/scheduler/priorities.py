@@ -444,8 +444,13 @@ class ServiceSpreadingPriority(SelectorSpreadPriority):
     """Registered non-default priority (``defaults.go``
     ServiceSpreadingPriority): SelectorSpread restricted to SERVICE
     selectors only — the pre-SelectorSpread spreading behavior kept for
-    compatibility."""
+    compatibility.
 
+    No kernel weight: not in ``ops/backend._PRIORITY_WEIGHT_KEY``, so any
+    config using it schedules through the oracle path (its spread_inc
+    semantics differ from SelectorSpread's, which IS kernel-mapped)."""
+
+    # kernel: host-fallback — compat-only priority; configs using it take the all-oracle path (no _PRIORITY_WEIGHT_KEY entry)
     name = "ServiceSpreadingPriority"
 
     def _selectors_for_pod(self, pod: api.Pod, ctx: PriorityContext):

@@ -1,0 +1,9 @@
+"""Seeded parity-coverage violations (kernel side).  Never imported."""
+
+
+def fixture_step(state, xs):
+    # kernel: implements CheckAlpha, MappedPriority
+    # kernel: implements CheckStale
+    # PC203: the marker below names an entity the oracle never registered
+    # kernel: implements CheckRenamedAway
+    return state, xs

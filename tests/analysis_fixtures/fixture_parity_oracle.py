@@ -1,0 +1,55 @@
+"""Seeded parity-coverage violations (oracle side).  Never imported.
+
+The registry mirrors scheduler/predicates.py's shape: a *_PREDICATES dict
+plus make_* factories plus priority classes carrying `name`.  The kernel
+half lives in fixture_parity_kernel.py.
+"""
+
+
+def check_alpha(pod, meta, info, ctx):
+    return True, []
+
+
+def check_beta(pod, meta, info, ctx):
+    return True, []
+
+
+def check_gamma(pod, meta, info, ctx):
+    """Host-only by design."""
+    return True, []
+
+
+def check_unjustified(pod, meta, info, ctx):
+    return True, []
+
+
+FIXTURE_PREDICATES = {
+    "CheckAlpha": check_alpha,  # implemented by the kernel fixture
+    "CheckBeta": check_beta,  # PC201: neither implemented nor marked
+    "CheckGamma": check_gamma,  # kernel: host-fallback — needs per-pod host state the tensorizer has no axis for
+    "CheckUnjustified": check_unjustified,  # kernel: host-fallback —
+    "CheckStale": check_alpha,  # kernel: host-fallback — stale: the kernel now implements this
+}
+
+
+def make_fixture_factory(labels):
+    # PC201: registered factory with no marker of either kind
+    def fixture_factory(pod, meta, info, ctx):
+        return True, []
+
+    return fixture_factory
+
+
+class MappedPriority:
+    name = "MappedPriority"
+
+    def compute_all(self, pod, infos, ctx):
+        return [0] * len(infos)
+
+
+class UnmappedPriority:
+    # PC202: no implements marker, no host-fallback marker
+    name = "UnmappedPriority"
+
+    def compute_all(self, pod, infos, ctx):
+        return [0] * len(infos)

@@ -1,0 +1,92 @@
+"""Seeded race-lint violations.  Never imported.
+
+One class per finding shape: unlocked scalar write (RL301), container
+mutation (RL303), lock-order cycle (RL302), plus clean classes asserting
+the exemptions (lock-guarded writes, per-connection HTTP handlers,
+__init__ writes before the thread starts).
+"""
+
+import heapq
+import threading
+from http.server import BaseHTTPRequestHandler
+
+
+class UnlockedCounter:
+    def __init__(self):
+        self.count = 0  # written before the thread exists: not a finding
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self._bump()
+
+    def _bump(self):
+        # RL301: worker-reachable scalar write, no lock held
+        self.count = self.count + 1
+
+
+class UnlockedContainers:
+    def __init__(self):
+        self._pending = {}
+        self._heap = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        # RL303 ×3: subscript write, heappush, del — all unlocked
+        self._pending["k"] = 1
+        heapq.heappush(self._heap, (0.0, "k"))
+        del self._pending["k"]
+
+
+class LockOrderCycle:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.value = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.value += 1
+
+    def backward(self):
+        # RL302: b-then-a inverts forward()'s a-then-b
+        with self._b:
+            with self._a:
+                self.value -= 1
+
+
+class GuardedCounter:
+    """NOT flagged: every cross-thread write holds the object's lock."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.count = 0
+        self._pending = {}
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._mu:
+            self.count += 1
+            self._pending["k"] = 1
+
+
+class PerRequestHandler(BaseHTTPRequestHandler):
+    """NOT flagged: one handler instance per connection — self is
+    thread-confined even though do_GET runs on a server thread."""
+
+    def do_GET(self):
+        self._cached = self.path
+        self.code = 200
+
+
+class HandlerCallbacks:
+    def __init__(self, informers):
+        self._index = {}
+        from kubernetes_tpu.client.informer import Handler
+
+        informers.add_handler(Handler(on_add=self._on_add))
+
+    def _on_add(self, obj):
+        # RL303: informer-thread callback mutating an unlocked container
+        self._index[obj.key] = obj

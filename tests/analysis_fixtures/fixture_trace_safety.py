@@ -1,0 +1,80 @@
+"""Seeded trace-safety violations for the analyzer's own tests.
+
+NEVER imported — the analyzer parses it as text.  Each violation below is
+asserted by exact code and symbol in tests/test_static_analysis.py; the
+clean functions assert the analyzer's exemptions (static bool flags,
+`is None` tests, sorted() iteration) hold.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_host_escape(x):
+    # TS101: float() concretizes the tracer (line anchors: float call)
+    scale = float(x[0])
+    return x * scale
+
+
+@jax.jit
+def bad_item_escape(x):
+    # TS101: .item() forces a device->host sync inside the traced body
+    n = x.sum().item()
+    return x + n
+
+
+@jax.jit
+def bad_np_call(x):
+    # TS101: host numpy inside a jitted body runs at trace time only
+    mask = np.argsort(x)
+    return x[mask]
+
+
+@jax.jit
+def bad_branch(x):
+    total = jnp.sum(x)
+    # TS102: Python branch on a traced value
+    if total > 0:
+        return x
+    return -x
+
+
+def bad_loop_body(state, xs):
+    # traced via the lax.scan consumer below, not via a decorator
+    if state:  # TS102 again, through consumer-seeded tracing
+        state = state + xs
+    return state, xs
+
+
+def drive(xs):
+    return jax.lax.scan(bad_loop_body, jnp.zeros(()), xs)
+
+
+def bad_set_feed(keys):
+    # TS103: set iteration order reaches tensor contents
+    ids = {k for k in keys}
+    return np.array([hash(k) for k in ids])
+
+
+@jax.jit
+def clean_static_flag(x, most: bool):
+    # NOT flagged: bool-annotated parameter is the static-flag idiom
+    if most:
+        return x * 2
+    return x
+
+
+@jax.jit
+def clean_is_none(x, aux=None):
+    # NOT flagged: identity tests never concretize a tracer
+    if aux is None:
+        return x
+    return x + aux
+
+
+def clean_sorted_feed(keys):
+    # NOT flagged: sorted() restores determinism before the array builder
+    ids = {k for k in keys}
+    return np.array([hash(k) for k in sorted(ids)])
