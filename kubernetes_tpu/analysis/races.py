@@ -63,6 +63,19 @@ DEFAULT_PATHS = [
     "kubernetes_tpu/dns",
     "kubernetes_tpu/proxy",
     "kubernetes_tpu/store",
+    # ISSUE 2 scope extension (ROADMAP open item): the federation/cloud/
+    # admission layers, the CLI, and the daemon supervisor run informer
+    # callbacks and timer loops too — triaged clean on extension (these
+    # trees are almost thread-free; daemon.py's single Thread only
+    # supervises subprocesses it owns)
+    "kubernetes_tpu/federation",
+    "kubernetes_tpu/cloud",
+    "kubernetes_tpu/admission",
+    "kubernetes_tpu/cli",
+    "kubernetes_tpu/daemon.py",
+    # the fault framework itself: armed/disarmed from test threads while
+    # hit() runs on any thread — keep it under the race lint
+    "kubernetes_tpu/faults",
 ]
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}

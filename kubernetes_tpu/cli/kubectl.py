@@ -248,6 +248,16 @@ class Kubectl:
                 ev = watch.get(timeout=min(0.5, max(0.0, deadline - _time.monotonic())))
                 if ev is None:
                     continue
+                from ..store.store import WATCH_GAP
+
+                if ev.type == WATCH_GAP:
+                    # the stream lost continuity (410 on resume): relist
+                    # like the reflector does — reprint the table at the
+                    # fresh revision and watch on from there
+                    watch.stop()
+                    rev = self._print_table(kind, client, ns_scope, want)
+                    watch = self.cs.store.watch(kind, from_revision=rev)
+                    continue
                 obj = api.from_dict(ev.object) if isinstance(ev.object, dict) else ev.object
                 # the stream scopes like the table: one namespace (unless
                 # the kind is cluster-scoped, where ns is always "")

@@ -22,12 +22,24 @@ divergence — the reference's ``KUBE_CACHE_MUTATION_DETECTOR``
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Optional
 
+from .. import faults
 from ..api import types as api
-from ..store.store import ADDED, DELETED, MODIFIED, ExpiredRevisionError, WatchEvent
+from ..store.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    WATCH_GAP,
+    ExpiredRevisionError,
+    WatchEvent,
+)
+from ..utils.metrics import DEFAULT_CLIENT_METRICS, ClientMetrics
 from .clientset import TypedClient
+
+logger = logging.getLogger("kubernetes_tpu.client.informer")
 
 
 class Handler:
@@ -43,7 +55,8 @@ class Handler:
 
 
 class SharedInformer:
-    def __init__(self, client: TypedClient, mutation_detector: bool = False):
+    def __init__(self, client: TypedClient, mutation_detector: bool = False,
+                 metrics: Optional[ClientMetrics] = None):
         self._client = client
         self.kind = client.kind
         self._handlers: list[Handler] = []
@@ -56,6 +69,17 @@ class SharedInformer:
         self._mutation_detector = mutation_detector
         self._snapshots: dict[str, dict] = {}
         self.last_revision = 0
+        self.metrics = metrics or DEFAULT_CLIENT_METRICS
+        # per-instance recovery audit trail (the fault matrix reads this)
+        self.stats = {"relists": 0, "dropped_events": 0, "handler_errors": 0,
+                      "relist_failures": 0}
+        # serializes relist(): a resync timer tick racing a GAP
+        # escalation must not build two watches and leak the loser
+        self._relist_mu = threading.Lock()
+        # set when a relist attempt failed (apiserver briefly down):
+        # pump()/_run_loop retry on their next turn instead of leaving
+        # the informer wedged on a dead watch serving a frozen cache
+        self._gap_pending = False
 
     # -- registration ------------------------------------------------------
     def add_handler(self, handler: Handler) -> None:
@@ -116,10 +140,22 @@ class SharedInformer:
 
     def _run_loop(self) -> None:
         while not self._stopped.is_set():
+            if self._gap_pending:
+                self._try_relist()  # the 0.2s get below paces retries
             ev = self._watch.get(timeout=0.2)
             if ev is None:
                 continue
-            self._apply(ev)
+            try:
+                self._apply(ev)
+            except CacheMutationError:
+                raise  # the detector's whole point is to panic
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                # the watch loop is the informer's heartbeat: one bad
+                # delta (or injected delivery failure) must not end it
+                logger.exception("informer %s: failed to apply %s %s",
+                                 self.kind, ev.type, ev.key)
 
     def pump(self, max_events: Optional[int] = None) -> int:
         """Synchronously apply all (or up to max_events) pending events.
@@ -130,6 +166,8 @@ class SharedInformer:
             return 0
         if self._watch is None:
             self._seed()
+        if self._gap_pending:
+            self._try_relist()  # one retry per pump: bounded, caller-paced
         n = 0
         while max_events is None or n < max_events:
             ev = self._watch.get(timeout=0)
@@ -139,8 +177,120 @@ class SharedInformer:
             n += 1
         return n
 
+    # -- relist (reflector 410 fallback + resync) --------------------------
+    def relist(self) -> None:
+        """Full LIST → cache diff → watch restart (``reflector.go``'s
+        "too old resource version" fallback, doubling as the resync
+        period).  Handlers see the diff as ordinary add/update/delete
+        callbacks — exactly what they'd have seen had the lost deltas
+        been delivered — so a cache gap of any size reconverges in one
+        call.  Safe to call periodically: an in-sync informer diffs to
+        nothing and only pays the LIST.
+
+        Crash-safe ordering: the new LIST + watch are built BEFORE the
+        old watch is touched, so a failure here (apiserver briefly down)
+        leaves the informer exactly as it was — and ``_gap_pending``
+        makes pump()/the watch loop retry, never wedging on a dead
+        stream.  ``_relist_mu`` serializes concurrent callers (resync
+        timer vs GAP escalation): the loser waits and then relists
+        against the fresh state instead of leaking a live watch."""
+        with self._relist_mu:
+            attempts = 0
+            while True:
+                objs, rev = self._client.list()
+                try:
+                    new_watch = self._client.watch(from_revision=rev)
+                    break
+                except ExpiredRevisionError:
+                    # the window slid past rev between LIST and WATCH —
+                    # possible only under extreme write pressure; relist
+                    attempts += 1
+                    if attempts >= 5:
+                        raise
+            new_cache = {o.meta.key: o for o in objs}
+            with self._mu:
+                old_watch = self._watch
+                old_cache = self._cache
+                self._cache = new_cache
+                if self._mutation_detector:
+                    self._snapshots = {o.meta.key: o.to_dict() for o in objs}
+                self.last_revision = max(self.last_revision, rev)
+                self._watch = new_watch
+                handlers = list(self._handlers)
+                self.stats["relists"] += 1
+                self._gap_pending = False
+            if old_watch is not None:
+                # events the old stream delivered after our LIST are at
+                # revisions the new watch replays too — dropping its
+                # queue loses nothing
+                old_watch.stop()
+        self.metrics.informer_relists.inc()
+        for key, obj in new_cache.items():
+            old = old_cache.get(key)
+            if old is None:
+                for h in handlers:
+                    self._deliver(h.on_add, obj)
+            elif getattr(old.meta, "resource_version", None) != getattr(
+                    obj.meta, "resource_version", None):
+                for h in handlers:
+                    self._deliver(h.on_update, old, obj)
+        for key, old in old_cache.items():
+            if key not in new_cache:
+                for h in handlers:
+                    self._deliver(h.on_delete, old)
+
+    # alias: the reference's resyncPeriod is this same relist, on a timer
+    resync = relist
+
+    def _try_relist(self) -> bool:
+        """Relist, absorbing failure into ``_gap_pending`` so the next
+        pump()/loop turn retries — a relist that fails because the
+        apiserver is briefly unreachable must degrade to 'stale until it
+        returns', never to 'wedged forever'."""
+        try:
+            self.relist()
+            return True
+        except Exception:
+            with self._mu:
+                self._gap_pending = True
+                self.stats["relist_failures"] += 1
+            logger.exception(
+                "informer %s: relist failed — will retry", self.kind)
+            return False
+
+    def _deliver(self, fn, *args) -> None:
+        """One handler callback, isolated: a panicking handler is counted
+        and logged, never allowed to wedge delivery to its peers or kill
+        the watch loop (processorListener's crash isolation)."""
+        try:
+            fn(*args)
+        except Exception:
+            with self._mu:
+                self.stats["handler_errors"] += 1
+            self.metrics.informer_handler_errors.inc()
+            logger.exception("informer %s: handler error (isolated)", self.kind)
+
     # -- delta application -------------------------------------------------
     def _apply(self, ev: WatchEvent) -> None:
+        if ev.type == WATCH_GAP:
+            # the transport admitted it lost continuity (410 on resume):
+            # no payload to apply; rebuild from a fresh LIST
+            self._try_relist()
+            return
+        if ev.revision <= self.last_revision:
+            # revision fence: a straggler from a watch that a relist
+            # already superseded (the LIST at last_revision subsumes it)
+            # must not overwrite the fresher cache
+            return
+        fault = faults.hit("informer.deliver", kind=self.kind, key=ev.key,
+                           type=ev.type)
+        if fault is not None and fault.mode == "drop":
+            # lossy delivery: the delta silently never happens — the
+            # cache diverges until the next relist/resync reconverges it
+            with self._mu:
+                self.stats["dropped_events"] += 1
+            self.metrics.informer_dropped_events.inc()
+            return
         obj = self._client._cls.from_dict(ev.object)
         with self._mu:
             old = self._cache.get(ev.key)
@@ -161,11 +311,11 @@ class SharedInformer:
             handlers = list(self._handlers)
         for h in handlers:
             if ev.type == ADDED:
-                h.on_add(obj)
+                self._deliver(h.on_add, obj)
             elif ev.type == MODIFIED:
-                h.on_update(old, obj)
+                self._deliver(h.on_update, old, obj)
             elif ev.type == DELETED:
-                h.on_delete(old if old is not None else obj)
+                self._deliver(h.on_delete, old if old is not None else obj)
 
 
 class CacheMutationError(RuntimeError):
@@ -202,6 +352,13 @@ class InformerFactory:
         # GC wiring a just-established CRD kind); the newcomer gets its
         # events on the caller's next pump round
         return sum(inf.pump() for inf in list(self._informers.values()))
+
+    def relist_all(self) -> None:
+        """Resync every synced informer (the factory-level resyncPeriod
+        tick): each one re-LISTs, diffs, and restarts its watch."""
+        for inf in list(self._informers.values()):
+            if inf.has_synced():
+                inf.relist()
 
     def stop_all(self) -> None:
         for inf in self._informers.values():

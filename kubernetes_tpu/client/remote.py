@@ -6,25 +6,51 @@ informers, controllers, schedulers, and kubelets run unchanged against a
 network apiserver (reference: ``client-go/rest`` under the generated
 clientsets).  Watches consume the chunked JSON-lines stream and reconnect
 from the last seen revision (reflector semantics, ``reflector.go:239``).
+
+Failure handling (the part ``client-go/rest`` calls request.go retry +
+``reflector.go`` relist):
+
+- every request classifies its failure **honestly**: transport errors,
+  5xx, and 429 are retryable (exponential backoff + seeded jitter, budget
+  ``max_retries``); 4xx is fatal and maps to the typed store errors;
+- a watch stream that breaks reconnects from the last seen revision with
+  its own backoff; a resume refused with **410 Gone** cannot be healed by
+  the stream itself — the watch emits a :data:`~..store.store.WATCH_GAP`
+  sentinel and terminates, and the informer above relists (reflector.go's
+  "too old resource version" → full LIST);
+- shutdown closes the half-open HTTP response so the reader thread never
+  leaks a socket past ``stop()``.
+
+Every failure path is countable (``utils.metrics.ClientMetrics``) and
+injectable (fault points ``remote.request`` / ``remote.watch.stream``) —
+the fault matrix in tests/test_faults.py drives each one deterministically.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import logging
 import queue as queue_mod
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
+from .. import faults
 from ..store.store import (
     AlreadyExistsError,
     ConflictError,
     ExpiredRevisionError,
     NotFoundError,
+    WATCH_GAP,
     WatchEvent,
     object_key,
 )
+from ..utils.metrics import ClientMetrics
+
+logger = logging.getLogger("kubernetes_tpu.client.remote")
 
 
 class RemoteError(Exception):
@@ -35,6 +61,17 @@ class ForbiddenError(RemoteError):
     """HTTP 403 — authorization or admission said no.  A distinct type so
     callers (kubectl) surface 'Error from server (Forbidden)' instead of
     crashing on a generic RemoteError."""
+
+
+class RetryExhaustedError(RemoteError):
+    """A retryable failure outlived the retry budget.  Carries the last
+    underlying error so callers can still see WHAT kept failing."""
+
+
+# HTTP statuses worth re-trying: the server never started (or refused to
+# start) the work.  Everything else in 4xx means the request itself is
+# wrong — repeating it verbatim cannot succeed and hides real bugs.
+RETRYABLE_STATUS = frozenset({429, 500, 502, 503, 504})
 
 
 def _raise_for_status(body: dict) -> None:
@@ -55,43 +92,113 @@ def _raise_for_status(body: dict) -> None:
 
 
 class RemoteWatch:
-    """Chunked-stream consumer with auto-reconnect from the last revision."""
+    """Chunked-stream consumer with auto-reconnect from the last revision.
 
-    def __init__(self, base_url: str, kind: str, from_revision: Optional[int], opener, resource: str):
+    Error classification in the read loop (``_run``):
+
+    - **410 Gone** on resume: the server compacted past our bookmark; no
+      reconnect can recover the lost deltas.  Emit ``WATCH_GAP`` and end
+      the stream — the informer relists and builds a fresh watch.
+    - **stopped**: clean shutdown; the half-open response is closed by
+      ``stop()`` so the blocking read unblocks instead of leaking.
+    - anything else (connection reset, timeout, truncated JSON line, 5xx
+      on reconnect): transient — count it, back off exponentially, and
+      reconnect from ``resourceVersion=last_seen`` (reflector.go:239).
+      The backoff resets once events flow again.
+    """
+
+    def __init__(self, base_url: str, kind: str, from_revision: Optional[int],
+                 opener, resource: str, metrics: Optional[ClientMetrics] = None,
+                 min_backoff: float = 0.05, max_backoff: float = 2.0,
+                 sleep: Callable[[float], None] = time.sleep):
         self._base = base_url
         self._resource = resource
         self._opener = opener
+        self.metrics = metrics or ClientMetrics()
+        self._min_backoff = min_backoff
+        self._max_backoff = max_backoff
+        self._sleep = sleep
         self._queue: "queue_mod.Queue[Optional[WatchEvent]]" = queue_mod.Queue()
         self._stopped = threading.Event()
         self._last_rev = from_revision
+        # the in-flight HTTP response: owned by the watch thread, closed
+        # by stop() from the caller's thread — both sides under _resp_mu
+        self._resp_mu = threading.Lock()
+        self._resp = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _open_stream(self):
+        url = f"{self._base}/api/v1/{self._resource}?watch=true&timeoutSeconds=5"
+        if self._last_rev is not None:
+            url += f"&resourceVersion={self._last_rev}"
+        faults.hit("remote.watch.stream", phase="connect",
+                   resource=self._resource)
+        return self._opener(url)
+
     def _run(self) -> None:
+        backoff = self._min_backoff
         while not self._stopped.is_set():
-            url = f"{self._base}/api/v1/{self._resource}?watch=true&timeoutSeconds=5"
-            if self._last_rev is not None:
-                url += f"&resourceVersion={self._last_rev}"
+            resp = None
             try:
-                with self._opener(url) as resp:
-                    for raw in resp:
-                        if self._stopped.is_set():
-                            return
-                        line = raw.strip()
-                        if not line:
-                            continue
-                        d = json.loads(line)
-                        ev = WatchEvent(
-                            d["type"], d["kind"], d["key"], d["revision"], d["object"]
-                        )
-                        self._last_rev = ev.revision
-                        self._queue.put(ev)
-            except Exception:
+                resp = self._open_stream()
+                with self._resp_mu:
+                    if self._stopped.is_set():
+                        resp.close()
+                        return
+                    self._resp = resp
+                for raw in resp:
+                    if self._stopped.is_set():
+                        return
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    faults.hit("remote.watch.stream", phase="event",
+                               resource=self._resource)
+                    d = json.loads(line)
+                    ev = WatchEvent(
+                        d["type"], d["kind"], d["key"], d["revision"], d["object"]
+                    )
+                    self._last_rev = ev.revision
+                    backoff = self._min_backoff  # healthy stream: reset
+                    self._queue.put(ev)
+                # clean server-side timeout (timeoutSeconds elapsed):
+                # immediate resume from the bookmark, not an error
+            except Exception as e:
                 if self._stopped.is_set():
                     return
-                import time
-
-                time.sleep(0.05)  # transient; reconnect from last revision
+                self.metrics.watch_errors.inc()
+                if isinstance(e, urllib.error.HTTPError) and e.code == 410:
+                    # resume refused: the server compacted past our
+                    # bookmark.  The stream cannot self-heal — escalate
+                    # to a relist through the informer and end.
+                    logger.warning(
+                        "watch %s: revision %s too old (410) — emitting "
+                        "gap for relist", self._resource, self._last_rev)
+                    self.metrics.watch_gaps.inc()
+                    self._queue.put(WatchEvent(
+                        WATCH_GAP, "", "", self._last_rev or 0, {}))
+                    return
+                # warn once on the transition into the broken state; the
+                # retries of an outage that persists log at debug (a dead
+                # server would otherwise emit a warning every backoff)
+                log = (logger.warning if backoff == self._min_backoff
+                       else logger.debug)
+                log("watch %s: transient %s: %s — reconnecting from "
+                    "revision %s in %.2fs", self._resource,
+                    type(e).__name__, e, self._last_rev, backoff)
+                self._sleep(backoff)
+                backoff = min(backoff * 2, self._max_backoff)
+                self.metrics.watch_reconnects.inc()
+            finally:
+                if resp is not None:
+                    with self._resp_mu:
+                        if self._resp is resp:
+                            self._resp = None
+                    try:
+                        resp.close()
+                    except Exception:
+                        pass
 
     def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         try:
@@ -108,6 +215,15 @@ class RemoteWatch:
 
     def stop(self) -> None:
         self._stopped.set()
+        # unblock the reader: close the half-open response NOW instead of
+        # leaking it until the server-side timeout fires
+        with self._resp_mu:
+            resp, self._resp = self._resp, None
+        if resp is not None:
+            try:
+                resp.close()
+            except Exception:
+                pass
         self._queue.put(None)
 
 
@@ -116,16 +232,39 @@ class RemoteStore:
 
     def __init__(self, base_url: str, token: Optional[str] = None, timeout: float = 10.0,
                  ca_file: Optional[str] = None, client_cert: Optional[str] = None,
-                 client_key: Optional[str] = None, binary: bool = False):
+                 client_key: Optional[str] = None, binary: bool = False,
+                 max_retries: int = 3, retry_backoff: float = 0.05,
+                 retry_backoff_max: float = 2.0,
+                 retry_seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 metrics: Optional[ClientMetrics] = None):
         """``ca_file`` pins the server CA for https:// servers;
         ``client_cert``/``client_key`` present an x509 client identity
         (reference kubeconfig certificate-authority / client-certificate).
         ``binary=True`` negotiates the compact binary wire form for
-        resource bodies (reference protobuf content type)."""
+        resource bodies (reference protobuf content type).
+
+        ``max_retries`` re-issues of a request after a retryable failure
+        (5xx/429 for every verb; transport errors only when the request
+        provably never ran — see ``_transport_retry_safe``), with
+        exponential backoff from ``retry_backoff`` capped at
+        ``retry_backoff_max`` and jittered per instance.  ``retry_seed``
+        defaults to fresh entropy — a shared fixed seed would march every
+        client through the SAME jitter sequence, re-synchronizing the
+        thundering herd the jitter exists to break; pass a seed only in
+        deterministic tests."""
+        import random
+
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
         self.binary = binary
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
+        self.retry_backoff_max = retry_backoff_max
+        self._retry_rng = random.Random(retry_seed)
+        self._sleep = sleep
+        self.metrics = metrics or ClientMetrics()
         self._ssl_ctx = None
         if base_url.startswith("https://"):
             import ipaddress
@@ -154,6 +293,82 @@ class RemoteStore:
             req.add_header("Authorization", f"Bearer {self.token}")
         return urllib.request.urlopen(req, timeout=self.timeout, context=self._ssl_ctx)
 
+    @staticmethod
+    def _transport_retry_safe(method: str, e: BaseException) -> bool:
+        """May this transport failure be retried without double-running
+        the request?  Idempotent verbs (GET/HEAD): always.  Everything
+        else only when the error proves the request never reached the
+        server (connection refused) — a reset/timeout mid-POST may have
+        committed server-side, and re-sending would turn one create into
+        two (surfacing as a spurious AlreadyExists/Conflict to the
+        caller).  client-go's retry gate draws the same line."""
+        if method in ("GET", "HEAD"):
+            return True
+        reason = getattr(e, "reason", e)
+        return isinstance(reason, ConnectionRefusedError)
+
+    def _retry_delay(self, attempt: int) -> float:
+        """Exponential backoff with jitter in [0.5x, 1.5x) of the nominal
+        step — deterministic per client (seeded RNG)."""
+        nominal = min(self.retry_backoff * (2 ** attempt), self.retry_backoff_max)
+        return nominal * (0.5 + self._retry_rng.random())
+
+    def _request_with_retries(self, send: Callable[[], "object"], method: str,
+                              path: str):
+        """Run ``send`` (one HTTP attempt) under the retry policy.
+
+        Returns the live response object on success.  Raises the mapped
+        typed error on a fatal classification, :class:`RetryExhaustedError`
+        when the budget runs out.  ``send`` may raise HTTPError — a
+        retryable status re-enters the loop, anything else is handed back
+        to the caller for body decoding (the Status body carries the real
+        reason: AlreadyExists vs Conflict, etc.)."""
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt > 0:
+                self._sleep(self._retry_delay(attempt - 1))
+                self.metrics.remote_retries.inc()
+            try:
+                faults.hit("remote.request", method=method, path=path,
+                           attempt=attempt)
+                return send()
+            except urllib.error.HTTPError as e:
+                if e.code in RETRYABLE_STATUS:
+                    # drain + close: keep-alive sockets with pending bodies
+                    # cannot be reused, and the retry opens a fresh one
+                    try:
+                        e.read()
+                        e.close()
+                    except Exception:
+                        pass
+                    last_err = e
+                    logger.warning("%s %s: retryable HTTP %d (attempt %d/%d)",
+                                   method, path, e.code, attempt + 1,
+                                   self.max_retries + 1)
+                    continue
+                # fatal 4xx: the caller decodes the Status body into the
+                # typed error — retrying a malformed/forbidden/conflicting
+                # request verbatim can never succeed
+                self.metrics.remote_fatal.inc()
+                raise
+            except (urllib.error.URLError, TimeoutError, ConnectionError,
+                    http.client.HTTPException, OSError) as e:
+                if not self._transport_retry_safe(method, e):
+                    # a non-idempotent request that MAY have committed:
+                    # re-sending could double-run it — surface the
+                    # transport error honestly instead
+                    self.metrics.remote_fatal.inc()
+                    raise
+                last_err = e
+                logger.warning("%s %s: transport error %s: %s (attempt %d/%d)",
+                               method, path, type(e).__name__, e, attempt + 1,
+                               self.max_retries + 1)
+                continue
+        self.metrics.remote_retry_exhausted.inc()
+        raise RetryExhaustedError(
+            f"{method} {path} failed after {self.max_retries + 1} attempts: "
+            f"{type(last_err).__name__}: {last_err}")
+
     def _call(self, method: str, path: str, body=None,
               content_type: Optional[str] = None) -> dict:
         if content_type is not None:
@@ -174,14 +389,19 @@ class RemoteStore:
         else:
             data = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"}
-        req = urllib.request.Request(
-            f"{self.base_url}{path}", data=data, method=method, headers=headers,
-        )
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+
+        def send():
+            req = urllib.request.Request(
+                f"{self.base_url}{path}", data=data, method=method,
+                headers=dict(headers),
+            )
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            return urllib.request.urlopen(req, timeout=self.timeout,
+                                          context=self._ssl_ctx)
+
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout,
-                                        context=self._ssl_ctx) as resp:
+            with self._request_with_retries(send, method, path) as resp:
                 out = self._decode(resp)
         except urllib.error.HTTPError as e:
             out = self._decode(e)
@@ -204,20 +424,25 @@ class RemoteStore:
         /healthz, subresource streams) so callers never hand-roll a
         urlopen that would drop the token or the pinned CA.  ``body`` may
         be a dict (JSON-encoded) or raw bytes (forwarded verbatim, e.g.
-        file payloads through kubectl proxy)."""
+        file payloads through kubectl proxy).  Same retry policy as the
+        resource verbs."""
         if isinstance(body, (bytes, bytearray)):
             data = bytes(body)
             headers = {}
         else:
             data = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"} if data else {}
-        req = urllib.request.Request(
-            f"{self.base_url}{path}", data=data, method=method, headers=headers)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        with urllib.request.urlopen(
-            req, timeout=timeout or self.timeout, context=self._ssl_ctx
-        ) as resp:
+
+        def send():
+            req = urllib.request.Request(
+                f"{self.base_url}{path}", data=data, method=method,
+                headers=dict(headers))
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            return urllib.request.urlopen(
+                req, timeout=timeout or self.timeout, context=self._ssl_ctx)
+
+        with self._request_with_retries(send, method, path) as resp:
             return resp.read()
 
     @staticmethod
@@ -320,4 +545,6 @@ class RemoteStore:
     def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None) -> RemoteWatch:
         if kind is None:
             raise RemoteError("remote watch requires a kind")
-        return RemoteWatch(self.base_url, kind, from_revision, self._open, self._resource(kind))
+        return RemoteWatch(self.base_url, kind, from_revision, self._open,
+                           self._resource(kind), metrics=self.metrics,
+                           sleep=self._sleep)

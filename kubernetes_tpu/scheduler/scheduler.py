@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import faults
 from ..api import types as api
 from ..client.clientset import BindConflictError, Clientset
 from ..client.informer import Handler, InformerFactory
@@ -69,6 +70,8 @@ class Scheduler:
         if backend is not None and hasattr(backend, "fallback_counter"):
             # kernel fallbacks surface in this scheduler's metrics registry
             backend.fallback_counter = self.metrics.pallas_fallback_total
+        if backend is not None and hasattr(backend, "breaker_counter"):
+            backend.breaker_counter = self.metrics.kernel_breaker_transitions
         self.emit_events = emit_events
         self.enable_preemption = enable_preemption
         self._clock = clock
@@ -183,18 +186,52 @@ class Scheduler:
         self._recorder.event(pod, etype, reason, message)
 
     # -- bind + failure handling ------------------------------------------
+    def _requeue_after_bind_failure(self, pod: api.Pod) -> None:
+        """Transient bind failures re-enqueue the pod with backoff.
+
+        Without this a pod whose bind hit a transport/store error was
+        stranded: popped from the queue, never bound, and no watch event
+        would ever re-add it.  Re-enqueues the LATEST informer version
+        (like handle_schedule_failure) and only while the pod is still
+        ours to place — a pod that meanwhile got bound or turned terminal
+        belongs to whoever did that."""
+        latest = self.informers.informer("Pod").get(pod.meta.key)
+        if latest is None:
+            return  # deleted while the bind was in flight
+        if latest.spec.node_name or not _is_scheduler_pod(latest, self.scheduler_name):
+            return  # bound by someone else, or became terminal
+        self.metrics.bind_requeues.inc()
+        self.queue.add_after(latest, self.backoff.get_backoff(pod.meta.key))
+
     def _bind(self, pod: api.Pod, node_name: str) -> bool:
         start = self._clock()
         try:
+            faults.hit("scheduler.bind", pod=pod.meta.key, node=node_name,
+                       via="bind")
             self.clientset.pods.bind(
                 api.Binding(
                     pod_namespace=pod.meta.namespace, pod_name=pod.meta.name, node_name=node_name
                 )
             )
         except (BindConflictError, NotFoundError) as e:
+            # permanent for THIS placement: the pod's fate is owned
+            # elsewhere (already bound / deleted) — the informer stream
+            # delivers the truth, nothing to retry
             logger.warning("bind failed for %s: %s", pod.meta.key, e)
+            self.metrics.bind_failures.inc()
             self.cache.forget_pod(pod)
             self._event(pod, "Warning", "FailedBinding", str(e))
+            return False
+        except Exception as e:
+            # transient (transport error, apiserver overload, injected
+            # fault): the placement decision may still be right — drop
+            # the assumption and retry the pod with backoff
+            logger.warning("transient bind failure for %s: %s: %s",
+                           pod.meta.key, type(e).__name__, e)
+            self.metrics.bind_failures.inc()
+            self.cache.forget_pod(pod)
+            self._event(pod, "Warning", "FailedBinding", str(e))
+            self._requeue_after_bind_failure(pod)
             return False
         self.metrics.binding_latency.observe((self._clock() - start) * 1e6)
         self.cache.finish_binding(pod.meta.key)
@@ -475,7 +512,16 @@ class Scheduler:
                 )
             self.cache.assume_many(to_assume)
             bind_start = self._clock()
-            errors = self.clientset.pods.bind_many([b for _, b in to_bind])
+            try:
+                errors = self.clientset.pods.bind_many([b for _, b in to_bind])
+            except Exception as e:
+                # the whole segment's commit failed before any CAS applied
+                # (store overload / transport outage / injected fault):
+                # nothing bound — every entry takes the per-item failure
+                # path below, which forgets the assumption and requeues
+                logger.warning("bind_many failed for %d pods: %s: %s",
+                               len(to_bind), type(e).__name__, e)
+                errors = [f"transient: {e}"] * len(to_bind)
             self.metrics.binding_latency.observe((self._clock() - bind_start) * 1e6)
             finished: list[str] = []
             emit = self.emit_events
@@ -491,9 +537,15 @@ class Scheduler:
                     totals["bound"] += 1
                 else:
                     logger.warning("bind failed for %s: %s", pod.meta.key, err)
+                    self.metrics.bind_failures.inc()
                     self.cache.forget_pod(pod)
                     if emit:
                         ev_batch.append((pod, "Warning", "FailedBinding", err))
+                    # requeue-with-backoff when the pod is still ours and
+                    # unbound (transient CAS/transport failure) — decided
+                    # from the informer's latest truth, so a genuine
+                    # conflict (bound elsewhere) is NOT retried
+                    self._requeue_after_bind_failure(pod)
                     totals["failed"] += 1
             self.cache.finish_binding_many(finished)
             totals["committed"] += len(finished)

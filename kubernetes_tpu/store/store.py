@@ -32,6 +32,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
+from .. import faults
 from ..api.meta import new_uid
 
 
@@ -71,6 +72,11 @@ def object_key(namespace: str, name: str) -> str:
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+# Not a state transition: a watch transport's admission that continuity
+# was lost (410 Gone on resume — the event-log window slid past the
+# consumer's bookmark).  An informer receiving this must relist; there is
+# no object payload to apply.
+WATCH_GAP = "GAP"
 
 
 class ConflictError(Exception):
@@ -194,6 +200,9 @@ class Store:
 
     # -- writes ------------------------------------------------------------
     def create(self, kind: str, obj: dict) -> dict:
+        # fault seam BEFORE the lock and any mutation: an injected commit
+        # failure models apiserver/etcd overload — the write never starts
+        faults.hit("store.commit", op="create", kind=kind)
         with self._mu:
             meta = obj.setdefault("metadata", {})
             key = object_key(meta.get("namespace", "default"), meta.get("name", ""))
@@ -219,6 +228,7 @@ class Store:
         pass 0/None there to force-write (last-write-wins).  ``_trusted``
         marks ``obj`` as privately owned (guaranteed_update's copy), skipping
         one defensive deep copy on the hot write path."""
+        faults.hit("store.commit", op="update", kind=kind)
         with self._mu:
             meta = obj.get("metadata") or {}
             key = object_key(meta.get("namespace", "default"), meta.get("name", ""))
@@ -267,11 +277,19 @@ class Store:
         emitted (informers depend on them); their objects share the stored
         containers/status structures and own fresh spec/metadata dicts —
         the only fields this path ever mutates in place."""
+        faults.hit("store.commit", op="bind_many", kind="Pod")
         results: list[Optional[str]] = []
         with self._mu:
             bucket = self._objects.setdefault("Pod", {})
             for namespace, name, node_name in items:
                 key = object_key(namespace, name)
+                # per-item seam: ONE pod's CAS fails while the rest of
+                # the batch commits (the real-world partial-bind shape) —
+                # surfaced as this item's error string, never an exception
+                if faults.hit("scheduler.bind", pod=key, node=node_name,
+                              via="bind_many") is not None:
+                    results.append("injected: bind fault")
+                    continue
                 item = bucket.get(key)
                 if item is None:
                     results.append("not found")
@@ -314,6 +332,7 @@ class Store:
         ``metadata.finalizers`` is non-empty the object is only *marked*
         deleting (``deletionRevision`` tombstone, MODIFIED event); the actual
         removal happens when an update clears the last finalizer."""
+        faults.hit("store.commit", op="delete", kind=kind)
         with self._mu:
             key = object_key(namespace, name)
             bucket = self._objects.setdefault(kind, {})

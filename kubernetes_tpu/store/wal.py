@@ -6,14 +6,21 @@ before it is acknowledged, and periodic snapshots bound replay time
 ``third_party/forked/etcd221``).  This module gives the in-process store
 the same durability contract on one node:
 
-- every committed event appends a length-prefixed record to ``wal.bin``
-  (binary wire codec — the same serialization the HTTP layer negotiates),
+- every committed event appends a ``[len][crc32][payload]`` record to
+  ``wal.bin`` (binary wire codec — the same serialization the HTTP layer
+  negotiates),
 - ``snapshot.bin`` holds a full state image at a revision; opening a
   store replays snapshot + WAL tail,
 - compaction rewrites the snapshot and truncates the WAL once it grows
   past ``compact_every`` records,
-- a torn final record (crash mid-append) is detected by its length
-  prefix and dropped — exactly the record that was never acknowledged.
+- a torn final record (crash mid-append) is detected **structurally**
+  (short length prefix / short payload) or by a CRC mismatch on the
+  file's last record, and truncated on replay — exactly the record that
+  was never acknowledged (etcd's ``wal.ReadAll`` tail repair),
+- a CRC mismatch on a record that is *not* the tail is different in kind:
+  acknowledged history was corrupted, and recovery refuses to guess
+  (:class:`CorruptWALError`) rather than silently dropping everything
+  after it.
 
 Replication/HA remains by the reference's own split: the store process
 is the etcd analogue; stateless apiservers above it restart freely, and
@@ -25,13 +32,30 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import zlib
 from typing import Optional
 
+from .. import faults
 from ..api import wire
 
 SNAPSHOT = "snapshot.bin"
 WAL = "wal.bin"
 _LEN = struct.Struct(">I")
+_CRC = struct.Struct(">I")
+_HEADER = _LEN.size + _CRC.size
+# v2 file marker: CRC-framed records follow.  A log without it is the
+# v1 ``[len][payload]`` format and is read that way — an upgrade must
+# never misparse acknowledged history as corruption.  (No collision
+# risk: a v1 file starts with a 4-byte record length, and b"KTPU" as a
+# big-endian length would be a ~1.2 GB record.)
+_MAGIC = b"KTPUWAL2"
+
+
+class CorruptWALError(Exception):
+    """A non-tail WAL record failed its checksum: acknowledged history is
+    damaged (bad disk, truncation in the middle, wrong file).  Replay
+    stops loudly — silently dropping acked records would un-commit writes
+    that callers were told succeeded."""
 
 
 class WriteAheadLog:
@@ -50,6 +74,20 @@ class WriteAheadLog:
         # storage/value transformer seam): record/snapshot bytes pass
         # through here on the way to and from disk; None = plaintext
         self.transformer = transformer
+        # what the last recover() observed — the crash-consistency audit
+        # trail the fault matrix asserts on
+        self.last_recovery: dict = {"replayed": 0, "truncated_bytes": 0,
+                                    "torn_tail": False, "revision": 0}
+        # detected on read (recover/open): False for a pre-CRC v1 file,
+        # which keeps its framing until compaction rewrites it as v2
+        self._crc_format = True
+
+    def _detect_format(self) -> None:
+        if os.path.exists(self._wal_path) and os.path.getsize(self._wal_path) > 0:
+            with open(self._wal_path, "rb") as f:
+                self._crc_format = f.read(len(_MAGIC)) == _MAGIC
+        else:
+            self._crc_format = True
 
     # -- recovery ----------------------------------------------------------
     def recover(self) -> tuple[int, dict, int]:
@@ -65,7 +103,9 @@ class WriteAheadLog:
             rev = int(snap["rev"])
             objects = snap["objects"]
         replayed = 0
-        valid_end = 0
+        self._detect_format()
+        valid_end = len(_MAGIC) if (self._crc_format and os.path.exists(
+            self._wal_path) and os.path.getsize(self._wal_path) > 0) else 0
         for rec, offset in self._read_wal():
             replayed += 1
             valid_end = offset
@@ -78,51 +118,100 @@ class WriteAheadLog:
                 bucket[key] = rec["o"]
         # drop the torn/corrupt tail NOW: future appends must follow the
         # last valid record, or they'd be unreachable behind the garbage
-        if (os.path.exists(self._wal_path)
-                and os.path.getsize(self._wal_path) > valid_end):
-            with open(self._wal_path, "r+b") as f:
-                f.truncate(valid_end)
+        truncated = 0
+        if os.path.exists(self._wal_path):
+            size = os.path.getsize(self._wal_path)
+            if size > valid_end:
+                truncated = size - valid_end
+                with open(self._wal_path, "r+b") as f:
+                    f.truncate(valid_end)
         self._records_since_snapshot = replayed
+        self.last_recovery = {"replayed": replayed,
+                              "truncated_bytes": truncated,
+                              "torn_tail": truncated > 0,
+                              "revision": rev}
         return rev, objects, replayed
 
     def _read_wal(self):
         """Yields (record, end_offset) for every intact record.
 
-        Torn appends (a crash mid-write) are STRUCTURAL: the length
-        prefix or payload comes up short and the tail is dropped — that
-        record was never acknowledged.  A structurally complete record
-        that fails decryption/decoding is a different animal entirely
-        (wrong key, or real corruption of acknowledged history) and
-        propagates loudly rather than silently truncating the log."""
+        Torn appends (a crash mid-write) are detected two ways, both
+        confined to the file TAIL: the length prefix or payload comes up
+        short (structural), or the last record's CRC disagrees with its
+        payload (the bytes landed but not all of them were the write's).
+        Either way that record was never acknowledged and the tail is
+        dropped.  A CRC mismatch on a record with valid records *after*
+        it — or a structurally complete record mid-file that fails
+        decryption/decoding — is real corruption of acknowledged history
+        and propagates loudly rather than silently truncating the log."""
         if not os.path.exists(self._wal_path):
             return
+        size = os.path.getsize(self._wal_path)
+        header_size = _HEADER if self._crc_format else _LEN.size
         with open(self._wal_path, "rb") as f:
+            if self._crc_format and size > 0:
+                f.read(len(_MAGIC))
             while True:
-                head = f.read(_LEN.size)
-                if len(head) < _LEN.size:
-                    return  # clean EOF or torn length prefix
-                (n,) = _LEN.unpack(head)
+                head = f.read(header_size)
+                if len(head) < header_size:
+                    return  # clean EOF or torn header
+                (n,) = _LEN.unpack(head[: _LEN.size])
                 payload = f.read(n)
                 if len(payload) < n:
                     return  # torn record: crash mid-append, never acked
+                if self._crc_format:
+                    (want_crc,) = _CRC.unpack(head[_LEN.size:])
+                    if zlib.crc32(payload) != want_crc:
+                        if f.tell() >= size:
+                            return  # tail half-written: torn, drop it
+                        raise CorruptWALError(
+                            f"{self._wal_path}: CRC mismatch at offset "
+                            f"{f.tell() - n - header_size} with valid "
+                            "records after it — acknowledged history is "
+                            "damaged")
                 if self.transformer is not None:
                     payload = self.transformer.decrypt(payload)
                 yield wire.decode(payload), f.tell()
 
     # -- append ------------------------------------------------------------
     def open(self) -> None:
+        self._detect_format()
+        fresh = (not os.path.exists(self._wal_path)
+                 or os.path.getsize(self._wal_path) == 0)
         self._f = open(self._wal_path, "ab")
+        if fresh:
+            # new logs are v2; a surviving v1 log keeps its framing
+            # until the next compaction rewrites it
+            self._f.write(_MAGIC)
+            self._f.flush()
 
     def append(self, ev_type: str, kind: str, key: str, rev: int,
                obj: dict) -> None:
+        fault = faults.hit("store.wal.append", kind=kind, key=key)
         payload = wire.encode({"t": ev_type, "k": kind, "key": key,
                                "r": rev, "o": obj})
         if self.transformer is not None:
             payload = self.transformer.encrypt(payload)
+        header = _LEN.pack(len(payload))
+        if self._crc_format:
+            header += _CRC.pack(zlib.crc32(payload))
         with self._mu:
             if self._f is None:
                 self.open()
-            self._f.write(_LEN.pack(len(payload)))
+            if fault is not None and fault.mode == "torn":
+                # crash mid-append: the header promises more bytes than
+                # land.  Flush what DID land (the crash happens after the
+                # page made it out) and die like the process would.
+                cut = max(0, int(len(payload) * fault.value))
+                self._f.write(header)
+                self._f.write(payload[:cut])
+                self._f.flush()
+                if self.fsync:
+                    os.fsync(self._f.fileno())
+                raise faults.FaultInjected(
+                    f"torn WAL append for {kind}/{key} (crash mid-write: "
+                    f"{cut}/{len(payload)} payload bytes on disk)")
+            self._f.write(header)
             self._f.write(payload)
             self._f.flush()
             if self.fsync:
@@ -149,6 +238,9 @@ class WriteAheadLog:
             if self._f is not None:
                 self._f.close()
             self._f = open(self._wal_path, "wb")  # truncate
+            self._f.write(_MAGIC)  # compaction upgrades a v1 log to v2
+            self._f.flush()
+            self._crc_format = True
             self._records_since_snapshot = 0
 
     def close(self) -> None:

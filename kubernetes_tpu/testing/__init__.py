@@ -1,4 +1,10 @@
 """Test infrastructure: chaos injection + SLO enforcement (SURVEY.md §4.6)."""
 
-from .chaos import ChaosMonkey, NodePartition, PodKiller, SchedulerRestart
+from .chaos import (
+    ChaosMonkey,
+    FaultInjection,
+    NodePartition,
+    PodKiller,
+    SchedulerRestart,
+)
 from .slo import SLOChecker, SLOViolation

@@ -12,12 +12,21 @@ Capability of the reference's e2e chaos tooling:
 - component crash/restart (upgrade tests): throw a component away and
   rebuild it from the store — the checkpoint/resume property (SURVEY.md
   §5.3: the store IS the checkpoint).
+
+The coarse disruptions above act from the OUTSIDE (remove a kubelet,
+drop a scheduler).  :class:`FaultInjection` plugs the deterministic
+fault framework (``kubernetes_tpu/faults``) into the same protocol: a
+seeded :class:`~kubernetes_tpu.faults.FaultPlan` armed at ``inject_at``
+and disarmed at ``recover_at`` makes a named INTERNAL seam misbehave —
+bind CAS failures, watch-stream cuts, WAL tears — with exact replay.
 """
 
 from __future__ import annotations
 
 import random
 from typing import Callable, Optional
+
+from ..faults import FaultPlan
 
 
 class Disruption:
@@ -103,6 +112,26 @@ class PodKiller(Disruption):
         self.active = False
 
 
+class FaultInjection(Disruption):
+    """A fault plan as a chaos disruption: the plan's policies are live
+    between begin() and end().  Composes with the external disruptions —
+    e.g. a node partition WHILE binds are failing — and inherits the
+    plan's determinism (same seed, same misbehavior sequence)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._armed = None
+
+    def begin(self) -> None:
+        self._armed = self.plan.armed()
+        self._armed.__enter__()
+
+    def end(self) -> None:
+        if self._armed is not None:
+            self._armed.__exit__(None, None, None)
+            self._armed = None
+
+
 class ChaosMonkey:
     """chaosmonkey.Do: drive the workload, inject at ``inject_at``,
     recover at ``recover_at``, stop when ``done`` or ``max_ticks``."""
@@ -126,21 +155,31 @@ class ChaosMonkey:
         self.recovered = False
 
     def run(self) -> int:
-        """Returns the tick count at completion."""
-        for t in range(self.max_ticks):
-            if t == self.inject_at:
+        """Returns the tick count at completion.  Disruptions that began
+        are ALWAYS ended — a tick() that raises mid-fault (likely, since
+        faults make workloads throw) must not leak the disruption past
+        the run: a still-armed FaultPlan would poison every later test
+        in the process (and block the next ``armed()``)."""
+        try:
+            for t in range(self.max_ticks):
+                if t == self.inject_at:
+                    for d in self.disruptions:
+                        d.begin()
+                    self.injected = True
+                if t == self.recover_at:
+                    for d in self.disruptions:
+                        d.end()
+                    self.recovered = True
+                self.tick(t)
                 for d in self.disruptions:
-                    d.begin()
-                self.injected = True
-            if t == self.recover_at:
+                    tick_fn = getattr(d, "tick", None)
+                    if tick_fn is not None:
+                        tick_fn()
+                if t > self.recover_at and self.done():
+                    return t
+            return self.max_ticks
+        finally:
+            if self.injected and not self.recovered:
                 for d in self.disruptions:
                     d.end()
                 self.recovered = True
-            self.tick(t)
-            for d in self.disruptions:
-                tick_fn = getattr(d, "tick", None)
-                if tick_fn is not None:
-                    tick_fn()
-            if t > self.recover_at and self.done():
-                return t
-        return self.max_ticks

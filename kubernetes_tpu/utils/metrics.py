@@ -138,6 +138,52 @@ class Registry:
             return "\n".join(m.expose() for m in self._metrics.values()) + "\n"
 
 
+class ClientMetrics:
+    """Client-transport observability: retry/reconnect/relist counters.
+
+    The fault-injection matrix (tests/test_faults.py) asserts recovery
+    through exactly these — a retry that happens but is invisible here
+    fails the test.  One instance per RemoteStore (watches inherit it);
+    informers default to the process-wide :data:`DEFAULT_CLIENT_METRICS`
+    unless handed their own."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.remote_retries = r.register(Counter(
+            "client_remote_retries_total",
+            "request attempts re-issued after a retryable failure"))
+        self.remote_fatal = r.register(Counter(
+            "client_remote_fatal_total",
+            "requests abandoned on a non-retryable (4xx) classification"))
+        self.remote_retry_exhausted = r.register(Counter(
+            "client_remote_retry_exhausted_total",
+            "requests abandoned after the retry budget ran out"))
+        self.watch_reconnects = r.register(Counter(
+            "client_watch_reconnects_total",
+            "watch streams re-established after an error or EOF"))
+        self.watch_gaps = r.register(Counter(
+            "client_watch_gaps_total",
+            "watch resumes refused with 410 Gone — informer must relist"))
+        self.watch_errors = r.register(Counter(
+            "client_watch_errors_total",
+            "classified watch-stream errors (transport + HTTP)"))
+        self.informer_relists = r.register(Counter(
+            "client_informer_relists_total",
+            "full LIST + watch restarts (gap escalation or resync)"))
+        self.informer_dropped_events = r.register(Counter(
+            "client_informer_dropped_events_total",
+            "deltas dropped before application (fault injection)"))
+        self.informer_handler_errors = r.register(Counter(
+            "client_informer_handler_errors_total",
+            "handler callbacks that raised (isolated, loop continues)"))
+
+
+# informers without an explicit metrics object aggregate here: one place
+# to ask "did anything relist / drop / leak handler errors this process"
+DEFAULT_CLIENT_METRICS = ClientMetrics()
+
+
 class SchedulerMetrics:
     """The reference's three scheduling SLIs, in microseconds
     (``metrics/metrics.go:26-50``), plus batch-backend extras."""
@@ -164,6 +210,19 @@ class SchedulerMetrics:
         self.pallas_fallback_total = r.register(Counter(
             "scheduler_pallas_fallback_total",
             "pallas dispatch/finalize failures that fell back to the XLA scan",
+        ))
+        self.kernel_breaker_transitions = r.register(Counter(
+            "scheduler_kernel_breaker_transitions_total",
+            "circuit-breaker level changes (degrade, probe, restore) on "
+            "the pallas→interpret→oracle ladder",
+        ))
+        self.bind_failures = r.register(Counter(
+            "scheduler_bind_failures_total",
+            "bind attempts that failed (conflict, not-found, transport)",
+        ))
+        self.bind_requeues = r.register(Counter(
+            "scheduler_bind_requeues_total",
+            "pods requeued with backoff after a transient bind failure",
         ))
         # preemption (the PostFilter phase)
         self.preemption_attempts = r.register(Counter(

@@ -230,10 +230,14 @@ def test_compaction_snapshot_and_truncate(tmp_path):
     s2 = Store(data_dir=d, compact_every=50)
     assert len(s2.list("Pod", None)[0]) == 120
     s2.close()
-    # explicit compact truncates the WAL entirely
+    # explicit compact truncates the WAL entirely (only the v2 format
+    # magic remains — zero records)
     s3 = Store(data_dir=d)
     s3.compact()
-    assert os.path.getsize(tmp_path / "state" / "wal.bin") == 0
+    wal = WriteAheadLog(d)
+    wal._detect_format()
+    assert sum(1 for _ in wal._read_wal()) == 0
+    assert os.path.getsize(tmp_path / "state" / "wal.bin") == 8  # magic only
     s3.close()
     s4 = Store(data_dir=d)
     assert len(s4.list("Pod", None)[0]) == 120
