@@ -30,6 +30,20 @@ Findings:
 - PC204 entity both kernel-implemented and marked host-fallback (stale
   fallback marker: the kernel caught up, the oracle annotation didn't)
 - PC205 host-fallback marker with no justification text
+- PC206 ``implements`` marker outside the kernel call graph (module-level
+  comment, or inside a private function no public kernel entry point
+  reaches) — the marker is IGNORED: a claim next to deleted or orphaned
+  code must not keep counting as coverage (ROADMAP "Parity markers are
+  comment-level").  Such a marker's entity reverts to PC201/PC202 unless
+  mapped elsewhere.
+
+Reachability: the units are module-level functions and class methods of
+the kernel files; roots are the public ones (no leading underscore —
+the kernel API surface); edges follow any referenced name, bare or
+attribute (``self._kernel_weights()``, ``tensorizer.build_static``,
+callbacks passed by reference), resolved against unit names across the
+whole kernel file set.  Nested functions belong to their enclosing
+unit's span, so markers inside closures of reachable functions count.
 """
 
 from __future__ import annotations
@@ -130,21 +144,104 @@ def _attach_fallback_markers(src: str, entities: list[OracleEntity]) -> None:
             best.fallback_reason = reason
 
 
+class _KernelUnit:
+    """One call-graph node: a module-level function or a class method of
+    a kernel file.  Nested defs are folded into the enclosing unit (their
+    lines fall inside its span; their references count as its calls)."""
+
+    def __init__(self, name: str, path: str, line: int, end_line: int,
+                 refs: set, owner_class: Optional[str] = None):
+        self.name = name
+        self.path = path
+        self.line = line
+        self.end_line = end_line
+        self.refs = refs  # every bare/attribute name the body references
+        self.owner_class = owner_class  # None for module-level functions
+
+
+def _unit_refs(node: ast.AST) -> set:
+    refs: set = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            refs.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            refs.add(sub.attr)
+    return refs
+
+
+def _collect_kernel_units(abs_path: str, rel: str) -> list[_KernelUnit]:
+    with open(abs_path, "r", encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=rel)
+    units: list[_KernelUnit] = []
+
+    def add(node: ast.AST, owner: Optional[str] = None) -> None:
+        units.append(_KernelUnit(
+            node.name, rel, node.lineno, node.end_lineno or node.lineno,
+            _unit_refs(node), owner_class=owner))
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add(node)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add(item, owner=node.name)
+    return units
+
+
+def _reachable_spans(units: list[_KernelUnit]) -> dict[str, list[tuple[int, int]]]:
+    """BFS from the public units over name-reference edges; returns the
+    reachable line spans per file.  A reference to a CLASS name reaches
+    that class's dunder methods (instantiation runs ``__init__``; the
+    public methods are roots in their own right) — a marker inside the
+    constructor of an instantiated kernel class must not be flagged."""
+    by_name: dict[str, list[_KernelUnit]] = {}
+    dunders_by_class: dict[str, list[_KernelUnit]] = {}
+    for u in units:
+        by_name.setdefault(u.name, []).append(u)
+        if (u.owner_class is not None
+                and u.name.startswith("__") and u.name.endswith("__")):
+            dunders_by_class.setdefault(u.owner_class, []).append(u)
+    work = [u for u in units if not u.name.startswith("_")]
+    seen = set(id(u) for u in work)
+    while work:
+        u = work.pop()
+        for ref in u.refs:
+            for target in by_name.get(ref, ()):
+                if id(target) not in seen:
+                    seen.add(id(target))
+                    work.append(target)
+            for target in dunders_by_class.get(ref, ()):
+                if id(target) not in seen:
+                    seen.add(id(target))
+                    work.append(target)
+    spans: dict[str, list[tuple[int, int]]] = {}
+    for u in units:
+        if id(u) in seen:
+            spans.setdefault(u.path, []).append((u.line, u.end_line))
+    return spans
+
+
 def _collect_implements(
-    abs_path: str, rel: str
-) -> list[tuple[str, str, int]]:
-    """(name, path, line) per implements-marker mention."""
-    out: list[tuple[str, str, int]] = []
+    abs_path: str, rel: str, spans: Optional[list[tuple[int, int]]]
+) -> tuple[list[tuple[str, str, int]], list[tuple[str, str, int]]]:
+    """(counted, ignored): implements-marker mentions inside vs outside
+    the reachable kernel spans of this file."""
+    counted: list[tuple[str, str, int]] = []
+    ignored: list[tuple[str, str, int]] = []
     with open(abs_path, "r", encoding="utf-8") as f:
         for lineno, line in enumerate(f, start=1):
             m = _IMPLEMENTS_RE.search(line)
             if not m:
                 continue
+            in_graph = spans is not None and any(
+                lo <= lineno <= hi for lo, hi in spans)
             for name in m.group("names").split(","):
                 name = name.strip()
                 if name:
-                    out.append((name, rel, lineno))
-    return out
+                    (counted if in_graph else ignored).append((name, rel, lineno))
+    return counted, ignored
 
 
 def run(
@@ -161,9 +258,46 @@ def run(
             findings.append(
                 Finding("PC200", rel, e.lineno or 1, "syntax", f"unparseable oracle file: {e.msg}")
             )
+    kernel_files = list(iter_py_files(root, kernel_paths or DEFAULT_KERNEL_PATHS))
+    units: list[_KernelUnit] = []
+    unparseable: set[str] = set()
+    for abs_path, rel in kernel_files:
+        try:
+            units.extend(_collect_kernel_units(abs_path, rel))
+        except SyntaxError as e:
+            findings.append(
+                Finding("PC200", rel, e.lineno or 1, "syntax",
+                        f"unparseable kernel file: {e.msg}")
+            )
+            unparseable.add(rel)
+    spans_by_file = _reachable_spans(units)
     implements: list[tuple[str, str, int]] = []
-    for abs_path, rel in iter_py_files(root, kernel_paths or DEFAULT_KERNEL_PATHS):
-        implements.extend(_collect_implements(abs_path, rel))
+    for abs_path, rel in kernel_files:
+        # an unparseable file has no call graph — count its markers as
+        # before rather than mass-reporting PC206 on top of PC200
+        counted, ignored = _collect_implements(
+            abs_path, rel, spans_by_file.get(rel, []))
+        if rel in unparseable:
+            implements.extend(counted)
+            implements.extend(ignored)
+            continue
+        implements.extend(counted)
+        for name, _rel, lineno in ignored:
+            findings.append(
+                Finding(
+                    code="PC206",
+                    path=rel,
+                    line=lineno,
+                    symbol=f"marker.{name}",
+                    message=(
+                        f"implements marker for {name!r} sits outside every "
+                        f"function the kernel call graph reaches (module-level "
+                        f"comment or orphaned private code) — it does NOT "
+                        f"count as kernel coverage; move it into the "
+                        f"implementing function or delete it"
+                    ),
+                )
+            )
 
     by_name: dict[str, OracleEntity] = {}
     for e in entities:

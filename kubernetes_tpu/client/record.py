@@ -53,6 +53,45 @@ def _fmt(message) -> str:
     return message if isinstance(message, str) else message[0] % tuple(message[1:])
 
 
+@dataclass
+class _PendingBatch:
+    """A whole wave's events, unexpanded: the emitting scheduler thread
+    enqueues (objects, kind, timestamp) and the SINK thread builds the
+    per-event records — at 2k bindings per wave the dataclass
+    construction alone is measurable on the timed path, and the sink
+    drains concurrently with the next wave's device execution anyway."""
+
+    items: list  # [(obj, etype, reason, message), ...]
+    kind: str
+    time: float
+
+    def expand(self) -> list[_PendingEvent]:
+        kind = self.kind
+        now = self.time
+        return [
+            _PendingEvent(
+                involved_kind=getattr(obj, "KIND", kind),
+                involved_key=obj.meta.key,
+                namespace=obj.meta.namespace,
+                etype=etype,
+                reason=reason,
+                message=message,
+                time=now,
+            )
+            for obj, etype, reason, message in self.items
+        ]
+
+
+def _expand_chunk(chunk: list) -> list[_PendingEvent]:
+    out: list[_PendingEvent] = []
+    for ev in chunk:
+        if isinstance(ev, _PendingBatch):
+            out.extend(ev.expand())
+        else:
+            out.append(ev)
+    return out
+
+
 class _TokenBucket:
     __slots__ = ("tokens", "last")
 
@@ -197,7 +236,12 @@ class EventBroadcaster:
     ):
         self.clientset = clientset
         self.correlator = correlator or EventCorrelator(source=source, clock=clock)
-        self._queue: collections.deque[_PendingEvent] = collections.deque()
+        # entries are _PendingEvent or _PendingBatch; the bound and all
+        # accounting (overflow drops, __len__) are in EVENTS — a batch
+        # weighs len(items), so the documented memory bound holds no
+        # matter how waves are packaged
+        self._queue: collections.deque = collections.deque()
+        self._queued_events = 0
         self._max_queued = max_queued
         self._cv = threading.Condition()
         self._stopped = False
@@ -205,25 +249,45 @@ class EventBroadcaster:
         self.dropped_overflow = 0
 
     # -- emitter side (hot path: append only) ------------------------------
+    @staticmethod
+    def _weight(ev) -> int:
+        return len(ev.items) if isinstance(ev, _PendingBatch) else 1
+
     def enqueue(self, ev: _PendingEvent) -> None:
         with self._cv:
-            if len(self._queue) >= self._max_queued:
+            if self._queued_events >= self._max_queued:
                 self.dropped_overflow += 1
                 return
             self._queue.append(ev)
+            self._queued_events += 1
             self._cv.notify()
 
-    def enqueue_many(self, evs: list[_PendingEvent]) -> None:
+    def enqueue_many(self, evs: list) -> None:
         """Batch append under ONE lock acquisition + ONE sink wake-up — the
         batch scheduler's whole bind wave enqueues without per-event
-        synchronization (and without waking the sink mid-timed-section)."""
+        synchronization (and without waking the sink mid-timed-section).
+        Entries may be unexpanded _PendingBatch items; room and drops are
+        accounted in EVENTS (a batch truncates to the remaining room)."""
         with self._cv:
-            room = self._max_queued - len(self._queue)
-            if room < len(evs):
-                self.dropped_overflow += len(evs) - max(room, 0)
-                evs = evs[:max(room, 0)]
-            if evs:
-                self._queue.extend(evs)
+            appended = False
+            for ev in evs:
+                w = self._weight(ev)
+                room = self._max_queued - self._queued_events
+                if room <= 0:
+                    self.dropped_overflow += w
+                    continue
+                if w > room:
+                    self.dropped_overflow += w - room
+                    if isinstance(ev, _PendingBatch):
+                        ev = _PendingBatch(items=ev.items[:room],
+                                           kind=ev.kind, time=ev.time)
+                        w = room
+                    else:
+                        continue
+                self._queue.append(ev)
+                self._queued_events += w
+                appended = True
+            if appended:
                 self._cv.notify()
 
     def recorder(self, involved_kind: str = "Pod") -> "EventRecorder":
@@ -251,7 +315,9 @@ class EventBroadcaster:
             if not self._queue:
                 return False
             ev = self._queue.popleft()
-        self._write(self.correlator.observe(ev))
+            self._queued_events -= self._weight(ev)
+        for pe in _expand_chunk([ev]):
+            self._write(self.correlator.observe(pe))
         return True
 
     def process_batch(self, max_n: int = 4096) -> int:
@@ -261,6 +327,8 @@ class EventBroadcaster:
                 return 0
             chunk = [self._queue.popleft()
                      for _ in range(min(max_n, len(self._queue)))]
+            self._queued_events -= sum(self._weight(ev) for ev in chunk)
+        chunk = _expand_chunk(chunk)
         for decision in self.correlator.observe_many(chunk):
             self._write(decision)
         return len(chunk)
@@ -289,8 +357,9 @@ class EventBroadcaster:
                     return
                 chunk = [self._queue.popleft()
                          for _ in range(min(4096, len(self._queue)))]
+                self._queued_events -= sum(self._weight(ev) for ev in chunk)
             if chunk:
-                for decision in self.correlator.observe_many(chunk):
+                for decision in self.correlator.observe_many(_expand_chunk(chunk)):
                     self._write(decision)
 
     @property
@@ -306,6 +375,7 @@ class EventBroadcaster:
             self._stopped = True
             if not drain:
                 self._queue.clear()
+                self._queued_events = 0
             self._cv.notify_all()
         t = self._thread
         if t is not None:
@@ -321,14 +391,15 @@ class EventBroadcaster:
             if t.is_alive():
                 logger.warning(
                     "event sink still draining after %.0fs; leaving the "
-                    "thread to finish (%d events queued)", timeout, len(self._queue))
+                    "thread to finish (%d events queued)", timeout,
+                    self._queued_events)
                 return  # keep _thread set so start() cannot double-sink
             self._thread = None
         if drain and (t is None or not t.is_alive()):
             self.flush()  # manual mode, or a remainder after thread exit
 
     def __len__(self) -> int:
-        return len(self._queue)
+        return self._queued_events  # pending EVENTS (batches pre-counted)
 
 
 class EventRecorder:
@@ -356,18 +427,15 @@ class EventRecorder:
 
     def event_batch(self, items) -> None:
         """items: iterable of (obj, etype, reason, message) — message may be
-        a lazy ("fmt %s", arg) tuple.  One timestamp, one lock, one wake."""
-        now = self.broadcaster.correlator.clock()
-        kind = self.involved_kind
-        self.broadcaster.enqueue_many([
-            _PendingEvent(
-                involved_kind=getattr(obj, "KIND", kind),
-                involved_key=obj.meta.key,
-                namespace=obj.meta.namespace,
-                etype=etype,
-                reason=reason,
-                message=message,
-                time=now,
-            )
-            for obj, etype, reason, message in items
-        ])
+        a lazy ("fmt %s", arg) tuple.  One timestamp, one lock, one wake —
+        and ZERO per-event construction on the emitting thread: the batch
+        rides the queue unexpanded (_PendingBatch) and the sink builds the
+        records while the next wave owns the hot path."""
+        items = list(items)
+        if not items:
+            return
+        self.broadcaster.enqueue_many([_PendingBatch(
+            items=items,
+            kind=self.involved_kind,
+            time=self.broadcaster.correlator.clock(),
+        )])

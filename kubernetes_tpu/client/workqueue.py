@@ -123,6 +123,27 @@ class WorkQueue:
     def try_get(self) -> Optional[Hashable]:
         return self.get(timeout=0)
 
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until at least one item is ready (without popping it),
+        the queue shuts down, or the timeout elapses.  Returns whether an
+        item is ready — the batch loop's accumulation wait: peek-and-wait
+        instead of pop-and-requeue, so FIFO order is untouched."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                next_delay = self._drain_delayed_locked()
+                if self._queue:
+                    return True
+                if self._shutdown:
+                    return False
+                wait = next_delay
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return False
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
     def drain_ready(self, max_n: Optional[int] = None) -> list:
         """Pop every currently-ready item under ONE lock acquisition (the
         batch scheduler's seam: item-at-a-time get/done costs two lock

@@ -23,6 +23,10 @@ scheduler.bind           Scheduler._bind /                  forget + requeue wit
                          Store.bind_many per item           retry lands on freed capacity
 backend.pallas.segment   TPUBatchBackend kernel dispatch/   circuit breaker: pallas →
                          finalize                           interpret → oracle, re-probe
+scheduler.pipeline.prep  Scheduler._pipeline_idle (the      contained: prep failure counted,
+                         overlapped cross-wave host prep)   work re-runs synchronously at
+                                                            the next wave (decisions and
+                                                            parity unaffected)
 ======================== ================================== ===========================
 """
 
@@ -61,6 +65,10 @@ register("scheduler.bind",
 register("backend.pallas.segment",
          "kernel segment dispatch/finalize — error: the device program "
          "fails for this segment (Mosaic compile/runtime failure)")
+register("scheduler.pipeline.prep",
+         "overlapped host prep (informer pump + signature warming) run in "
+         "the device's shadow between waves — error: the prep step dies "
+         "mid-wave; the wave still completes and prep re-runs synchronously")
 
 __all__ = [
     "Fault",

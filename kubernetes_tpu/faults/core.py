@@ -164,6 +164,14 @@ class FaultPlan:
         self._specs: dict[str, list[FaultSpec]] = {}
         self.hits: dict[str, int] = {}
         self.fired: dict[str, int] = {}
+        # Counter lock: watch/informer threads and the main thread hit
+        # armed points concurrently; per-point fire counts must be EXACT
+        # (nth/first_n/max_fires triggers and the coverage gate read
+        # them — ROADMAP "Fault-point thread counters").  The policy
+        # decision (seen/fires/rng) happens under the lock; the ACTION
+        # (raise / sleep / return) happens outside it so a delay-mode
+        # fault never stalls other threads' fault points.
+        self._mu = threading.Lock()
 
     def on(self, point: str, spec: Optional[FaultSpec] = None, **kwargs) -> "FaultPlan":
         """Attach a policy to a registered point.  Chainable."""
@@ -191,25 +199,30 @@ class FaultPlan:
                 f"hit() on unregistered fault point {name!r} — add it to "
                 "the faults/__init__.py catalogue"
             )
-        point.hits += 1
-        self.hits[name] = self.hits.get(name, 0) + 1
-        for spec in self._specs.get(name, ()):
-            if not spec._matches(ctx):
-                continue
-            spec.seen += 1
-            if not spec._should_fire(self.rng):
-                continue
-            spec.fires += 1
-            point.fired += 1
-            self.fired[name] = self.fired.get(name, 0) + 1
-            if spec.mode == "error":
-                raise (spec.error_factory() if spec.error_factory is not None
-                       else FaultInjected(f"injected fault at {name}"))
-            if spec.mode == "delay":
-                time.sleep(spec.value)
-                return None  # the site proceeds, just later
-            return Fault(spec.mode, spec.value, spec)
-        return None
+        fired_spec: Optional[FaultSpec] = None
+        with self._mu:
+            point.hits += 1
+            self.hits[name] = self.hits.get(name, 0) + 1
+            for spec in self._specs.get(name, ()):
+                if not spec._matches(ctx):
+                    continue
+                spec.seen += 1
+                if not spec._should_fire(self.rng):
+                    continue
+                spec.fires += 1
+                point.fired += 1
+                self.fired[name] = self.fired.get(name, 0) + 1
+                fired_spec = spec
+                break
+        if fired_spec is None:
+            return None
+        if fired_spec.mode == "error":
+            raise (fired_spec.error_factory() if fired_spec.error_factory is not None
+                   else FaultInjected(f"injected fault at {name}"))
+        if fired_spec.mode == "delay":
+            time.sleep(fired_spec.value)
+            return None  # the site proceeds, just later
+        return Fault(fired_spec.mode, fired_spec.value, fired_spec)
 
 
 class _Armed:

@@ -28,6 +28,7 @@ The produced ``BatchStatic`` (numpy, host) feeds ``ops.batch_kernel``;
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -166,6 +167,93 @@ _DISABLE_ROW_CACHE = False
 _NS_KEY = "\x00ns"  # namespace rides the label space as a reserved key
 
 
+def _node_static_cols(rep, infos, js, is_best_effort, ref, images,
+                      prefer_avoid_weight, image_weight,
+                      out_ok, out_aff, out_taint, out_score) -> None:
+    """Fill node columns ``js`` of one signature's static rows.
+
+    ``ref`` is the interaction-key's controller-ref component: the actual
+    ref when some node's prefer-avoid annotation names its uid, ``None``
+    otherwise — so a cached row recomputed for a dirty column keeps the
+    semantics of its interaction CLASS, not of the particular pod that
+    first populated it."""
+    # kernel: implements CheckNodeSchedulable, CheckNodeCondition,
+    # kernel: implements PodToleratesNodeTaints, CheckNodeMemoryPressure
+    # kernel: implements CheckNodeDiskPressure
+    # (node-static predicate verdicts folded into the [G, N] mask the
+    # device step ANDs in — the host/selector half of GeneralPredicates
+    # lands here too; ktpu-analyze parity pass reads these markers)
+    for j in js:
+        info = infos[j]
+        node = info.node
+        labels = node.meta.labels
+        ok = not node.spec.unschedulable
+        # Ready-condition gate (CheckNodeCondition)
+        if ok:
+            ready = node.status.condition(api.NODE_READY)
+            ok = ready is None or ready.status == "True"
+        # host match
+        if ok and rep.spec.node_name:
+            ok = rep.spec.node_name == node.meta.name
+        # selector + required node affinity
+        if ok and rep.spec.node_selector:
+            ok = all(labels.get(k) == v for k, v in rep.spec.node_selector.items())
+        if ok and rep.spec.affinity is not None and rep.spec.affinity.node_affinity_required is not None:
+            ok = rep.spec.affinity.node_affinity_required.matches(labels)
+        # taints (NoSchedule/NoExecute)
+        if ok:
+            for taint in node.spec.taints:
+                if taint.effect not in (api.NO_SCHEDULE, api.NO_EXECUTE):
+                    continue
+                if not any(t.tolerates(taint) for t in rep.spec.tolerations):
+                    ok = False
+                    break
+        # pressure conditions
+        if ok and is_best_effort and info.memory_pressure:
+            ok = False
+        if ok and info.disk_pressure:
+            ok = False
+        out_ok[j] = ok
+
+        # preferred node affinity raw weight
+        if rep.spec.affinity is not None:
+            cnt = 0
+            for pt in rep.spec.affinity.node_affinity_preferred:
+                if pt.weight > 0 and pt.preference.matches(labels):
+                    cnt += pt.weight
+            out_aff[j] = cnt
+        # intolerable PreferNoSchedule taints
+        cnt = 0
+        for taint in node.spec.taints:
+            if taint.effect != api.PREFER_NO_SCHEDULE:
+                continue
+            if not any(t.tolerates(taint) for t in rep.spec.tolerations):
+                cnt += 1
+        out_taint[j] = cnt
+
+        # absolute (non-normalized) priorities folded into one array
+        score = 0
+        if prefer_avoid_weight:
+            avoided = False
+            if ref is not None and ref.kind in ("ReplicaSet", "ReplicationController"):
+                ann = node.meta.annotations.get(PREFER_AVOID_PODS_ANNOTATION, "")
+                avoided = ref.uid in [u.strip() for u in ann.split(",") if u.strip()]
+            score += prefer_avoid_weight * (0 if avoided else 10)
+        if image_weight:
+            total_mib = 0
+            for img in node.status.images:
+                if any(nm in images for nm in img.get("names", [])):
+                    total_mib += int(img.get("sizeBytes", 0)) // (2**20)
+            if total_mib < _MIN_IMG_MIB:
+                iscore = 0
+            elif total_mib > _MAX_IMG_MIB:
+                iscore = 10
+            else:
+                iscore = ((total_mib - _MIN_IMG_MIB) * 10) // (_MAX_IMG_MIB - _MIN_IMG_MIB)
+            score += image_weight * iscore
+        out_score[j] = score
+
+
 def _pod_content_key(pod: api.Pod) -> tuple:
     """Content identity of a pod AS THE HOST STATE SEES IT (labels +
     namespace + disk refs) — what decides whether a same-key pod must be
@@ -220,6 +308,7 @@ class HostBatchState:
         self._sel_memo: dict[tuple, int] = {}
         self._content_rc: dict[tuple, int] = {}  # live pods per labelmap
         self._kind_pos = {k: i for i, k in enumerate(_VOL_KINDS)}
+        self.last_dirty: list[int] = []  # node_j's touched by the last reconcile
         self._rebuild(node_info_map)
 
     def _rebuild(self, node_info_map: dict[str, "NodeInfo"]) -> None:
@@ -258,7 +347,13 @@ class HostBatchState:
         """Bring the state up to date with a fresh snapshot: nodes whose
         generation is unchanged are skipped wholesale; changed nodes are
         diffed by pod key + content.  A changed node SET falls back to a
-        full rebuild (node add/remove is rare and re-indexes the axis)."""
+        full rebuild (node add/remove is rare and re-indexes the axis).
+
+        ``last_dirty`` records the node positions whose generation moved
+        (cache assume/forget and informer deliveries both bump it via the
+        CoW counters) — the backend accumulates it into
+        ``stats["host_state_dirty_nodes"]``, the per-wave reconcile-width
+        companion to the device cache's upload stats."""
         names = sorted(
             n for n, i in node_info_map.items() if i.node is not None
         )
@@ -272,15 +367,19 @@ class HostBatchState:
             self._sel_memo.clear()
             self._content_rc.clear()
             self._rebuild(node_info_map)
+            self.last_dirty = list(range(len(self.node_names)))
             return
         if names != self.node_names:
             self._rebuild(node_info_map)
+            self.last_dirty = list(range(len(self.node_names)))
             return
+        self.last_dirty = []
         for name in names:
             info = node_info_map[name]
             if self.node_gen.get(name) == info.generation:
                 continue
             j = self.node_index[name]
+            self.last_dirty.append(j)
             mine = self.node_pods[j]
             current: dict[str, api.Pod] = {q.meta.key: q for q in info.pods}
             for key in [k for k in mine if k not in current]:
@@ -418,6 +517,100 @@ class HostBatchState:
         self.eng.close()
 
 
+class NodeStaticRows:
+    """Cross-wave cache of the per-signature node-static rows
+    (``static_ok`` / ``node_aff_raw`` / ``taint_intol_raw`` /
+    ``static_score``) keyed by the signature's node-interaction identity.
+
+    The rows depend only on NODE OBJECTS (labels, taints, conditions,
+    annotations, images) — never on pod placements — so in steady-state
+    churn, where waves of template-stamped pods repeat the same
+    interaction keys against an unchanged fleet, every wave after the
+    first reuses the rows outright instead of paying the [G, N] Python
+    sweep (the dominant host cost of ``build_static`` at 5k nodes).
+
+    Invalidation is per NODE COLUMN: ``sync`` diffs the node-object
+    identity per axis position (``set_node`` always installs a fresh
+    object, so identity diffing is exact) and eagerly recomputes exactly
+    the dirty columns of every cached row.  A changed node SET or a
+    changed weight configuration flushes the cache (new axis epoch).
+    The (epoch, version) token and the dirty column list ride the
+    produced ``BatchStatic`` so the device-side cache
+    (``ops.batch_kernel.DeviceNodeCache``) can mirror the same
+    only-upload-dirty-columns discipline for the node-axis tensors."""
+
+    _NONCE = itertools.count(1)
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = max_entries
+        self._axis: Optional[tuple] = None
+        self._node_refs: list = []
+        self._weights_key = None
+        # instance nonce: tokens from DIFFERENT NodeStaticRows instances
+        # must never compare equal (a swapped-in tensorizer restarts at
+        # epoch 1 / version 0, which would alias a stale device cache)
+        self._nonce = next(NodeStaticRows._NONCE)
+        self.epoch = 0
+        self.version = 0
+        self.last_dirty: list[int] = []
+        # interaction_key -> (rep, is_best_effort, ref, images, rows)
+        self._rows: dict = {}
+        self.stats = {"hits": 0, "misses": 0, "flushes": 0,
+                      "dirty_nodes": 0, "dirty_recomputes": 0}
+
+    def sync(self, node_names: list[str], infos: list, weights_key: tuple,
+             row_fn) -> None:
+        """Bring the cache in line with the current node axis.  ``row_fn``
+        recomputes one cached entry's columns: called as
+        ``row_fn(entry, js)`` for each cached row when columns are dirty."""
+        axis = tuple(node_names)
+        if axis != self._axis or weights_key != self._weights_key:
+            self._rows.clear()
+            self.epoch += 1
+            self.version = 0
+            self._axis = axis
+            self._weights_key = weights_key
+            self._node_refs = [info.node for info in infos]
+            self.last_dirty = []
+            self.stats["flushes"] += 1
+            return
+        dirty = [j for j, info in enumerate(infos)
+                 if info.node is not self._node_refs[j]]
+        if not dirty:
+            self.last_dirty = []
+            return
+        self._node_refs = [info.node for info in infos]
+        self.version += 1
+        self.last_dirty = dirty
+        self.stats["dirty_nodes"] += len(dirty)
+        if len(dirty) > max(8, len(infos) // 4):
+            # a mostly-dirty axis: recomputing every cached row column by
+            # column costs more than letting the rows rebuild on miss
+            self._rows.clear()
+            return
+        for entry in self._rows.values():
+            row_fn(entry, dirty)
+            self.stats["dirty_recomputes"] += 1
+
+    def get(self, key: tuple):
+        entry = self._rows.get(key)
+        if entry is None:
+            self.stats["misses"] += 1
+            return None
+        self.stats["hits"] += 1
+        return entry[4]
+
+    def put(self, key: tuple, rep, is_best_effort: bool, ref, images,
+            rows: tuple) -> None:
+        if len(self._rows) >= self.max_entries:
+            self._rows.clear()  # wholesale: keys churn together (rollouts)
+            self.stats["flushes"] += 1
+        self._rows[key] = (rep, is_best_effort, ref, images, rows)
+
+    def token(self) -> tuple:
+        return (self._nonce, self.epoch, self.version)
+
+
 @dataclass
 class BatchStatic:
     """Host-computed static arrays for one kernel segment (numpy)."""
@@ -488,6 +681,13 @@ class BatchStatic:
     # scoring mode flags
     weights: dict = field(default_factory=dict)
 
+    # node-axis identity for the device-resident node-state cache
+    # (ops.batch_kernel.DeviceNodeCache): (epoch, version) from
+    # NodeStaticRows plus the columns dirtied since version-1.  None when
+    # the tensorizer runs without persistent rows (cache bypassed).
+    node_token: Optional[tuple] = None
+    node_dirty: Optional[list] = None
+
 
 @dataclass
 class InitialState:
@@ -529,13 +729,19 @@ class Tensorizer:
         max_vols: int = 1024,
         vols_per_pod: int = 8,
         group_multiple: int = 32,
-        term_multiple: int = 16,
-        vol_multiple: int = 256,
+        term_multiple: int = 4,
+        vol_multiple: int = 32,
         port_multiple: int = 8,
+        sticky_buckets: bool = True,
+        persistent_rows: bool = True,
     ):
         # Every shape-determining axis is padded to a bucket multiple so XLA
         # compiles ONE kernel per bucket combination instead of one per
         # batch (SURVEY.md §7.4 hard part 2: dynamic shapes vs static XLA).
+        # The term/vol multiples are deliberately TIGHT (padded [T, N] /
+        # [V, N] rows cost real per-step device time — ~25us/pod per padded
+        # term row at N=5120); sticky_buckets below keeps the tight pads
+        # from turning into per-wave recompiles.
         self.pad_multiple = pad_multiple
         self.max_groups = max_groups
         self.max_terms = max_terms
@@ -545,6 +751,33 @@ class Tensorizer:
         self.term_multiple = term_multiple
         self.vol_multiple = vol_multiple
         self.port_multiple = port_multiple
+        # Sticky shape buckets: each padded axis remembers its high-water
+        # bucket and never shrinks, so successive steady-state waves reuse
+        # the compiled kernel for their shape instead of recompiling when a
+        # wave's natural bucket wobbles (e.g. the volume vocab crossing a
+        # pad boundary mid-run cost a multi-second XLA recompile on the
+        # timed path).  Padding UP is always semantically inert.
+        self.sticky_buckets = sticky_buckets
+        self._sticky: dict[str, int] = {}
+        # Cross-wave node-static row cache (see NodeStaticRows).
+        self.persistent_rows = persistent_rows
+        self._node_rows: Optional[NodeStaticRows] = None
+
+    def _bucket(self, axis: str, n: int, multiple: int) -> int:
+        return self._sticky_pad(axis, _pad_to(n, multiple))
+
+    def _sticky_pad(self, axis: str, pad: int) -> int:
+        """One high-water discipline for every axis — including the vols
+        axis, whose natural pad has its own empty-vocab floor."""
+        if not self.sticky_buckets:
+            return pad
+        pad = max(pad, self._sticky.get(axis, 0))
+        self._sticky[axis] = pad
+        return pad
+
+    @property
+    def node_rows_stats(self) -> Optional[dict]:
+        return self._node_rows.stats if self._node_rows is not None else None
 
     # -- static ------------------------------------------------------------
     def build_static(
@@ -639,7 +872,7 @@ class Tensorizer:
             for port in rep.host_ports():
                 if port not in port_vocab:
                     port_vocab[port] = len(port_vocab)
-        pv = _pad_to(len(port_vocab), self.port_multiple)
+        pv = self._bucket("ports", len(port_vocab), self.port_multiple)
         g_ports = np.zeros((G, pv), dtype=bool)
         for g, rep in enumerate(reps):
             for port in rep.host_ports():
@@ -661,13 +894,10 @@ class Tensorizer:
         # node affinity, tolerations, QoS, controller ref, images): at
         # north scale ~512 signatures × 5k nodes collapses from 2.5M
         # Python iterations per segment to a handful of [N] sweeps —
-        # the dominant host cost of build_static (r4 profile)
-        # kernel: implements CheckNodeSchedulable, CheckNodeCondition,
-        # kernel: implements PodToleratesNodeTaints, CheckNodeMemoryPressure
-        # kernel: implements CheckNodeDiskPressure
-        # (node-static predicate verdicts folded into the [G, N] mask the
-        # device step ANDs in — the host/selector half of GeneralPredicates
-        # lands here too; ktpu-analyze parity pass reads these markers)
+        # the dominant host cost of build_static (r4 profile).  The sweep
+        # itself lives in _node_static_cols; with persistent_rows the rows
+        # additionally survive ACROSS segments and waves in NodeStaticRows,
+        # invalidated per dirty node column.
         static_ok = np.zeros((G, n_pad), dtype=bool)
         node_aff_raw = np.zeros((G, n_pad), dtype=np.int32)
         taint_intol_raw = np.zeros((G, n_pad), dtype=np.int32)
@@ -682,11 +912,37 @@ class Tensorizer:
             for info in infos:
                 ann = info.node.meta.annotations.get(PREFER_AVOID_PODS_ANNOTATION, "")
                 avoided_uids.update(u.strip() for u in ann.split(",") if u.strip())
+
+        # cross-wave persistent rows: validate the cache against the node
+        # axis and eagerly refresh dirty columns of every cached entry
+        # (each entry recomputes with its interaction CLASS's keyed ref)
+        rows_cache: Optional[NodeStaticRows] = None
+        node_token = node_dirty = None
+        if not _DISABLE_ROW_CACHE and self.persistent_rows:
+            if self._node_rows is None:
+                self._node_rows = NodeStaticRows()
+            rows_cache = self._node_rows
+
+            def _refresh(entry, js):
+                e_rep, e_be, e_ref, e_images, e_rows = entry
+                _node_static_cols(e_rep, infos, js, e_be, e_ref, e_images,
+                                  prefer_avoid_weight, image_weight, *e_rows)
+
+            rows_cache.sync(node_names, infos,
+                            (prefer_avoid_weight, image_weight), _refresh)
+            node_token = rows_cache.token()
+            node_dirty = list(rows_cache.last_dirty)
+
+        all_js = range(n_real)
         for g, rep in enumerate(reps):
             is_best_effort = rep.qos_class() == api.BEST_EFFORT
             ref = rep.meta.controller_ref()
             images = {c.image for c in rep.spec.containers if c.image}
             aff = rep.spec.affinity
+            # the keyed ref: None unless some node's prefer-avoid
+            # annotation names this controller (see _node_static_cols)
+            keyed_ref = (ref if ref is not None and ref.uid in avoided_uids
+                         else None)
             interaction_key = None
             if not _DISABLE_ROW_CACHE:
                 interaction_key = (
@@ -697,89 +953,34 @@ class Tensorizer:
                     repr(aff.node_affinity_preferred) if aff is not None else "",
                     tuple(sorted(repr(t) for t in rep.spec.tolerations)),
                     is_best_effort,
-                    (ref.kind, ref.uid)
-                    if ref is not None and ref.uid in avoided_uids else None,
+                    (keyed_ref.kind, keyed_ref.uid) if keyed_ref is not None else None,
                     tuple(sorted(images)) if image_weight else (),
                 )
-                cached = row_cache.get(interaction_key)
+                cached = (rows_cache.get(interaction_key)
+                          if rows_cache is not None
+                          else row_cache.get(interaction_key))
                 if cached is not None:
                     static_ok[g] = cached[0]
                     node_aff_raw[g] = cached[1]
                     taint_intol_raw[g] = cached[2]
                     static_score[g] = cached[3]
                     continue
-            for j, info in enumerate(infos):
-                node = info.node
-                labels = node.meta.labels
-                ok = not node.spec.unschedulable
-                # Ready-condition gate (CheckNodeCondition)
-                if ok:
-                    ready = node.status.condition(api.NODE_READY)
-                    ok = ready is None or ready.status == "True"
-                # host match
-                if ok and rep.spec.node_name:
-                    ok = rep.spec.node_name == node.meta.name
-                # selector + required node affinity
-                if ok and rep.spec.node_selector:
-                    ok = all(labels.get(k) == v for k, v in rep.spec.node_selector.items())
-                if ok and rep.spec.affinity is not None and rep.spec.affinity.node_affinity_required is not None:
-                    ok = rep.spec.affinity.node_affinity_required.matches(labels)
-                # taints (NoSchedule/NoExecute)
-                if ok:
-                    for taint in node.spec.taints:
-                        if taint.effect not in (api.NO_SCHEDULE, api.NO_EXECUTE):
-                            continue
-                        if not any(t.tolerates(taint) for t in rep.spec.tolerations):
-                            ok = False
-                            break
-                # pressure conditions
-                if ok and is_best_effort and info.memory_pressure:
-                    ok = False
-                if ok and info.disk_pressure:
-                    ok = False
-                static_ok[g, j] = ok
-
-                # preferred node affinity raw weight
-                if rep.spec.affinity is not None:
-                    cnt = 0
-                    for pt in rep.spec.affinity.node_affinity_preferred:
-                        if pt.weight > 0 and pt.preference.matches(labels):
-                            cnt += pt.weight
-                    node_aff_raw[g, j] = cnt
-                # intolerable PreferNoSchedule taints
-                cnt = 0
-                for taint in node.spec.taints:
-                    if taint.effect != api.PREFER_NO_SCHEDULE:
-                        continue
-                    if not any(t.tolerates(taint) for t in rep.spec.tolerations):
-                        cnt += 1
-                taint_intol_raw[g, j] = cnt
-
-                # absolute (non-normalized) priorities folded into one array
-                score = 0
-                if prefer_avoid_weight:
-                    avoided = False
-                    if ref is not None and ref.kind in ("ReplicaSet", "ReplicationController"):
-                        ann = node.meta.annotations.get(PREFER_AVOID_PODS_ANNOTATION, "")
-                        avoided = ref.uid in [u.strip() for u in ann.split(",") if u.strip()]
-                    score += prefer_avoid_weight * (0 if avoided else 10)
-                if image_weight:
-                    total_mib = 0
-                    for img in node.status.images:
-                        if any(nm in images for nm in img.get("names", [])):
-                            total_mib += int(img.get("sizeBytes", 0)) // (2**20)
-                    if total_mib < _MIN_IMG_MIB:
-                        iscore = 0
-                    elif total_mib > _MAX_IMG_MIB:
-                        iscore = 10
-                    else:
-                        iscore = ((total_mib - _MIN_IMG_MIB) * 10) // (_MAX_IMG_MIB - _MIN_IMG_MIB)
-                    score += image_weight * iscore
-                static_score[g, j] = score
+            rows = (np.zeros(n_pad, dtype=bool), np.zeros(n_pad, dtype=np.int32),
+                    np.zeros(n_pad, dtype=np.int32), np.zeros(n_pad, dtype=np.int32))
+            _node_static_cols(rep, infos, all_js, is_best_effort, keyed_ref,
+                              images, prefer_avoid_weight, image_weight, *rows)
+            static_ok[g] = rows[0]
+            node_aff_raw[g] = rows[1]
+            taint_intol_raw[g] = rows[2]
+            static_score[g] = rows[3]
             if interaction_key is not None:
-                row_cache[interaction_key] = (
-                    static_ok[g].copy(), node_aff_raw[g].copy(),
-                    taint_intol_raw[g].copy(), static_score[g].copy())
+                if rows_cache is not None:
+                    # the cache owns the row arrays: dirty-column syncs
+                    # update them in place, later gets return them directly
+                    rows_cache.put(interaction_key, rep, is_best_effort,
+                                   keyed_ref, images, rows)
+                else:
+                    row_cache[interaction_key] = rows
 
         # inter-pod affinity interactions with EXISTING pods.  Phase-A batch
         # pods have no (anti)affinity terms of their own, but existing pods'
@@ -790,12 +991,27 @@ class Tensorizer:
         #    matching the incoming pod contribute interpod priority weight
         #    (interpod_affinity.go:160-186) -> interpod_raw.
         interpod_raw = np.zeros((G, n_pad), dtype=np.int32)
-        existing_with_affinity = [
-            (q, qinfo)
-            for qinfo in node_info_map.values()
-            for q in qinfo.pods_with_affinity
-        ]
-        if existing_with_affinity:
+        # Existing pods' (anti)affinity terms, grouped by scheduling
+        # signature: _pod_matches_term depends only on (candidate,
+        # owner namespace, term) — identical for every pod of a
+        # signature — so a template-stamped fleet collapses thousands of
+        # per-pod matcher calls per segment into one per (rep, group,
+        # term), with per-node instance COUNTS scaling the weights.
+        # Contributions are bitwise identical (weights are additive).
+        aff_groups: dict = {}  # sig -> [q_rep, {node_name|None: [qinfo, count]}]
+        for qinfo in node_info_map.values():
+            for q in qinfo.pods_with_affinity:
+                sig = pod_signature_key(q)
+                entry = aff_groups.get(sig)
+                if entry is None:
+                    entry = aff_groups[sig] = [q, {}]
+                nkey = qinfo.node.meta.name if qinfo.node is not None else None
+                loc = entry[1].get(nkey)
+                if loc is None:
+                    entry[1][nkey] = [qinfo, 1]
+                else:
+                    loc[1] += 1
+        if aff_groups:
             # (topology key, value) -> weight accumulations per signature
             for g, rep in enumerate(reps):
                 topo_weights: dict[tuple[str, str], int] = {}
@@ -809,40 +1025,67 @@ class Tensorizer:
                         return
                     topo_weights[(key, value)] = topo_weights.get((key, value), 0) + weight
 
-                for q, qinfo in existing_with_affinity:
-                    qaff = q.spec.affinity
-                    qnode = qinfo.node
+                for q_rep, locs in aff_groups.values():
+                    qaff = q_rep.spec.affinity
                     for term in qaff.pod_anti_affinity_required:
-                        if _pod_matches_term(rep, q, term):
-                            if qnode is not None and term.topology_key:
-                                value = qnode.meta.labels.get(term.topology_key)
-                                if value is not None:
-                                    forbidden.append((term.topology_key, value))
-                            else:
-                                forbidden.append(("", ""))  # malformed term: always blocks
+                        if _pod_matches_term(rep, q_rep, term):
+                            for qinfo, _cnt in locs.values():
+                                qnode = qinfo.node
+                                if qnode is not None and term.topology_key:
+                                    value = qnode.meta.labels.get(term.topology_key)
+                                    if value is not None:
+                                        forbidden.append((term.topology_key, value))
+                                else:
+                                    forbidden.append(("", ""))  # malformed term: always blocks
                     if pctx.hard_pod_affinity_weight > 0:
                         for term in qaff.pod_affinity_required:
-                            if _pod_matches_term(rep, q, term):
-                                _add(qnode, term.topology_key, pctx.hard_pod_affinity_weight)
+                            if _pod_matches_term(rep, q_rep, term):
+                                for qinfo, cnt in locs.values():
+                                    _add(qinfo.node, term.topology_key,
+                                         pctx.hard_pod_affinity_weight * cnt)
                     for wt in qaff.pod_affinity_preferred:
-                        if _pod_matches_term(rep, q, wt.term):
-                            _add(qnode, wt.term.topology_key, wt.weight)
+                        if _pod_matches_term(rep, q_rep, wt.term):
+                            for qinfo, cnt in locs.values():
+                                _add(qinfo.node, wt.term.topology_key,
+                                     wt.weight * cnt)
                     for wt in qaff.pod_anti_affinity_preferred:
-                        if _pod_matches_term(rep, q, wt.term):
-                            _add(qnode, wt.term.topology_key, -wt.weight)
+                        if _pod_matches_term(rep, q_rep, wt.term):
+                            for qinfo, cnt in locs.values():
+                                _add(qinfo.node, wt.term.topology_key,
+                                     -wt.weight * cnt)
 
                 if topo_weights or forbidden:
+                    # group by topology KEY before the node sweep: a node
+                    # matches at most one value per key, so the sweep is
+                    # one label get per key — the pairwise loop was
+                    # O(placed-owners x N) under required-anti-affinity
+                    # fan-out (one forbidden entry per placed owner) and
+                    # dominated steady-state build_static
+                    w_by_key: dict[str, dict[str, int]] = {}
+                    for (key, value), w in topo_weights.items():
+                        w_by_key.setdefault(key, {})[value] = w
+                    forb_by_key: dict[str, set] = {}
+                    always_block = False
+                    for key, value in forbidden:
+                        if not key:
+                            always_block = True  # malformed term: blocks all
+                        else:
+                            forb_by_key.setdefault(key, set()).add(value)
+                    if always_block:
+                        static_ok[g, :] = False
                     for j, info in enumerate(infos):
                         labels = info.node.meta.labels
                         total = 0
-                        for (key, value), w in topo_weights.items():
-                            if labels.get(key) == value:
+                        for key, vmap in w_by_key.items():
+                            w = vmap.get(labels.get(key))
+                            if w:
                                 total += w
                         interpod_raw[g, j] = total
-                        for key, value in forbidden:
-                            if not key or labels.get(key) == value:
-                                static_ok[g, j] = False
-                                break
+                        if static_ok[g, j]:
+                            for key, vals in forb_by_key.items():
+                                if labels.get(key) in vals:
+                                    static_ok[g, j] = False
+                                    break
 
         # -- phase B: the batch's own (anti)affinity terms ------------------
         # Flatten every term carried by a signature into one table; empty
@@ -873,7 +1116,7 @@ class Tensorizer:
             for wt in a.pod_anti_affinity_preferred:
                 if wt.term.topology_key:
                     terms.append(_AffinityTerm(g, "PAA", -wt.weight, wt.term))
-        T = _pad_to(len(terms), self.term_multiple)  # padded rows stay inert
+        T = self._bucket("terms", len(terms), self.term_multiple)  # padded rows stay inert
 
         term_matches_sig = np.zeros((T, G), dtype=bool)
         sym_w = np.zeros(T, dtype=np.int32)
@@ -972,7 +1215,9 @@ class Tensorizer:
         # is small and stable across random workload mixes — shape-bucket
         # stability is what lets one warm-up compile cover every segment.
         use_vols = bool(vol_vocab) or any_count_only
-        v_state = 8 if not vol_vocab else _pad_to(len(vol_vocab) + 1, self.vol_multiple)
+        v_state = self._sticky_pad(
+            "vols",
+            8 if not vol_vocab else _pad_to(len(vol_vocab) + 1, self.vol_multiple))
         pod_vol_count_only = pod_vol_valid & (pod_vol_ids < 0)
         pod_vol_ids[~pod_vol_valid | pod_vol_count_only] = v_state - 1  # sentinel row
         vol_limits = np.array([VOLUME_COUNT_LIMITS[k] for k in _VOL_KINDS], dtype=np.int32)
@@ -1031,7 +1276,7 @@ class Tensorizer:
         # -- bucket-pad the signature axis ----------------------------------
         # Padded rows are never referenced (group_of_pod < G) but keep the
         # compiled kernel's shapes stable across batches.
-        Gp = _pad_to(G, self.group_multiple)
+        Gp = self._bucket("groups", G, self.group_multiple)
         if Gp != G:
             pad_g = Gp - G
             static_ok = np.pad(static_ok, ((0, pad_g), (0, 0)))
@@ -1091,6 +1336,8 @@ class Tensorizer:
             pod_vol_count_only=pod_vol_count_only,
             use_vols=use_vols,
             vol_limits=vol_limits,
+            node_token=node_token,
+            node_dirty=node_dirty,
             weights={
                 "least": least_requested_weight,
                 "most": most_requested_weight,

@@ -65,12 +65,10 @@ def _device_platform() -> str:
 
 # The oracle priorities the kernel scoring path reproduces bit-for-bit —
 # a configured priority outside this table forces the all-oracle path
-# (_config_supported), so this dict IS the kernel-coverage claim.
-# kernel: implements LeastRequestedPriority, MostRequestedPriority
-# kernel: implements BalancedResourceAllocation, SelectorSpreadPriority
-# kernel: implements NodeAffinityPriority, TaintTolerationPriority
-# kernel: implements InterPodAffinityPriority, NodePreferAvoidPodsPriority
-# kernel: implements ImageLocalityPriority
+# (_config_supported), so this dict IS the kernel-coverage claim.  The
+# parity-pass `kernel: implements` markers for these live in
+# _kernel_weights, the function that consumes this table — the analyzer
+# only counts markers inside functions the kernel call graph reaches.
 _PRIORITY_WEIGHT_KEY = {
     LeastRequestedPriority: "least",
     MostRequestedPriority: "most",
@@ -146,11 +144,23 @@ class TPUBatchBackend:
         # drops from O(cluster) to O(touched nodes) per wave
         self._host_state = None
         self.reuse_host_state = True
+        # device-resident node-axis tensors, reused across segments and
+        # waves via the tensorizer's (epoch, version) node tokens
+        from .batch_kernel import DeviceNodeCache
+
+        self.device_node_cache = DeviceNodeCache()
         self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0,
                       "pallas_segments": 0, "pallas_fallbacks": 0,
                       "interpret_fallbacks": 0, "oracle_segments": 0,
                       "breaker_transitions": 0,
-                      "host_state_rebuilds": 0, "host_state_reconciles": 0}
+                      "host_state_rebuilds": 0, "host_state_reconciles": 0,
+                      "host_state_dirty_nodes": 0,
+                      # steady-state phase timers (seconds, cumulative):
+                      # host tensorize, device dispatch, device wait
+                      # (finalize block) — bench deltas these per wave
+                      "tensorize_s": 0.0, "dispatch_s": 0.0,
+                      "device_wait_s": 0.0}
+        self._clock_wall = time.perf_counter
 
     def _on_breaker_transition(self, kind: str, key: tuple, frm: int,
                                to: int) -> None:
@@ -268,6 +278,11 @@ class TPUBatchBackend:
     def _kernel_weights(self) -> Optional[dict]:
         """Map the oracle's priority config onto kernel weights; None if any
         configured plugin has no kernel implementation."""
+        # kernel: implements LeastRequestedPriority, MostRequestedPriority
+        # kernel: implements BalancedResourceAllocation, SelectorSpreadPriority
+        # kernel: implements NodeAffinityPriority, TaintTolerationPriority
+        # kernel: implements InterPodAffinityPriority, NodePreferAvoidPodsPriority
+        # kernel: implements ImageLocalityPriority
         weights = {
             "least": 0,
             "most": 0,
@@ -303,6 +318,7 @@ class TPUBatchBackend:
         node_info_map: dict[str, NodeInfo],
         pctx: PriorityContext,
         on_segment=None,
+        on_idle=None,
     ) -> list[Optional[str]]:
         """``on_segment`` (optional): called with ``[(pod, node_name|None,
         req_vec|None, nz_vec|None), ...]`` per completed segment, AFTER the
@@ -314,7 +330,18 @@ class TPUBatchBackend:
         cache assume can skip its per-pod quantity parse; oracle-path
         entries carry ``None``.  Entry order across calls equals pod
         order, so sequential semantics are unchanged; with
-        ``on_segment=None`` behavior is exactly the unpipelined batch."""
+        ``on_segment=None`` behavior is exactly the unpipelined batch.
+
+        ``on_idle`` (optional): called ONCE as ``on_idle(device_busy=fn)``
+        after the batch's final kernel segment has been dispatched and
+        every earlier segment committed — the point where the host would
+        otherwise sit blocked in finalize while the device still
+        executes.  ``device_busy`` (or None when the dispatch exposes no
+        readiness probe) polls the in-flight result, so the callback can
+        fill the WHOLE device window with the next wave's ingest
+        (informer pump, signature warming), extending the per-segment
+        commit overlap across wave boundaries.  Must not mutate the
+        snapshot this batch was tensorized from."""
         weights = self._config_supported()
         # working state: clones so neither the scheduler's CoW snapshot nor
         # the cache sees our speculative assumptions
@@ -352,6 +379,8 @@ class TPUBatchBackend:
             else:
                 self._host_state.reconcile(work_map)
                 self.stats["host_state_reconciles"] += 1
+                self.stats["host_state_dirty_nodes"] += len(
+                    self._host_state.last_dirty)
             host_state = self._host_state
         mounted_disks = host_state.mounted_disks if host_state is not None else set()
 
@@ -400,6 +429,7 @@ class TPUBatchBackend:
             returns the segment's commit entries.  Returns None when the
             segment needs the sync split path (budget reject)."""
             seg_pods = [p for _, p in segment]
+            t_tensorize = self._clock_wall()
             static = self.tensorizer.build_static(
                 seg_pods,
                 work_map,
@@ -416,11 +446,13 @@ class TPUBatchBackend:
                 mounted_disks=mounted_disks,
             )
             if static is None:
+                self.stats["tensorize_s"] += self._clock_wall() - t_tensorize
                 return None
             init = self.tensorizer.initial_state(
                 static, work_map, work_pctx, seg_pods,
                 round_robin=self.algorithm._round_robin, host_state=host_state,
             )
+            self.stats["tensorize_s"] += self._clock_wall() - t_tensorize
             from .pallas_kernel import shape_key
 
             key = shape_key(static)
@@ -430,6 +462,7 @@ class TPUBatchBackend:
             # a better rung once a tripped shape's cool-down elapses
             level = self.breaker.plan_level(key, floor=floor)
             fut = None
+            t_dispatch = self._clock_wall()
             if level == 0:
                 from .pallas_kernel import dispatch_batch_pallas
 
@@ -449,13 +482,21 @@ class TPUBatchBackend:
 
                 try:
                     faults.hit("backend.pallas.segment", impl="interpret")
-                    fut = dispatch_batch_arrays(static, init)
+                    fut = dispatch_batch_arrays(
+                        static, init, node_cache=self.device_node_cache)
                 except Exception:
                     logger.exception(
                         "XLA scan dispatch failed; the oracle serves this "
                         "segment")
                     self._note_interpret_failure(static)
                     level = 2
+            self.stats["dispatch_s"] += self._clock_wall() - t_dispatch
+
+            device_probe = None
+            if fut is not None:
+                cand = fut[0] if isinstance(fut, (tuple, list)) and fut else fut
+                if hasattr(cand, "is_ready"):
+                    device_probe = cand
 
             def run_segment_oracle() -> list:
                 # the ladder's floor: sequential per-pod oracle — slow,
@@ -470,6 +511,7 @@ class TPUBatchBackend:
 
             def finish() -> list:
                 nonlocal level
+                t_wait = self._clock_wall()
                 if level == 0:
                     from .pallas_kernel import finalize_batch_pallas
 
@@ -502,6 +544,7 @@ class TPUBatchBackend:
                             "XLA scan failed; the oracle serves this segment")
                         self._note_interpret_failure(static)
                         return run_segment_oracle()
+                self.stats["device_wait_s"] += self._clock_wall() - t_wait
                 self.algorithm._round_robin = final_rr
                 req_vecs, nz_vecs = _segment_vecs(static)
                 group_of_pod = static.group_of_pod
@@ -517,6 +560,7 @@ class TPUBatchBackend:
                 self.stats["segments"] += 1
                 return entries
 
+            finish.device_probe = device_probe
             return finish
 
         # Phase B: every pod is kernel-expressible (inter-pod affinity and
@@ -540,7 +584,8 @@ class TPUBatchBackend:
             pending = []
 
         try:
-            for kind, segment in self._segments(pods, mounted_disks=mounted_disks):
+            segments = self._segments(pods, mounted_disks=mounted_disks)
+            for si, (kind, segment) in enumerate(segments):
                 if kind == "oracle":
                     for i, pod in segment:
                         run_oracle(pod, i)
@@ -556,6 +601,14 @@ class TPUBatchBackend:
                 # the device is executing THIS segment: commit everything
                 # earlier on host in the shadow of the scan
                 flush_pending()
+                if on_idle is not None and si == len(segments) - 1:
+                    # final segment in flight, nothing left to commit:
+                    # hand the device's shadow to the caller's cross-wave
+                    # prep instead of blocking straight into finalize
+                    probe = getattr(finish, "device_probe", None)
+                    on_idle(device_busy=(
+                        (lambda p=probe: not p.is_ready())
+                        if probe is not None else None))
                 pending = finish()
             flush_pending()
         except BaseException:

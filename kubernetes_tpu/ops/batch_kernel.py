@@ -84,12 +84,106 @@ class StaticArrays(NamedTuple):
     vol_limits: jnp.ndarray  # [K] int32
 
 
-def to_device(static: BatchStatic) -> StaticArrays:
+class DeviceNodeCache:
+    """Device-resident node-axis static tensors, kept across segments and
+    waves.
+
+    ``BatchStatic.node_token`` — (instance nonce, epoch, version) stamped
+    by the tensorizer's ``NodeStaticRows`` — names the node-axis state
+    the host arrays were built from; the nonce keeps tokens from a
+    swapped-in tensorizer (fresh epoch counter) from aliasing a stale
+    cache.  Same token → the previous device
+    buffers are reused with NO host→device transfer (every segment of a
+    wave, and every wave against an unchanged fleet: the arrays are pure
+    functions of the node objects, which the token versions).  On a new
+    token the incremental path diffs each HOST array against the cached
+    host copy and writes only the changed columns (``.at[js].set``) —
+    diffing the arrays themselves, not trusting the dirty-node list,
+    because a single node change can move OTHER columns' values (e.g. a
+    zone relabel shifts the first-occurrence zone_vocab ids of every
+    node).  Bulk changes fall back to a full upload — always correct,
+    just not incremental."""
+
+    FIELDS = ("node_exists", "node_alloc", "node_alloc_pods", "node_zone")
+
+    def __init__(self):
+        self._token = None
+        self._arrays = None
+        self._host = None  # host-side copies backing the device arrays
+        self.stats = {"reuses": 0, "col_updates": 0, "uploads": 0,
+                      "dirty_cols": 0, "cols_total": 0}
+
+    def _upload(self, static: BatchStatic) -> tuple:
+        return tuple(jnp.asarray(getattr(static, f)) for f in self.FIELDS)
+
+    @staticmethod
+    def _changed_cols(new: np.ndarray, old: np.ndarray):
+        diff = new != old
+        if diff.ndim > 1:
+            diff = diff.any(axis=tuple(range(1, diff.ndim)))
+        return np.nonzero(diff)[0]
+
+    def node_arrays(self, static: BatchStatic) -> tuple:
+        tok = static.node_token
+        n = len(static.node_exists)
+        if tok is None:
+            # cache bypassed (no persistent rows): a full upload every
+            # call — counted as all-dirty so the upload-fraction metric
+            # reads 1.0, not a spurious "fully resident"
+            self.stats["uploads"] += 1
+            self.stats["dirty_cols"] += n
+            self.stats["cols_total"] += n
+            return self._upload(static)
+        self.stats["cols_total"] += n
+        if self._token == tok and self._arrays is not None:
+            self.stats["reuses"] += 1
+            return self._arrays
+        host = tuple(np.array(getattr(static, f)) for f in self.FIELDS)
+        incremental = (
+            self._arrays is not None and self._host is not None
+            and self._token is not None and self._token[0] == tok[0]
+            and all(h.shape == o.shape for h, o in zip(host, self._host)))
+        if incremental:
+            arrays = []
+            dirty_total = 0
+            for new_h, old_h, arr in zip(host, self._host, self._arrays):
+                js = self._changed_cols(new_h, old_h)
+                dirty_total += len(js)
+                if len(js) == 0:
+                    arrays.append(arr)
+                elif len(js) <= max(1, n // 8):
+                    jdev = jnp.asarray(js.astype(np.int32))
+                    arrays.append(arr.at[jdev].set(jnp.asarray(new_h[js])))
+                else:
+                    arrays.append(jnp.asarray(new_h))
+            arrays = tuple(arrays)
+            self.stats["col_updates"] += 1
+            self.stats["dirty_cols"] += dirty_total
+        else:
+            arrays = self._upload(static)
+            self.stats["uploads"] += 1
+            self.stats["dirty_cols"] += n
+        self._token = tok
+        self._arrays = arrays
+        self._host = host
+        return arrays
+
+
+def to_device(static: BatchStatic,
+              node_cache: "DeviceNodeCache | None" = None) -> StaticArrays:
+    if node_cache is not None:
+        node_exists, node_alloc, node_alloc_pods, node_zone = (
+            node_cache.node_arrays(static))
+    else:
+        node_exists = jnp.asarray(static.node_exists)
+        node_alloc = jnp.asarray(static.node_alloc)
+        node_alloc_pods = jnp.asarray(static.node_alloc_pods)
+        node_zone = jnp.asarray(static.node_zone)
     return StaticArrays(
-        node_exists=jnp.asarray(static.node_exists),
-        node_alloc=jnp.asarray(static.node_alloc),
-        node_alloc_pods=jnp.asarray(static.node_alloc_pods),
-        node_zone=jnp.asarray(static.node_zone),
+        node_exists=node_exists,
+        node_alloc=node_alloc,
+        node_alloc_pods=node_alloc_pods,
+        node_zone=node_zone,
         static_ok=jnp.asarray(static.static_ok),
         node_aff_raw=jnp.asarray(static.node_aff_raw),
         taint_intol_raw=jnp.asarray(static.taint_intol_raw),
@@ -171,20 +265,41 @@ def state_to_device(init: InitialState) -> ScanState:
 # -- fixed-point scoring pieces (must mirror scheduler/priorities.py) -------
 
 
+def _idiv(a, b):
+    """int32 floor division, bit-identical to ``a // b`` on every lane the
+    scoring formulas SELECT, computed as an f32 division plus a one-step
+    integer fixup — variable-divisor int32 division has no SIMD lowering
+    on CPU and scalarized into the single most expensive scoring op.
+
+    Exactness: every selected lane of every caller has divisor 1 <= b <=
+    2^24 (node capacities, normalization maxima) and true quotient |q| <=
+    MAX_PRIORITY * FIXED_POINT_ONE = 10 * 1024 = 10240 < 2^23 (any
+    quotient below 2^23 keeps the argument; the current scale has 64x
+    headroom), so the f32 estimate (one input rounding of a, one
+    correctly-rounded divide; b exact) is within |q| * 2^-22 < 1 of q —
+    its floor is off by at most one, and the remainder fixup lands
+    exactly on floor(a / b).  Masked-out lanes (infeasible nodes, guard
+    branches of jnp.where) may hold garbage either way; they are never
+    selected."""
+    q0 = jnp.floor(a.astype(jnp.float32) / b.astype(jnp.float32)).astype(jnp.int32)
+    r = a - q0 * b
+    return q0 - (r < 0).astype(jnp.int32) + (r >= b).astype(jnp.int32)
+
+
 def _usage_score(requested, capacity, most: bool):
     """least/most-requested per-resource score with the reference's guards
     (capacity==0 -> 0, requested > capacity -> 0)."""
     safe_cap = jnp.maximum(capacity, 1)
     if most:
-        raw = (requested * MAX_PRIORITY) // safe_cap
+        raw = _idiv(requested * MAX_PRIORITY, safe_cap)
     else:
-        raw = ((capacity - requested) * MAX_PRIORITY) // safe_cap
+        raw = _idiv((capacity - requested) * MAX_PRIORITY, safe_cap)
     return jnp.where((capacity == 0) | (requested > capacity), 0, raw)
 
 
 def _balanced_score(cpu_req, cpu_cap, mem_req, mem_cap):
-    f_cpu = (cpu_req * FIXED_POINT_ONE) // jnp.maximum(cpu_cap, 1)
-    f_mem = (mem_req * FIXED_POINT_ONE) // jnp.maximum(mem_cap, 1)
+    f_cpu = _idiv(cpu_req * FIXED_POINT_ONE, jnp.maximum(cpu_cap, 1))
+    f_mem = _idiv(mem_req * FIXED_POINT_ONE, jnp.maximum(mem_cap, 1))
     diff = jnp.abs(f_cpu - f_mem)
     score = (MAX_PRIORITY * FIXED_POINT_ONE - diff * MAX_PRIORITY) // FIXED_POINT_ONE
     bad = (cpu_cap == 0) | (mem_cap == 0) | (cpu_req >= cpu_cap) | (mem_req >= mem_cap)
@@ -197,9 +312,9 @@ def _normalized_max(raw, feasible, reverse: bool):
     max_c = jnp.max(jnp.where(feasible, raw, 0))
     if reverse:
         return jnp.where(
-            max_c > 0, (MAX_PRIORITY * (max_c - raw)) // jnp.maximum(max_c, 1), MAX_PRIORITY
+            max_c > 0, _idiv(MAX_PRIORITY * (max_c - raw), jnp.maximum(max_c, 1)), MAX_PRIORITY
         )
-    return jnp.where(max_c > 0, (MAX_PRIORITY * raw) // jnp.maximum(max_c, 1), 0)
+    return jnp.where(max_c > 0, _idiv(MAX_PRIORITY * raw, jnp.maximum(max_c, 1)), 0)
 
 
 def make_step(
@@ -211,6 +326,19 @@ def make_step(
     runner key): segments whose batch carries no (anti)affinity terms or no
     direct-disk volumes skip those blocks entirely instead of paying the
     gather/scatter cost on inert state every step."""
+
+    # Zone membership as a [Z, N] one-hot contraction matrix, hoisted out
+    # of the step (scan treats closed-over values as loop constants): the
+    # per-step `.at[zone_idx].add` scatter plus `zsum[zone_idx]` gather
+    # scalarize on CPU and were the single most expensive ops of the plain
+    # step (~300us/pod at N=5120); the matvec form is SIMD-friendly and
+    # bit-identical (int32 adds in a different association order — exact).
+    has_zone = dev.node_zone >= 0
+    zone_idx = jnp.where(has_zone, dev.node_zone, 0)
+    zone_onehot = (
+        (jnp.arange(num_zones, dtype=jnp.int32)[:, None] == zone_idx[None, :])
+        & has_zone[None, :]
+    ).astype(jnp.int32)  # [Z, N]
 
     def step(state: ScanState, xs):
         # per-pod inputs: signature id, validity (False = scan-length
@@ -293,22 +421,17 @@ def make_step(
             max_n = jnp.max(jnp.where(feasible, cnt, 0))
             node_fp = jnp.where(
                 max_n > 0,
-                ((max_n - cnt) * (MAX_PRIORITY * FIXED_POINT_ONE)) // jnp.maximum(max_n, 1),
+                _idiv((max_n - cnt) * (MAX_PRIORITY * FIXED_POINT_ONE), jnp.maximum(max_n, 1)),
                 MAX_PRIORITY * FIXED_POINT_ONE,
             )
             # zone blend: counts aggregated over feasible nodes per zone
-            has_zone = dev.node_zone >= 0
-            zone_idx = jnp.where(has_zone, dev.node_zone, 0)
-            zsum = (
-                jnp.zeros(num_zones, dtype=jnp.int32)
-                .at[zone_idx]
-                .add(jnp.where(feasible & has_zone, cnt, 0))
-            )
+            # (one-hot matvec, not scatter/gather — see zone_onehot above)
+            zsum = zone_onehot @ jnp.where(feasible & has_zone, cnt, 0)  # [Z]
             max_z = jnp.max(zsum)
-            zcnt = zsum[zone_idx]
+            zcnt = zsum @ zone_onehot  # [N]: zsum[zone_idx] without the gather
             zone_fp = jnp.where(
                 max_z > 0,
-                ((max_z - zcnt) * (MAX_PRIORITY * FIXED_POINT_ONE)) // jnp.maximum(max_z, 1),
+                _idiv((max_z - zcnt) * (MAX_PRIORITY * FIXED_POINT_ONE), jnp.maximum(max_z, 1)),
                 MAX_PRIORITY * FIXED_POINT_ONE,
             )
             have_zones = dev.g_has_spread[gid] & jnp.any(feasible & has_zone)
@@ -333,7 +456,7 @@ def make_step(
             max_c = jnp.maximum(0, jnp.max(jnp.where(feasible, raw, INT32_MIN)))
             min_c = jnp.minimum(0, jnp.min(jnp.where(feasible, raw, INT32_MAX)))
             rng = max_c - min_c
-            s = jnp.where(rng > 0, (MAX_PRIORITY * (raw - min_c)) // jnp.maximum(rng, 1), 0)
+            s = jnp.where(rng > 0, _idiv(MAX_PRIORITY * (raw - min_c), jnp.maximum(rng, 1)), 0)
             total = total + w["interpod"] * s
 
         # -- selection (selectHost) -----------------------------------
@@ -440,12 +563,13 @@ def _runner_for(static: BatchStatic):
     )
 
 
-def dispatch_batch_arrays(static: BatchStatic, init: InitialState):
+def dispatch_batch_arrays(static: BatchStatic, init: InitialState,
+                          node_cache: "DeviceNodeCache | None" = None):
     """Async half: dispatch the scan and return the UNMATERIALIZED jax
     arrays (futures).  The caller may run host work while the device
     executes, then block via ``finalize_batch_arrays`` — the overlap seam
     the pipelined backend commits previous-segment bindings in."""
-    dev = to_device(static)
+    dev = to_device(static, node_cache=node_cache)
     state = state_to_device(init)
     xs = batch_xs(static)
     run = _runner_for(static)

@@ -102,12 +102,18 @@ def main(argv=None) -> int:
         logging.info("scheduler running (backend=%s)", args.backend)
         while not payload_stop.is_set():
             if backend is not None:
-                # batch mode: coalesce, then schedule the whole queue
-                payload_stop.wait(args.batch_interval)
-                if len(sched.queue):
-                    bound, failed = sched.schedule_pending_batch()
-                    if bound or failed:
-                        logging.info("batch: %d bound, %d failed", bound, failed)
+                # continuous service mode: drain as pods arrive under the
+                # min-batch/max-wait policy (batch_interval caps the
+                # accumulation window); returns when payload_stop is set
+                bound = sched.run_batch_loop(
+                    # one full kernel segment ends the accumulation early;
+                    # otherwise the window is batch_interval, matching the
+                    # old fixed-interval coalescing
+                    min_batch=backend.max_segment_pods,
+                    max_wait=args.batch_interval, stop=payload_stop,
+                    poll_interval=min(0.05, args.batch_interval))
+                if bound:
+                    logging.info("batch loop: %d bound", bound)
             else:
                 if not sched.schedule_one(timeout=0.2, async_bind=True):
                     continue

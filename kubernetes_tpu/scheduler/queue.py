@@ -133,6 +133,25 @@ class SchedulingQueue:
                     out.append(pod)
         return out
 
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until a key is ready, the queue closes, or the timeout
+        elapses — without consuming anything.  The batch loop's
+        accumulation primitive (a ready key may still be a phantom; the
+        loop's drain skips those as usual)."""
+        return self._wq.wait_ready(timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._wq.is_shutdown()
+
+    def snapshot_pending(self) -> list[api.Pod]:
+        """The live pod objects currently known to the queue (ready or
+        delayed), without consuming anything — the overlapped-prep path
+        warms per-pod memos (signature/content keys) on these while the
+        device executes the current wave."""
+        with self._mu:
+            return list(self._pods.values())
+
     def __len__(self) -> int:
         with self._mu:
             live = set(self._pods)

@@ -224,6 +224,33 @@ class SchedulerMetrics:
             "scheduler_bind_requeues_total",
             "pods requeued with backoff after a transient bind failure",
         ))
+        # steady-state pipeline (run_batch_loop / overlapped ingest)
+        self.batch_queue_wait = r.register(Histogram(
+            "scheduler_batch_queue_wait_microseconds",
+            "time from the first ready pod to the wave's drain (the "
+            "min-batch/max-wait accumulation window)",
+        ))
+        self.pipeline_prep_latency = r.register(Histogram(
+            "scheduler_pipeline_prep_microseconds",
+            "host prep (pump + signature warming) run inside the device's "
+            "shadow between the final dispatch and its finalize",
+        ))
+        self.pipeline_device_wait = r.register(Histogram(
+            "scheduler_pipeline_device_wait_microseconds",
+            "device time left after the overlapped prep returned — the "
+            "unfilled overlap headroom of the wave",
+        ))
+        self.pipeline_prep_failures = r.register(Counter(
+            "scheduler_pipeline_prep_failures_total",
+            "overlapped-prep runs that raised; the work is deferred to the "
+            "next wave's synchronous path (no decisions are affected)",
+        ))
+        self.tensorize_upload_fraction = r.register(Histogram(
+            "scheduler_tensorize_upload_fraction",
+            "fraction of node-axis columns re-uploaded to device per wave "
+            "(0 = fully cache-resident, 1 = full upload)",
+            buckets=[i / 20 for i in range(21)],
+        ))
         # preemption (the PostFilter phase)
         self.preemption_attempts = r.register(Counter(
             "scheduler_preemption_attempts_total"))

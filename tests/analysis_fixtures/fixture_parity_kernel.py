@@ -1,5 +1,8 @@
 """Seeded parity-coverage violations (kernel side).  Never imported."""
 
+# PC206: a free-floating module-level marker — next to no code at all.
+# kernel: implements CheckFloating
+
 
 def fixture_step(state, xs):
     # kernel: implements CheckAlpha, MappedPriority
@@ -7,3 +10,31 @@ def fixture_step(state, xs):
     # PC203: the marker below names an entity the oracle never registered
     # kernel: implements CheckRenamedAway
     return state, xs
+
+
+def fixture_entry(state):
+    """Public entry point: the call graph must follow the private chain."""
+    return _chained_helper(state)
+
+
+def _chained_helper(state):
+    # counted: reachable from fixture_entry through a private call
+    # kernel: implements CheckChained
+    return state
+
+
+def _dead_helper(state):
+    # PC206: no public kernel entry point reaches this function
+    # kernel: implements CheckDead
+    return state
+
+
+class _FixtureKernelClass:
+    def __init__(self):
+        # counted: instantiating the class (below) runs the constructor
+        # kernel: implements CheckCtor
+        self.state = None
+
+
+def fixture_uses_class(state):
+    return _FixtureKernelClass()

@@ -69,6 +69,53 @@ def test_hit_is_noop_when_disarmed():
     assert faults.hit("store.commit", op="create") is None
 
 
+def test_fault_counters_exact_under_concurrency():
+    """ROADMAP "Fault-point thread counters" (ISSUE 3 satellite): hits,
+    seen, fires, and fired must be EXACT when watch threads and the main
+    thread hammer an armed point concurrently — the nth/first_n triggers
+    and the coverage gate read these."""
+    import threading
+
+    plan = FaultPlan(seed=1).on("informer.deliver", mode="drop",
+                                probability=0.5)
+    n_threads, per = 8, 400
+    with plan.armed():
+        def worker():
+            for _ in range(per):
+                faults.hit("informer.deliver", kind="Pod", type="ADDED")
+
+        ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    total = n_threads * per
+    assert plan.hits["informer.deliver"] == total
+    spec = plan._specs["informer.deliver"][0]
+    assert spec.seen == total
+    assert spec.fires == plan.fired["informer.deliver"]
+    assert 0 < spec.fires < total  # the seeded coin actually flipped both ways
+
+
+def test_first_n_exact_under_concurrency():
+    """first_n must fire exactly n times no matter how many threads race
+    the trigger window."""
+    import threading
+
+    plan = FaultPlan(seed=2).on("informer.deliver", mode="drop", first_n=7)
+    with plan.armed():
+        def worker():
+            for _ in range(300):
+                faults.hit("informer.deliver")
+
+        ts = [threading.Thread(target=worker) for _ in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert plan.fired["informer.deliver"] == 7
+
+
 def test_unknown_point_rejected_on_plan_and_on_hit():
     with pytest.raises(FaultConfigError):
         FaultPlan().on("store.comit", mode="error")  # typo
@@ -751,6 +798,15 @@ MATRIX = {
         check=lambda w, plan: (
             w.backend.stats["interpret_fallbacks"] > 0
             and w.backend.stats["oracle_segments"] > 0)),
+    # the overlapped cross-wave prep dies mid-wave: the wave completes,
+    # prep work re-runs synchronously next wave — decisions are already
+    # fixed at dispatch time, so the pod→node map matches the oracle
+    # exactly and recovery is visible only in the failure counter
+    "scheduler.pipeline.prep": dict(
+        spec=dict(mode="error", first_n=1),
+        world="local", exact=True,
+        check=lambda w, plan: (
+            w.sched.metrics.pipeline_prep_failures.value > 0)),
     "store.wal.append": dict(world="wal"),  # special-cased crash/recover run
     "remote.request": dict(
         spec=dict(mode="error", first_n=2,

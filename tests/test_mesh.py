@@ -128,11 +128,15 @@ def test_collective_structure_gate_rejects_state_allgather():
     from kubernetes_tpu.parallel import assert_collective_structure
 
     static, _ = _build(22, 32, 32)
+    # a full state plane: the gate's limit keys off the LARGEST of the
+    # [G, N] / [T, N] planes, so size the synthetic gather accordingly
+    # (term padding is tight now — [T, N] alone can be under the limit)
+    g = int(static.static_ok.shape[0])
     t = int(static.term_matches_sig.shape[0])
     n = int(static.n_pad)
     bad_hlo = (
         "ENTRY %main {\n"
-        f"  %ag = s32[{max(t, 2)},{n}]{{1,0}} all-gather(%x), dimensions={{1}}\n"
+        f"  %ag = s32[{max(g, t, 2)},{n}]{{1,0}} all-gather(%x), dimensions={{1}}\n"
         "}\n")
     with pytest.raises(AssertionError, match="all-gathers node-axis state"):
         assert_collective_structure(bad_hlo, static)

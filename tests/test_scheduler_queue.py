@@ -211,3 +211,106 @@ def test_close_unblocks_pop():
     t.join(timeout=5)
     assert not t.is_alive()
     assert out == [None]
+
+
+# -- concurrent use: drain/add racing an arrival thread ----------------------
+
+
+def test_drain_races_arrival_thread():
+    """The batch loop's shape: an arrival thread feeds the queue while
+    the scheduler thread drains batches.  Every pod must come out exactly
+    once, in per-thread FIFO order, with nothing lost or duplicated."""
+    q = SchedulingQueue()  # real clock: genuine lock interleaving
+    n = 800
+    done = threading.Event()
+
+    def arrivals():
+        for i in range(n):
+            q.add(make_pod(f"p{i:04d}"))
+        done.set()
+
+    t = threading.Thread(target=arrivals, daemon=True)
+    t.start()
+    got: list[str] = []
+    while not (done.is_set() and len(q) == 0):
+        got.extend(p.meta.name for p in q.drain())
+    got.extend(p.meta.name for p in q.drain())
+    t.join(timeout=5)
+    assert len(got) == n, f"lost/duplicated pods: {len(got)} != {n}"
+    assert got == sorted(got)  # single producer: FIFO order survives drains
+    assert len(set(got)) == n
+
+
+def test_backoff_requeue_lands_mid_drain():
+    """A failed pod re-added (backoff-requeue path) by another thread
+    while the scheduler is mid-drain must surface in a later drain —
+    exactly once, never swallowed by the dirty/processing bookkeeping."""
+    q = SchedulingQueue()
+    backoff = PodBackoff(initial=0.0)
+    for i in range(50):
+        q.add(make_pod(f"p{i:03d}"))
+    failed = q.drain(max_n=10)  # scheduler popped a batch; one pod fails
+    loser = failed[0]
+    requeued = threading.Event()
+
+    def requeue():
+        q.add_after(loser, backoff.get_backoff(loser.meta.key))  # 0.0: ready now
+        requeued.set()
+
+    t = threading.Thread(target=requeue, daemon=True)
+    t.start()
+    seen: list[str] = []
+    deadline = 50  # drains, not seconds: the re-add is near-instant
+    for _ in range(deadline):
+        seen.extend(p.meta.name for p in q.drain())
+        if requeued.is_set() and loser.meta.name in seen:
+            break
+    t.join(timeout=5)
+    assert seen.count(loser.meta.name) == 1
+    assert len(seen) == 41  # the 40 never-popped pods + the requeue
+    assert len(q) == 0
+
+
+def test_wait_ready_blocks_then_sees_add():
+    q = SchedulingQueue()
+    out = []
+    t = threading.Thread(target=lambda: out.append(q.wait_ready(timeout=5)),
+                         daemon=True)
+    t.start()
+    q.add(make_pod("wake"))
+    t.join(timeout=5)
+    assert out == [True]
+    assert q.wait_ready(timeout=0) is True  # non-consuming: still ready
+
+
+def test_wait_ready_timeout_and_close():
+    q = SchedulingQueue()
+    assert q.wait_ready(timeout=0.01) is False  # nothing ever arrives
+    out = []
+    t = threading.Thread(target=lambda: out.append(q.wait_ready(timeout=5)),
+                         daemon=True)
+    t.start()
+    q.close()
+    t.join(timeout=5)
+    assert out == [False]
+    assert q.closed
+
+
+def test_close_unblocks_batch_loop():
+    """queue.close() must end Scheduler.run_batch_loop even while it sits
+    in the accumulation wait (the continuous-service shutdown path)."""
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.store import Store
+
+    sched = Scheduler(Clientset(Store()), emit_events=False)
+    sched.start()
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(sched.run_batch_loop(min_batch=10**6)),
+        daemon=True)
+    t.start()
+    sched.queue.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "run_batch_loop did not exit on queue.close()"
+    assert out == [0]

@@ -164,13 +164,32 @@ def test_parity_fixture_codes_and_locations(parity_findings):
         ("PC205", "fallback.CheckUnjustified"): (
             oracle, _fixture_line(oracle, '"CheckUnjustified"'),
         ),
+        # reachability (ISSUE 3 satellite): ignored markers are reported
+        # AND their entities revert to unmapped
+        ("PC206", "marker.CheckFloating"): (
+            kernel, _fixture_line(kernel, "implements CheckFloating"),
+        ),
+        ("PC206", "marker.CheckDead"): (
+            kernel, _fixture_line(kernel, "implements CheckDead"),
+        ),
+        ("PC201", "unmapped.CheckFloating"): (
+            oracle, _fixture_line(oracle, '"CheckFloating"'),
+        ),
+        ("PC201", "unmapped.CheckDead"): (
+            oracle, _fixture_line(oracle, '"CheckDead"'),
+        ),
     }
     assert got == expected
 
 
 def test_parity_fixture_mapped_entities_stay_clean(parity_findings):
     symbols = {f.symbol for f in parity_findings}
-    for clean in ("CheckAlpha", "MappedPriority", "CheckGamma"):
+    # CheckChained's marker sits in a PRIVATE helper reachable only
+    # through the public fixture_entry; CheckCtor's sits in the __init__
+    # of a private class the public entry instantiates — the call graph
+    # must count both
+    for clean in ("CheckAlpha", "MappedPriority", "CheckGamma", "CheckChained",
+                  "CheckCtor"):
         assert not any(clean in s for s in symbols), sorted(symbols)
 
 
