@@ -320,16 +320,70 @@ def run_parity(backend_res: dict, n_nodes: int, n_pods: int, workload: str, seed
 
 CHURN_SLO_P99_MS = 5_000.0  # reference pod-startup SLO (metrics_util.go:46)
 # regression floor for the NORTH-scale churn preset (5k nodes).  ISSUE 3's
-# pipeline doubled same-box churn (BENCH_AB_churn_pipeline.json: old
-# 629.3 -> new 1282.1 pods/s medians, 4/4 pairs both orders, 1-core CPU
-# host); 900 sits ~30% under the measured new floor and ~43% ABOVE the
-# pre-pipeline code, so a regression to the old path fails the gate.
-CHURN_FLOOR_PODS_PER_SEC = 900.0
+# pipeline reached ~1282 pods/s; ISSUE 4's zero-copy ingest lifted the
+# same-box medians to 1434.7 pods/s (BENCH_AB_pump_ingest.json: old
+# 1271.0 -> new 1434.7, 4/4 interleaved pairs both orders, worktree
+# method, per-wave oracle parity exact on both arms).  1000 sits ~30%
+# under the demonstrated new level and ~59% ABOVE the pre-pipeline code,
+# so a regression to either old path fails the gate.
+CHURN_FLOOR_PODS_PER_SEC = 1_000.0
+
+
+def _oracle_replay_waves(drain_batches: list, final_assignments: dict,
+                         n_nodes: int, total_pods: int, workload: str,
+                         seed: int) -> dict:
+    """Off-clock per-wave oracle parity for a churn run: replay the
+    RECORDED drain batches, in drain order, through the per-pod CPU
+    oracle on an identically seeded cluster, and compare each wave's
+    bindings against the timed run's final map.  Exact by prefix-closure
+    (sequential-greedy: pod i's placement depends only on the initial
+    cluster and the pods scheduled before it) as long as no key was
+    drained twice — a requeue re-decides under different queue state, so
+    the exact replay degrades honestly to 'skipped'."""
+    flat = [k for b in drain_batches for k in b]
+    if len(set(flat)) != len(flat):
+        return {"mode": "skipped (requeues present)",
+                "checked": 0, "mismatches": -1}
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+    from kubernetes_tpu.store import Store
+
+    rng = random.Random(seed)
+    cs = Clientset(Store(event_log_window=max(200_000, 2 * (n_nodes + total_pods))))
+    for node in make_nodes(n_nodes, rng, workload):
+        cs.nodes.create(node)
+    if workload == "mixed":
+        for svc in make_services():
+            cs.services.create(svc)
+    pods_by_key = {p.meta.key: p for p in make_pods(total_pods, rng, workload)}
+    sched = Scheduler(cs, algorithm=GenericScheduler(), backend=None,
+                      emit_events=False)
+    sched.start()
+    checked = mismatches = 0
+    sample = []
+    for batch in drain_batches:
+        for key in batch:
+            cs.pods.create(pods_by_key[key])
+        sched.pump()
+        sched.run_pending()
+        sched.pump()
+        pods_now, _ = cs.pods.list()
+        got = {p.meta.key: p.spec.node_name or None for p in pods_now}
+        for key in batch:
+            checked += 1
+            if got.get(key) != final_assignments.get(key):
+                mismatches += 1
+                if len(sample) < 5:
+                    sample.append((key, got.get(key),
+                                   final_assignments.get(key)))
+    return {"mode": "exact per-wave replay", "checked": checked,
+            "mismatches": mismatches, "sample": sample}
 
 
 def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               workload: str = "mixed", seed: int = 0, warmup: bool = True,
-              pipeline: bool = True) -> dict:
+              pipeline: bool = True, lazy_ingest: bool = True,
+              verify_oracle: bool = False) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
     created the moment wave w leaves the queue, the density.go shape
@@ -347,12 +401,20 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     device-resident node state) on the SAME harness, isolating the
     ISSUE-3 pipeline from everything else.
 
+    ``lazy_ingest=False`` is the ISSUE-4 A/B arm (``--ab-pump``): eager
+    per-event ``from_dict`` and the classic item LIST (the dict
+    compatibility oracle) instead of lazy decode-on-access views and the
+    columnar store emit.  ``verify_oracle=True`` additionally replays
+    the recorded drain batches through the per-pod CPU oracle off-clock
+    and reports per-wave binding parity (``oracle_parity``).
+
     The default preset is NORTH-scale churn (5,000 nodes — VERDICT r4
     directive 4): the returned dict carries an SLO verdict
     (``slo_pass``) gating e2e p99 ≤ 5s (the reference pod-startup SLO)
     and throughput ≥ the recorded floor; ``main`` exits 1 on failure."""
     import threading
 
+    from kubernetes_tpu.api import lazy as lazy_mod
     from kubernetes_tpu.client import Clientset
     from kubernetes_tpu.models.snapshot import Tensorizer
     from kubernetes_tpu.ops import TPUBatchBackend
@@ -361,7 +423,27 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
 
     if warmup:  # compile the wave-sized segment buckets off the clock
         run_churn(n_nodes, 2 * (total_pods // waves), 2, workload, seed + 1,
-                  warmup=False, pipeline=pipeline)
+                  warmup=False, pipeline=pipeline, lazy_ingest=lazy_ingest)
+
+    lazy_was = lazy_mod.ENABLED
+    lazy_mod.ENABLED = lazy_ingest
+    try:
+        return _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
+                                pipeline, lazy_ingest, verify_oracle)
+    finally:
+        lazy_mod.ENABLED = lazy_was
+
+
+def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
+                     lazy_ingest, verify_oracle) -> dict:
+    import threading
+
+    from kubernetes_tpu.api import lazy as lazy_mod
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.models.snapshot import Tensorizer
+    from kubernetes_tpu.ops import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+    from kubernetes_tpu.store import Store
 
     rng = random.Random(seed)
     cs = Clientset(Store(event_log_window=max(200_000, 2 * (n_nodes + total_pods))))
@@ -398,11 +480,14 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     # wave-drain detection feeds the arrival thread: wave w+1 is created
     # the moment wave w left the queue, so creation overlaps scheduling
     drained = [0]
+    drain_batches: list[list[str]] = []  # per drain call, keys in order
     wave_drained = [threading.Event() for _ in range(waves)]
     orig_drain = sched.queue.drain
 
     def recording_drain(max_n=None):
         out = orig_drain(max_n)
+        if out:
+            drain_batches.append([p.meta.key for p in out])
         drained[0] += len(out)
         for w in range(waves):
             if drained[0] >= (w + 1) * per_wave:
@@ -418,6 +503,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
             if not wave_drained[w].wait(timeout=300):
                 return  # scheduler wedged: the SLO gate will fail loudly
 
+    lazy_pre = lazy_mod.stats_snapshot()
     t0 = time.perf_counter()
     arr = threading.Thread(target=arrivals, daemon=True)
     arr.start()
@@ -430,7 +516,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
         bound += b
         ph = {k: round(sched.last_batch_phases.get(k, 0.0), 4)
               for k in ("tensorize_s", "dispatch_s", "device_wait_s",
-                        "commit_s", "prep_s")}
+                        "commit_s", "prep_s", "decode_s")}
+        ph["promotions"] = int(sched.last_batch_phases.get("promotions", 0))
         ph["pump_s"] = round(pump_acc[0] - pump_before, 4)
         ph["bound"] = b
         phase_timers.append(ph)
@@ -452,6 +539,14 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     prep_total = sum(p["prep_s"] for p in phase_timers)
     wait_total = sum(p["device_wait_s"] for p in phase_timers)
     ncache = backend.device_node_cache.stats
+    lazy_post = lazy_mod.stats_snapshot()
+    pod_inf = sched.informers.informer("Pod").stats
+    oracle_parity = None
+    if verify_oracle:
+        oracle_parity = _oracle_replay_waves(
+            drain_batches, {p.meta.key: p.spec.node_name or None
+                            for p in pods_final},
+            n_nodes, total_pods, workload, seed)
     return {
         "nodes": n_nodes,
         "pods": total_pods,
@@ -479,6 +574,17 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
                 ncache["dirty_cols"] / max(ncache["cols_total"], 1), 4),
         },
         "row_cache": dict(backend.tensorizer.node_rows_stats or {}),
+        # zero-copy ingest (ISSUE 4): what the decode path actually did
+        "ingest": {
+            "lazy": lazy_ingest,
+            "decoded_events": pod_inf["decoded_events"],
+            "decode_s": round(pod_inf["decode_s"], 4),
+            "decode_errors": pod_inf["decode_errors"],
+            "wrapped": lazy_post["wrapped"] - lazy_pre["wrapped"],
+            "promotions": (lazy_post["promotions"] + lazy_post["sections"]
+                           - lazy_pre["promotions"] - lazy_pre["sections"]),
+        },
+        "oracle_parity": oracle_parity,
         "slo_p99_ms": CHURN_SLO_P99_MS,
         "floor_pods_per_sec": CHURN_FLOOR_PODS_PER_SEC,
         "slo_pass": bool(p99 is not None and p99 <= CHURN_SLO_P99_MS
@@ -546,6 +652,80 @@ def run_churn_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
         "win_pct": round((b_med - a_med) / a_med * 100, 1) if a_med else None,
         "b_won_pairs": f"{won}/{len(ab_pairs) + len(ba_pairs)} (both orders)",
         "bound_counts": sorted(bounds),
+    }
+
+
+def run_pump_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
+                waves: int = 10, pairs: int = 2, seed: int = 0) -> dict:
+    """Both-orders interleaved A/B of the zero-copy ingest path (ISSUE 4):
+    B (new) = lazy decode-on-access watch/LIST views + the columnar store
+    emit; A (old) = eager per-event ``from_dict`` + classic item LIST (the
+    dict compatibility oracle), same harness, same seeds.  The first run
+    of EACH arm additionally replays the recorded drain batches through
+    the per-pod CPU oracle (off-clock) and reports per-wave binding
+    parity.  Writes the BENCH_AB_pump_ingest.json ledger shape."""
+    # pay the XLA compiles off the books (shape buckets are identical in
+    # both arms — one warm-up covers the process-wide compile cache)
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False, lazy_ingest=True)
+
+    parity = {}
+
+    def one(lazy: bool, verify: bool = False) -> dict:
+        r = run_churn(n_nodes, total_pods, waves, seed=seed, warmup=False,
+                      lazy_ingest=lazy, verify_oracle=verify)
+        if verify:
+            parity["lazy" if lazy else "eager"] = r["oracle_parity"]
+        return r
+
+    ab_pairs, ba_pairs = [], []
+    a_all, b_all = [], []
+    bounds = set()
+    for i in range(pairs):
+        b = one(True, verify=(i == 0))
+        a = one(False, verify=(i == 0))
+        ab_pairs.append({"B_new": b["pods_per_sec"], "A_old": a["pods_per_sec"]})
+        b_all.append(b["pods_per_sec"])
+        a_all.append(a["pods_per_sec"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-pump AB: B={b['pods_per_sec']} A={a['pods_per_sec']} "
+              f"decode_s A={a['ingest']['decode_s']} "
+              f"B={b['ingest']['decode_s']}", file=sys.stderr)
+    for _ in range(pairs):
+        a = one(False)
+        b = one(True)
+        ba_pairs.append({"A_old": a["pods_per_sec"], "B_new": b["pods_per_sec"]})
+        a_all.append(a["pods_per_sec"])
+        b_all.append(b["pods_per_sec"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-pump BA: A={a['pods_per_sec']} B={b['pods_per_sec']}",
+              file=sys.stderr)
+    a_med = sorted(a_all)[len(a_all) // 2]
+    b_med = sorted(b_all)[len(b_all) // 2]
+    won = sum(1 for p in ab_pairs + ba_pairs if p["B_new"] > p["A_old"])
+    return {
+        "claim": ("Zero-copy ingest: lazy decode-on-access watch/LIST views "
+                  "(typed fields materialize only when touched) + columnar "
+                  "store LIST emit (shared-subtree views, identity/request/"
+                  "signature columns) between store and tensorizer"),
+        "method": (f"Churn {n_nodes} nodes / {total_pods} mixed pods / "
+                   f"{waves} waves, arrival thread + run_batch_loop serving "
+                   "(both arms), events on; interleaved pairs in BOTH "
+                   "orders, one shared process, warm-up compiles paid up "
+                   "front; A = eager from_dict per event + item LIST "
+                   "(pre-ISSUE-4), B = lazy + columnar; first run of each "
+                   "arm replayed off-clock through the per-pod CPU oracle "
+                   "per drained wave"),
+        "pairs_order_AB_first": ab_pairs,
+        "pairs_order_BA_first": ba_pairs,
+        "A_old_all": a_all,
+        "B_new_all": b_all,
+        "A_median": a_med,
+        "B_median": b_med,
+        "win_pct": round((b_med - a_med) / a_med * 100, 1) if a_med else None,
+        "b_won_pairs": f"{won}/{len(ab_pairs) + len(ba_pairs)} (both orders)",
+        "bound_counts": sorted(bounds),
+        "oracle_parity": parity,
     }
 
 
@@ -782,9 +962,17 @@ def main() -> None:
         "the ledger JSON to PATH (default BENCH_AB_churn_pipeline.json); "
         "--nodes/--pods/--trials override scale and pair count",
     )
+    parser.add_argument(
+        "--ab-pump", nargs="?", const="BENCH_AB_pump_ingest.json",
+        default=None, metavar="PATH",
+        help="run the both-orders zero-copy-ingest A/B (lazy+columnar vs "
+        "eager from_dict) and write the ledger JSON to PATH (default "
+        "BENCH_AB_pump_ingest.json); --nodes/--pods/--trials override "
+        "scale and pair count",
+    )
     args = parser.parse_args()
 
-    if args.ab_churn:
+    if args.ab_churn or args.ab_pump:
         import datetime
 
         kw = {}
@@ -794,19 +982,23 @@ def main() -> None:
             kw["total_pods"] = args.pods
         if args.trials:
             kw["pairs"] = args.trials
-        ledger = run_churn_ab(**kw)
+        runner = run_pump_ab if args.ab_pump else run_churn_ab
+        path = args.ab_pump or args.ab_churn
+        metric = ("pump-ingest-win-pct" if args.ab_pump
+                  else "churn-pipeline-win-pct")
+        ledger = runner(**kw)
         ledger["date"] = datetime.date.today().isoformat()
-        with open(args.ab_churn, "w") as f:
+        with open(path, "w") as f:
             json.dump(ledger, f, indent=1)
             f.write("\n")
         print(json.dumps({
-            "metric": "churn-pipeline-win-pct",
+            "metric": metric,
             "value": ledger["win_pct"],
             "unit": "% (B_median vs A_median)",
             "vs_baseline": round(ledger["B_median"] / 100.0, 2),
             "A_median": ledger["A_median"],
             "B_median": ledger["B_median"],
-            "ledger": args.ab_churn,
+            "ledger": path,
         }))
         return
 

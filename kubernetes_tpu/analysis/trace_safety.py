@@ -11,10 +11,15 @@ What counts as *traced* (the call-graph part):
 - a function decorated ``@jax.jit`` / ``@jit`` / ``partial(jax.jit, …)``;
 - a function passed by name into a tracing consumer
   (``lax.scan/fori_loop/while_loop/cond/switch``, ``pl.pallas_call``,
-  ``jax.vmap/pmap/grad/remat/checkpoint/shard_map``);
-- transitively: any function called by simple name from a traced
-  function, and any function *defined inside* a traced function (factory
-  bodies like ``make_step`` run under trace).
+  ``jax.vmap/pmap/grad/remat/checkpoint/shard_map``) — including when
+  the reference rides a ``functools.partial(fn, …)`` wrapper (direct
+  argument or a module/class-level ``name = partial(fn, …)`` alias) or a
+  bound-method reference (``self._step`` → the method def);
+- transitively: any function called by simple name OR as a
+  ``self.method(...)`` / ``cls.method(...)`` call from a traced function,
+  any function a traced body wraps in ``functools.partial``, and any
+  function *defined inside* a traced function (factory bodies like
+  ``make_step`` run under trace).
 
 What counts as *kernel-derived* (the taint part): the traced function's
 own parameters plus anything dataflow-derived from them or from a
@@ -148,6 +153,13 @@ def _is_static_flag_param(arg: ast.arg, default: Optional[ast.expr]) -> bool:
     return False
 
 
+def _is_partial_call(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Name) and fn.id == "partial") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "partial"
+    )
+
+
 class _ModuleTraceIndex:
     """Which functions in one module execute under trace."""
 
@@ -159,9 +171,48 @@ class _ModuleTraceIndex:
         self.by_name: dict[str, list[ast.AST]] = {}
         for node, path in self.defs:
             self.by_name.setdefault(path[-1], []).append(node)
+        # name = partial(fn, ...) aliases (module/class/function level):
+        # a consumer receiving the alias name traces the wrapped fn
+        self.partial_aliases: dict[str, list[str]] = {}
+        for stmt in ast.walk(tree):
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) and stmt.value is not None:
+                refs = self._partial_refs(stmt.value)
+                if refs:
+                    targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    for t in targets:
+                        for name in _assigned_names(t):
+                            self.partial_aliases.setdefault(name, []).extend(refs)
         self.traced: set[ast.AST] = set()
         self._seed_roots(tree)
         self._closure()
+
+    def _partial_refs(self, expr: ast.expr) -> list[str]:
+        """Function names a ``partial(...)`` expression wraps (first
+        positional arg, by bare name or attribute tail)."""
+        if not (isinstance(expr, ast.Call) and _is_partial_call(expr) and expr.args):
+            return []
+        head = expr.args[0]
+        if isinstance(head, ast.Name):
+            return [head.id]
+        if isinstance(head, ast.Attribute):
+            return [head.attr]
+        return []
+
+    def _callable_refs(self, arg: ast.expr) -> list[str]:
+        """Possible function-def names one consumer argument references:
+        a bare name, a bound-method reference (``self._step`` →
+        ``_step``), a ``partial(fn, …)`` wrapper, or a name aliasing a
+        partial (interprocedural taint, ROADMAP open item)."""
+        if isinstance(arg, ast.Name):
+            return [arg.id] + self.partial_aliases.get(arg.id, [])
+        if isinstance(arg, ast.Attribute):
+            return [arg.attr]
+        refs = self._partial_refs(arg)
+        out = list(refs)
+        for r in refs:
+            out.extend(self.partial_aliases.get(r, []))
+        return out
 
     def _seed_roots(self, tree: ast.AST) -> None:
         for node, _path in self.defs:
@@ -170,8 +221,8 @@ class _ModuleTraceIndex:
         for call in (n for n in ast.walk(tree) if isinstance(n, ast.Call)):
             if _call_target_attr(call) in TRACING_CONSUMERS:
                 for arg in list(call.args) + [kw.value for kw in call.keywords]:
-                    if isinstance(arg, ast.Name):
-                        for fn in self.by_name.get(arg.id, ()):
+                    for name in self._callable_refs(arg):
+                        for fn in self.by_name.get(name, ()):
                             self.traced.add(fn)
 
     def _closure(self) -> None:
@@ -184,10 +235,24 @@ class _ModuleTraceIndex:
                     if child not in self.traced and self._encloses(node, child):
                         self.traced.add(child)
                         changed = True
-                # simple-name calls out of a traced body
                 for call in (n for n in ast.walk(node) if isinstance(n, ast.Call)):
+                    # simple-name calls out of a traced body
+                    names: list[str] = []
                     if isinstance(call.func, ast.Name):
-                        for fn in self.by_name.get(call.func.id, ()):
+                        names.append(call.func.id)
+                    # bound-method calls: self.helper(...) / cls.helper(...)
+                    # run under the same trace (only the self/cls receiver
+                    # is followed — other attribute calls are library code)
+                    elif (isinstance(call.func, ast.Attribute)
+                          and isinstance(call.func.value, ast.Name)
+                          and call.func.value.id in ("self", "cls")):
+                        names.append(call.func.attr)
+                    # a traced body wrapping a helper in partial(...) will
+                    # call it under trace wherever the wrapper flows
+                    if _is_partial_call(call):
+                        names.extend(self._partial_refs(call))
+                    for name in names:
+                        for fn in self.by_name.get(name, ()):
                             if fn not in self.traced:
                                 self.traced.add(fn)
                                 changed = True

@@ -1029,6 +1029,16 @@ def _make_handler(server: APIServer):
                     if q.get("watch", ["false"])[0] == "true":
                         return self._serve_watch(kind, q)
                     ns = q.get("namespace", [None])[0]
+                    # columnar wire fast-path (ISSUE 4): the packed batch
+                    # LIST (pods only, no selector filtering — selector
+                    # queries take the classic item path below)
+                    if (q.get("columnar", ["0"])[0] in ("1", "true")
+                            and "labelSelector" not in q
+                            and "fieldSelector" not in q):
+                        lc = getattr(server.store, "list_columns", None)
+                        batch = lc(kind, ns) if lc is not None else None
+                        if batch is not None:
+                            return self._send(200, batch.to_wire())
                     items, rev = server.store.list(kind, ns)
                     items = self._apply_list_selectors(items, q)
                     if items is None:

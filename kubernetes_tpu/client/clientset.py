@@ -22,6 +22,7 @@ from __future__ import annotations
 import copy
 from typing import Callable, Optional, Type
 
+from ..api import lazy as lazy_mod
 from ..api import types as api
 from ..store.store import Store, Watch
 
@@ -38,6 +39,13 @@ class TypedClient:
         self.kind = kind
         self._cls = cls
         self.default_namespace = "" if kind in CLUSTER_SCOPED_KINDS else "default"
+        import inspect
+
+        try:
+            self._trusted_create = "_trusted" in inspect.signature(
+                store.create).parameters
+        except (TypeError, ValueError):
+            self._trusted_create = False
 
     def _ns(self, namespace: Optional[str]) -> str:
         """Resolve the effective namespace.  Cluster-scoped kinds ignore any
@@ -55,17 +63,36 @@ class TypedClient:
         meta["namespace"] = self._ns(meta.get("namespace"))
         return d
 
+    def _decode(self, d: dict):
+        """Decode a store response: a lazy view on the zero-copy path
+        (callers that never read the result — fire-and-forget creates,
+        heartbeat updates — pay nothing; readers promote on touch), the
+        eager typed decode on the compatibility path."""
+        if lazy_mod.ENABLED:
+            return lazy_mod.lazy_class(self._cls)(d)
+        return self._cls.from_dict(d)
+
+    def _create_raw(self, obj) -> dict:
+        """One store create over the freshly built wire dict.  Stores
+        whose create accepts ``_trusted`` (the in-process one) take it
+        without a defensive deep copy — ``to_dict`` output is private by
+        construction; other transports get the plain call."""
+        if self._trusted_create:
+            return self._store.create(self.kind, self._to_wire(obj),
+                                      _trusted=True)
+        return self._store.create(self.kind, self._to_wire(obj))
+
     def create(self, obj):
-        return self._cls.from_dict(self._store.create(self.kind, self._to_wire(obj)))
+        return self._decode(self._create_raw(obj))
 
     def create_nowait(self, obj) -> None:
         """``create`` without decoding the stored object back — for
         fire-and-forget writers (the event sink) where the return decode
         is pure overhead on a contended thread."""
-        self._store.create(self.kind, self._to_wire(obj))
+        self._create_raw(obj)
 
     def get(self, name: str, namespace: Optional[str] = None):
-        return self._cls.from_dict(self._store.get(self.kind, self._ns(namespace), name))
+        return self._decode(self._store.get(self.kind, self._ns(namespace), name))
 
     def list(self, namespace: Optional[str] = None):
         if namespace is not None:
@@ -73,8 +100,27 @@ class TypedClient:
         dicts, rev = self._store.list(self.kind, namespace)
         return [self._cls.from_dict(d) for d in dicts], rev
 
+    def list_lazy(self, namespace: Optional[str] = None):
+        """LIST into decode-on-access views (``api/lazy.py``): same
+        objects semantically, but ``from_dict`` is deferred until a field
+        is actually read — the informer seed path's zero-copy arm."""
+        if namespace is not None:
+            namespace = self._ns(namespace)
+        dicts, rev = self._store.list(self.kind, namespace)
+        cls = lazy_mod.lazy_class(self._cls)
+        return [cls(d) for d in dicts], rev
+
+    def list_columns(self):
+        """Packed column batch for kinds with a columnar emitter (Pod),
+        when the transport supports it; None otherwise (callers fall
+        back to :meth:`list_lazy`/:meth:`list`)."""
+        fn = getattr(self._store, "list_columns", None)
+        if fn is None:
+            return None
+        return fn(self.kind)
+
     def update(self, obj):
-        return self._cls.from_dict(self._store.update(self.kind, self._to_wire(obj)))
+        return self._decode(self._store.update(self.kind, self._to_wire(obj)))
 
     def guaranteed_update(self, name: str, mutate: Callable, namespace: Optional[str] = None):
         """mutate receives a typed object, returns the new typed object."""

@@ -155,6 +155,7 @@ class RemoteWatch:
                         continue
                     faults.hit("remote.watch.stream", phase="event",
                                resource=self._resource)
+                    self.metrics.ingest_bytes.inc(len(line))
                     d = json.loads(line)
                     ev = WatchEvent(
                         d["type"], d["kind"], d["key"], d["revision"], d["object"]
@@ -485,6 +486,30 @@ class RemoteStore:
             path += "?" + "&".join(params)
         out = self._call("GET", path)
         return out["items"], int(out["resourceVersion"])
+
+    def list_columns(self, kind: str = "Pod",
+                     namespace: Optional[str] = None):
+        """Columnar LIST over the wire (``?columnar=1``): the server ships
+        the packed batch payload (raw views + identity columns) in one
+        response; the derived numeric/signature columns are rebuilt
+        client-side.  Returns None when the server (or kind) lacks
+        columnar support — callers fall back to :meth:`list`."""
+        if kind != "Pod":
+            return None
+        from urllib.parse import quote
+
+        path = f"/api/v1/{self._resource(kind)}?columnar=1"
+        if namespace is not None:
+            path += f"&namespace={quote(namespace)}"
+        try:
+            out = self._call("GET", path)
+        except RemoteError:
+            return None
+        if out.get("kind") != "PodColumnBatch":
+            return None  # pre-columnar server answered with plain items
+        from ..store.columns import PodColumnBatch
+
+        return PodColumnBatch.from_wire(out)
 
     def patch(self, kind: str, namespace: str, name: str, patch,
               patch_type: str = "merge") -> dict:

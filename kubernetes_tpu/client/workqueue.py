@@ -175,6 +175,17 @@ class WorkQueue:
         with self._cond:
             return len(self._delayed)
 
+    def delayed_count(self) -> int:
+        """Number of items still waiting in the delay heap AFTER moving
+        ready ones into the queue — unlike :meth:`pending_delayed`, an
+        item whose deadline passed is not counted.  O(ready-moves), no
+        set materialization: the batch loop's accumulation window polls
+        queue length every few ms, and building ``delayed_keys()``'s set
+        per poll was pure overhead in the (typical) empty-heap case."""
+        with self._cond:
+            self._drain_delayed_locked()
+            return len(self._delayed)
+
     def delayed_keys(self) -> set:
         """Items currently waiting in the delay heap (not yet ready)."""
         with self._cond:

@@ -19,6 +19,8 @@ remote.request           RemoteStore request loop           retry + exponential 
 remote.watch.stream      RemoteWatch connect/read loop      reconnect from resourceVersion;
                                                             410 → GAP → informer relist
 informer.deliver         SharedInformer._apply              relist/resync reconverges cache
+informer.decode          SharedInformer._apply decode       delta lost, gap marked; next
+                         (lazy wrap / eager from_dict)      pump relists and reconverges
 scheduler.bind           Scheduler._bind /                  forget + requeue with backoff;
                          Store.bind_many per item           retry lands on freed capacity
 backend.pallas.segment   TPUBatchBackend kernel dispatch/   circuit breaker: pallas →
@@ -59,6 +61,10 @@ register("remote.watch.stream",
 register("informer.deliver",
          "SharedInformer delta application — drop: the event never "
          "reaches cache or handlers (lossy delivery)")
+register("informer.decode",
+         "watch-event payload decode (lazy wrap or eager from_dict) — "
+         "error: the payload cannot be decoded; the delta is lost and "
+         "the informer marks a gap so the next pump relists")
 register("scheduler.bind",
          "placement commit — error/drop: one pod's bind CAS fails "
          "(per-pod path raises, bind_many reports a per-item error)")

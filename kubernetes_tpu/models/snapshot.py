@@ -34,6 +34,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..api import lazy as lazy_mod
 from ..api import types as api
 from ..native import MatchEngine
 from ..scheduler.nodeinfo import NodeInfo
@@ -70,6 +71,51 @@ def _freeze(x):
     return x
 
 
+def _raw_sig_spec_parts(spec: dict, ns: str, labels_t: tuple, ref) -> tuple:
+    """Assemble the signature key from a RAW spec dict plus resolved meta
+    components — field-for-field the same key `pod_signature_key` builds
+    from a decoded pod (store payloads are ``to_dict`` images, so the
+    frozen subtrees come out identical; test_lazy pins it)."""
+    aff = spec.get("affinity")
+    return (
+        ns,
+        labels_t,
+        tuple(sorted((spec.get("nodeSelector") or {}).items())),
+        spec.get("nodeName", ""),
+        _freeze(aff) if aff else None,
+        tuple(_freeze(t) for t in spec.get("tolerations") or ()),
+        tuple(_freeze(v) for v in spec.get("volumes") or ()
+              if not v.get("diskID")),
+        ref,
+        tuple(
+            (
+                c.get("image", ""),
+                tuple(sorted(
+                    (k, str(v)) for k, v in
+                    (((c.get("resources") or {}).get("requests")) or {}).items())),
+                tuple(sorted(
+                    (p.get("protocol", "TCP"), p.get("hostPort", 0))
+                    for p in c.get("ports") or () if p.get("hostPort", 0) > 0)),
+            )
+            for c in spec.get("containers") or ()
+        ),
+    )
+
+
+def raw_pod_signature_key(d: dict) -> tuple:
+    """``pod_signature_key`` straight from a wire dict — the column-batch
+    emit path computes grouping without constructing a single typed
+    object."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return _raw_sig_spec_parts(
+        spec,
+        meta.get("namespace", "default"),
+        tuple(sorted((meta.get("labels") or {}).items())),
+        lazy_mod.raw_controller_ref(meta),
+    )
+
+
 def pod_signature_key(pod: api.Pod) -> tuple:
     """Canonical scheduling-equivalence key (the ecache hash analogue:
     reference ``equivalence_cache.go:98 getEquivalenceHash`` uses the
@@ -82,34 +128,65 @@ def pod_signature_key(pod: api.Pod) -> tuple:
     both key every pod of every segment.  Safe because batch pods are
     immutable while in flight (informer objects; mutation is a bug the
     cache mutation detector exists to catch) — a spec patch produces a new
-    object and therefore a fresh key."""
+    object and therefore a fresh key.
+
+    Lazy pods whose spec is still undecoded key straight off the wire
+    dict (``_raw_sig_spec_parts``): identical tuples for store
+    round-tripped payloads, so grouping is unchanged and no Container/
+    Affinity objects are ever built for non-representative pods.
+    Payloads that entered via the HTTP POST path may keep the client's
+    UNnormalized JSON (omitted defaulted keys) — their raw key then
+    differs from the eager key, which only splits equivalence groups
+    more finely (same-raw pods are still truly identical), never merges
+    distinct pods: correctness and parity are unaffected, G grows a
+    little for unnormalized clients."""
     cached = getattr(pod, "_sig_key", None)
     if cached is not None:
         return cached
-    ref = pod.meta.controller_ref()
-    key = (
-        pod.meta.namespace,
-        tuple(sorted(pod.meta.labels.items())),
-        tuple(sorted(pod.spec.node_selector.items())),
-        pod.spec.node_name,
-        _freeze(pod.spec.affinity.to_dict()) if pod.spec.affinity else None,
-        tuple(_freeze(t.to_dict()) for t in pod.spec.tolerations),
-        # direct-disk volumes are deliberately EXCLUDED: their identity lives
-        # on the per-pod volume-slot axis (pod_vol_ids), not the signature
-        # axis — otherwise every distinct disk id would mint a new signature
-        # and G would grow with the batch.  PVC-backed and other volumes stay
-        # in the key (their constraints fold into the static [G, N] masks).
-        tuple(_freeze(v.to_dict()) for v in pod.spec.volumes if not v.disk_id),
-        (ref.kind, ref.uid) if ref else None,
-        tuple(
-            (
-                c.image,
-                tuple(sorted((k, str(v)) for k, v in c.resources.requests.items())),
-                tuple(sorted((p.protocol, p.host_port) for p in c.ports if p.host_port > 0)),
-            )
-            for c in pod.spec.containers
-        ),
-    )
+    spec_raw = lazy_mod.undecoded_spec(pod)
+    if spec_raw is not None:
+        meta_raw = lazy_mod.undecoded_meta(pod)
+        if meta_raw is not None:
+            key = _raw_sig_spec_parts(
+                spec_raw,
+                meta_raw.get("namespace", "default"),
+                tuple(sorted((meta_raw.get("labels") or {}).items())),
+                lazy_mod.raw_controller_ref(meta_raw))
+        else:
+            # meta already decoded (e.g. the queue touched .key): read it
+            # typed — promotion makes the decoded section authoritative
+            ref = pod.meta.controller_ref()
+            key = _raw_sig_spec_parts(
+                spec_raw,
+                pod.meta.namespace,
+                tuple(sorted(pod.meta.labels.items())),
+                (ref.kind, ref.uid) if ref else None)
+    else:
+        ref = pod.meta.controller_ref()
+        key = (
+            pod.meta.namespace,
+            tuple(sorted(pod.meta.labels.items())),
+            tuple(sorted(pod.spec.node_selector.items())),
+            pod.spec.node_name,
+            _freeze(pod.spec.affinity.to_dict()) if pod.spec.affinity else None,
+            tuple(_freeze(t.to_dict()) for t in pod.spec.tolerations),
+            # direct-disk volumes are deliberately EXCLUDED: their identity
+            # lives on the per-pod volume-slot axis (pod_vol_ids), not the
+            # signature axis — otherwise every distinct disk id would mint a
+            # new signature and G would grow with the batch.  PVC-backed and
+            # other volumes stay in the key (their constraints fold into the
+            # static [G, N] masks).
+            tuple(_freeze(v.to_dict()) for v in pod.spec.volumes if not v.disk_id),
+            (ref.kind, ref.uid) if ref else None,
+            tuple(
+                (
+                    c.image,
+                    tuple(sorted((k, str(v)) for k, v in c.resources.requests.items())),
+                    tuple(sorted((p.protocol, p.host_port) for p in c.ports if p.host_port > 0)),
+                )
+                for c in pod.spec.containers
+            ),
+        )
     try:
         object.__setattr__(pod, "_sig_key", key)
     except AttributeError:
@@ -121,7 +198,24 @@ def count_affinity_terms(pod: api.Pod) -> int:
     """Number of (anti)affinity term rows this pod contributes to the [T, G]
     tables (empty-topology-key terms never become rows).  Shared by the
     build_static budget probe and the backend's segmenter so both always
-    agree on what fits."""
+    agree on what fits.  The raw branch mirrors the ``from_dict``
+    topology-key default (absent key → hostname → counts)."""
+    spec_raw = lazy_mod.undecoded_spec(pod)
+    if spec_raw is not None:
+        a = spec_raw.get("affinity")
+        if not a:
+            return 0
+        n = 0
+        for fld in ("podAffinityRequired", "podAntiAffinityRequired"):
+            for t in a.get(fld) or ():
+                if t.get("topologyKey", api.HOSTNAME_LABEL):
+                    n += 1
+        for fld in ("podAffinityPreferred", "podAntiAffinityPreferred"):
+            for wt in a.get(fld) or ():
+                if (wt.get("podAffinityTerm") or {}).get(
+                        "topologyKey", api.HOSTNAME_LABEL):
+                    n += 1
+        return n
     a = pod.spec.affinity
     if a is None:
         return 0
@@ -133,12 +227,25 @@ def count_affinity_terms(pod: api.Pod) -> int:
     )
 
 
+def _disk_refs(pod: api.Pod) -> list:
+    """(disk_kind, disk_id, read_only) per direct-disk volume reference,
+    raw-first: the [P] loops (build_static slot fill, host-state ingest)
+    must never decode a spec just to learn it has no volumes."""
+    spec_raw = lazy_mod.undecoded_spec(pod)
+    if spec_raw is not None:
+        return [(v.get("diskKind", ""), v.get("diskID", ""),
+                 bool(v.get("readOnly", False)))
+                for v in spec_raw.get("volumes") or () if v.get("diskID")]
+    if not pod.spec.volumes:
+        return []
+    return [(v.disk_kind, v.disk_id, v.read_only)
+            for v in pod.spec.volumes if v.disk_id]
+
+
 def pod_disk_vols(pod: api.Pod) -> set:
     """Distinct (disk_kind, disk_id) identities the pod references — the
     per-pod volume-slot budget unit (same sharing contract as above)."""
-    if not pod.spec.volumes:
-        return set()
-    return {(v.disk_kind, v.disk_id) for v in pod.spec.volumes if v.disk_id}
+    return {(kind, disk_id) for kind, disk_id, _ in _disk_refs(pod)}
 
 
 @dataclass
@@ -258,16 +365,29 @@ def _pod_content_key(pod: api.Pod) -> tuple:
     """Content identity of a pod AS THE HOST STATE SEES IT (labels +
     namespace + disk refs) — what decides whether a same-key pod must be
     re-ingested on reconcile.  Memoized on the pod object under the same
-    immutability contract as ``pod_signature_key``."""
+    immutability contract as ``pod_signature_key``; lazy pods read the
+    wire dict directly (identical tuples by the round-trip argument)."""
     cached = getattr(pod, "_hbs_key", None)
     if cached is not None:
         return cached
-    disks = None
-    if pod.spec.volumes:
-        disks = tuple(sorted(
-            (v.disk_kind, v.disk_id, v.read_only)
-            for v in pod.spec.volumes if v.disk_id))
-    key = (pod.meta.namespace, tuple(sorted(pod.meta.labels.items())), disks)
+    spec_raw = lazy_mod.undecoded_spec(pod)
+    if spec_raw is not None:
+        disks = None
+        vols = spec_raw.get("volumes")
+        if vols:
+            disks = tuple(sorted(
+                (v.get("diskKind", ""), v.get("diskID", ""),
+                 bool(v.get("readOnly", False)))
+                for v in vols if v.get("diskID")))
+        labels, ns = lazy_mod.labels_ns_of(pod)
+        key = (ns, tuple(sorted(labels.items())), disks)
+    else:
+        disks = None
+        if pod.spec.volumes:
+            disks = tuple(sorted(
+                (v.disk_kind, v.disk_id, v.read_only)
+                for v in pod.spec.volumes if v.disk_id))
+        key = (pod.meta.namespace, tuple(sorted(pod.meta.labels.items())), disks)
     try:
         object.__setattr__(pod, "_hbs_key", key)
     except AttributeError:
@@ -423,8 +543,8 @@ class HostBatchState:
         content = _pod_content_key(pod)
         lid = self._lid_memo.get(content[:2])
         if lid is None:
-            lid = self.eng.add_labelmap(
-                {**pod.meta.labels, _NS_KEY: pod.meta.namespace})
+            labels, ns = lazy_mod.labels_ns_of(pod)
+            lid = self.eng.add_labelmap({**labels, _NS_KEY: ns})
             self._lid_memo[content[:2]] = lid
         self._content_rc[content[:2]] = self._content_rc.get(content[:2], 0) + 1
         idx = len(self.pod_lids)
@@ -435,13 +555,12 @@ class HostBatchState:
         self.node_pods[j][key] = idx
         self._node_j_cache = None
         disks = None
-        if pod.spec.volumes:
+        vol_refs = _disk_refs(pod)
+        if vol_refs:
             per_pod: dict[tuple, bool] = {}  # all-refs-read-only per disk
-            for vol in pod.spec.volumes:
-                if not vol.disk_id:
-                    continue
-                key = (vol.disk_kind, vol.disk_id)
-                per_pod[key] = per_pod.get(key, True) and vol.read_only
+            for kind, disk_id, read_only in vol_refs:
+                key = (kind, disk_id)
+                per_pod[key] = per_pod.get(key, True) and read_only
             if per_pod:
                 disks = []
                 for key, all_ro in per_pod.items():
@@ -1185,14 +1304,13 @@ class Tensorizer:
         pod_vol_kind = np.zeros((P, W), dtype=np.int32)
         any_count_only = False
         for i, pod in enumerate(pods):
-            if not pod.spec.volumes:
+            vol_refs = _disk_refs(pod)  # raw-first: no [P]-wide spec decode
+            if not vol_refs:
                 continue
             per_pod: dict[tuple[str, str], bool] = {}  # all-refs-read-only
-            for vol in pod.spec.volumes:
-                if not vol.disk_id:
-                    continue
-                key = (vol.disk_kind, vol.disk_id)
-                per_pod[key] = per_pod.get(key, True) and vol.read_only
+            for kind, disk_id, read_only in vol_refs:
+                key = (kind, disk_id)
+                per_pod[key] = per_pod.get(key, True) and read_only
             for s, (key, all_ro) in enumerate(per_pod.items()):
                 if key in conflict_vols:
                     v = vol_vocab.setdefault(key, len(vol_vocab))

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..api import lazy as lazy_mod
 from ..api import types as api
 from .units import (
     ResourceVec,
@@ -50,6 +51,9 @@ def _zone_key_of(node) -> str:
 
 
 def pod_has_affinity(pod: api.Pod) -> bool:
+    spec_raw = lazy_mod.undecoded_spec(pod)
+    if spec_raw is not None:
+        return lazy_mod.raw_has_affinity(spec_raw)
     a = pod.spec.affinity
     return a is not None and bool(
         a.pod_affinity_required
@@ -57,6 +61,18 @@ def pod_has_affinity(pod: api.Pod) -> bool:
         or a.pod_anti_affinity_required
         or a.pod_anti_affinity_preferred
     )
+
+
+def _containers_equal(a: api.Pod, b: api.Pod) -> bool:
+    """Container-list equality without forcing a decode when both sides
+    still hold their wire payloads (the assume→watch-confirm hot path:
+    the confirmed object differs from the assumed one only by nodeName
+    and resourceVersion, so the raw subtrees compare equal by value)."""
+    ra = lazy_mod.undecoded_spec(a)
+    rb = lazy_mod.undecoded_spec(b)
+    if ra is not None and rb is not None:
+        return (ra.get("containers") or []) == (rb.get("containers") or [])
+    return a.spec.containers == b.spec.containers
 
 
 class NodeInfo:
@@ -279,7 +295,7 @@ class SchedulerCache:
                     # without re-aggregating.  A concurrent spec change
                     # falls back to remove+add.
                     info = self._nodes[node_name]
-                    if not (assumed_pod.spec.containers == pod.spec.containers
+                    if not (_containers_equal(assumed_pod, pod)
                             and pod_has_affinity(assumed_pod) == pod_has_affinity(pod)
                             and info.replace_pod(assumed_pod, pod)):
                         info.remove_pod(assumed_pod)

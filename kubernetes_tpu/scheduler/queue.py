@@ -153,6 +153,12 @@ class SchedulingQueue:
             return list(self._pods.values())
 
     def __len__(self) -> int:
+        # fast path: nothing delayed (the steady-state accumulation loop
+        # polls len() every few ms) — every live pod is ready, no key-set
+        # materialization needed
+        if self._wq.delayed_count() == 0:
+            with self._mu:
+                return len(self._pods)
         with self._mu:
             live = set(self._pods)
         # live pods that are ready (not still in the delay heap)

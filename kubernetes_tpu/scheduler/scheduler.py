@@ -26,6 +26,7 @@ import time
 from typing import Callable, Optional
 
 from .. import faults
+from ..api import lazy
 from ..api import types as api
 from ..client.clientset import BindConflictError, Clientset
 from ..client.informer import Handler, InformerFactory
@@ -42,9 +43,43 @@ logger = logging.getLogger("kubernetes_tpu.scheduler")
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
+# memoized accelerator platform ("tpu" / "gpu" / "cpu" / "unknown"):
+# _pipeline_idle's full-window polling gate reads it once per process
+_ACCEL_PLATFORM: Optional[str] = None
+
+
+def _accel_platform() -> str:
+    global _ACCEL_PLATFORM
+    if _ACCEL_PLATFORM is None:
+        try:
+            import jax
+
+            _ACCEL_PLATFORM = jax.devices()[0].platform
+        except Exception:
+            _ACCEL_PLATFORM = "unknown"
+    return _ACCEL_PLATFORM
+
+
+def _poll_full_device_window() -> bool:
+    """Should overlapped prep keep polling the device for the whole scan
+    window?  A real accelerator (TPU/GPU) executes off the host CPU, so
+    polling always hides in its shadow — poll unconditionally (ROADMAP
+    open item: the old ``cpu_count > 1`` gate wrongly throttled 1-CPU
+    TPU hosts).  On the XLA *CPU* "device" (or when the platform is
+    unknown) the computation shares the host cores, and on a 1-core box
+    every poll cycle stretches the scan 1:1 (measured 2x) — keep the
+    spare-core requirement there."""
+    import os
+
+    platform = _accel_platform()
+    if platform not in ("cpu", "unknown"):
+        return True
+    return (os.cpu_count() or 1) > 1
+
 
 def _is_scheduler_pod(pod: api.Pod, name: str) -> bool:
-    return pod.spec.scheduler_name == name and pod.status.phase in (api.PENDING, api.RUNNING)
+    _, sched_name, phase = lazy.pod_brief(pod)
+    return sched_name == name and phase in (api.PENDING, api.RUNNING)
 
 
 class Scheduler:
@@ -123,14 +158,19 @@ class Scheduler:
         self.informers.informer("PersistentVolumeClaim")
 
     def _on_pod_add(self, pod: api.Pod) -> None:
-        if pod.spec.node_name:
+        # pod_brief reads the routing fields (nodeName/schedulerName/
+        # phase) straight off the wire dict for lazy events — the handler
+        # fan-out never builds spec/status views for pods it only routes
+        node_name, sched_name, phase = lazy.pod_brief(pod)
+        if node_name:
             self.cache.add_pod(pod)
-        elif _is_scheduler_pod(pod, self.scheduler_name):
+        elif sched_name == self.scheduler_name and phase in (api.PENDING,
+                                                            api.RUNNING):
             self.queue.add(pod)
 
     def _on_pod_update(self, old: api.Pod, new: api.Pod) -> None:
-        if new.spec.node_name:
-            if old is not None and old.spec.node_name:
+        if lazy.pod_brief(new)[0]:
+            if old is not None and lazy.pod_brief(old)[0]:
                 self.cache.update_pod(old, new)
             else:
                 self.queue.remove(new.meta.key)
@@ -144,7 +184,7 @@ class Scheduler:
                 self.queue.remove(new.meta.key)
 
     def _on_pod_delete(self, pod: api.Pod) -> None:
-        if pod.spec.node_name:
+        if lazy.pod_brief(pod)[0]:
             self.cache.remove_pod(pod)
         else:
             self.queue.remove(pod.meta.key)
@@ -166,6 +206,18 @@ class Scheduler:
             # manual drive: no sink thread, so drain events synchronously
             self.broadcaster.flush()
         return n
+
+    def _ingest_decode_stats(self) -> tuple[float, int]:
+        """(cumulative informer decode seconds, cumulative lazy
+        promotions) across this scheduler's informers — per-wave deltas
+        feed ``scheduler_ingest_decode_seconds`` and the churn bench."""
+        from ..api import lazy as lazy_mod
+
+        decode_s = sum(
+            inf.stats.get("decode_s", 0.0)
+            for inf in self.informers._informers.values())
+        st = lazy_mod.STATS
+        return decode_s, st["promotions"] + st["sections"]
 
     # -- snapshot ----------------------------------------------------------
     def snapshot(self) -> dict[str, NodeInfo]:
@@ -477,16 +529,15 @@ class Scheduler:
         here (including the injected ``scheduler.pipeline.prep`` fault)
         is contained: the work re-runs synchronously at the next wave's
         start, which is exactly the unpipelined behavior."""
-        import os as _os
         import time as _time
 
         t0 = _time.perf_counter()
-        # Keep pumping for the whole device window only when a spare core
-        # exists: on a single-CPU host the XLA "device" computation shares
-        # the core with this loop, and every poll cycle stretches the scan
-        # 1:1 instead of hiding in its shadow (measured: the scan window
-        # doubled under 1ms polling on a 1-core box).
-        poll = device_busy is not None and (_os.cpu_count() or 1) > 1
+        # Full-window polling is gated by PLATFORM (ROADMAP open item): a
+        # real accelerator executes off the host CPU, so prep always hides
+        # in its shadow; only the XLA CPU "device" — which shares the host
+        # cores — still requires a spare core (on a 1-core box every poll
+        # cycle stretched the scan 1:1, measured 2x).
+        poll = device_busy is not None and _poll_full_device_window()
         try:
             faults.hit("scheduler.pipeline.prep")
             from ..models.snapshot import _pod_content_key, pod_signature_key
@@ -494,6 +545,10 @@ class Scheduler:
             while True:
                 self.pump()
                 for pod in self.queue.snapshot_pending():
+                    # the wave's decode work, spread into the idle shadow:
+                    # on the lazy path these are raw-dict reads (columns),
+                    # never full object decodes — the drain then finds
+                    # every per-pod memo warm
                     pod_signature_key(pod)
                     _pod_content_key(pod)
                 if not poll or not device_busy():
@@ -682,6 +737,7 @@ class Scheduler:
         pre_cols = ((ncache.stats["dirty_cols"], ncache.stats["cols_total"],
                      ncache.stats["reuses"])
                     if ncache is not None else None)
+        pre_decode = self._ingest_decode_stats()
         self._last_prep_s = 0.0
         extra = {}
         if self.overlap_ingest:
@@ -725,6 +781,17 @@ class Scheduler:
                 self.last_batch_phases["prep_s"] = self._last_prep_s
                 self.metrics.pipeline_device_wait.observe(
                     self.last_batch_phases["device_wait_s"] * 1e6)
+            # ingest-decode split of the wave (ISSUE 4): informer decode
+            # seconds + lazy promotions since the last snapshot — the
+            # churn bench's pump-phase companion timers
+            post_decode = self._ingest_decode_stats()
+            decode_s = post_decode[0] - pre_decode[0]
+            promos = post_decode[1] - pre_decode[1]
+            self.last_batch_phases["decode_s"] = decode_s
+            self.last_batch_phases["promotions"] = promos
+            self.metrics.ingest_decode_seconds.observe(decode_s)
+            if promos > 0:
+                self.metrics.ingest_promotions.inc(promos)
             if pre_cols is not None:
                 dirty = ncache.stats["dirty_cols"] - pre_cols[0]
                 cols = ncache.stats["cols_total"] - pre_cols[1]

@@ -199,7 +199,11 @@ class Store:
         return self._rev
 
     # -- writes ------------------------------------------------------------
-    def create(self, kind: str, obj: dict) -> dict:
+    def create(self, kind: str, obj: dict, _trusted: bool = False) -> dict:
+        """``_trusted`` marks ``obj`` as privately owned (the typed
+        client's freshly built ``to_dict`` wire form), skipping the
+        defensive deep copy — one of two per create on the hot arrival
+        path (the other is the shared event/return copy below)."""
         # fault seam BEFORE the lock and any mutation: an injected commit
         # failure models apiserver/etcd overload — the write never starts
         faults.hit("store.commit", op="create", kind=kind)
@@ -210,7 +214,7 @@ class Store:
             if key in bucket:
                 raise AlreadyExistsError(f"{kind} {key} already exists")
             rev = self._next_rev()
-            data = _fast_deepcopy(obj)
+            data = obj if _trusted else _fast_deepcopy(obj)
             m = data["metadata"]
             m.setdefault("namespace", "default")
             if not m.get("uid"):
@@ -218,8 +222,13 @@ class Store:
             m["resourceVersion"] = rev
             m["creationRevision"] = rev
             bucket[key] = _Item(data=data, revision=rev)
-            self._emit(WatchEvent(ADDED, kind, key, rev, _fast_deepcopy(data)))
-            return _fast_deepcopy(data)
+            ev_copy = _fast_deepcopy(data)
+            self._emit(WatchEvent(ADDED, kind, key, rev, ev_copy))
+            # like update(): the event copy doubles as the caller's return
+            # value — both are read-only by contract, and the stored dict
+            # never escapes.  One deepcopy per create, not two (the create
+            # flood is the churn bench's arrival path).
+            return ev_copy
 
     def update(
         self, kind: str, obj: dict, expect_rev: Optional[int] = None, _trusted: bool = False
@@ -414,6 +423,31 @@ class Store:
                     out.append(_fast_deepcopy(item.data))
             out.sort(key=lambda d: (d["metadata"]["namespace"], d["metadata"]["name"]))
             return out, self._rev
+
+    def list_columns(self, kind: str = "Pod", namespace: Optional[str] = None):
+        """Columnar LIST fast path (Pod only): one packed batch of raw
+        object views + parallel identity/request/signature columns — see
+        ``store/columns.py``.  The views share deep subtrees with the
+        stored dicts (zero-copy): only the two levels the store ever
+        mutates in place are copied, under the lock, so consumers get a
+        consistent snapshot at the returned revision.  Consumers MUST
+        treat the payloads as read-only (the informer contract).  Returns
+        None for kinds without a columnar emitter — callers fall back to
+        :meth:`list`."""
+        if kind != "Pod":
+            return None
+        from .columns import batch_from_views, shallow_object_view
+
+        with self._mu:
+            rev = self._rev
+            views = []
+            for item in self._objects.get(kind, {}).values():
+                if namespace is not None:
+                    ns = item.data.get("metadata", {}).get("namespace", "")
+                    if ns != namespace:
+                        continue
+                views.append(shallow_object_view(item.data))
+        return batch_from_views(views, rev)
 
     # -- watch -------------------------------------------------------------
     def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None) -> Watch:

@@ -177,6 +177,16 @@ class ClientMetrics:
         self.informer_handler_errors = r.register(Counter(
             "client_informer_handler_errors_total",
             "handler callbacks that raised (isolated, loop continues)"))
+        # zero-copy ingest observability (ISSUE 4): decode failures heal
+        # via relist; bytes counts the wire payload the watch delivered
+        # (remote transport only — the in-process store never serializes)
+        self.informer_decode_errors = r.register(Counter(
+            "client_informer_decode_errors_total",
+            "event payloads that failed to decode (delta lost, gap marked "
+            "for relist)"))
+        self.ingest_bytes = r.register(Counter(
+            "scheduler_ingest_decode_bytes_total",
+            "wire bytes of watch payloads delivered to informers"))
 
 
 # informers without an explicit metrics object aggregate here: one place
@@ -244,6 +254,21 @@ class SchedulerMetrics:
             "scheduler_pipeline_prep_failures_total",
             "overlapped-prep runs that raised; the work is deferred to the "
             "next wave's synchronous path (no decisions are affected)",
+        ))
+        # zero-copy ingest (ISSUE 4): per-wave informer decode time in
+        # SECONDS (lazy wrap ~0; the eager compatibility path shows the
+        # true from_dict cost), plus lazy-promotion volume — how much
+        # typed decode the wave's consumers actually pulled
+        self.ingest_decode_seconds = r.register(Histogram(
+            "scheduler_ingest_decode_seconds",
+            "informer event-decode time per scheduling wave (seconds; "
+            "near-zero on the lazy path)",
+            buckets=[1e-5 * (2 ** (i / 2)) for i in range(44)],
+        ))
+        self.ingest_promotions = r.register(Counter(
+            "scheduler_ingest_promotions_total",
+            "lazy-object sections/objects promoted to typed form by "
+            "consumers (decode work that was actually needed)",
         ))
         self.tensorize_upload_fraction = r.register(Histogram(
             "scheduler_tensorize_upload_fraction",

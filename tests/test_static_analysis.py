@@ -111,6 +111,17 @@ def test_trace_fixture_codes_and_locations(trace_findings):
         ("TS102", "bad_branch.if.total"): _fixture_line(path, "if total > 0:"),
         ("TS102", "bad_loop_body.if.state"): _fixture_line(path, "if state:"),
         ("TS103", "bad_set_feed.set-iter"): _fixture_line(path, "hash(k) for k in ids"),
+        # interprocedural taint (ISSUE 4 satellite): helpers reached via
+        # functools.partial (direct + module alias), bound-method
+        # references, and self.method() calls from traced bodies
+        ("TS102", "bad_partial_step.if.state"): _fixture_line(
+            path, "if state:  # TS102 through the partial reference"),
+        ("TS102", "bad_alias_step.if.state"): _fixture_line(
+            path, "if state:  # TS102 through a module-level partial alias"),
+        ("TS102", "MethodStepper._bad_method_step.if.state"): _fixture_line(
+            path, "if state:  # TS102 through a bound-method reference"),
+        ("TS101", "MethodStepper._bad_helper.float"): _fixture_line(
+            path, "n = float(x.sum())"),
     }
     for key, line in expected.items():
         assert key in got, f"missing finding {key}; got {sorted(got)}"
