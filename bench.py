@@ -320,13 +320,16 @@ def run_parity(backend_res: dict, n_nodes: int, n_pods: int, workload: str, seed
 
 CHURN_SLO_P99_MS = 5_000.0  # reference pod-startup SLO (metrics_util.go:46)
 # regression floor for the NORTH-scale churn preset (5k nodes).  ISSUE 3's
-# pipeline reached ~1282 pods/s; ISSUE 4's zero-copy ingest lifted the
-# same-box medians to 1434.7 pods/s (BENCH_AB_pump_ingest.json: old
-# 1271.0 -> new 1434.7, 4/4 interleaved pairs both orders, worktree
-# method, per-wave oracle parity exact on both arms).  1000 sits ~30%
-# under the demonstrated new level and ~59% ABOVE the pre-pipeline code,
-# so a regression to either old path fails the gate.
-CHURN_FLOOR_PODS_PER_SEC = 1_000.0
+# pipeline reached ~1282 pods/s; ISSUE 4's zero-copy ingest ~1434.7; the
+# ISSUE 5 frontier scan (monotone prefilter + chunked still_ok + axis
+# tightening + batched arrival/event txns + clone-on-write work map)
+# lifted same-box medians to 1788.4 pods/s (BENCH_AB_frontier_scan.json:
+# old 1390.2 -> new 1788.4, 4/4 interleaved pairs both orders, worktree
+# method, per-wave oracle parity exact on both arms).  1300 sits ~27%
+# under the demonstrated new level (this bench has ~±15-20% day drift)
+# and 30% above the previous floor, so a regression to any pre-ISSUE-3/4
+# path fails the gate loudly.
+CHURN_FLOOR_PODS_PER_SEC = 1_300.0
 
 
 def _oracle_replay_waves(drain_batches: list, final_assignments: dict,
@@ -383,7 +386,7 @@ def _oracle_replay_waves(drain_batches: list, final_assignments: dict,
 def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               workload: str = "mixed", seed: int = 0, warmup: bool = True,
               pipeline: bool = True, lazy_ingest: bool = True,
-              verify_oracle: bool = False) -> dict:
+              frontier: bool = True, verify_oracle: bool = False) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
     created the moment wave w leaves the queue, the density.go shape
@@ -404,7 +407,10 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     ``lazy_ingest=False`` is the ISSUE-4 A/B arm (``--ab-pump``): eager
     per-event ``from_dict`` and the classic item LIST (the dict
     compatibility oracle) instead of lazy decode-on-access views and the
-    columnar store emit.  ``verify_oracle=True`` additionally replays
+    columnar store emit.  ``frontier=False`` is the ISSUE-5 A/B arm
+    (``--ab-frontier``): the full-width plain scan instead of the
+    frontier scan (monotone prefilter + chunked still_ok + mid-segment
+    node-axis compaction).  ``verify_oracle=True`` additionally replays
     the recorded drain batches through the per-pod CPU oracle off-clock
     and reports per-wave binding parity (``oracle_parity``).
 
@@ -423,19 +429,21 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
 
     if warmup:  # compile the wave-sized segment buckets off the clock
         run_churn(n_nodes, 2 * (total_pods // waves), 2, workload, seed + 1,
-                  warmup=False, pipeline=pipeline, lazy_ingest=lazy_ingest)
+                  warmup=False, pipeline=pipeline, lazy_ingest=lazy_ingest,
+                  frontier=frontier)
 
     lazy_was = lazy_mod.ENABLED
     lazy_mod.ENABLED = lazy_ingest
     try:
         return _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
-                                pipeline, lazy_ingest, verify_oracle)
+                                pipeline, lazy_ingest, frontier,
+                                verify_oracle)
     finally:
         lazy_mod.ENABLED = lazy_was
 
 
 def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
-                     lazy_ingest, verify_oracle) -> dict:
+                     lazy_ingest, frontier, verify_oracle) -> dict:
     import threading
 
     from kubernetes_tpu.api import lazy as lazy_mod
@@ -455,7 +463,7 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
     all_pods = make_pods(total_pods, rng, workload)
 
     algo = GenericScheduler()
-    backend = TPUBatchBackend(algorithm=algo)
+    backend = TPUBatchBackend(algorithm=algo, frontier=frontier)
     if not pipeline:
         backend.tensorizer = Tensorizer(sticky_buckets=False,
                                         persistent_rows=False)
@@ -498,8 +506,11 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
 
     def arrivals():
         for w in range(waves):
-            for pod in all_pods[w * per_wave:(w + 1) * per_wave]:
-                cs.pods.create(pod)
+            # ONE batch-create txn per wave (Store.create_many): the
+            # arrival client's per-pod lock/fanout round-trips leave the
+            # host budget; event order (and therefore queue/drain order
+            # and binding parity) is identical to per-item creates
+            cs.pods.create_many_nowait(all_pods[w * per_wave:(w + 1) * per_wave])
             if not wave_drained[w].wait(timeout=300):
                 return  # scheduler wedged: the SLO gate will fail loudly
 
@@ -520,6 +531,11 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
         ph["promotions"] = int(sched.last_batch_phases.get("promotions", 0))
         ph["pump_s"] = round(pump_acc[0] - pump_before, 4)
         ph["bound"] = b
+        fr = sched.last_batch_phases.get("frontier")
+        if fr:
+            # per-wave alive-union trajectory (the ISSUE 5 artifact):
+            # prefilter width + per-chunk alive fractions per segment
+            ph["frontier"] = fr
         phase_timers.append(ph)
     elapsed = time.perf_counter() - t0
     arr.join(timeout=10)
@@ -572,6 +588,15 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             "col_updates": ncache["col_updates"],
             "dirty_fraction": round(
                 ncache["dirty_cols"] / max(ncache["cols_total"], 1), 4),
+        },
+        # frontier scan (ISSUE 5): segments served, device compactions,
+        # tensorize-time column drops, full-width retries
+        "frontier": {
+            "enabled": frontier,
+            "segments": backend.stats["frontier_segments"],
+            "compactions": backend.stats["frontier_compactions"],
+            "prefilter_cols": backend.stats["frontier_prefilter_cols"],
+            "fallbacks": backend.stats["frontier_fallbacks"],
         },
         "row_cache": dict(backend.tensorizer.node_rows_stats or {}),
         # zero-copy ingest (ISSUE 4): what the decode path actually did
@@ -726,6 +751,83 @@ def run_pump_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
         "b_won_pairs": f"{won}/{len(ab_pairs) + len(ba_pairs)} (both orders)",
         "bound_counts": sorted(bounds),
         "oracle_parity": parity,
+    }
+
+
+def run_frontier_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
+                    waves: int = 10, pairs: int = 2, seed: int = 0) -> dict:
+    """Both-orders interleaved A/B of the frontier scan (ISSUE 5):
+    B (new) = frontier mode on (tensorize-time monotone prefilter,
+    chunked still_ok scan, mid-segment node-axis compaction); A (old) =
+    the full-width plain scan, same harness, same seeds.  The first pair
+    replays both arms' recorded drain batches through the per-pod CPU
+    oracle (off-clock) and reports per-wave binding parity.  Writes the
+    BENCH_AB_frontier_scan.json ledger shape."""
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False, frontier=True)
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False, frontier=False)
+
+    parity = {}
+
+    def one(frontier: bool, verify: bool = False) -> dict:
+        r = run_churn(n_nodes, total_pods, waves, seed=seed, warmup=False,
+                      frontier=frontier, verify_oracle=verify)
+        if verify:
+            parity["frontier" if frontier else "plain"] = r["oracle_parity"]
+        return r
+
+    ab_pairs, ba_pairs = [], []
+    a_all, b_all = [], []
+    bounds = set()
+    trajectories = None
+    for i in range(pairs):
+        b = one(True, verify=(i == 0))
+        a = one(False, verify=(i == 0))
+        if trajectories is None:
+            trajectories = [p.get("frontier") for p in b["phase_timers"]]
+        ab_pairs.append({"B_new": b["pods_per_sec"], "A_old": a["pods_per_sec"]})
+        b_all.append(b["pods_per_sec"])
+        a_all.append(a["pods_per_sec"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-frontier AB: B={b['pods_per_sec']} A={a['pods_per_sec']} "
+              f"frontier={b['frontier']}", file=sys.stderr)
+    for _ in range(pairs):
+        a = one(False)
+        b = one(True)
+        ba_pairs.append({"A_old": a["pods_per_sec"], "B_new": b["pods_per_sec"]})
+        a_all.append(a["pods_per_sec"])
+        b_all.append(b["pods_per_sec"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-frontier BA: A={a['pods_per_sec']} B={b['pods_per_sec']}",
+              file=sys.stderr)
+    a_med = sorted(a_all)[len(a_all) // 2]
+    b_med = sorted(b_all)[len(b_all) // 2]
+    won = sum(1 for p in ab_pairs + ba_pairs if p["B_new"] > p["A_old"])
+    return {
+        "claim": ("Frontier scan: tensorize-time monotone node prefilter, "
+                  "per-signature still_ok carry plane, and mid-segment "
+                  "device node-axis compaction on the XLA scan path "
+                  "(bit-exact oracle parity by construction)"),
+        "method": (f"Churn {n_nodes} nodes / {total_pods} mixed pods / "
+                   f"{waves} waves, arrival thread + run_batch_loop serving "
+                   "(both arms), events on; interleaved pairs in BOTH "
+                   "orders, one shared process, per-arm warm-up compiles "
+                   "paid up front; A = frontier off (full-width plain "
+                   "scan), B = frontier on; first pair of each arm "
+                   "replayed off-clock through the per-pod CPU oracle per "
+                   "drained wave"),
+        "pairs_order_AB_first": ab_pairs,
+        "pairs_order_BA_first": ba_pairs,
+        "A_old_all": a_all,
+        "B_new_all": b_all,
+        "A_median": a_med,
+        "B_median": b_med,
+        "win_pct": round((b_med - a_med) / a_med * 100, 1) if a_med else None,
+        "b_won_pairs": f"{won}/{len(ab_pairs) + len(ba_pairs)} (both orders)",
+        "bound_counts": sorted(bounds),
+        "oracle_parity": parity,
+        "alive_trajectories_first_run": trajectories,
     }
 
 
@@ -970,9 +1072,18 @@ def main() -> None:
         "BENCH_AB_pump_ingest.json); --nodes/--pods/--trials override "
         "scale and pair count",
     )
+    parser.add_argument(
+        "--ab-frontier", nargs="?", const="BENCH_AB_frontier_scan.json",
+        default=None, metavar="PATH",
+        help="run the both-orders frontier-scan A/B (monotone prefilter + "
+        "mid-segment node-axis compaction vs the full-width plain scan) "
+        "and write the ledger JSON to PATH (default "
+        "BENCH_AB_frontier_scan.json); --nodes/--pods/--trials override "
+        "scale and pair count",
+    )
     args = parser.parse_args()
 
-    if args.ab_churn or args.ab_pump:
+    if args.ab_churn or args.ab_pump or args.ab_frontier:
         import datetime
 
         kw = {}
@@ -982,9 +1093,11 @@ def main() -> None:
             kw["total_pods"] = args.pods
         if args.trials:
             kw["pairs"] = args.trials
-        runner = run_pump_ab if args.ab_pump else run_churn_ab
-        path = args.ab_pump or args.ab_churn
-        metric = ("pump-ingest-win-pct" if args.ab_pump
+        runner = (run_frontier_ab if args.ab_frontier
+                  else run_pump_ab if args.ab_pump else run_churn_ab)
+        path = args.ab_frontier or args.ab_pump or args.ab_churn
+        metric = ("frontier-scan-win-pct" if args.ab_frontier
+                  else "pump-ingest-win-pct" if args.ab_pump
                   else "churn-pipeline-win-pct")
         ledger = runner(**kw)
         ledger["date"] = datetime.date.today().isoformat()

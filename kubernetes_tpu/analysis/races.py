@@ -40,10 +40,21 @@ shared state is analyzed through that thread entry like any other
 class.)  Lock-order cycles are checked for every class that defines
 locks, entries or not.
 
-Known blind spots (documented, deliberate): mutations through aliases
-(``p = self._pending; p[k] = v``) and locks held by callers across
-method boundaries are not tracked (a method that writes under "caller
-holds the lock" convention baselines with that as its justification).
+Aliased mutations (``p = self._pending; p[k] = v``) ARE tracked for the
+single-assignment case (ISSUE 5, first slice of the points-to-lite
+item): a local name assigned exactly ONCE in the method, from a plain
+``self.<container>`` read, is treated as that container — subscript
+writes/deletes, mutator calls, and heap functions on it report RL301/
+RL303 exactly as the direct form would.  A name reassigned anywhere in
+the method (including loop/with targets) or shadowing a parameter is
+dropped: flow-insensitive alias tracking must over-approximate toward
+SILENCE, never invent findings on a rebound local.
+
+Known blind spots (documented, deliberate): aliases through more than
+one hop (``q = p``), aliases captured by nested defs, and locks held by
+callers across method boundaries are not tracked (a method that writes
+under "caller holds the lock" convention baselines with that as its
+justification).
 """
 
 from __future__ import annotations
@@ -310,14 +321,103 @@ def _subscript_self_attr(target: ast.expr) -> Optional[str]:
     return _is_self_attr(target)
 
 
+def _subscript_name(target: ast.expr) -> Optional[str]:
+    """`p[k]` (possibly nested subscripts) -> "p"."""
+    while isinstance(target, ast.Subscript):
+        target = target.value
+    if isinstance(target, ast.Name):
+        return target.id
+    return None
+
+
+def _local_aliases(fn: ast.FunctionDef, containers: set[str]) -> dict[str, str]:
+    """Local name -> container attribute, for names assigned exactly once
+    in ``fn`` (nested defs excluded, mirroring _WriteVisitor's scope) and
+    whose one assignment is a plain ``self.<container>`` read.  Any other
+    binding of the name — a second assignment, a for/with target, a
+    parameter — disqualifies it (flow-insensitive tracking must never
+    flag a rebound local)."""
+    counts: dict[str, int] = {}
+    cand: dict[str, str] = {}
+    params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                              + fn.args.posonlyargs)}
+    if fn.args.vararg is not None:
+        params.add(fn.args.vararg.arg)
+    if fn.args.kwarg is not None:
+        params.add(fn.args.kwarg.arg)
+
+    def bind(name: str) -> None:
+        counts[name] = counts.get(name, 0) + 1
+
+    def bind_target(t: ast.expr) -> None:
+        # only NAME bindings count: a subscript/attribute store
+        # (``p[k] = v``) mutates the referent, it does not rebind ``p``
+        if isinstance(t, ast.Name):
+            bind(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                bind_target(el)
+        elif isinstance(t, ast.Starred):
+            bind_target(t.value)
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node: ast.Assign) -> None:
+            for t in node.targets:
+                bind_target(t)
+                if isinstance(t, ast.Name):
+                    attr = _is_self_attr(node.value)
+                    if attr is not None and attr in containers:
+                        cand[t.id] = attr
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node: ast.AugAssign) -> None:
+            bind_target(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+            if node.value is not None:
+                bind_target(node.target)
+            self.generic_visit(node)
+
+        def visit_NamedExpr(self, node) -> None:
+            bind_target(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node: ast.For) -> None:
+            bind_target(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node: ast.With) -> None:
+            for item in node.items:
+                if item.optional_vars is not None:
+                    bind_target(item.optional_vars)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node) -> None:
+            return  # nested defs execute elsewhere (same as _WriteVisitor)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    v = V()
+    for stmt in fn.body:
+        v.visit(stmt)
+    return {name: attr for name, attr in cand.items()
+            if counts.get(name) == 1 and name not in params}
+
+
 class _WriteVisitor(ast.NodeVisitor):
     """Find self-attribute writes/mutations and the lock context they run
     under.  ``writes`` are rebinding assignments (RL301); ``mutations``
     are container-interior writes (RL303)."""
 
-    def __init__(self, locks: set[str], containers: set[str]):
+    def __init__(self, locks: set[str], containers: set[str],
+                 aliases: Optional[dict[str, str]] = None):
         self.locks = locks
         self.containers = containers
+        # single-assignment local aliases of container attributes
+        # (``p = self._pending``): mutations through them count against
+        # the aliased attribute (see _local_aliases)
+        self.aliases = aliases or {}
         self.held: list[str] = []
         self.writes: list[tuple[str, int, frozenset]] = []  # (attr, line, held)
         self.mutations: list[tuple[str, int, frozenset, str]] = []  # +what
@@ -344,6 +444,13 @@ class _WriteVisitor(ast.NodeVisitor):
         attr = _subscript_self_attr(target)
         if attr is not None and attr in self.containers:
             self.mutations.append((attr, line, frozenset(self.held), "subscript write"))
+            return
+        if isinstance(target, ast.Subscript):
+            name = _subscript_name(target)
+            if name is not None and name in self.aliases:
+                self.mutations.append((
+                    self.aliases[name], line, frozenset(self.held),
+                    f"subscript write via alias `{name}`"))
 
     def visit_Assign(self, node: ast.Assign) -> None:
         for t in node.targets:
@@ -364,6 +471,13 @@ class _WriteVisitor(ast.NodeVisitor):
             attr = _subscript_self_attr(t)
             if attr is not None and attr in self.containers:
                 self.mutations.append((attr, node.lineno, frozenset(self.held), "del"))
+                continue
+            if isinstance(t, ast.Subscript):
+                name = _subscript_name(t)
+                if name is not None and name in self.aliases:
+                    self.mutations.append((
+                        self.aliases[name], node.lineno, frozenset(self.held),
+                        f"del via alias `{name}`"))
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -374,6 +488,12 @@ class _WriteVisitor(ast.NodeVisitor):
                 self.mutations.append(
                     (attr, node.lineno, frozenset(self.held), f".{fn.attr}()")
                 )
+            elif (isinstance(fn.value, ast.Name)
+                    and fn.value.id in self.aliases):
+                self.mutations.append((
+                    self.aliases[fn.value.id], node.lineno,
+                    frozenset(self.held),
+                    f".{fn.attr}() via alias `{fn.value.id}`"))
         else:
             hname = (
                 fn.attr if isinstance(fn, ast.Attribute)
@@ -385,6 +505,12 @@ class _WriteVisitor(ast.NodeVisitor):
                     self.mutations.append(
                         (attr, node.lineno, frozenset(self.held), f"{hname}()")
                     )
+                elif (isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in self.aliases):
+                    self.mutations.append((
+                        self.aliases[node.args[0].id], node.lineno,
+                        frozenset(self.held),
+                        f"{hname}() via alias `{node.args[0].id}`"))
         self.generic_visit(node)
 
     # nested defs (callbacks) execute elsewhere; analyzed separately
@@ -500,7 +626,8 @@ def run(root: str, paths: Optional[list[str]] = None) -> list[Finding]:
             ci, fn = table[meth]
             if meth == "__init__":
                 continue  # runs on the constructing (main) thread
-            visitor = _WriteVisitor(locks, containers)
+            visitor = _WriteVisitor(locks, containers,
+                                    aliases=_local_aliases(fn, containers))
             for stmt in fn.body:
                 visitor.visit(stmt)
             for attr, line, held in visitor.writes:

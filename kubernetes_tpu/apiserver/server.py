@@ -23,6 +23,7 @@ Routes:
   DELETE /api/v1/namespaces/{ns}/{resource}/{name}
   POST   /api/v1/namespaces/{ns}/pods/{name}/binding
   POST   /api/v1/bindings:batch          (the TPU batch-bind txn)
+  POST   /api/v1/{resource}:batch        (batch create: one store txn)
 Cluster-scoped objects use ns "-" in paths.
 """
 
@@ -1002,6 +1003,25 @@ def _make_handler(server: APIServer):
                     [(b.get("podNamespace", "default"), b["podName"], b["nodeName"]) for b in items]
                 )
                 return self._send(200, {"errors": errors})
+            # batch create: POST /api/v1/{resource}:batch {"items": [...]}
+            # — one store txn (Store.create_many: one lock/WAL/fanout
+            # pass); per-item failures come back as null slots, the rest
+            # commit (the wire twin of the typed client's create_many)
+            if (url.path.startswith("/api/v1/") and url.path.endswith(":batch")
+                    and method == "POST"):
+                res = url.path[len("/api/v1/"):-len(":batch")]
+                kind = _kind_for(res)
+                if kind is None:
+                    return self._error(404, "NotFound", f"unknown resource {res}")
+                from ..api.scheme import convert_to_internal
+
+                items = [convert_to_internal(d)
+                         for d in self._body().get("items", [])]
+                if kind in CLUSTER_SCOPED:
+                    for d in items:
+                        d.setdefault("metadata", {})["namespace"] = ""
+                created = server.store.create_many(kind, items)
+                return self._send(201, {"items": created})
 
             if url.path == SSAR_PATH and method == "POST":
                 return self._serve_ssar()

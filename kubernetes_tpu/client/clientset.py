@@ -41,11 +41,17 @@ class TypedClient:
         self.default_namespace = "" if kind in CLUSTER_SCOPED_KINDS else "default"
         import inspect
 
-        try:
-            self._trusted_create = "_trusted" in inspect.signature(
-                store.create).parameters
-        except (TypeError, ValueError):
-            self._trusted_create = False
+        def _takes_trusted(fn) -> bool:
+            if fn is None:
+                return False
+            try:
+                return "_trusted" in inspect.signature(fn).parameters
+            except (TypeError, ValueError):
+                return False
+
+        self._trusted_create = _takes_trusted(store.create)
+        self._trusted_create_many = _takes_trusted(
+            getattr(store, "create_many", None))
 
     def _ns(self, namespace: Optional[str]) -> str:
         """Resolve the effective namespace.  Cluster-scoped kinds ignore any
@@ -90,6 +96,36 @@ class TypedClient:
         fire-and-forget writers (the event sink) where the return decode
         is pure overhead on a contended thread."""
         self._create_raw(obj)
+
+    def _create_many_raw(self, objs) -> list:
+        """Batch create through the store's one-txn path when the
+        transport offers it (``Store.create_many``: one lock/WAL/fanout
+        pass for the whole list), else a per-object loop with identical
+        semantics.  Items that fail (already exists) come back as None;
+        the rest commit — the best-effort contract batch writers want."""
+        wires = [self._to_wire(o) for o in objs]
+        fn = getattr(self._store, "create_many", None)
+        if fn is not None:
+            if self._trusted_create_many:
+                return fn(self.kind, wires, _trusted=True)
+            return fn(self.kind, wires)
+        out = []
+        for w in wires:
+            try:
+                out.append(self._store.create(self.kind, w))
+            except Exception:  # noqa: BLE001 - per-item best effort
+                out.append(None)
+        return out
+
+    def create_many(self, objs) -> list:
+        """Batch create; one decoded object (or None) per input, in order."""
+        return [self._decode(d) if d is not None else None
+                for d in self._create_many_raw(objs)]
+
+    def create_many_nowait(self, objs) -> None:
+        """Batch create for fire-and-forget writers (the event sink's
+        whole drained chunk, a bench wave's arrivals): no return decode."""
+        self._create_many_raw(objs)
 
     def get(self, name: str, namespace: Optional[str] = None):
         return self._decode(self._store.get(self.kind, self._ns(namespace), name))

@@ -123,7 +123,9 @@ class SharedInformer:
             lc = getattr(self._client, "list_columns", None)
             batch = lc() if lc is not None else None
             if batch is not None:
-                return batch.pods(), batch.revision, batch.keys
+                # kind-agnostic: Pod and Node batches both expose
+                # objects()/keys (store/columns.py COLUMN_BATCH_KINDS)
+                return batch.objects(), batch.revision, batch.keys
             ll = getattr(self._client, "list_lazy", None)
             if ll is not None:
                 objs, rev = ll()

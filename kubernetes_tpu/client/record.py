@@ -300,6 +300,17 @@ class EventBroadcaster:
             if action == "create":
                 # no return decode: the sink never reads the stored copy
                 self.clientset.events.create_nowait(payload)
+            elif action == "create_many":
+                # a whole chunk's creates as ONE store txn (the batched
+                # event-creation satellite); clients without the batch
+                # verb degrade to the per-item loop
+                batch_fn = getattr(self.clientset.events,
+                                   "create_many_nowait", None)
+                if batch_fn is not None:
+                    batch_fn(payload)
+                else:
+                    for ev in payload:
+                        self.clientset.events.create_nowait(ev)
             elif action == "patch":
                 def _bump(cur: api.Event) -> api.Event:
                     cur.count += 1
@@ -308,6 +319,23 @@ class EventBroadcaster:
                 self.clientset.events.guaranteed_update(payload, _bump, namespace)
         except Exception:  # events are best-effort, like the reference sink
             logger.debug("event write failed", exc_info=True)
+
+    def _write_chunk(self, decisions) -> None:
+        """Write one correlated chunk: every "create" decision is folded
+        into ONE ``("create_many", [events], None)`` decision — a single
+        batch store txn (one lock/WAL/fanout pass) instead of a per-Event
+        commit.  "patch" decisions (count bumps on prior events) stay
+        per-item CAS loops.  Create order within the chunk is preserved
+        (patches target already-stored names, so their relative order to
+        creates is immaterial).  Everything still flows through
+        ``_write`` — the single best-effort/override seam."""
+        creates = [payload for action, payload, _ns in decisions
+                   if action == "create"]
+        if creates:
+            self._write(("create_many", creates, None))
+        for decision in decisions:
+            if decision[0] != "create":
+                self._write(decision)
 
     def process_one(self) -> bool:
         """Synchronous drain step (tests / manual pumping)."""
@@ -329,8 +357,7 @@ class EventBroadcaster:
                      for _ in range(min(max_n, len(self._queue)))]
             self._queued_events -= sum(self._weight(ev) for ev in chunk)
         chunk = _expand_chunk(chunk)
-        for decision in self.correlator.observe_many(chunk):
-            self._write(decision)
+        self._write_chunk(self.correlator.observe_many(chunk))
         return len(chunk)
 
     def flush(self) -> int:
@@ -359,8 +386,8 @@ class EventBroadcaster:
                          for _ in range(min(4096, len(self._queue)))]
                 self._queued_events -= sum(self._weight(ev) for ev in chunk)
             if chunk:
-                for decision in self.correlator.observe_many(_expand_chunk(chunk)):
-                    self._write(decision)
+                self._write_chunk(
+                    self.correlator.observe_many(_expand_chunk(chunk)))
 
     @property
     def running(self) -> bool:

@@ -463,6 +463,29 @@ class RemoteStore:
     def create(self, kind: str, obj: dict) -> dict:
         return self._call("POST", f"/api/v1/{self._resource(kind)}", obj)
 
+    def create_many(self, kind: str, objs: list[dict]) -> list:
+        """Batch create over the wire (``POST /{resource}:batch``): one
+        request, one server-side store txn.  Mirrors Store.create_many's
+        per-item best-effort contract (failed slots come back null).
+        ONLY a 404 (NotFoundError: a pre-batch server has no such route)
+        falls back to per-item creates — every other failure
+        (RetryExhausted, Forbidden, 5xx) propagates: re-sending N
+        individual requests against a failing or refusing server would
+        amplify load and mask the real error."""
+        try:
+            out = self._call(
+                "POST", f"/api/v1/{self._resource(kind)}:batch",
+                {"items": objs})
+            return out.get("items", [])
+        except NotFoundError:
+            results = []
+            for obj in objs:
+                try:
+                    results.append(self.create(kind, obj))
+                except Exception:  # noqa: BLE001 - per-item best effort
+                    results.append(None)
+            return results
+
     def get(self, kind: str, namespace: str, name: str) -> dict:
         return self._call(
             "GET",
@@ -494,7 +517,10 @@ class RemoteStore:
         response; the derived numeric/signature columns are rebuilt
         client-side.  Returns None when the server (or kind) lacks
         columnar support — callers fall back to :meth:`list`."""
-        if kind != "Pod":
+        from ..store.columns import COLUMN_BATCH_KINDS
+
+        batch_cls = COLUMN_BATCH_KINDS.get(kind)
+        if batch_cls is None:
             return None
         from urllib.parse import quote
 
@@ -505,11 +531,9 @@ class RemoteStore:
             out = self._call("GET", path)
         except RemoteError:
             return None
-        if out.get("kind") != "PodColumnBatch":
+        if out.get("kind") != f"{kind}ColumnBatch":
             return None  # pre-columnar server answered with plain items
-        from ..store.columns import PodColumnBatch
-
-        return PodColumnBatch.from_wire(out)
+        return batch_cls.from_wire(out)
 
     def patch(self, kind: str, namespace: str, name: str, patch,
               patch_type: str = "merge") -> dict:

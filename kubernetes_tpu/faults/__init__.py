@@ -29,6 +29,10 @@ scheduler.pipeline.prep  Scheduler._pipeline_idle (the      contained: prep fail
                          overlapped cross-wave host prep)   work re-runs synchronously at
                                                             the next wave (decisions and
                                                             parity unaffected)
+backend.compact          frontier-scan prefilter seed /     segment retries on the
+                         mid-segment node-axis gather       full-width scan from the same
+                         (TPUBatchBackend / FrontierRun)    state — identical bindings,
+                                                            only the pruning win is lost
 ======================== ================================== ===========================
 """
 
@@ -75,6 +79,12 @@ register("scheduler.pipeline.prep",
          "overlapped host prep (informer pump + signature warming) run in "
          "the device's shadow between waves — error: the prep step dies "
          "mid-wave; the wave still completes and prep re-runs synchronously")
+register("backend.compact",
+         "frontier-scan node-axis compaction (phase=seed: the tensorize-"
+         "time monotone prefilter; phase=gather: the mid-segment device "
+         "gather) — error: the frontier step dies; the segment retries on "
+         "the full-width scan from the same state, so bindings are "
+         "unchanged and only time is lost")
 
 __all__ = [
     "Fault",

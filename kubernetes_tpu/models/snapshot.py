@@ -807,6 +807,21 @@ class BatchStatic:
     node_token: Optional[tuple] = None
     node_dirty: Optional[list] = None
 
+    # compile-time flag: any host port in the segment (no ports → the
+    # kernel skips the [N, Pv] port logic and carry write entirely)
+    use_ports: bool = True
+    # resource-axis selection: the NUM_RESOURCES slots some signature in
+    # the segment actually requests (always including CPU_MILLI/MEM_MIB
+    # at positions 0/1 — scoring indexes them positionally).  None = all.
+    # Host arrays stay full-width (oracle/commit paths); only the device
+    # upload is sliced.  Sticky-unioned across waves so the compiled
+    # kernel's [.., R'] shapes never wobble mid-run.
+    r_sel: Optional[np.ndarray] = None
+    # (compacted frontier views carry node_token=None — see
+    # compact_segment — so they can never alias a full-width
+    # DeviceNodeCache entry; chosen-index mapping flows through the
+    # compacted node_names subset, no extra provenance field needed)
+
 
 @dataclass
 class InitialState:
@@ -831,12 +846,109 @@ class InitialState:
     vol_any: np.ndarray = None  # [V, N] bool volume instance present
     vol_ns: np.ndarray = None  # [V, N] bool non-sharable instance present
     nk: np.ndarray = None  # [K, N] int32 distinct limited-kind disks on node
+    # frontier mode: step-0 monotone feasibility per signature (seeded by
+    # ``frontier_seed``); becomes the kernel's still_ok carry plane
+    still_ok: np.ndarray = None  # [G, N] bool
 
 
 def _pad_to(n: int, multiple: int) -> int:
     if multiple <= 1:
         return max(n, 1)
     return max(((n + multiple - 1) // multiple) * multiple, multiple)
+
+
+# -- frontier scan: tensorize-time prefilter + host-side compaction ---------
+
+# BatchStatic / InitialState fields carrying a node axis → axis position
+# (shared by compact_segment; the device-side twin lives in
+# ops.batch_kernel._STATIC_NODE_AXES / _STATE_NODE_AXES)
+_STATIC_NODE_FIELDS = {
+    "node_exists": 0, "node_alloc": 0, "node_alloc_pods": 0, "node_zone": 0,
+    "static_ok": 1, "node_aff_raw": 1, "taint_intol_raw": 1,
+    "static_score": 1, "interpod_raw": 1, "node_domain": 1, "dom_valid": 1,
+}
+_INIT_NODE_FIELDS = {
+    "requested": 0, "nonzero_requested": 0, "pod_count": 0, "ports_used": 0,
+    "spread_counts": 1, "dm": 1, "downer": 1, "vol_any": 1, "vol_ns": 1,
+    "nk": 1, "still_ok": 1,
+}
+
+
+def frontier_seed(static: BatchStatic, init: InitialState) -> np.ndarray:
+    """Compute the step-0 MONOTONE feasibility plane [G, N] and seed
+    ``init.still_ok`` with it; returns the G-union alive mask [N].
+
+    A column False here for signature g can never become feasible for g
+    within the segment: static_ok never changes, requested/pod_count/
+    ports_used only grow (fit/pods/ports only get worse), and the
+    required-anti-affinity hit (``dm > 0`` on an own-RAA term) is
+    monotone because placements only add matching pods.  The own
+    required-AFFINITY terms and the first-pod rule are non-monotone
+    (a landing pod can turn them ON) and are deliberately excluded —
+    still_ok must over-approximate feasibility, never under.  A column
+    False for EVERY signature is therefore provably inert: every
+    normalization, tie set, and n_feasible in the kernel ranges over
+    feasible columns only, so dropping it is bit-exact."""
+    # kernel: implements GeneralPredicates
+    # (the prefilter evaluates the same resource/pod-count/port masks the
+    # step computes, vectorized over [G, N] at step-0 state)
+    g_request = static.g_request  # full-width: r_sel only trims the device
+    fit0 = np.all(
+        (init.requested[None, :, :] + g_request[:, None, :]
+         <= static.node_alloc[None, :, :]) | (g_request[:, None, :] <= 0),
+        axis=2)  # [G, N]
+    pods_ok0 = init.pod_count + 1 <= static.node_alloc_pods  # [N]
+    mono = static.static_ok & static.node_exists[None, :] & fit0 & pods_ok0[None, :]
+    if static.use_ports:
+        ports_bad0 = (init.ports_used[None, :, :]
+                      & static.g_ports[:, None, :]).any(axis=2)  # [G, N]
+        mono &= ~ports_bad0
+    if static.terms and init.dm is not None:
+        # own required-anti terms already violated by EXISTING pods'
+        # domain counts (downer starts at zero — placed-owner symmetry
+        # cannot have fired yet)
+        raa_bad0 = static.own_raa.astype(np.int32) @ (init.dm > 0).astype(np.int32) > 0
+        mono &= ~raa_bad0
+    init.still_ok = mono
+    return mono.any(axis=0)
+
+
+def compact_segment(static: BatchStatic, init: InitialState,
+                    js: np.ndarray, width: int
+                    ) -> tuple[BatchStatic, InitialState]:
+    """Host-side node-axis compaction (the tensorize-time prefilter's
+    second half): keep columns ``js`` (full-axis order preserved — the
+    round-robin tie-break walks the axis in order) padded to ``width``.
+    ``node_names`` becomes the kept subset, so chosen indices map back
+    through it and the backend's commit path needs no change.
+    ``node_token`` is cleared: a compacted view must never alias a
+    full-width DeviceNodeCache entry."""
+    import dataclasses
+
+    k = len(js)
+    assert width >= k
+
+    def take(arr, axis):
+        pad = [(0, 0)] * arr.ndim
+        pad[axis] = (0, width - k)
+        return np.pad(np.take(arr, js, axis=axis), pad)
+
+    s_fields = {f: take(getattr(static, f), ax)
+                for f, ax in _STATIC_NODE_FIELDS.items()}
+    s_fields["node_exists"][k:] = False
+    cstatic = dataclasses.replace(
+        static,
+        node_names=[static.node_names[j] for j in js],
+        n_pad=width,
+        node_token=None,
+        node_dirty=None,
+        **s_fields,
+    )
+    i_fields = {f: take(getattr(init, f), ax)
+                for f, ax in _INIT_NODE_FIELDS.items()
+                if getattr(init, f) is not None}
+    cinit = dataclasses.replace(init, **i_fields)
+    return cstatic, cinit
 
 
 class Tensorizer:
@@ -878,6 +990,9 @@ class Tensorizer:
         # timed path).  Padding UP is always semantically inert.
         self.sticky_buckets = sticky_buckets
         self._sticky: dict[str, int] = {}
+        # resource slots seen requested so far (sticky union: the device
+        # [.., R'] shapes must never shrink mid-run); cpu/mem always in
+        self._r_sticky: set[int] = {CPU_MILLI, MEM_MIB}
         # Cross-wave node-static row cache (see NodeStaticRows).
         self.persistent_rows = persistent_rows
         self._node_rows: Optional[NodeStaticRows] = None
@@ -956,10 +1071,13 @@ class Tensorizer:
                     mounted_disks |= pod_disk_vols(q)
         seen_once: set[tuple[str, str]] = set()
         conflict_vols: set[tuple[str, str]] = set()
+        w_used = 0  # max distinct disks any ONE pod carries (slot axis)
         for pod in pods:
             per_pod = pod_disk_vols(pod)
             if len(per_pod) > self.vols_per_pod:
                 return None  # caller falls back to oracle for this pod
+            if len(per_pod) > w_used:
+                w_used = len(per_pod)
             for d in per_pod:
                 if d in mounted_disks or d in seen_once:
                     conflict_vols.add(d)
@@ -1005,6 +1123,20 @@ class Tensorizer:
             nz = pod_nonzero_request_vec(rep)
             g_nonzero[g, 0] = nz[CPU_MILLI]
             g_nonzero[g, 1] = nz[MEM_MIB]
+        # resource-axis selection: slots no signature requests are inert
+        # in the kernel step (masked True in fit, zero in the commit) —
+        # the device upload carries only the used ones.  cpu/mem stay at
+        # positions 0/1 (sorted; both always present) for the scoring
+        # formulas' positional reads.
+        r_used = {CPU_MILLI, MEM_MIB}
+        for r in range(NUM_RESOURCES):
+            if g_request[:, r].any():
+                r_used.add(r)
+        if self.sticky_buckets:
+            self._r_sticky |= r_used
+            r_used = set(self._r_sticky)
+        r_sel = (None if len(r_used) == NUM_RESOURCES
+                 else np.array(sorted(r_used), dtype=np.int64))
 
         # static per-(signature, node) masks & raw scores.  Signatures that
         # differ only in resources/ports/pod-labels interact with every
@@ -1295,7 +1427,18 @@ class Tensorizer:
         # Volume identity lives on the pod axis, not the signature axis:
         # each pod gets <= W slots pointing into the [V, N] occupancy arrays.
         K = len(_VOL_KINDS)
-        W = self.vols_per_pod
+        # volume-SLOT axis tightening: size the per-pod slot axis to the
+        # segment's real maximum (power-of-two, sticky so the compiled
+        # [W, N] shapes never shrink mid-run) instead of the worst-case
+        # vols_per_pod.  Slots past a pod's real disks are invalid on
+        # every pod, so the kernel's per-step [W, N] gathers and the
+        # commit scatter shrink with zero semantic change (vols_per_pod
+        # stays the segmentation budget bound).
+        w_nat = 1
+        while w_nat < max(w_used, 1):
+            w_nat *= 2
+        W = max(min(self._sticky_pad("volslots", w_nat), self.vols_per_pod),
+                w_used)
         P = len(pods)
         vol_vocab: dict[tuple[str, str], int] = {}
         pod_vol_ids = np.zeros((P, W), dtype=np.int32)
@@ -1456,6 +1599,8 @@ class Tensorizer:
             vol_limits=vol_limits,
             node_token=node_token,
             node_dirty=node_dirty,
+            use_ports=bool(port_vocab),
+            r_sel=r_sel,
             weights={
                 "least": least_requested_weight,
                 "most": most_requested_weight,

@@ -100,6 +100,21 @@ def _segment_vecs(static):
     return req_vecs, nz_vecs
 
 
+class _PrefilteredScan:
+    """Dispatch wrapper for a prefilter-compacted segment served by the
+    PLAIN (unchunked) scan: holds the compacted static (whose node_names
+    the chosen indices refer to) next to the in-flight arrays."""
+
+    def __init__(self, static, fut):
+        self.static = static
+        self.fut = fut
+
+    @property
+    def device_probe(self):
+        cand = self.fut[0] if isinstance(self.fut, (tuple, list)) else self.fut
+        return cand if hasattr(cand, "is_ready") else None
+
+
 class TPUBatchBackend:
     def __init__(
         self,
@@ -125,6 +140,25 @@ class TPUBatchBackend:
         # reversible, never a silent permanent blacklist
         breaker_cooldown: float = 30.0,
         clock=time.monotonic,
+        # Frontier scan (XLA path only): tensorize-time prefilter drops
+        # node columns monotonically infeasible for every signature, the
+        # scan runs in chunks carrying the still_ok plane, and when the
+        # alive-union fraction falls below frontier_compact_frac the node
+        # axis is compacted on device to a power-of-two width (≥
+        # frontier_min_width).  Parity is exact by construction (see
+        # models.snapshot.frontier_seed); any frontier failure falls back
+        # to the full-width scan of the SAME segment state.
+        frontier: bool = True,
+        frontier_chunk: int = 512,
+        frontier_compact_frac: float = 0.5,
+        frontier_min_width: int = 128,
+        # chunked still_ok mode engages when the prefilter's alive
+        # fraction is at or below this.  Default 1.0 = always chunk when
+        # the segment is big enough: measured on the north churn preset
+        # the chunked scan is FASTER than the single monolithic scan even
+        # with zero compactions (3/3 interleaved runs), so the knob
+        # exists for experiments, not as a cost gate.
+        frontier_engage_frac: float = 1.0,
     ):
         self.algorithm = algorithm or GenericScheduler()
         self.tensorizer = tensorizer or Tensorizer()
@@ -149,12 +183,27 @@ class TPUBatchBackend:
         from .batch_kernel import DeviceNodeCache
 
         self.device_node_cache = DeviceNodeCache()
+        self.frontier = frontier
+        self.frontier_chunk = frontier_chunk
+        self.frontier_compact_frac = frontier_compact_frac
+        self.frontier_min_width = frontier_min_width
+        self.frontier_engage_frac = frontier_engage_frac
+        # wired to scheduler_frontier_compactions_total
+        self.frontier_counter = None
+        # per-batch frontier trajectory: one entry per frontier segment
+        # ({"widths": [...], "alive_frac": [...], ...}); bench snapshots it
+        self.last_frontier: list = []
         self.stats = {"kernel_pods": 0, "oracle_pods": 0, "segments": 0,
                       "pallas_segments": 0, "pallas_fallbacks": 0,
                       "interpret_fallbacks": 0, "oracle_segments": 0,
                       "breaker_transitions": 0,
                       "host_state_rebuilds": 0, "host_state_reconciles": 0,
                       "host_state_dirty_nodes": 0,
+                      # frontier scan: segments served by it, device
+                      # compactions, columns dropped at tensorize time,
+                      # and full-width retries after a frontier failure
+                      "frontier_segments": 0, "frontier_compactions": 0,
+                      "frontier_prefilter_cols": 0, "frontier_fallbacks": 0,
                       # steady-state phase timers (seconds, cumulative):
                       # host tensorize, device dispatch, device wait
                       # (finalize block) — bench deltas these per wave
@@ -219,6 +268,73 @@ class TPUBatchBackend:
         self.stats["interpret_fallbacks"] += 1
         if self.fallback_counter is not None:
             self.fallback_counter.inc()
+
+    # -- frontier scan (XLA path only) --------------------------------------
+    def _on_frontier_compact(self, width: int, width_new: int,
+                             n_alive: int) -> None:
+        # fault seam BEFORE the gather: an injected compaction failure
+        # aborts the frontier run and the segment retries full-width
+        faults.hit("backend.compact", phase="gather", width=width,
+                   new_width=width_new)
+        self.stats["frontier_compactions"] += 1
+        if self.frontier_counter is not None:
+            self.frontier_counter.inc()
+
+    def _dispatch_frontier(self, static, init):
+        """Try to serve this segment through the frontier scan: seed the
+        monotone step-0 plane, compact the node axis at tensorize time
+        when enough columns are already dead, and hand the chunked run
+        (``FrontierRun``) back as the dispatch future.  Returns None when
+        the frontier adds nothing for this segment (no prefilter drop and
+        too few pods to chunk) or when any frontier step fails — the
+        caller then dispatches the plain full-width scan, so a frontier
+        bug can cost time, never parity."""
+        import numpy as np
+
+        from ..models.snapshot import compact_segment, frontier_seed
+        from .batch_kernel import FrontierRun, _pow2_width
+
+        try:
+            faults.hit("backend.compact", phase="seed")
+            alive = frontier_seed(static, init)
+            n_alive = int(alive.sum())
+            width = _pow2_width(n_alive, self.frontier_min_width)
+            cstatic, cinit = static, init
+            if (width < static.n_pad
+                    and n_alive <= self.frontier_compact_frac * static.n_pad):
+                js = np.nonzero(alive)[0]
+                cstatic, cinit = compact_segment(static, init, js, width)
+                self.stats["frontier_prefilter_cols"] += static.n_pad - width
+            # chunked still_ok mode only when the axis is actually dying
+            # (otherwise the carry plane + per-chunk syncs cost scan time
+            # and no compaction can ever trigger); a mostly-alive fleet
+            # takes the prefilter (if it cut anything) + the plain scan
+            chunked = (len(cstatic.group_of_pod) > self.frontier_chunk
+                       and cstatic.n_pad > self.frontier_min_width
+                       and n_alive <= self.frontier_engage_frac * static.n_pad)
+            if not chunked:
+                if cstatic is static:
+                    return None  # nothing to prune, nothing to watch
+                from .batch_kernel import dispatch_batch_arrays
+
+                fut = dispatch_batch_arrays(
+                    cstatic, cinit, node_cache=self.device_node_cache)
+                self.stats["frontier_segments"] += 1
+                return _PrefilteredScan(cstatic, fut)
+            run = FrontierRun(
+                cstatic, cinit, node_cache=self.device_node_cache,
+                chunk_len=self.frontier_chunk,
+                compact_frac=self.frontier_compact_frac,
+                min_width=self.frontier_min_width,
+                on_compact=self._on_frontier_compact)
+            run.prefilter_width = (static.n_pad, cstatic.n_pad)
+            self.stats["frontier_segments"] += 1
+            return run
+        except Exception:
+            logger.exception(
+                "frontier dispatch failed; the segment runs full-width")
+            self.stats["frontier_fallbacks"] += 1
+            return None
 
     # -- greedy segmentation ------------------------------------------------
     def _segments(
@@ -343,9 +459,26 @@ class TPUBatchBackend:
         commit overlap across wave boundaries.  Must not mutate the
         snapshot this batch was tensorized from."""
         weights = self._config_supported()
-        # working state: clones so neither the scheduler's CoW snapshot nor
-        # the cache sees our speculative assumptions
-        work_map = {name: info.clone() for name, info in node_info_map.items()}
+        self.last_frontier = []  # this batch's frontier trajectory
+        # Clone-on-write working state: speculative assumptions must never
+        # leak into the scheduler's CoW snapshot, but nothing here READS
+        # differently through a clone — so a NodeInfo is cloned only when
+        # the first pod actually lands on it.  At steady state a wave
+        # touches a fraction of the fleet; cloning all N up front was
+        # ~50ms/wave at 5k nodes.  Every mutation in this method flows
+        # through ``mutable_info`` (apply() is the only writer); the
+        # oracle, tensorizer, and host-state reconcile only read.
+        work_map = dict(node_info_map)
+        _cloned: set[str] = set()
+
+        def mutable_info(node_name: str):
+            info = work_map.get(node_name)
+            if info is None or node_name in _cloned:
+                return info
+            info = info.clone()
+            work_map[node_name] = info
+            _cloned.add(node_name)
+            return info
         work_pctx = PriorityContext(
             work_map,
             services=pctx.services,
@@ -388,7 +521,7 @@ class TPUBatchBackend:
                   req_vec=None, nz_vec=None) -> None:
             assignments[i] = node_name
             if node_name is not None:
-                info = work_map.get(node_name)
+                info = mutable_info(node_name)
                 if info is not None:
                     if req_vec is not None:
                         # kernel path: the segment's per-signature vectors
@@ -480,21 +613,28 @@ class TPUBatchBackend:
             if level == 1:
                 from .batch_kernel import dispatch_batch_arrays
 
-                try:
-                    faults.hit("backend.pallas.segment", impl="interpret")
-                    fut = dispatch_batch_arrays(
-                        static, init, node_cache=self.device_node_cache)
-                except Exception:
-                    logger.exception(
-                        "XLA scan dispatch failed; the oracle serves this "
-                        "segment")
-                    self._note_interpret_failure(static)
-                    level = 2
+                if self.frontier:
+                    # frontier scan first; any frontier failure already
+                    # degraded to None inside (full-width retry below)
+                    fut = self._dispatch_frontier(static, init)
+                if fut is None:
+                    try:
+                        faults.hit("backend.pallas.segment", impl="interpret")
+                        fut = dispatch_batch_arrays(
+                            static, init, node_cache=self.device_node_cache)
+                    except Exception:
+                        logger.exception(
+                            "XLA scan dispatch failed; the oracle serves "
+                            "this segment")
+                        self._note_interpret_failure(static)
+                        level = 2
             self.stats["dispatch_s"] += self._clock_wall() - t_dispatch
 
             device_probe = None
             if fut is not None:
                 cand = fut[0] if isinstance(fut, (tuple, list)) and fut else fut
+                if hasattr(cand, "device_probe"):
+                    cand = cand.device_probe
                 if hasattr(cand, "is_ready"):
                     device_probe = cand
 
@@ -512,6 +652,9 @@ class TPUBatchBackend:
             def finish() -> list:
                 nonlocal level
                 t_wait = self._clock_wall()
+                # which static's node axis the chosen indices refer to
+                # (a FrontierRun's compacted view, or the original)
+                names_static = static
                 if level == 0:
                     from .pallas_kernel import finalize_batch_pallas
 
@@ -534,23 +677,81 @@ class TPUBatchBackend:
                             self._note_interpret_failure(static)
                             return run_segment_oracle()
                 else:
-                    from .batch_kernel import finalize_batch_arrays
+                    from .batch_kernel import (FrontierRun,
+                                               finalize_batch_arrays)
+
+                    # one finalize ladder for all three XLA shapes: the
+                    # frontier forms may additionally retry the SAME
+                    # segment state full-width on failure (a frontier bug
+                    # is not a SHAPE failure — the breaker stays out of
+                    # it); the last rung is always the per-pod oracle
+                    if isinstance(fut, _PrefilteredScan):
+                        def finalize_primary():
+                            chosen, rr = finalize_batch_arrays(
+                                fut.static, *fut.fut)
+                            self.last_frontier.append({
+                                "prefilter": [static.n_pad,
+                                              fut.static.n_pad],
+                                "widths": [fut.static.n_pad],
+                                "alive_frac": [],
+                                "chunks": 1,
+                                "compactions": 0,
+                            })
+                            return chosen, rr, fut.static
+                        frontier_retry = True
+                    elif isinstance(fut, FrontierRun):
+                        def finalize_primary():
+                            chosen, rr = fut.finalize()
+                            self.last_frontier.append({
+                                "prefilter": list(
+                                    getattr(fut, "prefilter_width",
+                                            (static.n_pad, static.n_pad))),
+                                "widths": fut.stats["widths"],
+                                "alive_frac": fut.stats["alive_frac"],
+                                "chunks": fut.stats["chunks"],
+                                "compactions": fut.stats["compactions"],
+                            })
+                            return chosen, rr, fut.static
+                        frontier_retry = True
+                    else:
+                        def finalize_primary():
+                            chosen, rr = finalize_batch_arrays(static, *fut)
+                            return chosen, rr, static
+                        frontier_retry = False
 
                     try:
-                        chosen, final_rr = finalize_batch_arrays(static, *fut)
+                        chosen, final_rr, names_static = finalize_primary()
                         self.breaker.record_success(key, 1)
                     except Exception:
-                        logger.exception(
-                            "XLA scan failed; the oracle serves this segment")
-                        self._note_interpret_failure(static)
-                        return run_segment_oracle()
+                        if frontier_retry:
+                            logger.exception(
+                                "frontier scan failed; retrying the "
+                                "segment full-width")
+                            self.stats["frontier_fallbacks"] += 1
+                        else:
+                            logger.exception(
+                                "XLA scan failed; the oracle serves this "
+                                "segment")
+                            self._note_interpret_failure(static)
+                            return run_segment_oracle()
+                        try:
+                            chosen, final_rr = schedule_batch_arrays(
+                                static, init)
+                            names_static = static
+                            self.breaker.record_success(key, 1)
+                        except Exception:
+                            logger.exception(
+                                "XLA scan failed; the oracle serves this "
+                                "segment")
+                            self._note_interpret_failure(static)
+                            return run_segment_oracle()
                 self.stats["device_wait_s"] += self._clock_wall() - t_wait
                 self.algorithm._round_robin = final_rr
                 req_vecs, nz_vecs = _segment_vecs(static)
                 group_of_pod = static.group_of_pod
                 entries = []
                 for k, ((i, pod), idx) in enumerate(zip(segment, chosen)):
-                    node_name = static.node_names[int(idx)] if int(idx) >= 0 else None
+                    node_name = names_static.node_names[int(idx)] if int(idx) >= 0 else None
                     g = int(group_of_pod[k])
                     apply(pod, node_name, i, req_vecs[g], nz_vecs[g])
                     # the segment's per-signature vectors ride along so the

@@ -49,6 +49,16 @@ class ScanState(NamedTuple):
     vol_any: jnp.ndarray  # [V, N] bool
     vol_ns: jnp.ndarray  # [V, N] bool non-sharable instance present
     nk: jnp.ndarray  # [K, N] int32 distinct limited-kind disks
+    # frontier mode: per-signature monotone-feasibility plane.  Row g is
+    # ANDed each step a sig-g pod is processed with the MONOTONE filter
+    # components (resource fit, pod-count, ports, required-anti-affinity
+    # hits) — once a column goes infeasible for g it can never come back
+    # within the segment, so still_ok over-approximates every FUTURE
+    # pod's feasibility and its G-union is a safe compaction mask.  The
+    # non-monotone terms (own required-affinity / first-pod rule, which
+    # dm growth can turn BACK on) deliberately stay out.  None outside
+    # frontier mode (an empty pytree leaf: zero carry cost).
+    still_ok: "jnp.ndarray | None" = None  # [G, N] bool
 
 
 class StaticArrays(NamedTuple):
@@ -113,8 +123,31 @@ class DeviceNodeCache:
         self.stats = {"reuses": 0, "col_updates": 0, "uploads": 0,
                       "dirty_cols": 0, "cols_total": 0}
 
+    @staticmethod
+    def _host_val(static: BatchStatic, f: str):
+        """The field as the DEVICE wants it: node_alloc is resource-axis
+        sliced here (not after the cache) so the cached buffer IS the
+        buffer the kernel consumes — repeated same-token calls return
+        identical device arrays with no per-segment gather."""
+        arr = getattr(static, f)
+        r_sel = getattr(static, "r_sel", None)
+        if f == "node_alloc" and r_sel is not None:
+            arr = arr[:, r_sel]
+        return arr
+
+    @staticmethod
+    def _token_for(static: BatchStatic):
+        tok = static.node_token
+        r_sel = getattr(static, "r_sel", None)
+        if tok is not None and r_sel is not None:
+            # a changed resource selection changes the cached node_alloc
+            # SHAPE — it must never alias a same-(epoch, version) entry
+            tok = tok + (tuple(int(r) for r in r_sel),)
+        return tok
+
     def _upload(self, static: BatchStatic) -> tuple:
-        return tuple(jnp.asarray(getattr(static, f)) for f in self.FIELDS)
+        return tuple(jnp.asarray(self._host_val(static, f))
+                     for f in self.FIELDS)
 
     @staticmethod
     def _changed_cols(new: np.ndarray, old: np.ndarray):
@@ -124,7 +157,7 @@ class DeviceNodeCache:
         return np.nonzero(diff)[0]
 
     def node_arrays(self, static: BatchStatic) -> tuple:
-        tok = static.node_token
+        tok = self._token_for(static)
         n = len(static.node_exists)
         if tok is None:
             # cache bypassed (no persistent rows): a full upload every
@@ -138,7 +171,7 @@ class DeviceNodeCache:
         if self._token == tok and self._arrays is not None:
             self.stats["reuses"] += 1
             return self._arrays
-        host = tuple(np.array(getattr(static, f)) for f in self.FIELDS)
+        host = tuple(np.array(self._host_val(static, f)) for f in self.FIELDS)
         incremental = (
             self._arrays is not None and self._host is not None
             and self._token is not None and self._token[0] == tok[0]
@@ -171,14 +204,26 @@ class DeviceNodeCache:
 
 def to_device(static: BatchStatic,
               node_cache: "DeviceNodeCache | None" = None) -> StaticArrays:
+    # resource-axis tightening: slots no signature in the segment requests
+    # are inert in the step (`g_req > 0` masks them to True in fit, and
+    # the commit adds zero), so the device arrays carry only the selected
+    # slots.  r_sel always keeps CPU_MILLI/MEM_MIB at positions 0/1 — the
+    # scoring formulas index them positionally.  Host arrays stay
+    # full-width for the oracle/commit paths; the slice happens at upload
+    # (DeviceNodeCache._host_val on the cached path, here otherwise).
+    r_sel = getattr(static, "r_sel", None)
     if node_cache is not None:
         node_exists, node_alloc, node_alloc_pods, node_zone = (
             node_cache.node_arrays(static))
     else:
         node_exists = jnp.asarray(static.node_exists)
-        node_alloc = jnp.asarray(static.node_alloc)
+        node_alloc = jnp.asarray(
+            static.node_alloc if r_sel is None else static.node_alloc[:, r_sel])
         node_alloc_pods = jnp.asarray(static.node_alloc_pods)
         node_zone = jnp.asarray(static.node_zone)
+    g_request = static.g_request
+    if r_sel is not None:
+        g_request = g_request[:, r_sel]
     return StaticArrays(
         node_exists=node_exists,
         node_alloc=node_alloc,
@@ -189,7 +234,7 @@ def to_device(static: BatchStatic,
         taint_intol_raw=jnp.asarray(static.taint_intol_raw),
         static_score=jnp.asarray(static.static_score),
         interpod_raw=jnp.asarray(static.interpod_raw),
-        g_request=jnp.asarray(static.g_request),
+        g_request=jnp.asarray(g_request),
         g_nonzero=jnp.asarray(static.g_nonzero),
         g_ports=jnp.asarray(static.g_ports),
         g_has_spread=jnp.asarray(static.g_has_spread),
@@ -245,9 +290,11 @@ def batch_xs(static: BatchStatic, min_length: int = 512):
     )
 
 
-def state_to_device(init: InitialState) -> ScanState:
+def state_to_device(init: InitialState, r_sel=None,
+                    use_frontier: bool = False) -> ScanState:
+    requested = init.requested if r_sel is None else init.requested[:, r_sel]
     return ScanState(
-        requested=jnp.asarray(init.requested),
+        requested=jnp.asarray(requested),
         nonzero_requested=jnp.asarray(init.nonzero_requested),
         pod_count=jnp.asarray(init.pod_count),
         ports_used=jnp.asarray(init.ports_used),
@@ -259,6 +306,8 @@ def state_to_device(init: InitialState) -> ScanState:
         vol_any=jnp.asarray(init.vol_any),
         vol_ns=jnp.asarray(init.vol_ns),
         nk=jnp.asarray(init.nk),
+        still_ok=(jnp.asarray(init.still_ok)
+                  if use_frontier and init.still_ok is not None else None),
     )
 
 
@@ -318,14 +367,22 @@ def _normalized_max(raw, feasible, reverse: bool):
 
 
 def make_step(
-    dev: StaticArrays, num_zones: int, w: dict, use_terms: bool = True, use_vols: bool = True
+    dev: StaticArrays, num_zones: int, w: dict, use_terms: bool = True,
+    use_vols: bool = True, use_ports: bool = True, use_frontier: bool = False,
 ):
     """Builds the scan step: (state, xs) -> (state', chosen_node).
 
-    ``use_terms`` / ``use_vols`` are compile-time flags (part of the cached
-    runner key): segments whose batch carries no (anti)affinity terms or no
-    direct-disk volumes skip those blocks entirely instead of paying the
-    gather/scatter cost on inert state every step."""
+    ``use_terms`` / ``use_vols`` / ``use_ports`` are compile-time flags
+    (part of the cached runner key): segments whose batch carries no
+    (anti)affinity terms, no direct-disk volumes, or no host ports skip
+    those blocks entirely instead of paying the gather/scatter cost on
+    inert state every step.
+
+    ``use_frontier`` additionally maintains the ``still_ok`` carry plane
+    (see ScanState): the current signature's row is ANDed with the
+    monotone filter components each step, so a chunked caller can read
+    the G-union between chunks and compact the node axis (frontier
+    scan).  Off, the plane stays None and the step is unchanged."""
 
     # Zone membership as a [Z, N] one-hot contraction matrix, hoisted out
     # of the step (scan treats closed-over values as loop constants): the
@@ -356,9 +413,11 @@ def make_step(
             jnp.where(g_req > 0, state.requested + g_req <= dev.node_alloc, True), axis=1
         )
         pods_ok = state.pod_count + 1 <= dev.node_alloc_pods
-        ports_ok = ~jnp.any(state.ports_used & g_ports, axis=1)
 
-        feasible = dev.static_ok[gid] & fit & pods_ok & ports_ok & dev.node_exists
+        feasible = dev.static_ok[gid] & fit & pods_ok & dev.node_exists
+        if use_ports:
+            ports_ok = ~jnp.any(state.ports_used & g_ports, axis=1)
+            feasible = feasible & ports_ok
 
         if use_terms:
             # kernel: implements MatchInterPodAffinity
@@ -401,6 +460,26 @@ def make_step(
             vol_bad = disk_bad | jnp.any(over, axis=0)
             feasible = feasible & ~vol_bad
         n_feasible = jnp.sum(feasible.astype(jnp.int32))
+
+        if use_frontier:
+            # monotone components ONLY: fit/pods/ports can only get worse
+            # as the carry grows, and the required-anti hits (downer / dm
+            # only ever increase) likewise — a False here is False for
+            # the rest of the segment.  Volume conflicts are per-POD
+            # (disk ids are off the signature axis) and own required
+            # affinity can RESURRECT (dm growth / first-pod rule), so
+            # neither belongs in the plane.  Padded steps (pvalid False)
+            # leave the plane untouched.
+            mono = fit & pods_ok
+            if use_ports:
+                mono = mono & ports_ok
+            if use_terms:
+                mono = mono & ~sym_anti_bad & ~own_raa_bad
+            row = state.still_ok[gid]
+            still_ok_new = state.still_ok.at[gid].set(
+                jnp.where(pvalid, row & mono, row))
+        else:
+            still_ok_new = state.still_ok
 
         # -- scores (priorities) --------------------------------------
         cpu_req = state.nonzero_requested[:, 0] + g_nz[0]
@@ -525,7 +604,8 @@ def make_step(
             requested=state.requested + oh_i[:, None] * g_req[None, :],
             nonzero_requested=state.nonzero_requested + oh_i[:, None] * g_nz[None, :],
             pod_count=state.pod_count + oh_i,
-            ports_used=state.ports_used | (onehot[:, None] & g_ports[None, :]),
+            ports_used=(state.ports_used | (onehot[:, None] & g_ports[None, :])
+                        if use_ports else state.ports_used),
             spread_counts=state.spread_counts
             + dev.spread_inc[:, gid][:, None] * oh_i[None, :],
             round_robin=rr,
@@ -535,6 +615,7 @@ def make_step(
             vol_any=vol_any,
             vol_ns=vol_ns,
             nk=nk,
+            still_ok=still_ok_new,
         )
         return new_state, chosen
 
@@ -542,24 +623,30 @@ def make_step(
 
 
 @lru_cache(maxsize=64)
-def _runner(num_zones: int, weights: tuple, use_terms: bool = True, use_vols: bool = True):
+def _runner(num_zones: int, weights: tuple, use_terms: bool = True,
+            use_vols: bool = True, use_ports: bool = True,
+            use_frontier: bool = False):
     w = dict(zip(WEIGHT_KEYS, weights))
 
     @jax.jit
     def run(dev: StaticArrays, xs, state: ScanState):
-        step = make_step(dev, num_zones, w, use_terms=use_terms, use_vols=use_vols)
+        step = make_step(dev, num_zones, w, use_terms=use_terms,
+                         use_vols=use_vols, use_ports=use_ports,
+                         use_frontier=use_frontier)
         return jax.lax.scan(step, state, xs)
 
     return run
 
 
-def _runner_for(static: BatchStatic):
+def _runner_for(static: BatchStatic, use_frontier: bool = False):
     weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
     return _runner(
         int(static.num_zones),
         weights,
         use_terms=bool(static.terms),
         use_vols=bool(static.use_vols),
+        use_ports=bool(getattr(static, "use_ports", True)),
+        use_frontier=use_frontier,
     )
 
 
@@ -570,7 +657,7 @@ def dispatch_batch_arrays(static: BatchStatic, init: InitialState,
     executes, then block via ``finalize_batch_arrays`` — the overlap seam
     the pipelined backend commits previous-segment bindings in."""
     dev = to_device(static, node_cache=node_cache)
-    state = state_to_device(init)
+    state = state_to_device(init, r_sel=getattr(static, "r_sel", None))
     xs = batch_xs(static)
     run = _runner_for(static)
     final_state, chosen = run(dev, xs, state)
@@ -589,3 +676,199 @@ def schedule_batch_arrays(static: BatchStatic, init: InitialState) -> tuple[np.n
     final round-robin counter)."""
     chosen, rr = dispatch_batch_arrays(static, init)
     return finalize_batch_arrays(static, chosen, rr)
+
+
+# -- frontier scan: chunked execution + mid-segment node-axis compaction ----
+
+# StaticArrays fields carrying a node axis, with the axis position.
+_STATIC_NODE_AXES = {
+    "node_exists": 0, "node_alloc": 0, "node_alloc_pods": 0, "node_zone": 0,
+    "static_ok": 1, "node_aff_raw": 1, "taint_intol_raw": 1,
+    "static_score": 1, "interpod_raw": 1, "node_domain": 1, "dom_valid": 1,
+}
+# ScanState fields carrying a node axis (still_ok handled explicitly).
+_STATE_NODE_AXES = {
+    "requested": 0, "nonzero_requested": 0, "pod_count": 0, "ports_used": 0,
+    "spread_counts": 1, "dm": 1, "downer": 1, "vol_any": 1, "vol_ns": 1,
+    "nk": 1,
+}
+
+
+def _pow2_width(n: int, min_width: int) -> int:
+    w = max(min_width, 1)
+    while w < n:
+        w *= 2
+    return w
+
+
+def gather_node_axis(dev: StaticArrays, state: ScanState, js: np.ndarray,
+                     width: int) -> tuple[StaticArrays, ScanState]:
+    """Device-side node-axis compaction: gather the kept columns ``js``
+    (node-axis order preserved — the round-robin tie-break walks the axis
+    in order, so relative order IS semantics) of every node-axis plane of
+    the statics and the carry onto a ``width``-column buffer.  Positions
+    past ``len(js)`` are padding: their ``node_exists`` / ``still_ok``
+    are forced False, which makes every other plane's garbage there
+    unreachable (feasible ≡ False).
+
+    Parity: excluded columns are provably inert — every normalization,
+    tie set, and n_feasible ranges over *feasible* columns only, and a
+    column is dropped only when ``still_ok`` (the monotone
+    over-approximation of every future pod's feasibility) has it False
+    for ALL signatures.  The caller maps chosen indices back through its
+    cumulative permutation."""
+    # kernel: implements GeneralPredicates
+    # (the compaction consumes the same monotone filter verdicts the step
+    # computes; gathering them preserves each column's masks bit-for-bit)
+    k = len(js)
+    idx_host = np.zeros(width, dtype=np.int32)
+    idx_host[:k] = js
+    idx = jnp.asarray(idx_host)
+    pad_mask = jnp.asarray(np.arange(width) < k)
+
+    def take(arr, axis):
+        return jnp.take(arr, idx, axis=axis)
+
+    dev_new = dev._replace(**{
+        f: take(getattr(dev, f), ax) for f, ax in _STATIC_NODE_AXES.items()
+    })
+    dev_new = dev_new._replace(node_exists=dev_new.node_exists & pad_mask)
+    st_new = state._replace(**{
+        f: take(getattr(state, f), ax) for f, ax in _STATE_NODE_AXES.items()
+    })
+    if state.still_ok is not None:
+        st_new = st_new._replace(
+            still_ok=take(state.still_ok, 1) & pad_mask[None, :])
+    return dev_new, st_new
+
+
+def _host_xs(static: BatchStatic):
+    """The per-pod scan inputs as UNPADDED host numpy arrays — the
+    frontier loop slices chunks out of these and pads each chunk to the
+    chunk bucket (padding entries are pvalid=False, inert)."""
+    p_real = len(static.group_of_pod)
+    w = static.pod_vol_ids.shape[1]
+    vco = np.zeros((p_real, w), dtype=bool)
+    if static.pod_vol_count_only is not None:
+        vco[:] = static.pod_vol_count_only
+    return (
+        np.asarray(static.group_of_pod, dtype=np.int32),
+        np.ones(p_real, dtype=bool),
+        np.asarray(static.pod_vol_ids, dtype=np.int32),
+        np.asarray(static.pod_vol_valid, dtype=bool),
+        np.asarray(static.pod_vol_ro_ok, dtype=bool),
+        np.asarray(static.pod_vol_kind, dtype=np.int32),
+        vco,
+    )
+
+
+def _chunk_xs(host_xs, start: int, chunk_len: int, v_sentinel: int):
+    gids, pvalid, vids, vval, vro, vkind, vco = host_xs
+    p_real = len(gids)
+    end = min(start + chunk_len, p_real)
+    n = end - start
+    w = vids.shape[1]
+    cg = np.zeros(chunk_len, dtype=np.int32)
+    cg[:n] = gids[start:end]
+    cp = np.zeros(chunk_len, dtype=bool)
+    cp[:n] = True
+    cv = np.full((chunk_len, w), v_sentinel, dtype=np.int32)
+    cv[:n] = vids[start:end]
+    cvv = np.zeros((chunk_len, w), dtype=bool)
+    cvv[:n] = vval[start:end]
+    cvr = np.zeros((chunk_len, w), dtype=bool)
+    cvr[:n] = vro[start:end]
+    cvk = np.zeros((chunk_len, w), dtype=np.int32)
+    cvk[:n] = vkind[start:end]
+    cvc = np.zeros((chunk_len, w), dtype=bool)
+    cvc[:n] = vco[start:end]
+    return tuple(jnp.asarray(a) for a in (cg, cp, cv, cvv, cvr, cvk, cvc))
+
+
+class FrontierRun:
+    """One segment's frontier execution: the scan split into fixed-length
+    chunks; between chunks the alive-union fraction (one [N] reduce over
+    the ``still_ok`` carry) decides whether to compact the node axis on
+    device and resume at a power-of-two width N' ≪ N.
+
+    ``__init__`` dispatches the FIRST chunk and returns (the async seam
+    the backend commits prior segments in — ``device_probe`` polls it);
+    ``finalize()`` drives the remaining chunks, applies compactions, and
+    returns chosen indices in the ORIGINAL node axis plus the final
+    round-robin counter and the per-chunk alive trajectory."""
+
+    def __init__(self, static: BatchStatic, init: InitialState,
+                 node_cache: "DeviceNodeCache | None" = None,
+                 chunk_len: int = 512, compact_frac: float = 0.5,
+                 min_width: int = 128, on_compact=None):
+        self.static = static
+        self.chunk_len = chunk_len
+        self.compact_frac = compact_frac
+        self.min_width = min_width
+        self.on_compact = on_compact
+        self._p_real = len(static.group_of_pod)
+        self._run = _runner_for(static, use_frontier=True)
+        self._dev = to_device(static, node_cache=node_cache)
+        self._state = state_to_device(
+            init, r_sel=getattr(static, "r_sel", None), use_frontier=True)
+        if self._state.still_ok is None:
+            raise ValueError("frontier run requires init.still_ok (seed the "
+                             "InitialState via models.snapshot.frontier_seed)")
+        self._host_xs = _host_xs(static)
+        self._width = int(static.n_pad)
+        # cumulative permutation: current column position -> original
+        # full-axis index (chosen indices map back through the snapshot
+        # of this array taken at each chunk's dispatch)
+        self._map = np.arange(self._width, dtype=np.int64)
+        self._chunks: list = []  # (chosen_dev, map_snapshot)
+        self._next = 0
+        self.stats = {"chunks": 0, "compactions": 0,
+                      "alive_frac": [], "widths": [self._width]}
+        self._dispatch_chunk()
+
+    def _dispatch_chunk(self) -> None:
+        xs = _chunk_xs(self._host_xs, self._next, self.chunk_len,
+                       int(self.static.v_state) - 1)
+        self._state, chosen = self._run(self._dev, xs, self._state)
+        chosen.copy_to_host_async()
+        self._chunks.append((chosen, self._map))
+        self._next += self.chunk_len
+        self.stats["chunks"] += 1
+
+    @property
+    def device_probe(self):
+        cand = self._chunks[0][0]
+        return cand if hasattr(cand, "is_ready") else None
+
+    def _maybe_compact(self) -> None:
+        alive = jnp.any(self._state.still_ok, axis=0) & self._dev.node_exists
+        n_alive = int(jnp.sum(alive))  # the one [N] reduce + sync
+        self.stats["alive_frac"].append(round(n_alive / max(self._width, 1), 4))
+        width_new = _pow2_width(n_alive, self.min_width)
+        if width_new >= self._width or n_alive > self.compact_frac * self._width:
+            return
+        if self.on_compact is not None:
+            self.on_compact(self._width, width_new, n_alive)
+        js = np.nonzero(np.asarray(alive))[0]
+        self._dev, self._state = gather_node_axis(
+            self._dev, self._state, js, width_new)
+        self._map = self._map[js]
+        self._width = width_new
+        self.stats["compactions"] += 1
+        self.stats["widths"].append(width_new)
+
+    def finalize(self) -> tuple[np.ndarray, int]:
+        while self._next < self._p_real:
+            self._maybe_compact()
+            self._dispatch_chunk()
+        chosen_full = np.empty(self._p_real, dtype=np.int64)
+        pos = 0
+        for chosen_dev, map_snap in self._chunks:
+            part = np.asarray(chosen_dev)
+            n = min(len(part), self._p_real - pos)
+            part = part[:n].astype(np.int64)
+            safe = np.clip(part, 0, len(map_snap) - 1)
+            chosen_full[pos:pos + n] = np.where(
+                part >= 0, map_snap[safe], -1)
+            pos += n
+        return chosen_full, int(self._state.round_robin)

@@ -112,7 +112,8 @@ def _prepare(static: BatchStatic, init: InitialState, mesh: Mesh):
     placement/xs policy so the asserted HLO can never diverge from the
     executed program."""
     dev = shard_static(to_device(static), mesh)
-    state = shard_state(state_to_device(init), mesh)
+    state = shard_state(
+        state_to_device(init, r_sel=getattr(static, "r_sel", None)), mesh)
     xs = batch_xs(static)  # per-pod inputs replicate (scan slices [W] rows)
     return _runner_for(static), dev, xs, state
 

@@ -107,6 +107,8 @@ class Scheduler:
             backend.fallback_counter = self.metrics.pallas_fallback_total
         if backend is not None and hasattr(backend, "breaker_counter"):
             backend.breaker_counter = self.metrics.kernel_breaker_transitions
+        if backend is not None and hasattr(backend, "frontier_counter"):
+            backend.frontier_counter = self.metrics.frontier_compactions
         self.emit_events = emit_events
         self.enable_preemption = enable_preemption
         self._clock = clock
@@ -797,6 +799,15 @@ class Scheduler:
                 cols = ncache.stats["cols_total"] - pre_cols[1]
                 if cols > 0:
                     self.metrics.tensorize_upload_fraction.observe(dirty / cols)
+            # frontier trajectory of this wave (per-segment prefilter
+            # widths, alive-union fractions, compactions) for the bench
+            lf = getattr(self.backend, "last_frontier", None)
+            if lf:
+                self.last_batch_phases["frontier"] = [dict(seg) for seg in lf]
+                for seg in lf:
+                    fr = seg.get("alive_frac") or []
+                    if fr:
+                        self.metrics.frontier_alive_fraction.observe(min(fr))
         finally:
             if gc_was_enabled:
                 _gc.enable()

@@ -129,6 +129,9 @@ class PodColumnBatch:
             out.append(pod)
         return out
 
+    # the kind-agnostic accessor informer seeding uses
+    objects = pods
+
     # -- wire form (the apiserver's ?columnar=1 LIST payload) ---------------
     def to_wire(self) -> dict:
         # ships ONLY the raw views: every column is recomputed client-side
@@ -145,6 +148,62 @@ class PodColumnBatch:
         return cls(d.get("raw") or [], int(d.get("resourceVersion", 0)))
 
 
+class NodeColumnBatch:
+    """One Node LIST as identity columns + shared-subtree raw views
+    (ISSUE 5 satellite: ROADMAP named Node the next columnar candidate).
+
+    Nodes are cluster-scoped (bare-name keys) and the store never mutates
+    a stored Node in place (status heartbeats go through
+    guaranteed_update, which installs a fresh deep copy), so the same
+    top-two-levels-fresh view contract holds.  The identity columns —
+    keys/names plus the zone label the spread priorities read — let
+    informer seeding and the tensorizer's node-axis ordering run without
+    decoding a single typed object; ``objects()`` yields ``LazyNode``
+    views whose sections decode on first touch."""
+
+    kind = "Node"
+
+    def __init__(self, raw: list[dict], revision: int):
+        self.raw = raw
+        self.revision = revision
+        n = len(raw)
+        self.keys: list[str] = [""] * n
+        self.names: list[str] = [""] * n
+        self.zones: list[str] = [""] * n
+        for i, d in enumerate(raw):
+            meta = d.get("metadata") or {}
+            name = meta.get("name", "")
+            self.names[i] = name
+            ns = meta.get("namespace", "")
+            self.keys[i] = f"{ns}/{name}" if ns else name
+            labels = meta.get("labels") or {}
+            self.zones[i] = labels.get(
+                "failure-domain.beta.kubernetes.io/zone", "")
+
+    def __len__(self) -> int:
+        return len(self.raw)
+
+    def objects(self) -> list:
+        from ..api.lazy import LazyNode
+
+        return [LazyNode(d) for d in self.raw]
+
+    def to_wire(self) -> dict:
+        return {
+            "kind": "NodeColumnBatch",
+            "resourceVersion": self.revision,
+            "raw": self.raw,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "NodeColumnBatch":
+        return cls(d.get("raw") or [], int(d.get("resourceVersion", 0)))
+
+
+# kind -> batch class (the store's emitter registry; extend per kind)
+COLUMN_BATCH_KINDS = {"Pod": PodColumnBatch, "Node": NodeColumnBatch}
+
+
 def shallow_object_view(data: dict) -> dict:
     """The zero-copy emit unit: top two levels fresh, subtrees shared
     (see module docstring for why this is safe against store writes).
@@ -158,11 +217,12 @@ def shallow_object_view(data: dict) -> dict:
     return top
 
 
-def batch_from_views(views: list[dict], revision: int) -> PodColumnBatch:
+def batch_from_views(views: list[dict], revision: int,
+                     kind: str = "Pod"):
     """Sort to ``Store.list`` order (namespace, name) — queue/drain order,
     and therefore binding parity, must be identical on both LIST paths —
     then pack the columns (safe outside the store lock: only shared
     subtrees are read, and those are never mutated in place)."""
     views.sort(key=lambda d: (d["metadata"].get("namespace", ""),
                               d["metadata"].get("name", "")))
-    return PodColumnBatch(views, revision)
+    return COLUMN_BATCH_KINDS[kind](views, revision)

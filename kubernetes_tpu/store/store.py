@@ -230,6 +230,46 @@ class Store:
             # flood is the churn bench's arrival path).
             return ev_copy
 
+    def create_many(self, kind: str, objs: list[dict],
+                    _trusted: bool = False) -> list[Optional[dict]]:
+        """Batch create: every object commits under ONE lock acquisition
+        (one revision run, one WAL stretch, one watch-fanout pass) — the
+        txn shape for a churn wave's worth of arrivals or a whole bind
+        wave's Events, where per-create lock round-trips and sink wake-ups
+        are pure overhead.  Semantics per item are exactly :meth:`create`
+        (same defaulting, same ADDED event, events in list order); a
+        failed item (already exists / malformed) yields None in its slot
+        and the REST of the batch still commits — the best-effort contract
+        batch writers (the event sink) want, and loud enough for callers
+        that care to check."""
+        faults.hit("store.commit", op="create_many", kind=kind)
+        results: list[Optional[dict]] = []
+        with self._mu:
+            bucket = self._objects.setdefault(kind, {})
+            for obj in objs:
+                try:
+                    meta = obj.setdefault("metadata", {})
+                    key = object_key(meta.get("namespace", "default"),
+                                     meta.get("name", ""))
+                    if key in bucket:
+                        results.append(None)
+                        continue
+                    rev = self._next_rev()
+                    data = obj if _trusted else _fast_deepcopy(obj)
+                    m = data["metadata"]
+                    m.setdefault("namespace", "default")
+                    if not m.get("uid"):
+                        m["uid"] = new_uid()
+                    m["resourceVersion"] = rev
+                    m["creationRevision"] = rev
+                    bucket[key] = _Item(data=data, revision=rev)
+                    ev_copy = _fast_deepcopy(data)
+                    self._emit(WatchEvent(ADDED, kind, key, rev, ev_copy))
+                    results.append(ev_copy)
+                except Exception:  # noqa: BLE001 - one bad item, not the batch
+                    results.append(None)
+        return results
+
     def update(
         self, kind: str, obj: dict, expect_rev: Optional[int] = None, _trusted: bool = False
     ) -> dict:
@@ -425,19 +465,19 @@ class Store:
             return out, self._rev
 
     def list_columns(self, kind: str = "Pod", namespace: Optional[str] = None):
-        """Columnar LIST fast path (Pod only): one packed batch of raw
-        object views + parallel identity/request/signature columns — see
-        ``store/columns.py``.  The views share deep subtrees with the
-        stored dicts (zero-copy): only the two levels the store ever
-        mutates in place are copied, under the lock, so consumers get a
-        consistent snapshot at the returned revision.  Consumers MUST
-        treat the payloads as read-only (the informer contract).  Returns
-        None for kinds without a columnar emitter — callers fall back to
-        :meth:`list`."""
-        if kind != "Pod":
-            return None
-        from .columns import batch_from_views, shallow_object_view
+        """Columnar LIST fast path (Pod and Node): one packed batch of
+        raw object views + parallel identity (and for pods request/
+        signature) columns — see ``store/columns.py``.  The views share
+        deep subtrees with the stored dicts (zero-copy): only the two
+        levels the store ever mutates in place are copied, under the
+        lock, so consumers get a consistent snapshot at the returned
+        revision.  Consumers MUST treat the payloads as read-only (the
+        informer contract).  Returns None for kinds without a columnar
+        emitter — callers fall back to :meth:`list`."""
+        from .columns import COLUMN_BATCH_KINDS, batch_from_views, shallow_object_view
 
+        if kind not in COLUMN_BATCH_KINDS:
+            return None
         with self._mu:
             rev = self._rev
             views = []
@@ -447,7 +487,7 @@ class Store:
                     if ns != namespace:
                         continue
                 views.append(shallow_object_view(item.data))
-        return batch_from_views(views, rev)
+        return batch_from_views(views, rev, kind=kind)
 
     # -- watch -------------------------------------------------------------
     def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None) -> Watch:

@@ -276,6 +276,20 @@ class SchedulerMetrics:
             "(0 = fully cache-resident, 1 = full upload)",
             buckets=[i / 20 for i in range(21)],
         ))
+        # frontier scan (ISSUE 5): monotone node pruning + mid-segment
+        # node-axis compaction on the XLA scan path
+        self.frontier_compactions = r.register(Counter(
+            "scheduler_frontier_compactions_total",
+            "mid-segment device node-axis compactions (the alive-union "
+            "fraction fell below the threshold and the scan resumed at a "
+            "smaller power-of-two width)",
+        ))
+        self.frontier_alive_fraction = r.register(Histogram(
+            "scheduler_frontier_alive_fraction",
+            "lowest alive-union fraction observed per frontier segment "
+            "(1.0 = no column ever died; small = heavy pruning)",
+            buckets=[i / 20 for i in range(21)],
+        ))
         # preemption (the PostFilter phase)
         self.preemption_attempts = r.register(Counter(
             "scheduler_preemption_attempts_total"))

@@ -90,3 +90,49 @@ class HandlerCallbacks:
     def _on_add(self, obj):
         # RL303: informer-thread callback mutating an unlocked container
         self._index[obj.key] = obj
+
+
+class AliasedMutations:
+    """The ISSUE 5 alias slice: a single-assignment local alias of a
+    container attribute is the container — subscript writes, mutator
+    calls, del, and heap pushes through it are RL303 findings."""
+
+    def __init__(self):
+        self._pending = {}
+        self._queue = []
+        self._heap = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        p = self._pending
+        p["k"] = 1  # RL303 via alias
+        q = self._queue
+        q.append("k")  # RL303 via alias
+        h = self._heap
+        heapq.heappush(h, (0.0, "k"))  # RL303 via alias
+        del p["k"]  # folds into the same _pending finding (dedup by attr)
+
+
+class AliasExemptions:
+    """NOT flagged: reassigned aliases, parameter shadows, and aliases
+    mutated under the lock stay silent — alias tracking must
+    over-approximate toward silence."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._pending = {}
+        self._other = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        p = self._pending
+        p = {}  # reassigned: no longer provably the container
+        p["k"] = 1
+        with self._mu:
+            g = self._other
+            g["k"] = 1  # lock held: clean
+        self._with_param(None)
+
+    def _with_param(self, p):
+        p = self._pending  # shadows a parameter: dropped
+        p["k"] = 1

@@ -818,6 +818,16 @@ MATRIX = {
         world="local", exact=True,
         check=lambda w, plan: (
             w.sched.metrics.pipeline_prep_failures.value > 0)),
+    # the frontier prefilter seed dies on the first kernel segment: the
+    # segment is served by the full-width scan from the SAME state, so
+    # the pod→node map matches the oracle exactly — only the pruning win
+    # is lost, visible in the fallback counter.  (The gather-phase twin,
+    # which needs a cluster that saturates mid-segment to even attempt a
+    # compaction, is exercised in tests/test_frontier.py.)
+    "backend.compact": dict(
+        spec=dict(mode="error", match={"phase": "seed"}, first_n=1),
+        world="local", exact=True,
+        check=lambda w, plan: w.backend.stats["frontier_fallbacks"] > 0),
     "store.wal.append": dict(world="wal"),  # special-cased crash/recover run
     "remote.request": dict(
         spec=dict(mode="error", first_n=2,

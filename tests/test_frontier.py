@@ -1,0 +1,381 @@
+"""Frontier scan (ISSUE 5): monotone node pruning + mid-segment
+node-axis compaction.
+
+Parity law under test: a node column dropped by the prefilter or a
+mid-segment compaction is provably inert — it was monotonically
+infeasible for EVERY signature, and every normalization, tie set, and
+n_feasible in the kernel ranges over feasible columns only — so the
+frontier path must reproduce the sequential CPU oracle's bindings AND
+its round-robin tie counter bit-for-bit, at any chunk length, any
+compaction threshold, and any width floor.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api import Toleration
+from kubernetes_tpu.faults import FaultPlan
+from kubernetes_tpu.models.snapshot import (
+    Tensorizer,
+    compact_segment,
+    frontier_seed,
+)
+from kubernetes_tpu.ops import TPUBatchBackend
+from kubernetes_tpu.ops.batch_kernel import (
+    FrontierRun,
+    schedule_batch_arrays,
+)
+from kubernetes_tpu.scheduler import GenericScheduler, PriorityContext
+from kubernetes_tpu.scheduler.generic_scheduler import FitError
+from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+from kubernetes_tpu.testutil import make_node, make_pod
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def oracle_batch(pods, node_info_map, pctx, algorithm):
+    work = {n: i.clone() for n, i in node_info_map.items()}
+    wctx = PriorityContext(
+        work, services=pctx.services, replicasets=pctx.replicasets,
+        hard_pod_affinity_weight=pctx.hard_pod_affinity_weight,
+        pvcs=pctx.pvcs, pvs=pctx.pvs,
+    )
+    out = []
+    for pod in pods:
+        try:
+            res = algorithm.schedule(pod, work, wctx)
+            out.append(res.node_name)
+            work[res.node_name].add_pod(pod)
+        except FitError:
+            out.append(None)
+    return out
+
+
+def tiny_cluster(n_small=8, n_big=8, small_cpu="1", big_cpu="64"):
+    """Small nodes saturate fast (columns die mid-segment); big nodes are
+    IDENTICAL (scores tie, so the round-robin counter is live)."""
+    nim = {}
+    for i in range(n_small):
+        n = make_node(f"small-{i:03d}", cpu=small_cpu, memory="64Gi", pods=110,
+                      labels={"kubernetes.io/hostname": f"small-{i:03d}",
+                              ZONE: f"zone-{i % 2}"})
+        nim[n.meta.name] = NodeInfo(n)
+    for i in range(n_big):
+        n = make_node(f"zbig-{i:03d}", cpu=big_cpu, memory="64Gi", pods=110,
+                      labels={"kubernetes.io/hostname": f"zbig-{i:03d}",
+                              ZONE: f"zone-{i % 2}"})
+        nim[n.meta.name] = NodeInfo(n)
+    return nim
+
+
+def tie_cluster(n=16):
+    """Every node IDENTICAL on all score inputs (cpu/mem/zone) so the
+    whole fleet is one big tie set and the round-robin counter rotates it
+    — but the pod-count caps are STAGGERED (2, 2, 3, 3, …), so columns
+    die one after another as the rotation fills them: exactly the shape
+    that forces mid-segment compactions while ties stay live
+    throughout."""
+    nim = {}
+    for i in range(n):
+        node = make_node(f"node-{i:03d}", cpu="64", memory="64Gi",
+                         pods=2 + i // 2,
+                         labels={"kubernetes.io/hostname": f"node-{i:03d}",
+                                 ZONE: "zone-0"})
+        nim[node.meta.name] = NodeInfo(node)
+    return nim
+
+
+def assert_frontier_parity(pods, nim, backend_kwargs=None, pctx=None):
+    pctx = pctx or PriorityContext(nim)
+    a, b = GenericScheduler(), GenericScheduler()
+    want = oracle_batch(pods, nim, pctx, a)
+    backend = TPUBatchBackend(algorithm=b, **(backend_kwargs or {}))
+    got = backend.schedule_batch(pods, nim, pctx)
+    mism = [(p.meta.name, w, g) for p, w, g in zip(pods, want, got) if w != g]
+    assert not mism, f"{len(mism)} mismatches; first: {mism[:5]}"
+    assert a._round_robin == b._round_robin, "tie-break counter diverged"
+    assert backend.stats["frontier_fallbacks"] == 0
+    return backend
+
+
+# ---------------------------------------------------------------------------
+# leg 1: the tensorize-time prefilter
+# ---------------------------------------------------------------------------
+
+
+def test_frontier_seed_matches_bruteforce():
+    """still_ok[g, j] must equal the conjunction of the monotone step-0
+    filters, computed here independently per (signature, column)."""
+    rng = random.Random(3)
+    nim = tiny_cluster(n_small=5, n_big=3)
+    # one nearly-full node: resource headroom kills it for the batch
+    full = make_pod("full-0", cpu="63", memory="1Gi", node_name="zbig-000")
+    nim["zbig-000"].add_pod(full)
+    pods = [make_pod(f"p-{i:03d}", cpu=rng.choice(["500m", "2"]),
+                     memory="128Mi", labels={"app": "web"},
+                     host_ports=[8080] if i % 3 == 0 else None)
+            for i in range(12)]
+    # a port already taken on one node
+    taken = make_pod("taken", cpu="100m", host_ports=[8080],
+                     node_name="small-001")
+    nim["small-001"].add_pod(taken)
+    pctx = PriorityContext(nim)
+    tz = Tensorizer()
+    static = tz.build_static(pods, nim, pctx)
+    init = tz.initial_state(static, nim, pctx, pods)
+    alive = frontier_seed(static, init)
+    assert init.still_ok is not None
+
+    G = static.static_ok.shape[0]
+    for g in range(G):
+        req = static.g_request[g]
+        for j in range(len(static.node_names)):
+            fit = all(init.requested[j, r] + req[r] <= static.node_alloc[j, r]
+                      for r in range(len(req)) if req[r] > 0)
+            pods_ok = init.pod_count[j] + 1 <= static.node_alloc_pods[j]
+            ports_ok = not (init.ports_used[j] & static.g_ports[g]).any()
+            want = bool(static.static_ok[g, j] and fit and pods_ok and ports_ok)
+            assert bool(init.still_ok[g, j]) == want, (g, j)
+    np.testing.assert_array_equal(alive, init.still_ok.any(axis=0))
+
+
+def test_prefilter_compaction_is_inert():
+    """Compacting away the dead columns changes nothing: the compacted
+    plain scan reproduces the full-width scan index-for-index (through
+    the kept-column map) and the oracle's bindings."""
+    nim = tiny_cluster(n_small=6, n_big=4)
+    # kill the small nodes for every signature up front: saturate them
+    for i in range(6):
+        nim[f"small-{i:03d}"].add_pod(
+            make_pod(f"hog-{i}", cpu="1", node_name=f"small-{i:03d}"))
+    pods = [make_pod(f"p-{i:03d}", cpu="2", memory="128Mi",
+                     labels={"app": "web"}) for i in range(20)]
+    pctx = PriorityContext(nim)
+    tz = Tensorizer()
+    static = tz.build_static(pods, nim, pctx)
+    init = tz.initial_state(static, nim, pctx, pods)
+    full_chosen, full_rr = schedule_batch_arrays(static, init)
+
+    alive = frontier_seed(static, init)
+    js = np.nonzero(alive)[0]
+    assert 0 < len(js) < len(static.node_names)  # something really died
+    cstatic, cinit = compact_segment(static, init, js, width=8)
+    assert cstatic.node_token is None  # must never alias the device cache
+    c_chosen, c_rr = schedule_batch_arrays(cstatic, cinit)
+    # map compacted indices back to full-axis names
+    full_names = [static.node_names[i] if i >= 0 else None
+                  for i in full_chosen]
+    c_names = [cstatic.node_names[i] if i >= 0 else None for i in c_chosen]
+    assert full_names == c_names
+    assert full_rr == c_rr
+
+
+# ---------------------------------------------------------------------------
+# legs 2+3: still_ok carry + mid-segment compaction
+# ---------------------------------------------------------------------------
+
+
+def test_monotone_mask_never_resurrects():
+    """Property: the alive union is monotone non-increasing over chunks —
+    once a column leaves the frontier it never comes back (the guarantee
+    compaction correctness rests on).  Compaction is disabled so every
+    mask lives on the same axis."""
+    rng = random.Random(11)
+    nim = tiny_cluster(n_small=10, n_big=4, small_cpu="2")
+    pods = [make_pod(f"p-{i:03d}", cpu=rng.choice(["500m", "1"]),
+                     memory="128Mi", labels={"app": "web"})
+            for i in range(120)]
+    pctx = PriorityContext(nim)
+    tz = Tensorizer()
+    static = tz.build_static(pods, nim, pctx)
+    init = tz.initial_state(static, nim, pctx, pods)
+    frontier_seed(static, init)
+
+    masks = []
+
+    class Recorder(FrontierRun):
+        def _maybe_compact(self):
+            import jax.numpy as jnp
+
+            alive = np.asarray(
+                jnp.any(self._state.still_ok, axis=0) & self._dev.node_exists)
+            masks.append(alive)
+            # compact_frac=0 below: the super() call never compacts
+
+    run = Recorder(static, init, chunk_len=16, compact_frac=0.0,
+                   min_width=8)
+    chosen, rr = run.finalize()
+    assert len(masks) >= 3
+    for prev, cur in zip(masks, masks[1:]):
+        resurrected = cur & ~prev
+        assert not resurrected.any(), "a dead column came back alive"
+    # and the run itself is exact vs the plain scan
+    plain_chosen, plain_rr = schedule_batch_arrays(static, init)
+    np.testing.assert_array_equal(chosen, plain_chosen)
+    assert rr == plain_rr
+
+
+def test_forced_tie_and_compaction_roundrobin_parity():
+    """The capstone tie fixture: identical big nodes tie on every score
+    while the small nodes saturate and die, forcing a mid-segment
+    compaction — the round-robin rotation over the surviving tie set must
+    match the oracle's exactly through the permutation."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    backend = assert_frontier_parity(
+        pods, nim,
+        backend_kwargs=dict(frontier_chunk=16, frontier_min_width=8))
+    assert backend.stats["frontier_segments"] >= 1
+    assert backend.stats["frontier_compactions"] >= 1, (
+        backend.last_frontier)
+
+
+def test_n_feasible_one_fast_path_survives_compaction():
+    """Selector-pinned pods exercise the n_feasible==1 fast path (the
+    round-robin counter must NOT advance for them) interleaved with tie
+    pods while compaction fires."""
+    nim = tie_cluster(16)
+    pinned = make_node("zz-pinned", cpu="32", memory="64Gi", pods=110,
+                       labels={"kubernetes.io/hostname": "zz-pinned",
+                               "disk": "ssd"})
+    nim[pinned.meta.name] = NodeInfo(pinned)
+    pods = []
+    for i in range(80):
+        if i % 5 == 0:
+            pods.append(make_pod(f"pin-{i:03d}", cpu="100m", memory="64Mi",
+                                 labels={"app": "db"},
+                                 node_selector={"disk": "ssd"}))
+        else:
+            pods.append(make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                                 labels={"app": "web"}))
+    backend = assert_frontier_parity(
+        pods, nim,
+        backend_kwargs=dict(frontier_chunk=16, frontier_min_width=8))
+    assert backend.stats["frontier_compactions"] >= 1
+
+
+def test_randomized_frontier_parity_with_aggressive_compaction():
+    """Property sweep: random mixed clusters under stress compaction
+    settings (tiny chunks, tiny width floor) stay exact — bindings AND
+    tie counter — including taints, zones, and saturation."""
+    for seed in range(4):
+        rng = random.Random(100 + seed)
+        nim = {}
+        for i in range(rng.randrange(12, 28)):
+            labels = {"kubernetes.io/hostname": f"node-{i:03d}",
+                      ZONE: f"zone-{i % 3}"}
+            taints = []
+            if rng.random() < 0.2:
+                from kubernetes_tpu.api import Taint
+
+                taints.append(Taint(key="dedicated", value="x",
+                                    effect="NoSchedule"))
+            n = make_node(f"node-{i:03d}", cpu=rng.choice(["1", "2", "8"]),
+                          memory=rng.choice(["4Gi", "16Gi"]), pods=20,
+                          labels=labels, taints=taints)
+            nim[n.meta.name] = NodeInfo(n)
+        templates = [
+            dict(cpu="500m", memory="128Mi", labels={"app": "web"}),
+            dict(cpu="1", memory="256Mi", labels={"app": "db"}),
+            dict(cpu="250m", memory="128Mi", labels={"app": "batch"},
+                 tolerations=[Toleration(key="dedicated",
+                                         operator="Exists")]),
+        ]
+        pods = [make_pod(f"p-{i:04d}", **rng.choice(templates))
+                for i in range(rng.randrange(60, 140))]
+        assert_frontier_parity(
+            pods, nim,
+            backend_kwargs=dict(frontier_chunk=16, frontier_min_width=8,
+                                frontier_compact_frac=0.9))
+
+
+# ---------------------------------------------------------------------------
+# fault injection: backend.compact
+# ---------------------------------------------------------------------------
+
+
+def test_compact_fault_at_seed_falls_back_full_width():
+    nim = tiny_cluster(n_small=8, n_big=8, small_cpu="1")
+    pods = [make_pod(f"p-{i:03d}", cpu="500m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(60)]
+    pctx = PriorityContext(nim)
+    a, b = GenericScheduler(), GenericScheduler()
+    want = oracle_batch(pods, nim, pctx, a)
+    backend = TPUBatchBackend(algorithm=b, frontier_chunk=16,
+                              frontier_min_width=8)
+    plan = FaultPlan(seed=1).on("backend.compact", mode="error",
+                                match={"phase": "seed"}, first_n=1)
+    with plan.armed():
+        got = backend.schedule_batch(pods, nim, pctx)
+    assert plan.fired["backend.compact"] == 1
+    assert backend.stats["frontier_fallbacks"] >= 1
+    assert [g for g in got] == want
+    assert a._round_robin == b._round_robin
+
+
+def test_compact_fault_at_gather_retries_full_width():
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(100)]
+    pctx = PriorityContext(nim)
+    a, b = GenericScheduler(), GenericScheduler()
+    want = oracle_batch(pods, nim, pctx, a)
+    backend = TPUBatchBackend(algorithm=b, frontier_chunk=16,
+                              frontier_min_width=8)
+    plan = FaultPlan(seed=1).on("backend.compact", mode="error",
+                                match={"phase": "gather"}, first_n=1)
+    with plan.armed():
+        got = backend.schedule_batch(pods, nim, pctx)
+    assert plan.fired["backend.compact"] == 1
+    assert backend.stats["frontier_fallbacks"] >= 1
+    assert [g for g in got] == want
+    assert a._round_robin == b._round_robin
+    # the breaker was NOT involved: a frontier failure is not a shape
+    # failure, the full-width scan served the segment directly
+    assert backend.stats["oracle_segments"] == 0
+
+
+def test_frontier_off_is_plain_path():
+    nim = tiny_cluster(n_small=4, n_big=4)
+    pods = [make_pod(f"p-{i:03d}", cpu="500m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(20)]
+    backend = assert_frontier_parity(pods, nim,
+                                     backend_kwargs=dict(frontier=False))
+    assert backend.stats["frontier_segments"] == 0
+
+
+# ---------------------------------------------------------------------------
+# axis tightening riding the same release: exactness of r_sel / W / ports
+# ---------------------------------------------------------------------------
+
+
+def test_axis_tightening_shapes_and_parity():
+    from kubernetes_tpu.api import Volume
+
+    nim = tiny_cluster(n_small=4, n_big=6)
+    pods = []
+    for i in range(30):
+        if i % 6 == 0:
+            pods.append(make_pod(
+                f"vol-{i:03d}", cpu="100m", memory="64Mi",
+                labels={"app": "api"},
+                volumes=[Volume(name="v", disk_id=f"pd-{i % 4}",
+                                disk_kind="gce-pd")]))
+        else:
+            pods.append(make_pod(f"p-{i:03d}", cpu="250m", memory="128Mi",
+                                 labels={"app": "web"}))
+    pctx = PriorityContext(nim)
+    tz = Tensorizer()
+    static = tz.build_static(pods, nim, pctx)
+    # no signature requests GPU/storage slots → r_sel drops them
+    assert static.r_sel is not None and len(static.r_sel) == 2
+    assert list(static.r_sel) == [0, 1]
+    # one disk per pod → the slot axis is 1 wide, not vols_per_pod
+    assert static.pod_vol_ids.shape[1] == 1
+    # no host ports anywhere → the kernel skips the port logic
+    assert static.use_ports is False
+    assert_frontier_parity(pods, nim)

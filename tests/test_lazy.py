@@ -358,8 +358,16 @@ def test_remote_columnar_list(tmp_path):
         assert batch.keys == local.keys
         assert [pod_signature_key(p) for p in batch.pods()] == \
             [pod_signature_key(p) for p in local.pods()]
+        # Node has its own columnar emitter now (ISSUE 5): identity
+        # columns ride the wire batch and objects() yields lazy views
+        from kubernetes_tpu.testutil import make_node
+
+        cs.nodes.create(make_node("n-0", cpu="4", memory="8Gi"))
+        nbatch = remote.list_columns("Node")
+        assert nbatch is not None and nbatch.keys == ["n-0"]
+        assert [n.meta.name for n in nbatch.objects()] == ["n-0"]
         # non-columnar kinds answer None and callers fall back
-        assert remote.list_columns("Node") is None
+        assert remote.list_columns("Service") is None
     finally:
         server.stop()
 

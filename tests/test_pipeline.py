@@ -270,6 +270,14 @@ def test_sticky_buckets_stabilize_shapes_across_waves():
 # -- device-resident node state ---------------------------------------------
 
 
+def _expect_alloc(static):
+    """What the device-side node_alloc should hold: the host array sliced
+    by the segment's resource-axis selection (ISSUE 5 tightening)."""
+    if static.r_sel is None:
+        return static.node_alloc
+    return static.node_alloc[:, static.r_sel]
+
+
 def test_device_node_cache_reuses_and_updates_columns():
     from kubernetes_tpu.ops.batch_kernel import DeviceNodeCache, to_device
     from kubernetes_tpu.scheduler.priorities import PriorityContext
@@ -298,7 +306,8 @@ def test_device_node_cache_reuses_and_updates_columns():
     assert s2.node_dirty == [2]
     d3 = to_device(s2, node_cache=cache)
     assert cache.stats["col_updates"] == 1
-    np.testing.assert_array_equal(np.asarray(d3.node_alloc), s2.node_alloc)
+    np.testing.assert_array_equal(
+        np.asarray(d3.node_alloc), _expect_alloc(s2))
     np.testing.assert_array_equal(np.asarray(d3.node_exists), s2.node_exists)
 
 
@@ -337,7 +346,8 @@ def test_device_node_cache_zone_vocab_shift():
     assert not np.array_equal(s1.node_zone, s2.node_zone)
     d2 = to_device(s2, node_cache=cache)
     np.testing.assert_array_equal(np.asarray(d2.node_zone), s2.node_zone)
-    np.testing.assert_array_equal(np.asarray(d2.node_alloc), s2.node_alloc)
+    np.testing.assert_array_equal(
+        np.asarray(d2.node_alloc), _expect_alloc(s2))
 
 
 def test_device_node_cache_survives_tensorizer_swap():
@@ -369,7 +379,8 @@ def test_device_node_cache_survives_tensorizer_swap():
     s2 = Tensorizer().build_static(pods, snap2, PriorityContext(snap2))
     assert s1.node_token != s2.node_token  # nonce differs
     d2 = to_device(s2, node_cache=cache)
-    np.testing.assert_array_equal(np.asarray(d2.node_alloc), s2.node_alloc)
+    np.testing.assert_array_equal(
+        np.asarray(d2.node_alloc), _expect_alloc(s2))
 
 
 # -- _idiv exactness ---------------------------------------------------------
