@@ -386,7 +386,8 @@ def _oracle_replay_waves(drain_batches: list, final_assignments: dict,
 def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               workload: str = "mixed", seed: int = 0, warmup: bool = True,
               pipeline: bool = True, lazy_ingest: bool = True,
-              frontier: bool = True, verify_oracle: bool = False) -> dict:
+              frontier: bool = True, watch_frames: bool = True,
+              verify_oracle: bool = False) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
     created the moment wave w leaves the queue, the density.go shape
@@ -410,7 +411,11 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     columnar store emit.  ``frontier=False`` is the ISSUE-5 A/B arm
     (``--ab-frontier``): the full-width plain scan instead of the
     frontier scan (monotone prefilter + chunked still_ok + mid-segment
-    node-axis compaction).  ``verify_oracle=True`` additionally replays
+    node-axis compaction).  ``watch_frames=False`` is the ISSUE-6 A/B
+    arm (``--ab-watch``): per-event watch delivery and per-pod cache
+    apply/bind confirm instead of column-packed frames, one-lock batch
+    apply, and the columnar wave confirm.  ``verify_oracle=True``
+    additionally replays
     the recorded drain batches through the per-pod CPU oracle off-clock
     and reports per-wave binding parity (``oracle_parity``).
 
@@ -427,23 +432,29 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
     from kubernetes_tpu.store import Store
 
+    from kubernetes_tpu.store import frames as frames_mod
+
     if warmup:  # compile the wave-sized segment buckets off the clock
         run_churn(n_nodes, 2 * (total_pods // waves), 2, workload, seed + 1,
                   warmup=False, pipeline=pipeline, lazy_ingest=lazy_ingest,
-                  frontier=frontier)
+                  frontier=frontier, watch_frames=watch_frames)
 
     lazy_was = lazy_mod.ENABLED
+    frames_was = frames_mod.ENABLED
     lazy_mod.ENABLED = lazy_ingest
+    frames_mod.ENABLED = watch_frames
     try:
         return _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
                                 pipeline, lazy_ingest, frontier,
-                                verify_oracle)
+                                watch_frames, verify_oracle)
     finally:
         lazy_mod.ENABLED = lazy_was
+        frames_mod.ENABLED = frames_was
 
 
 def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
-                     lazy_ingest, frontier, verify_oracle) -> dict:
+                     lazy_ingest, frontier, watch_frames,
+                     verify_oracle) -> dict:
     import threading
 
     from kubernetes_tpu.api import lazy as lazy_mod
@@ -522,6 +533,12 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
     phase_timers: list[dict] = []
     for w in range(waves):
         pump_before = pump_acc[0]
+        # pump-APPLICATION bracket at the wave level (ISSUE 6): the
+        # bind-confirm frame of wave w is often digested by wave w+1's
+        # pre-drain pumps, so per-wave apply time is deltaed around the
+        # whole serving call, not just schedule_pending_batch
+        apply_before = sched._pump_apply_stats()
+        fb_before = sched.metrics.confirm_fallbacks.value
         b = sched.run_batch_loop(min_batch=per_wave, max_wait=30.0,
                                  max_waves=1, poll_interval=0.002)
         bound += b
@@ -530,6 +547,12 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
                         "commit_s", "prep_s", "decode_s")}
         ph["promotions"] = int(sched.last_batch_phases.get("promotions", 0))
         ph["pump_s"] = round(pump_acc[0] - pump_before, 4)
+        apply_after = sched._pump_apply_stats()
+        ph["apply_s"] = round(apply_after[0] - apply_before[0], 4)
+        ph["frames"] = apply_after[1] - apply_before[1]
+        ph["frame_events"] = apply_after[2] - apply_before[2]
+        ph["confirm_fallbacks"] = int(
+            sched.metrics.confirm_fallbacks.value - fb_before)
         ph["bound"] = b
         fr = sched.last_batch_phases.get("frontier")
         if fr:
@@ -608,6 +631,16 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             "wrapped": lazy_post["wrapped"] - lazy_pre["wrapped"],
             "promotions": (lazy_post["promotions"] + lazy_post["sections"]
                            - lazy_pre["promotions"] - lazy_pre["sections"]),
+        },
+        # batched watch frames (ISSUE 6): delivery + one-lock apply +
+        # columnar confirm volume of the run
+        "watch": {
+            "frames_enabled": watch_frames,
+            "frames": pod_inf["frames"],
+            "frame_events": pod_inf["frame_events"],
+            "batch_errors": pod_inf["batch_errors"],
+            "apply_s": round(pod_inf["apply_s"], 4),
+            "confirm_fallbacks": int(sched.metrics.confirm_fallbacks.value),
         },
         "oracle_parity": oracle_parity,
         "slo_p99_ms": CHURN_SLO_P99_MS,
@@ -828,6 +861,88 @@ def run_frontier_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
         "bound_counts": sorted(bounds),
         "oracle_parity": parity,
         "alive_trajectories_first_run": trajectories,
+    }
+
+
+def run_watch_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
+                 waves: int = 10, pairs: int = 2, seed: int = 0) -> dict:
+    """Both-orders interleaved A/B of batched watch frames (ISSUE 6):
+    B (new) = column-packed watch frames + one-lock informer batch apply
+    + the scheduler's columnar wave confirm; A (old) = per-event watch
+    delivery and per-pod cache apply/bind confirm, same harness, same
+    seeds.  The first pair replays both arms' recorded drain batches
+    through the per-pod CPU oracle (off-clock) and reports per-wave
+    binding parity.  Writes the BENCH_AB_watch_frames.json ledger shape
+    (the recorded ledger uses the worktree method; this flag A/B
+    isolates the feature seam on one tree)."""
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False, watch_frames=True)
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False, watch_frames=False)
+
+    parity = {}
+
+    def one(framed: bool, verify: bool = False) -> dict:
+        r = run_churn(n_nodes, total_pods, waves, seed=seed, warmup=False,
+                      watch_frames=framed, verify_oracle=verify)
+        if verify:
+            parity["frames" if framed else "per_event"] = r["oracle_parity"]
+        return r
+
+    ab_pairs, ba_pairs = [], []
+    a_all, b_all = [], []
+    a_apply, b_apply = [], []
+    bounds = set()
+    for i in range(pairs):
+        b = one(True, verify=(i == 0))
+        a = one(False, verify=(i == 0))
+        ab_pairs.append({"B_new": b["pods_per_sec"], "A_old": a["pods_per_sec"]})
+        b_all.append(b["pods_per_sec"])
+        a_all.append(a["pods_per_sec"])
+        b_apply.append(b["watch"]["apply_s"])
+        a_apply.append(a["watch"]["apply_s"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-watch AB: B={b['pods_per_sec']} A={a['pods_per_sec']} "
+              f"apply_s A={a['watch']['apply_s']} B={b['watch']['apply_s']}",
+              file=sys.stderr)
+    for _ in range(pairs):
+        a = one(False)
+        b = one(True)
+        ba_pairs.append({"A_old": a["pods_per_sec"], "B_new": b["pods_per_sec"]})
+        a_all.append(a["pods_per_sec"])
+        b_all.append(b["pods_per_sec"])
+        a_apply.append(a["watch"]["apply_s"])
+        b_apply.append(b["watch"]["apply_s"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-watch BA: A={a['pods_per_sec']} B={b['pods_per_sec']}",
+              file=sys.stderr)
+    a_med = sorted(a_all)[len(a_all) // 2]
+    b_med = sorted(b_all)[len(b_all) // 2]
+    won = sum(1 for p in ab_pairs + ba_pairs if p["B_new"] > p["A_old"])
+    return {
+        "claim": ("Batched watch frames: column-packed event delivery "
+                  "(one frame per correlated store txn), one-lock informer "
+                  "batch apply, and the scheduler's columnar wave confirm "
+                  "(prev-revision fence) from store to bind confirm"),
+        "method": (f"Churn {n_nodes} nodes / {total_pods} mixed pods / "
+                   f"{waves} waves, arrival thread + run_batch_loop serving "
+                   "(both arms), events on; interleaved pairs in BOTH "
+                   "orders, one shared process, per-arm warm-up compiles "
+                   "paid up front; A = frames seam off (per-event delivery "
+                   "+ per-pod apply/confirm, pre-ISSUE-6), B = frames on; "
+                   "first pair of each arm replayed off-clock through the "
+                   "per-pod CPU oracle per drained wave"),
+        "pairs_order_AB_first": ab_pairs,
+        "pairs_order_BA_first": ba_pairs,
+        "A_old_all": a_all,
+        "B_new_all": b_all,
+        "A_median": a_med,
+        "B_median": b_med,
+        "win_pct": round((b_med - a_med) / a_med * 100, 1) if a_med else None,
+        "b_won_pairs": f"{won}/{len(ab_pairs) + len(ba_pairs)} (both orders)",
+        "bound_counts": sorted(bounds),
+        "apply_s_per_run": {"A_old": a_apply, "B_new": b_apply},
+        "oracle_parity": parity,
     }
 
 
@@ -1081,9 +1196,18 @@ def main() -> None:
         "BENCH_AB_frontier_scan.json); --nodes/--pods/--trials override "
         "scale and pair count",
     )
+    parser.add_argument(
+        "--ab-watch", nargs="?", const="BENCH_AB_watch_frames.json",
+        default=None, metavar="PATH",
+        help="run the both-orders batched-watch-frames A/B (column-packed "
+        "frames + one-lock batch apply + columnar confirm vs per-event "
+        "delivery) and write the ledger JSON to PATH (default "
+        "BENCH_AB_watch_frames.json); --nodes/--pods/--trials override "
+        "scale and pair count",
+    )
     args = parser.parse_args()
 
-    if args.ab_churn or args.ab_pump or args.ab_frontier:
+    if args.ab_churn or args.ab_pump or args.ab_frontier or args.ab_watch:
         import datetime
 
         kw = {}
@@ -1093,10 +1217,12 @@ def main() -> None:
             kw["total_pods"] = args.pods
         if args.trials:
             kw["pairs"] = args.trials
-        runner = (run_frontier_ab if args.ab_frontier
+        runner = (run_watch_ab if args.ab_watch
+                  else run_frontier_ab if args.ab_frontier
                   else run_pump_ab if args.ab_pump else run_churn_ab)
-        path = args.ab_frontier or args.ab_pump or args.ab_churn
-        metric = ("frontier-scan-win-pct" if args.ab_frontier
+        path = args.ab_watch or args.ab_frontier or args.ab_pump or args.ab_churn
+        metric = ("watch-frames-win-pct" if args.ab_watch
+                  else "frontier-scan-win-pct" if args.ab_frontier
                   else "pump-ingest-win-pct" if args.ab_pump
                   else "churn-pipeline-win-pct")
         ledger = runner(**kw)

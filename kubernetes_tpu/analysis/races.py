@@ -45,16 +45,19 @@ single-assignment case (ISSUE 5, first slice of the points-to-lite
 item): a local name assigned exactly ONCE in the method, from a plain
 ``self.<container>`` read, is treated as that container — subscript
 writes/deletes, mutator calls, and heap functions on it report RL301/
-RL303 exactly as the direct form would.  A name reassigned anywhere in
-the method (including loop/with targets) or shadowing a parameter is
+RL303 exactly as the direct form would.  Chains of such names
+(``q = p; q[k] = v`` — the ISSUE 6 slice) resolve by fixed point, so a
+two-hop (or k-hop) alias reports identically; a name reassigned
+anywhere in the method (including loop/with targets) or shadowing a
+parameter breaks the chain at that link and everything downstream is
 dropped: flow-insensitive alias tracking must over-approximate toward
 SILENCE, never invent findings on a rebound local.
 
-Known blind spots (documented, deliberate): aliases through more than
-one hop (``q = p``), aliases captured by nested defs, and locks held by
-callers across method boundaries are not tracked (a method that writes
-under "caller holds the lock" convention baselines with that as its
-justification).
+Known blind spots (documented, deliberate): aliases captured by nested
+defs, aliases flowing through calls/containers (``q = f(p)``,
+``pair = (p,); pair[0][k] = v``), and locks held by callers across
+method boundaries are not tracked (a method that writes under "caller
+holds the lock" convention baselines with that as its justification).
 """
 
 from __future__ import annotations
@@ -333,12 +336,17 @@ def _subscript_name(target: ast.expr) -> Optional[str]:
 def _local_aliases(fn: ast.FunctionDef, containers: set[str]) -> dict[str, str]:
     """Local name -> container attribute, for names assigned exactly once
     in ``fn`` (nested defs excluded, mirroring _WriteVisitor's scope) and
-    whose one assignment is a plain ``self.<container>`` read.  Any other
-    binding of the name — a second assignment, a for/with target, a
-    parameter — disqualifies it (flow-insensitive tracking must never
-    flag a rebound local)."""
+    whose one assignment is a plain ``self.<container>`` read — or, the
+    ISSUE 6 points-to slice, a chain of such names (``p = self._pending;
+    q = p; q[k] = v``): name→name links between single-assignment locals
+    resolve to the container by fixed point, so a two-hop (or k-hop)
+    alias reports exactly as the direct form would.  Any other binding of
+    ANY name in the chain — a second assignment, a for/with target, a
+    parameter — breaks the chain at that link and every name past it is
+    dropped (flow-insensitive tracking must never flag a rebound local)."""
     counts: dict[str, int] = {}
     cand: dict[str, str] = {}
+    links: dict[str, str] = {}  # q -> p for single-candidate `q = p`
     params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
                               + fn.args.posonlyargs)}
     if fn.args.vararg is not None:
@@ -368,6 +376,11 @@ def _local_aliases(fn: ast.FunctionDef, containers: set[str]) -> dict[str, str]:
                     attr = _is_self_attr(node.value)
                     if attr is not None and attr in containers:
                         cand[t.id] = attr
+                    elif isinstance(node.value, ast.Name):
+                        # `q = p`: a name-to-name link — resolved to a
+                        # container only if the whole chain survives the
+                        # single-assignment filter below
+                        links[t.id] = node.value.id
             self.generic_visit(node)
 
         def visit_AugAssign(self, node: ast.AugAssign) -> None:
@@ -401,8 +414,25 @@ def _local_aliases(fn: ast.FunctionDef, containers: set[str]) -> dict[str, str]:
     v = V()
     for stmt in fn.body:
         v.visit(stmt)
-    return {name: attr for name, attr in cand.items()
-            if counts.get(name) == 1 and name not in params}
+
+    def valid(name: str) -> bool:
+        return counts.get(name) == 1 and name not in params
+
+    resolved = {name: attr for name, attr in cand.items() if valid(name)}
+    # fixed point over the name→name links: `q = p` resolves to p's
+    # container only when BOTH names are single-assignment non-params —
+    # a rebound or shadowed link anywhere in the chain drops everything
+    # downstream of it (over-approximate toward silence)
+    chain_links = {q: p for q, p in links.items()
+                   if valid(q) and q not in resolved}
+    changed = True
+    while changed:
+        changed = False
+        for q, p in chain_links.items():
+            if q not in resolved and p in resolved:
+                resolved[q] = resolved[p]
+                changed = True
+    return resolved
 
 
 class _WriteVisitor(ast.NodeVisitor):

@@ -340,6 +340,56 @@ def wrap(cls: type, raw: dict):
 
 
 # ---------------------------------------------------------------------------
+# promote-and-drop-raw compaction (ISSUE 6 satellite; ROADMAP carried item)
+# ---------------------------------------------------------------------------
+
+
+def _promote_all_sections(obj, names: tuple) -> None:
+    for name in names:
+        getattr(obj, name)  # _section installs into the instance dict
+
+
+def promote_and_drop_raw(obj) -> bool:
+    """Force-promote every lazy section of ``obj`` and release its pinned
+    wire dict.
+
+    A cached lazy view keeps its whole raw payload alive for its
+    lifetime — including every wire field the typed form doesn't model,
+    which on real payloads is most of the bytes.  This sweep converges a
+    lazy object to exactly what an eager ``from_dict`` would hold: all
+    sections promoted (observable value unchanged — promotion ≡
+    from_dict, pinned by test_lazy), raw references nulled so the wire
+    dicts can be collected.  After the drop every raw fast-path helper
+    (``undecoded_spec``/``undecoded_meta``/``pod_brief``) answers through
+    the typed objects — they all gate on the raw still being present.
+
+    Returns True when a raw payload was actually dropped (False for
+    eager objects and already-compacted views)."""
+    d = getattr(obj, "__dict__", None)
+    if d is None or d.get("_lzraw") is None:
+        return False
+    if isinstance(obj, (LazyPod, LazyNode)):
+        _promote_all_sections(obj, ("meta", "spec", "status"))
+        meta = d["meta"]
+        if isinstance(meta, LazyObjectMeta):
+            _promote_all_sections(meta, ("labels", "annotations",
+                                         "owner_references", "finalizers"))
+            meta.__dict__["_lzraw"] = None
+        spec = d["spec"]
+        if isinstance(spec, LazyPodSpec):
+            _promote_all_sections(spec, _LAZY_SPEC_FIELDS)
+            spec.__dict__["_lzraw"] = None
+        d["_lzraw"] = None
+        return True
+    promote = getattr(obj, "_lz_promote", None)
+    if promote is None:
+        return False  # not a lazy view at all
+    promote()
+    d["_lzraw"] = None
+    return True
+
+
+# ---------------------------------------------------------------------------
 # raw fast-path readers (the column view)
 # ---------------------------------------------------------------------------
 

@@ -1150,6 +1150,8 @@ def _make_handler(server: APIServer):
 
         # -- watch streaming (handlers/rest.go:276 watch upgrade) ----------
         def _serve_watch(self, kind: str, q) -> None:
+            from ..store.frames import FRAME
+
             from_rev = None
             if "resourceVersion" in q:
                 from_rev = int(q["resourceVersion"][0])
@@ -1157,7 +1159,14 @@ def _make_handler(server: APIServer):
             has_selectors = bool(q.get("labelSelector") or q.get("fieldSelector"))
             if has_selectors and self._apply_list_selectors([], q) is None:
                 return  # bad selector: 400 written BEFORE the stream starts
-            watch = server.store.watch(kind, from_revision=from_rev)
+            # column-packed frame delivery (?frames=1): one JSON line per
+            # correlated batch txn instead of N.  Selector watches stay
+            # per-event — the stream filter below is per-object, and a
+            # partially-matching frame would have to be re-packed anyway
+            want_frames = (q.get("frames", ["0"])[0] in ("1", "true")
+                           and not has_selectors)
+            watch = server.store.watch(kind, from_revision=from_rev,
+                                       frames=want_frames)
             try:
                 self._last_code = 200
                 self.send_response(200)
@@ -1170,6 +1179,12 @@ def _make_handler(server: APIServer):
                 while _t.monotonic() < deadline:
                     ev = watch.get(timeout=min(0.5, max(0.0, deadline - _t.monotonic())))
                     if ev is None:
+                        continue
+                    if ev.type == FRAME:
+                        # one chunked line carries the whole frame (only
+                        # possible when this watcher opted in above)
+                        self._write_chunk(
+                            json.dumps(ev.to_wire()).encode() + b"\n")
                         continue
                     if has_selectors:
                         # the LIST-then-WATCH contract: the same selectors

@@ -53,6 +53,17 @@ class TypedClient:
         self._trusted_create_many = _takes_trusted(
             getattr(store, "create_many", None))
 
+        def _takes_frames(fn) -> bool:
+            try:
+                return "frames" in inspect.signature(fn).parameters
+            except (TypeError, ValueError):
+                return False
+
+        # column-packed watch delivery (store/frames.py): opt-in per
+        # watcher, and only when the transport speaks it — a pre-frame
+        # store silently degrades to per-event delivery
+        self._watch_frames = _takes_frames(store.watch)
+
     def _ns(self, namespace: Optional[str]) -> str:
         """Resolve the effective namespace.  Cluster-scoped kinds ignore any
         caller/object namespace (reference: the registry's scope strategy,
@@ -184,7 +195,14 @@ class TypedClient:
     def delete(self, name: str, namespace: Optional[str] = None):
         return self._cls.from_dict(self._store.delete(self.kind, self._ns(namespace), name))
 
-    def watch(self, from_revision: Optional[int] = None) -> Watch:
+    def watch(self, from_revision: Optional[int] = None,
+              frames: bool = False) -> Watch:
+        """``frames=True`` requests column-packed batch delivery (one
+        WatchFrame per correlated store txn) when the transport supports
+        it; per-event otherwise.  Only frame-aware consumers (the
+        informer's batch apply) should opt in."""
+        if frames and self._watch_frames:
+            return self._store.watch(self.kind, from_revision, frames=True)
         return self._store.watch(self.kind, from_revision)
 
 

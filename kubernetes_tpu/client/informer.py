@@ -30,6 +30,7 @@ from typing import Callable, Optional
 from .. import faults
 from ..api import lazy as lazy_mod
 from ..api import types as api
+from ..store.frames import FRAME, WatchFrame
 from ..store.store import (
     ADDED,
     DELETED,
@@ -50,10 +51,17 @@ class Handler:
         on_add: Optional[Callable] = None,
         on_update: Optional[Callable] = None,
         on_delete: Optional[Callable] = None,
+        on_batch: Optional[Callable] = None,
     ):
         self.on_add = on_add or (lambda obj: None)
         self.on_update = on_update or (lambda old, new: None)
         self.on_delete = on_delete or (lambda obj: None)
+        # batch-aware handlers receive a whole watch frame in ONE call:
+        # ``on_batch(frame, deltas)`` with deltas = [(type, old, new, i)]
+        # (i indexes the frame's columns — dropped/fenced events are
+        # absent).  Handlers without it get the per-event callbacks for
+        # every framed event, so frames never change handler semantics.
+        self.on_batch = on_batch
 
 
 class SharedInformer:
@@ -77,7 +85,14 @@ class SharedInformer:
         # per wave; decode_errors is the informer.decode recovery signal)
         self.stats = {"relists": 0, "dropped_events": 0, "handler_errors": 0,
                       "relist_failures": 0, "decode_errors": 0,
-                      "decoded_events": 0, "decode_s": 0.0}
+                      "decoded_events": 0, "decode_s": 0.0,
+                      # batched watch frames (ISSUE 6): frames applied,
+                      # events they carried, frames lost whole (→ gap),
+                      # cumulative apply time (cache + handler fan-out) —
+                      # the scheduler's per-wave pump_apply delta source —
+                      # and promote-and-drop-raw sweeps
+                      "frames": 0, "frame_events": 0, "batch_errors": 0,
+                      "apply_s": 0.0, "compactions": 0}
         # serializes relist(): a resync timer tick racing a GAP
         # escalation must not build two watches and leak the loser
         self._relist_mu = threading.Lock()
@@ -133,6 +148,15 @@ class SharedInformer:
         objs, rev = self._client.list()
         return objs, rev, None
 
+    def _watch_from(self, rev: int):
+        """Build the watch, opting into column-packed frame delivery when
+        the client speaks it (the informer is frame-aware; clients that
+        predate the parameter degrade to per-event)."""
+        try:
+            return self._client.watch(from_revision=rev, frames=True)
+        except TypeError:
+            return self._client.watch(from_revision=rev)
+
     def _seed(self) -> None:
         objs, rev, keys = self._list()
         with self._mu:
@@ -141,7 +165,7 @@ class SharedInformer:
             if self._mutation_detector:
                 self._snapshots = {o.meta.key: o.to_dict() for o in objs}
             self.last_revision = rev
-            self._watch = self._client.watch(from_revision=rev)
+            self._watch = self._watch_from(rev)
             handlers = list(self._handlers)
             objs_now = list(self._cache.values())
         for h in handlers:
@@ -205,7 +229,9 @@ class SharedInformer:
             if ev is None:
                 break
             self._apply(ev)
-            n += 1
+            # a frame counts for the events it carried (max_events stays
+            # a soft bound: frames are never split mid-apply)
+            n += len(ev) if ev.type == FRAME else 1
         return n
 
     # -- relist (reflector 410 fallback + resync) --------------------------
@@ -230,7 +256,7 @@ class SharedInformer:
             while True:
                 objs, rev, keys = self._list()
                 try:
-                    new_watch = self._client.watch(from_revision=rev)
+                    new_watch = self._watch_from(rev)
                     break
                 except ExpiredRevisionError:
                     # the window slid past rev between LIST and WATCH —
@@ -304,12 +330,25 @@ class SharedInformer:
             logger.exception("informer %s: handler error (isolated)", self.kind)
 
     # -- delta application -------------------------------------------------
-    def _apply(self, ev: WatchEvent) -> None:
+    def _apply(self, ev) -> None:
+        if ev.type == FRAME:
+            # a column-packed batch: one lock hold for the whole frame
+            return self._apply_batch(ev)
         if ev.type == WATCH_GAP:
             # the transport admitted it lost continuity (410 on resume):
             # no payload to apply; rebuild from a fresh LIST
             self._try_relist()
             return
+        t_apply = time.perf_counter()
+        try:
+            self._apply_event(ev)
+        finally:
+            # the scheduler deltas this per wave (pump APPLICATION time)
+            dt = time.perf_counter() - t_apply
+            with self._mu:
+                self.stats["apply_s"] += dt
+
+    def _apply_event(self, ev: WatchEvent) -> None:
         if ev.revision <= self.last_revision:
             # revision fence: a straggler from a watch that a relist
             # already superseded (the LIST at last_revision subsumes it)
@@ -374,6 +413,139 @@ class SharedInformer:
             elif ev.type == DELETED:
                 self._deliver(h.on_delete, old if old is not None else obj)
 
+    # -- batch (frame) application -----------------------------------------
+    def _decode_frame(self, frame: WatchFrame, fence: int) -> tuple:
+        """Decode a frame's payloads OUTSIDE the cache lock.  Returns
+        (decoded, dropped, decode_errors, decode_s) where decoded is
+        [(i, type, key, revision, obj-or-None)] — per-event faults keep
+        their per-event semantics: a dropped delivery or an undecodable
+        payload loses THAT delta (gap marked for decode), never the
+        frame."""
+        decoded = []
+        dropped = 0
+        decode_errors = 0
+        t_decode = time.perf_counter()
+        cls = self._client._cls
+        for i in range(len(frame)):
+            etype, key, rev = frame.types[i], frame.keys[i], frame.revisions[i]
+            if rev <= fence:
+                continue  # straggler events inside a superseded frame
+            fault = faults.hit("informer.deliver", kind=self.kind, key=key,
+                               type=etype)
+            if fault is not None and fault.mode == "drop":
+                dropped += 1
+                continue
+            try:
+                faults.hit("informer.decode", kind=self.kind, key=key,
+                           type=etype)
+                raw = frame.objects[i]
+                obj = (lazy_mod.wrap(cls, raw) if lazy_mod.ENABLED
+                       else cls.from_dict(raw))
+            except Exception:
+                decode_errors += 1
+                logger.exception("informer %s: failed to decode %s %s in a "
+                                 "frame — relist scheduled", self.kind,
+                                 etype, key)
+                continue
+            decoded.append((i, etype, key, rev, obj))
+        return decoded, dropped, decode_errors, time.perf_counter() - t_decode
+
+    def _apply_batch(self, frame: WatchFrame) -> None:
+        """Apply one column-packed frame: decode outside the lock, then
+        the WHOLE batch lands in the cache under ONE lock hold, and each
+        handler receives it in one isolated call (``on_batch``) or as the
+        usual per-event callbacks.  A failure before any event applied
+        (the ``informer.apply_batch`` fault, broken columns) loses the
+        frame as a unit and marks a gap — the existing relist path heals
+        it, exactly like a decode failure or a 410."""
+        t_apply = time.perf_counter()
+        try:
+            faults.hit("informer.apply_batch", kind=self.kind, n=len(frame))
+            decoded, dropped, decode_errors, decode_s = self._decode_frame(
+                frame, self.last_revision)
+        except Exception:
+            with self._mu:
+                self.stats["batch_errors"] += 1
+                self._gap_pending = True
+            self.metrics.informer_frame_errors.inc()
+            logger.exception(
+                "informer %s: failed to apply a %d-event frame — relist "
+                "scheduled", self.kind, len(frame))
+            return
+        if dropped:
+            self.metrics.informer_dropped_events.inc(dropped)
+        if decode_errors:
+            self.metrics.informer_decode_errors.inc(decode_errors)
+        applied: list = []
+        with self._mu:
+            self.stats["frames"] += 1
+            self.stats["dropped_events"] += dropped
+            self.stats["decode_errors"] += decode_errors
+            if decode_errors:
+                self._gap_pending = True
+            self.stats["decoded_events"] += len(decoded)
+            self.stats["decode_s"] += decode_s
+            for i, etype, key, rev, obj in decoded:
+                if rev <= self.last_revision:
+                    continue  # a concurrent relist superseded this event
+                old = self._cache.get(key)
+                if self._mutation_detector and old is not None:
+                    snap = self._snapshots.get(key)
+                    if snap is not None and old.to_dict() != snap:
+                        raise CacheMutationError(
+                            f"{self.kind} {key} was mutated in the informer cache"
+                        )
+                if etype == DELETED:
+                    self._cache.pop(key, None)
+                    self._snapshots.pop(key, None)
+                else:
+                    self._cache[key] = obj
+                    if self._mutation_detector:
+                        self._snapshots[key] = obj.to_dict()
+                self.last_revision = max(self.last_revision, rev)
+                applied.append((etype, old, obj, i))
+            self.stats["frame_events"] += len(applied)
+            handlers = list(self._handlers)
+        for h in handlers:
+            if h.on_batch is not None:
+                # one isolated call per handler: a batch-aware handler
+                # (the scheduler's columnar confirm) sees the whole wave
+                self._deliver(h.on_batch, frame, applied)
+                continue
+            for etype, old, obj, _i in applied:
+                if etype == ADDED:
+                    self._deliver(h.on_add, obj)
+                elif etype == MODIFIED:
+                    self._deliver(h.on_update, old, obj)
+                elif etype == DELETED:
+                    self._deliver(h.on_delete, old if old is not None else obj)
+        dt = time.perf_counter() - t_apply
+        with self._mu:
+            self.stats["apply_s"] += dt
+
+    # -- cache compaction (promote-and-drop-raw) ---------------------------
+    def compact_cache(self) -> int:
+        """Opt-in sweep over a synced cache: promote every lazy view to
+        its typed form and release the pinned wire dict (carried-forward
+        ROADMAP item — a cached lazy object otherwise keeps its raw
+        payload alive for its lifetime).  Promotion is exactly what any
+        reader would have triggered, so concurrent readers are safe; the
+        objects' observable value is unchanged (promotion ≡ from_dict).
+        Returns the number of objects whose raw payload was dropped."""
+        with self._mu:
+            objs = list(self._cache.values())
+        n = 0
+        for obj in objs:
+            try:
+                if lazy_mod.promote_and_drop_raw(obj):
+                    n += 1
+            except Exception:  # noqa: BLE001 - sweep is best-effort
+                logger.exception("informer %s: compaction failed for one "
+                                 "object (kept as-is)", self.kind)
+        with self._mu:
+            self.stats["compactions"] += n
+        return n
+
 
 class CacheMutationError(RuntimeError):
     pass
@@ -416,6 +588,13 @@ class InformerFactory:
         for inf in list(self._informers.values()):
             if inf.has_synced():
                 inf.relist()
+
+    def compact_all(self) -> int:
+        """Promote-and-drop-raw sweep over every synced cache (opt-in:
+        trades decode-now for releasing the pinned wire payloads)."""
+        return sum(inf.compact_cache()
+                   for inf in list(self._informers.values())
+                   if inf.has_synced())
 
     def stop_all(self) -> None:
         for inf in self._informers.values():

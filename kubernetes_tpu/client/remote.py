@@ -39,6 +39,7 @@ import urllib.request
 from typing import Callable, Optional
 
 from .. import faults
+from ..store import frames as frames_mod
 from ..store.store import (
     AlreadyExistsError,
     ConflictError,
@@ -99,6 +100,12 @@ class RemoteWatch:
     - **410 Gone** on resume: the server compacted past our bookmark; no
       reconnect can recover the lost deltas.  Emit ``WATCH_GAP`` and end
       the stream — the informer relists and builds a fresh watch.
+    - **mid-frame failure** (a ``?frames=1`` line whose JSON parsed but
+      whose columns are broken — length mismatch, corrupt revisions, or
+      the injected ``phase=frame`` fault): the frame's events are lost as
+      a UNIT and the bookmark cannot be trusted past it — same contract
+      as 410: ``WATCH_GAP`` + stream end, the informer relists.  Never a
+      silent partial apply, never a dead loop.
     - **stopped**: clean shutdown; the half-open response is closed by
       ``stop()`` so the blocking read unblocks instead of leaking.
     - anything else (connection reset, timeout, truncated JSON line, 5xx
@@ -110,10 +117,15 @@ class RemoteWatch:
     def __init__(self, base_url: str, kind: str, from_revision: Optional[int],
                  opener, resource: str, metrics: Optional[ClientMetrics] = None,
                  min_backoff: float = 0.05, max_backoff: float = 2.0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 frames: bool = False):
         self._base = base_url
         self._resource = resource
         self._opener = opener
+        # request column-packed frame delivery (?frames=1).  A pre-frame
+        # server ignores the parameter and streams per-event lines — the
+        # read loop handles both shapes, so this is a pure opt-in.
+        self._frames = frames
         self.metrics = metrics or ClientMetrics()
         self._min_backoff = min_backoff
         self._max_backoff = max_backoff
@@ -130,6 +142,8 @@ class RemoteWatch:
 
     def _open_stream(self):
         url = f"{self._base}/api/v1/{self._resource}?watch=true&timeoutSeconds=5"
+        if self._frames:
+            url += "&frames=1"
         if self._last_rev is not None:
             url += f"&resourceVersion={self._last_rev}"
         faults.hit("remote.watch.stream", phase="connect",
@@ -157,6 +171,34 @@ class RemoteWatch:
                                resource=self._resource)
                     self.metrics.ingest_bytes.inc(len(line))
                     d = json.loads(line)
+                    if d.get("type") == frames_mod.FRAME:
+                        try:
+                            faults.hit("remote.watch.stream", phase="frame",
+                                       resource=self._resource)
+                            frame = frames_mod.WatchFrame.from_wire(d)
+                            # resourceVersion fence per frame: a replayed
+                            # or reordered frame at-or-below the bookmark
+                            # must not rewind it (its events were seen)
+                            if (self._last_rev is not None
+                                    and frame.revision <= self._last_rev):
+                                continue
+                        except Exception as e:  # noqa: BLE001 - classified
+                            # mid-frame failure: the frame's events are
+                            # lost as a unit and the bookmark is no longer
+                            # trustworthy — gap + relist, like a 410
+                            logger.warning(
+                                "watch %s: undecodable frame (%s: %s) — "
+                                "emitting gap for relist", self._resource,
+                                type(e).__name__, e)
+                            self.metrics.watch_errors.inc()
+                            self.metrics.watch_gaps.inc()
+                            self._queue.put(WatchEvent(
+                                WATCH_GAP, "", "", self._last_rev or 0, {}))
+                            return
+                        self._last_rev = frame.revision
+                        backoff = self._min_backoff
+                        self._queue.put(frame)
+                        continue
                     ev = WatchEvent(
                         d["type"], d["kind"], d["key"], d["revision"], d["object"]
                     )
@@ -591,9 +633,10 @@ class RemoteStore:
         )
         return out["errors"]
 
-    def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None) -> RemoteWatch:
+    def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None,
+              frames: bool = False) -> RemoteWatch:
         if kind is None:
             raise RemoteError("remote watch requires a kind")
         return RemoteWatch(self.base_url, kind, from_revision, self._open,
                            self._resource(kind), metrics=self.metrics,
-                           sleep=self._sleep)
+                           sleep=self._sleep, frames=frames)

@@ -21,6 +21,8 @@ remote.watch.stream      RemoteWatch connect/read loop      reconnect from resou
 informer.deliver         SharedInformer._apply              relist/resync reconverges cache
 informer.decode          SharedInformer._apply decode       delta lost, gap marked; next
                          (lazy wrap / eager from_dict)      pump relists and reconverges
+informer.apply_batch     SharedInformer._apply_batch        frame lost as a unit, gap
+                         (column-packed watch frames)       marked; next pump relists
 scheduler.bind           Scheduler._bind /                  forget + requeue with backoff;
                          Store.bind_many per item           retry lands on freed capacity
 backend.pallas.segment   TPUBatchBackend kernel dispatch/   circuit breaker: pallas →
@@ -61,7 +63,9 @@ register("remote.request",
          "failure; delay: slow apiserver")
 register("remote.watch.stream",
          "RemoteWatch connect/read — error: stream breaks mid-flight "
-         "(connection reset, 410 Gone on resume)")
+         "(connection reset, 410 Gone on resume); phase=frame: a "
+         "column-packed frame fails to decode — the watch emits a GAP "
+         "and ends (the informer relists), never a partial apply")
 register("informer.deliver",
          "SharedInformer delta application — drop: the event never "
          "reaches cache or handlers (lossy delivery)")
@@ -69,6 +73,11 @@ register("informer.decode",
          "watch-event payload decode (lazy wrap or eager from_dict) — "
          "error: the payload cannot be decoded; the delta is lost and "
          "the informer marks a gap so the next pump relists")
+register("informer.apply_batch",
+         "column-packed watch-frame application (SharedInformer."
+         "_apply_batch) — error: the whole frame is lost as a unit "
+         "before any event applied; the informer marks a gap and the "
+         "existing relist path reconverges the cache")
 register("scheduler.bind",
          "placement commit — error/drop: one pod's bind CAS fails "
          "(per-pod path raises, bind_many reports a per-item error)")

@@ -278,6 +278,39 @@ class SchedulerCache:
             del self._pod_states[key]
             self._assume_deadlines.pop(key, None)
 
+    def confirm_many(self, entries: list) -> list:
+        """Columnar wave confirm (ISSUE 6): one lock hold for a whole
+        bind-confirm frame.  ``entries`` are ``(key, node_name, prev_rev,
+        new_pod)`` straight off the frame's identity/node/prev-revision
+        columns.  An entry is confirmed — assumed object swapped for the
+        API truth WITHOUT re-aggregation — when the cache holds a
+        matching assumption AND the frame's ``prev_rev`` equals the
+        assumed object's resourceVersion: by CAS semantics the bind txn
+        then mutated exactly nodeName/resourceVersion, so the per-pod
+        containers/affinity equality check collapses to one integer
+        compare per column entry.  Anything the columnar fence rejects
+        (no assumption, different node, an intervening write) is returned
+        UNTOUCHED for the caller's per-pod fallback path."""
+        leftover: list = []
+        with self._mu:
+            for entry in entries:
+                # (key, node_name, prev_rev, new, *caller_context) — extra
+                # fields ride through untouched for the fallback router
+                key, node_name, prev_rev, new = entry[:4]
+                st = self._pod_states.get(key)
+                if st is None or st[2] != "assumed" or st[1] != node_name:
+                    leftover.append(entry)
+                    continue
+                assumed = st[0]
+                if (prev_rev < 0
+                        or lazy_mod.resource_version_of(assumed) != prev_rev
+                        or not self._nodes[node_name].replace_pod(assumed, new)):
+                    leftover.append(entry)
+                    continue
+                self._pod_states[key] = (new, node_name, "bound")
+                self._assume_deadlines.pop(key, None)
+        return leftover
+
     def add_pod(self, pod: api.Pod) -> None:
         """Watch-confirmed bound pod.  Confirms a matching assumption, or
         (re)inserts after expiry/restart."""

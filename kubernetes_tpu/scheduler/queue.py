@@ -102,6 +102,14 @@ class SchedulingQueue:
         with self._mu:
             self._pods.pop(pod_key, None)
 
+    def remove_many(self, pod_keys: list) -> None:
+        """Batch remove under ONE lock hold — the scheduler's columnar
+        bind confirm clears a whole wave's keys at once (each is a
+        no-op dict pop for pods the wave already drained)."""
+        with self._mu:
+            for key in pod_keys:
+                self._pods.pop(key, None)
+
     def pop(self, timeout: Optional[float] = None) -> Optional[api.Pod]:
         """Blocking FIFO pop (``getNextPod``)."""
         deadline = None if timeout is None else self._clock() + timeout

@@ -31,7 +31,7 @@ from ..api import types as api
 from ..client.clientset import BindConflictError, Clientset
 from ..client.informer import Handler, InformerFactory
 from ..client.record import EventBroadcaster
-from ..store.store import NotFoundError
+from ..store.store import ADDED, MODIFIED, NotFoundError
 from ..utils.metrics import SchedulerMetrics
 from ..utils.trace import Trace
 from .generic_scheduler import FitError, GenericScheduler
@@ -140,6 +140,7 @@ class Scheduler:
                 on_add=self._on_pod_add,
                 on_update=self._on_pod_update,
                 on_delete=self._on_pod_delete,
+                on_batch=self._on_pod_frame,
             )
         )
         nodes = self.informers.informer("Node")
@@ -191,6 +192,51 @@ class Scheduler:
         else:
             self.queue.remove(pod.meta.key)
 
+    def _on_pod_frame(self, frame, deltas) -> None:
+        """Batch-aware pod routing (``Handler.on_batch``, ISSUE 6): one
+        column-packed watch frame carries a whole correlated store txn.
+        A bind-confirm frame (``bind_many``: all-MODIFIED, prev-revision
+        column present) confirms the ENTIRE wave against the frame's
+        identity/node/prev-revision columns in one cache lock hold —
+        per-pod dict probes and containers compares collapse to integer
+        compares (``SchedulerCache.confirm_many``).  Whatever the
+        columnar fence rejects — and every non-confirm delta — takes the
+        existing per-pod routing, so semantics are identical to per-event
+        delivery by construction."""
+        self.metrics.watch_frames.inc()
+        self.metrics.watch_frame_events.inc(len(deltas))
+        rest = deltas
+        prev = frame.prev_revisions
+        if prev is not None:
+            node_names = frame.node_names
+            keys = frame.keys
+            confirmable: list = []
+            rest = []
+            for d in deltas:
+                etype, old, new, i = d
+                if etype == MODIFIED and node_names[i]:
+                    confirmable.append((keys[i], node_names[i], prev[i],
+                                        new, old))
+                else:
+                    rest.append(d)
+            if confirmable:
+                # one queue lock + one cache lock for the whole wave
+                self.queue.remove_many([c[0] for c in confirmable])
+                for key, _node, _prev, new, old in self.cache.confirm_many(
+                        confirmable):
+                    # revision fence rejected it (no assumption, different
+                    # node, or an intervening write): the per-pod compare
+                    # path decides, exactly as per-event delivery would
+                    self.metrics.confirm_fallbacks.inc()
+                    self._on_pod_update(old, new)
+        for etype, old, new, _i in rest:
+            if etype == ADDED:
+                self._on_pod_add(new)
+            elif etype == MODIFIED:
+                self._on_pod_update(old, new)
+            else:
+                self._on_pod_delete(old if old is not None else new)
+
     def start(self, manual: bool = True) -> None:
         """Seed informers.  manual=True (tests, bench) → caller pumps and
         events drain via ``broadcaster.flush()``; manual=False → informer
@@ -220,6 +266,19 @@ class Scheduler:
             for inf in self.informers._informers.values())
         st = lazy_mod.STATS
         return decode_s, st["promotions"] + st["sections"]
+
+    def _pump_apply_stats(self) -> tuple[float, int, int]:
+        """(cumulative pump-application seconds, frames, frame events)
+        across this scheduler's informers — per-wave deltas feed
+        ``scheduler_pump_apply_seconds`` and the churn bench's
+        pump-apply timers (ISSUE 6)."""
+        apply_s = frames = frame_events = 0
+        for inf in self.informers._informers.values():
+            st = inf.stats
+            apply_s += st.get("apply_s", 0.0)
+            frames += st.get("frames", 0)
+            frame_events += st.get("frame_events", 0)
+        return apply_s, frames, frame_events
 
     # -- snapshot ----------------------------------------------------------
     def snapshot(self) -> dict[str, NodeInfo]:
@@ -740,6 +799,8 @@ class Scheduler:
                      ncache.stats["reuses"])
                     if ncache is not None else None)
         pre_decode = self._ingest_decode_stats()
+        pre_apply = self._pump_apply_stats()
+        pre_fallbacks = self.metrics.confirm_fallbacks.value
         self._last_prep_s = 0.0
         extra = {}
         if self.overlap_ingest:
@@ -794,6 +855,19 @@ class Scheduler:
             self.metrics.ingest_decode_seconds.observe(decode_s)
             if promos > 0:
                 self.metrics.ingest_promotions.inc(promos)
+            # pump APPLICATION split of the wave (ISSUE 6): informer
+            # cache-apply + handler fan-out (incl. the columnar bind
+            # confirm) time, frame volume, and confirm fallbacks
+            post_apply = self._pump_apply_stats()
+            apply_s = post_apply[0] - pre_apply[0]
+            frames = post_apply[1] - pre_apply[1]
+            frame_events = post_apply[2] - pre_apply[2]
+            self.last_batch_phases["apply_s"] = apply_s
+            self.last_batch_phases["frames"] = frames
+            self.last_batch_phases["frame_events"] = frame_events
+            self.last_batch_phases["confirm_fallbacks"] = int(
+                self.metrics.confirm_fallbacks.value - pre_fallbacks)
+            self.metrics.pump_apply_seconds.observe(apply_s)
             if pre_cols is not None:
                 dirty = ncache.stats["dirty_cols"] - pre_cols[0]
                 cols = ncache.stats["cols_total"] - pre_cols[1]

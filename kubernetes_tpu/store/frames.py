@@ -1,0 +1,161 @@
+"""Column-packed watch frames: N correlated events as ONE delivery unit.
+
+The last leg of the zero-copy contract (ROADMAP "batched watch frames"):
+LIST went columnar in PR 4 (``store/columns.py``), but every watch event
+still crossed the store→informer boundary — and the wire — one at a
+time: one queue put, one JSON line, one informer lock acquisition, one
+cache dict probe per event.  At churn scale a single ``bind_many`` wave
+commits thousands of MODIFIED events back to back, and that per-event
+pump APPLICATION (cache apply + bind confirm) was the largest remaining
+host cost in the profile (~0.3-0.8s spikes per wave).
+
+A :class:`WatchFrame` packs one correlated store batch — everything a
+``create_many``/``bind_many`` txn committed under one store lock hold —
+into parallel columns:
+
+- **op/kind/identity columns**: ``types`` (ADDED/MODIFIED/DELETED),
+  ``keys``, ``revisions`` as flat lists (one ``kind`` per frame — a
+  store batch is single-kind by construction);
+- **prev_revisions**: the revision each object held *before* this
+  transition (-1 = unknown).  This is the columnar confirm fence: a
+  scheduler that assumed a pod at revision r and sees a bind event with
+  ``prev_revision == r`` knows, by CAS semantics, that NOTHING else
+  changed in between — the whole containers/affinity equality check
+  collapses to one integer compare per column entry;
+- **shared raw-view payloads**: ``objects`` are the same shallow views /
+  event copies the per-event path would have carried, shared-immutable
+  (the informer contract: consumers never mutate wire payloads).
+
+Consumers that predate frames are never broken: frames are **opt-in per
+watcher** (``Store.watch(..., frames=True)``), the apiserver serves them
+only to ``?frames=1`` clients (per-event JSON lines otherwise), and
+``events()`` expands a frame back into the exact per-event sequence.
+
+``ENABLED`` is the A/B seam: ``bench.py --ab-watch`` flips it to measure
+framed vs per-event delivery on the same harness.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+# module seam for the watch-frame A/B (bench.py --ab-watch): False
+# restores per-event delivery everywhere (frame-aware consumers stay
+# dormant — they only ever see plain WatchEvents)
+ENABLED = True
+
+# WatchFrame.type value: a transport framing marker, not a state
+# transition (like WATCH_GAP).  Consumers that dispatch on event type
+# must expand the frame (``events()``) or apply it as a batch.
+FRAME = "FRAME"
+
+
+class FrameDecodeError(Exception):
+    """A frame's columns are structurally broken (length mismatch,
+    non-monotone revisions, malformed payloads).  A consumer cannot know
+    WHICH events it lost — the only honest recovery is a gap + relist,
+    never a silent partial apply."""
+
+
+class WatchFrame:
+    """One correlated batch of watch events, column-packed.
+
+    Shared-immutable like :class:`~.store.WatchEvent`: one frame object
+    is handed to the log consumers and every watcher; nobody mutates it.
+    """
+
+    __slots__ = ("kind", "types", "keys", "revisions", "prev_revisions",
+                 "objects", "_node_names")
+
+    # duck-typed dispatch marker (``ev.type == FRAME``) for consumers
+    # that pull mixed WatchEvent/WatchFrame items off one watch queue
+    type = FRAME
+
+    def __init__(self, kind: str, types: list, keys: list, revisions: list,
+                 objects: list, prev_revisions: Optional[list] = None):
+        self.kind = kind
+        self.types = types
+        self.keys = keys
+        self.revisions = revisions
+        # -1 = unknown (creates, deletes, plain updates); >= 0 only where
+        # the emitting txn knew the pre-transition revision (bind_many)
+        self.prev_revisions = prev_revisions
+        self.objects = objects
+        self._node_names: Optional[list] = None
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @property
+    def revision(self) -> int:
+        """The frame's resourceVersion fence: a consumer that applied
+        this frame has seen everything up to its LAST event."""
+        return self.revisions[-1] if self.revisions else 0
+
+    @property
+    def node_names(self) -> list:
+        """Per-event ``spec.nodeName`` column, computed on first touch —
+        what the scheduler's columnar bind confirm compares against its
+        assumed placements (one raw dict get per entry, no decode)."""
+        got = self._node_names
+        if got is None:
+            got = self._node_names = [
+                (o.get("spec") or {}).get("nodeName", "") if o else ""
+                for o in self.objects]
+        return got
+
+    def events(self) -> Iterator:
+        """Expand back into the exact per-event sequence (order, content,
+        revisions) — the compatibility path for per-event consumers."""
+        from .store import WatchEvent
+
+        for i in range(len(self.keys)):
+            yield WatchEvent(self.types[i], self.kind, self.keys[i],
+                             self.revisions[i], self.objects[i])
+
+    # -- wire form (the apiserver's ?frames=1 watch line) -------------------
+    def to_wire(self) -> dict:
+        out = {
+            "type": FRAME,
+            "kind": self.kind,
+            "types": self.types,
+            "keys": self.keys,
+            "revisions": self.revisions,
+            "objects": self.objects,
+        }
+        if self.prev_revisions is not None:
+            out["prevRevisions"] = self.prev_revisions
+        return out
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "WatchFrame":
+        """Decode + validate.  A structurally broken frame must fail HERE
+        with :class:`FrameDecodeError` — the consumer turns it into a
+        watch gap (relist), never a partial apply."""
+        try:
+            kind = d["kind"]
+            types = d["types"]
+            keys = d["keys"]
+            revisions = [int(r) for r in d["revisions"]]
+            objects = d["objects"]
+            prev = d.get("prevRevisions")
+            if prev is not None:
+                prev = [int(r) for r in prev]
+        except (KeyError, TypeError, ValueError) as e:
+            raise FrameDecodeError(f"malformed frame: {e!r}") from None
+        n = len(keys)
+        if not (len(types) == len(revisions) == len(objects) == n) or (
+                prev is not None and len(prev) != n):
+            raise FrameDecodeError(
+                f"frame column lengths diverge: keys={n} types={len(types)} "
+                f"revisions={len(revisions)} objects={len(objects)}")
+        if n == 0:
+            raise FrameDecodeError("empty frame")
+        if any(revisions[i] >= revisions[i + 1] for i in range(n - 1)):
+            # one store txn commits strictly increasing revisions; a frame
+            # violating that was corrupted in flight
+            raise FrameDecodeError("frame revisions not strictly increasing")
+        if any(o is not None and not isinstance(o, dict) for o in objects):
+            raise FrameDecodeError("frame payloads must be dicts")
+        return cls(kind, list(types), list(keys), revisions, list(objects),
+                   prev_revisions=prev)

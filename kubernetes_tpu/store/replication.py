@@ -116,8 +116,10 @@ class ReplicatedStore(Store):
                 f"need {self.majority()}")
         return super()._next_rev()
 
-    def _emit(self, ev: WatchEvent) -> None:
-        super()._emit(ev)  # local durability (WAL) before shipping
+    def _replicate(self, ev: WatchEvent) -> None:
+        # the per-event shipping hook: runs after local durability on BOTH
+        # the per-event emit and the batch (_emit_many/frame) emit path —
+        # a correlated batch txn ships every event, framed fan-out or not
         for f in self._followers:
             if not f.alive:
                 continue

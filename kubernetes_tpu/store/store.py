@@ -151,7 +151,10 @@ class Store:
         # window costs more than the write itself.
         self._log: collections.deque[WatchEvent] = collections.deque(maxlen=event_log_window)
         self._log_window = event_log_window
-        self._watchers: list[tuple[Optional[str], "queue.Queue[Optional[WatchEvent]]"]] = []
+        # (kind filter, queue, wants_frames): frame-aware watchers opted
+        # in via watch(frames=True) receive one WatchFrame per correlated
+        # batch txn; everyone else gets the per-event expansion
+        self._watchers: list[tuple[Optional[str], "queue.Queue[Optional[WatchEvent]]", bool]] = []
         # durability (the etcd WAL+snapshot analogue, store/wal.py):
         # with a data_dir every committed event is logged before the call
         # returns, and a fresh Store over the same dir recovers the state
@@ -246,6 +249,7 @@ class Store:
         results: list[Optional[dict]] = []
         with self._mu:
             bucket = self._objects.setdefault(kind, {})
+            events: list[WatchEvent] = []
             for obj in objs:
                 try:
                     meta = obj.setdefault("metadata", {})
@@ -264,10 +268,13 @@ class Store:
                     m["creationRevision"] = rev
                     bucket[key] = _Item(data=data, revision=rev)
                     ev_copy = _fast_deepcopy(data)
-                    self._emit(WatchEvent(ADDED, kind, key, rev, ev_copy))
+                    events.append(WatchEvent(ADDED, kind, key, rev, ev_copy))
                     results.append(ev_copy)
                 except Exception:  # noqa: BLE001 - one bad item, not the batch
                     results.append(None)
+            # the whole txn fans out as ONE column-packed frame per
+            # frame-aware watcher (per-event to everyone else)
+            self._emit_many(events)
         return results
 
     def update(
@@ -330,6 +337,8 @@ class Store:
         results: list[Optional[str]] = []
         with self._mu:
             bucket = self._objects.setdefault("Pod", {})
+            events: list[WatchEvent] = []
+            prev_revs: list[int] = []
             for namespace, name, node_name in items:
                 key = object_key(namespace, name)
                 # per-item seam: ONE pod's CAS fails while the rest of
@@ -348,6 +357,7 @@ class Store:
                 if cur and cur != node_name:
                     results.append(f"conflict: already bound to {cur}")
                     continue
+                prev_rev = item.revision
                 rev = self._next_rev()
                 spec["nodeName"] = node_name
                 item.data["metadata"]["resourceVersion"] = rev
@@ -357,8 +367,13 @@ class Store:
                     "spec": dict(spec),
                     "metadata": dict(item.data["metadata"]),
                 }
-                self._emit(WatchEvent(MODIFIED, "Pod", key, rev, ev_obj))
+                events.append(WatchEvent(MODIFIED, "Pod", key, rev, ev_obj))
+                # the columnar-confirm fence: the revision this pod held
+                # BEFORE the bind CAS — a consumer that assumed the pod at
+                # exactly this revision knows nothing else changed
+                prev_revs.append(prev_rev)
                 results.append(None)
+            self._emit_many(events, prev_revisions=prev_revs)
         return results
 
     def guaranteed_update(
@@ -490,11 +505,17 @@ class Store:
         return batch_from_views(views, rev, kind=kind)
 
     # -- watch -------------------------------------------------------------
-    def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None) -> Watch:
+    def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None,
+              frames: bool = False) -> Watch:
         """Watch events for ``kind`` (None = all kinds) strictly after
         ``from_revision`` (None = now).  Raises if the revision has fallen
         out of the event-log window ("too old resource version" — the
-        reflector then relists)."""
+        reflector then relists).
+
+        ``frames=True`` opts this watcher into column-packed delivery:
+        a correlated batch txn (``create_many``/``bind_many``) arrives as
+        ONE :class:`~.frames.WatchFrame` instead of N events (the log
+        replay below stays per-event — only live batches frame)."""
         with self._mu:
             q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
             if from_revision is not None and from_revision < self._rev:
@@ -506,18 +527,16 @@ class Store:
                 for ev in self._log:
                     if ev.revision > from_revision and (kind is None or ev.kind == kind):
                         q.put(ev)  # shared-immutable (see _emit)
-            self._watchers.append((kind, q))
+            self._watchers.append((kind, q, frames))
             return Watch(self, q)
 
     def _remove_watch(self, q) -> None:
         with self._mu:
-            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+            self._watchers = [(k, w, f) for (k, w, f) in self._watchers
+                              if w is not q]
 
-    def _emit(self, ev: WatchEvent) -> None:
-        # WatchEvent.object is SHARED-IMMUTABLE: one private copy is made at
-        # emit time and handed to the log and every watcher.  Consumers must
-        # not mutate it (the informer parses it into fresh typed objects;
-        # the mutation detector catches violations in tests).
+    def _append_log(self, ev: WatchEvent) -> None:
+        """Durability + watch-cache window for one event (no fan-out)."""
         if self._wal is not None:
             # durability BEFORE visibility: the record is on disk before
             # any watcher (or the caller) observes the commit
@@ -525,9 +544,59 @@ class Store:
             if self._wal.needs_compaction():
                 self.compact()  # RLock: safe to re-enter from the write path
         self._log.append(ev)  # deque maxlen trims the window in C
-        for kind, q in self._watchers:
+
+    def _replicate(self, ev: WatchEvent) -> None:
+        """Per-event shipping hook (no-op here): ``ReplicatedStore``
+        overrides it to ship to followers.  Called on BOTH the per-event
+        and the batch emit path, after local durability."""
+
+    def _emit(self, ev: WatchEvent) -> None:
+        # WatchEvent.object is SHARED-IMMUTABLE: one private copy is made at
+        # emit time and handed to the log and every watcher.  Consumers must
+        # not mutate it (the informer parses it into fresh typed objects;
+        # the mutation detector catches violations in tests).
+        self._append_log(ev)
+        self._replicate(ev)
+        for kind, q, _frames in self._watchers:
             if kind is None or kind == ev.kind:
                 q.put(ev)
+
+    def _emit_many(self, events: list[WatchEvent],
+                   prev_revisions: Optional[list[int]] = None) -> None:
+        """Fan one correlated batch out: WAL + log stay per-event (the
+        replay window and durability framing are unchanged), but every
+        frame-aware watcher receives ONE column-packed
+        :class:`~.frames.WatchFrame` — one queue put, one informer lock
+        hold, one handler fan-out for the whole txn.  Per-event watchers
+        (kubectl -w, controllers, pre-frame clients) see the identical
+        event sequence they always did."""
+        if not events:
+            return
+        for ev in events:
+            self._append_log(ev)
+            self._replicate(ev)
+        frame = None
+        from . import frames as frames_mod
+
+        want_frame = len(events) > 1 and frames_mod.ENABLED
+        kind = events[0].kind  # batch txns are single-kind by construction
+        for wkind, q, wants_frames in self._watchers:
+            if wkind is not None and wkind != kind:
+                continue
+            if wants_frames and want_frame:
+                if frame is None:  # built once, shared-immutable
+                    frame = frames_mod.WatchFrame(
+                        kind,
+                        [ev.type for ev in events],
+                        [ev.key for ev in events],
+                        [ev.revision for ev in events],
+                        [ev.object for ev in events],
+                        prev_revisions=prev_revisions,
+                    )
+                q.put(frame)
+            else:
+                for ev in events:
+                    q.put(ev)
 
 
 class ExpiredRevisionError(Exception):

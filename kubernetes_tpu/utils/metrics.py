@@ -184,6 +184,10 @@ class ClientMetrics:
             "client_informer_decode_errors_total",
             "event payloads that failed to decode (delta lost, gap marked "
             "for relist)"))
+        self.informer_frame_errors = r.register(Counter(
+            "client_informer_frame_errors_total",
+            "column-packed watch frames lost whole before application "
+            "(apply fault / broken columns) — gap marked for relist"))
         self.ingest_bytes = r.register(Counter(
             "scheduler_ingest_decode_bytes_total",
             "wire bytes of watch payloads delivered to informers"))
@@ -269,6 +273,31 @@ class SchedulerMetrics:
             "scheduler_ingest_promotions_total",
             "lazy-object sections/objects promoted to typed form by "
             "consumers (decode work that was actually needed)",
+        ))
+        # batched watch frames (ISSUE 6): per-wave pump APPLICATION time
+        # in SECONDS (informer cache apply + handler fan-out + the
+        # scheduler's bind confirm), plus frame/event volume and how often
+        # the columnar confirm had to fall back to the per-pod compare
+        self.pump_apply_seconds = r.register(Histogram(
+            "scheduler_pump_apply_seconds",
+            "informer event/frame application time per scheduling wave "
+            "(cache apply + handler fan-out + bind confirm; seconds)",
+            buckets=[1e-5 * (2 ** (i / 2)) for i in range(44)],
+        ))
+        self.watch_frames = r.register(Counter(
+            "scheduler_watch_frames_total",
+            "column-packed watch frames applied by this scheduler's "
+            "informers (one per correlated store batch txn)",
+        ))
+        self.watch_frame_events = r.register(Counter(
+            "scheduler_watch_frame_events_total",
+            "events delivered inside watch frames (the per-event path "
+            "they replaced)",
+        ))
+        self.confirm_fallbacks = r.register(Counter(
+            "scheduler_confirm_fallbacks_total",
+            "frame bind-confirm entries the columnar revision fence "
+            "rejected — routed through the per-pod compare instead",
         ))
         self.tensorize_upload_fraction = r.register(Histogram(
             "scheduler_tensorize_upload_fraction",

@@ -113,6 +113,26 @@ class AliasedMutations:
         del p["k"]  # folds into the same _pending finding (dedup by attr)
 
 
+class TwoHopAliasedMutations:
+    """The ISSUE 6 points-to slice: chains of single-assignment locals
+    (``t = self._x; u = t``) resolve to the container — mutations through
+    the LAST name in the chain are RL303 findings on the attribute."""
+
+    def __init__(self):
+        self._twohop = {}
+        self._threehop = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        t = self._twohop
+        u = t
+        u["k"] = 1  # RL303 via two-hop alias chain
+        a = self._threehop
+        b = a
+        c = b
+        c.append("k")  # RL303 via three-hop chain (fixed point, not depth-2)
+
+
 class AliasExemptions:
     """NOT flagged: reassigned aliases, parameter shadows, and aliases
     mutated under the lock stay silent — alias tracking must
@@ -132,7 +152,19 @@ class AliasExemptions:
             g = self._other
             g["k"] = 1  # lock held: clean
         self._with_param(None)
+        self._two_hop_broken_chain()
+        self._two_hop_param_root(None)
 
     def _with_param(self, p):
         p = self._pending  # shadows a parameter: dropped
         p["k"] = 1
+
+    def _two_hop_broken_chain(self):
+        a = self._pending
+        b = a
+        a = {}  # the ROOT is rebound: every name downstream drops too
+        b["k"] = 1  # silent
+
+    def _two_hop_param_root(self, r):
+        s = r  # chain rooted in a parameter, not a container: silent
+        s["k"] = 1

@@ -803,6 +803,25 @@ MATRIX = {
         check=lambda w, plan: (
             w.sched.informers.informer("Pod").stats["decode_errors"] > 0
             and w.sched.informers.informer("Pod").stats["relists"] > 0)),
+    # EVERY column-packed Pod frame (the bind_many confirm waves) is
+    # lost whole before any event applied, for the entire run: each loss
+    # marks a gap and the next pump relists — no pod is requeued and no
+    # decision re-made (the binds already landed in the store), so the
+    # pod→node map must match the oracle exactly; recovery is visible in
+    # batch_errors + relists.  (No trigger: the fault fires on every
+    # frame, on every Pod informer — scheduler's and hollow fleet's.
+    # Store convergence can land before the gap-driven relist runs, so
+    # the check pumps once to drive the heal, then asserts the counters
+    # AND that the cache reconverged to the bound truth.)
+    "informer.apply_batch": dict(
+        spec=dict(mode="error", match={"kind": "Pod"}),
+        world="local", exact=True,
+        check=lambda w, plan: (
+            w.sched.pump() is not None  # drive the gap-pending relist
+            and w.sched.informers.informer("Pod").stats["batch_errors"] > 0
+            and w.sched.informers.informer("Pod").stats["relists"] > 0
+            and all(st[2] == "bound"
+                    for st in w.sched.cache._pod_states.values()))),
     "backend.pallas.segment": dict(
         spec=dict(mode="error", match={"impl": "interpret"}, first_n=1),
         world="local", exact=True,

@@ -232,6 +232,9 @@ def test_race_fixture_codes_and_locations(race_findings):
         ("RL303", "AliasedMutations._worker._pending"),
         ("RL303", "AliasedMutations._worker._queue"),
         ("RL303", "AliasedMutations._worker._heap"),
+        # ISSUE 6: chains of single-assignment aliases (fixed point)
+        ("RL303", "TwoHopAliasedMutations._worker._twohop"),
+        ("RL303", "TwoHopAliasedMutations._worker._threehop"),
     }
     assert got == expected, f"got {sorted(got)}"
     by_symbol = {f.symbol: f.line for f in race_findings}
@@ -244,6 +247,12 @@ def test_race_fixture_codes_and_locations(race_findings):
     assert by_symbol["HandlerCallbacks._on_add._index"] == _fixture_line(
         path, "self._index[obj.key] = obj"
     )
+    assert by_symbol["TwoHopAliasedMutations._worker._twohop"] == _fixture_line(
+        path, 'u["k"] = 1  # RL303 via two-hop alias chain'
+    )
+    messages = {f.symbol: f.message for f in race_findings}
+    assert "via alias `u`" in messages["TwoHopAliasedMutations._worker._twohop"]
+    assert "via alias `c`" in messages["TwoHopAliasedMutations._worker._threehop"]
 
 
 def test_race_fixture_exemptions_stay_clean(race_findings):
