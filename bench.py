@@ -387,7 +387,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               workload: str = "mixed", seed: int = 0, warmup: bool = True,
               pipeline: bool = True, lazy_ingest: bool = True,
               frontier: bool = True, watch_frames: bool = True,
-              verify_oracle: bool = False) -> dict:
+              verify_oracle: bool = False, trace=None) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
     created the moment wave w leaves the queue, the density.go shape
@@ -419,6 +419,12 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     the recorded drain batches through the per-pod CPU oracle off-clock
     and reports per-wave binding parity (``oracle_parity``).
 
+    ``trace`` (ISSUE 7): truthy enables the wave tracer + flight
+    recorder for the TIMED run only (the warm-up compiles untraced); a
+    string value additionally writes the Chrome trace-event JSON
+    artifact there (load into chrome://tracing / Perfetto), and the
+    result carries a ``trace`` summary block either way.
+
     The default preset is NORTH-scale churn (5,000 nodes — VERDICT r4
     directive 4): the returned dict carries an SLO verdict
     (``slo_pass``) gating e2e p99 ≤ 5s (the reference pod-startup SLO)
@@ -443,13 +449,37 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     frames_was = frames_mod.ENABLED
     lazy_mod.ENABLED = lazy_ingest
     frames_mod.ENABLED = watch_frames
+    tracer = None
+    if trace:
+        from kubernetes_tpu.utils import tracing
+
+        tracer = tracing.enable(ring_waves=waves + 2)
     try:
-        return _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
-                                pipeline, lazy_ingest, frontier,
-                                watch_frames, verify_oracle)
+        r = _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
+                             pipeline, lazy_ingest, frontier,
+                             watch_frames, verify_oracle)
     finally:
         lazy_mod.ENABLED = lazy_was
         frames_mod.ENABLED = frames_was
+        if tracer is not None:
+            from kubernetes_tpu.utils import tracing
+
+            tracing.disable()
+    if tracer is not None:
+        doc = tracer.chrome_trace()
+        r["trace"] = {
+            "enabled": True,
+            "events": len(doc["traceEvents"]),
+            "waves_recorded": len(tracer.ring),
+            "flight_dumps": len(tracer.dumps),
+            "dump_reasons": sorted({d["reason"] for d in tracer.dumps}),
+        }
+        if isinstance(trace, str):
+            with open(trace, "w") as f:
+                json.dump(doc, f)
+                f.write("\n")
+            r["trace"]["artifact"] = trace
+    return r
 
 
 def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
@@ -946,6 +976,74 @@ def run_watch_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
     }
 
 
+def run_trace_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
+                 waves: int = 10, pairs: int = 2, seed: int = 0) -> dict:
+    """Both-orders interleaved A/B pricing the wave tracer (ISSUE 7):
+    A = tracing disabled (the production default — instrumented sites
+    cost one global load + None check), B = tracer + flight recorder
+    ENABLED for the whole timed run.  This is an overhead PRICE report,
+    not a win claim: ``win_pct`` is the measured cost of enabling (≈0
+    means the enabled path is free too; the DISABLED path's "within
+    noise of pre-PR" claim uses the worktree ledger, not this flag A/B,
+    because the instrumentation exists in both arms here)."""
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False)
+
+    def one(traced: bool) -> dict:
+        return run_churn(n_nodes, total_pods, waves, seed=seed,
+                         warmup=False, trace=traced)
+
+    ab_pairs, ba_pairs = [], []
+    a_all, b_all = [], []
+    trace_stats = []
+    bounds = set()
+    for i in range(pairs):
+        b = one(True)
+        a = one(False)
+        ab_pairs.append({"B_on": b["pods_per_sec"], "A_off": a["pods_per_sec"]})
+        b_all.append(b["pods_per_sec"])
+        a_all.append(a["pods_per_sec"])
+        trace_stats.append(b["trace"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-trace AB: on={b['pods_per_sec']} off={a['pods_per_sec']} "
+              f"events={b['trace']['events']}", file=sys.stderr)
+    for _ in range(pairs):
+        a = one(False)
+        b = one(True)
+        ba_pairs.append({"A_off": a["pods_per_sec"], "B_on": b["pods_per_sec"]})
+        a_all.append(a["pods_per_sec"])
+        b_all.append(b["pods_per_sec"])
+        trace_stats.append(b["trace"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-trace BA: off={a['pods_per_sec']} on={b['pods_per_sec']}",
+              file=sys.stderr)
+    a_med = sorted(a_all)[len(a_all) // 2]
+    b_med = sorted(b_all)[len(b_all) // 2]
+    return {
+        "claim": ("Wave tracing + flight recorder: per-wave span trees, "
+                  "store-txn correlation ids, dump-on-fault — priced "
+                  "ENABLED vs disabled on the same tree (the disabled "
+                  "path's no-regression claim is the worktree ledger)"),
+        "method": (f"Churn {n_nodes} nodes / {total_pods} mixed pods / "
+                   f"{waves} waves, arrival thread + run_batch_loop serving "
+                   "(both arms), events on; interleaved pairs in BOTH "
+                   "orders, one shared process, warm-up compiles paid up "
+                   "front; A = tracing disabled, B = tracer + flight "
+                   "recorder enabled for the whole timed run"),
+        "pairs_order_AB_first": ab_pairs,
+        "pairs_order_BA_first": ba_pairs,
+        "A_off_all": a_all,
+        "B_on_all": b_all,
+        "A_median": a_med,
+        "B_median": b_med,
+        # the sign convention matches the other ledgers (B vs A), so a
+        # NEGATIVE value here is the enabled-tracing slowdown
+        "win_pct": round((b_med - a_med) / a_med * 100, 1) if a_med else None,
+        "bound_counts": sorted(bounds),
+        "trace_stats": trace_stats,
+    }
+
+
 def run_preemption(n_nodes: int = 2_000) -> dict:
     """Priority-preemption workload (VERDICT r4 directive 6: measure
     preemption cost at all).  Saturate every node's CPU with priority-0
@@ -1205,9 +1303,29 @@ def main() -> None:
         "BENCH_AB_watch_frames.json); --nodes/--pods/--trials override "
         "scale and pair count",
     )
+    parser.add_argument(
+        "--trace", nargs="?", const="BENCH_trace_churn.json",
+        default=None, metavar="PATH",
+        help="enable the wave tracer + flight recorder for the churn "
+        "measurement and write its Chrome trace-event JSON to PATH "
+        "(default BENCH_trace_churn.json); load into chrome://tracing "
+        "or Perfetto",
+    )
+    parser.add_argument(
+        "--ab-trace", nargs="?", const="BENCH_AB_trace_enabled.json",
+        default=None, metavar="PATH",
+        help="run the both-orders tracing-overhead A/B (tracer + flight "
+        "recorder enabled vs disabled, same tree) and write the ledger "
+        "JSON to PATH (default BENCH_AB_trace_enabled.json); a negative "
+        "win_pct is the enabled-tracing slowdown — the disabled path's "
+        "no-regression claim is the worktree ledger "
+        "(BENCH_AB_trace_overhead.json); --nodes/--pods/--trials "
+        "override scale and pair count",
+    )
     args = parser.parse_args()
 
-    if args.ab_churn or args.ab_pump or args.ab_frontier or args.ab_watch:
+    if (args.ab_churn or args.ab_pump or args.ab_frontier or args.ab_watch
+            or args.ab_trace):
         import datetime
 
         kw = {}
@@ -1217,11 +1335,14 @@ def main() -> None:
             kw["total_pods"] = args.pods
         if args.trials:
             kw["pairs"] = args.trials
-        runner = (run_watch_ab if args.ab_watch
+        runner = (run_trace_ab if args.ab_trace
+                  else run_watch_ab if args.ab_watch
                   else run_frontier_ab if args.ab_frontier
                   else run_pump_ab if args.ab_pump else run_churn_ab)
-        path = args.ab_watch or args.ab_frontier or args.ab_pump or args.ab_churn
-        metric = ("watch-frames-win-pct" if args.ab_watch
+        path = (args.ab_trace or args.ab_watch or args.ab_frontier
+                or args.ab_pump or args.ab_churn)
+        metric = ("trace-enabled-overhead-pct" if args.ab_trace
+                  else "watch-frames-win-pct" if args.ab_watch
                   else "frontier-scan-win-pct" if args.ab_frontier
                   else "pump-ingest-win-pct" if args.ab_pump
                   else "churn-pipeline-win-pct")
@@ -1336,7 +1457,12 @@ def main() -> None:
     # under continuous creation; VERDICT r3 Missing #5)
     churn = None
     if not args.oracle and args.preset == "north" and args.churn:
-        churn = run_churn(seed=0)
+        churn = run_churn(seed=0, trace=args.trace)
+        if args.trace:
+            tr = churn["trace"]
+            print(f"# trace: {tr['events']} events over "
+                  f"{tr['waves_recorded']} waves -> {tr['artifact']} "
+                  f"({tr['flight_dumps']} flight dumps)", file=sys.stderr)
         print(
             f"# churn[{churn['nodes']} nodes]: {churn['bound']} bound / "
             f"{churn['unbound']} unbound over "

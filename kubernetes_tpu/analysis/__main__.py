@@ -28,6 +28,7 @@ PASS_DESCRIPTIONS = {
     "trace": "trace-safety over ops/ (TS1xx: host escapes, Python branches on traced values, set-order nondeterminism)",
     "parity": "oracle↔kernel parity coverage (PC2xx: unmapped predicates/priorities, stale markers)",
     "races": "controller/kubelet race lint (RL3xx: unlocked cross-thread writes, lock-order cycles)",
+    "metrics": "metrics-name lint (MN4xx: snake_case names, counters end _total, histograms carry a unit, no duplicate registrations)",
 }
 
 

@@ -19,7 +19,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-PASS_NAMES = ("trace", "parity", "races")
+PASS_NAMES = ("trace", "parity", "races", "metrics")
 
 
 def repo_root() -> str:
@@ -128,7 +128,8 @@ class Report:
 
 # finding-code prefix -> the pass that can produce it (stale-entry
 # detection must not call a races suppression "stale" in a parity-only run)
-_CODE_PREFIX_PASS = {"TS": "trace", "PC": "parity", "RL": "races"}
+_CODE_PREFIX_PASS = {"TS": "trace", "PC": "parity", "RL": "races",
+                     "MN": "metrics"}
 
 
 def _split_baseline(
@@ -164,7 +165,7 @@ def run_analysis(
     "parity": {"oracle_paths": [...], "kernel_paths": [...]},
     "races": {"paths": [...]}}``.
     """
-    from . import parity, races, trace_safety
+    from . import metrics_lint, parity, races, trace_safety
 
     root = root or repo_root()
     passes = list(passes) if passes else list(PASS_NAMES)
@@ -177,6 +178,7 @@ def run_analysis(
         "trace": lambda: trace_safety.run(root, **scopes.get("trace", {})),
         "parity": lambda: parity.run(root, **scopes.get("parity", {})),
         "races": lambda: races.run(root, **scopes.get("races", {})),
+        "metrics": lambda: metrics_lint.run(root, **scopes.get("metrics", {})),
     }
     findings: list[Finding] = []
     for name in passes:

@@ -40,6 +40,7 @@ a lazy object, so every fast path degrades to the status quo.
 
 from __future__ import annotations
 
+import sys
 from typing import Optional
 
 from .meta import ObjectMeta, OwnerReference
@@ -387,6 +388,28 @@ def promote_and_drop_raw(obj) -> bool:
     promote()
     d["_lzraw"] = None
     return True
+
+
+def _approx_bytes(o) -> int:
+    """Cheap recursive size estimate for a JSON-shaped wire payload —
+    the compaction sweep's freed-bytes accounting.  Same O(payload) cost
+    class as the promotion walk that accompanies it."""
+    if isinstance(o, dict):
+        return sys.getsizeof(o) + sum(
+            _approx_bytes(k) + _approx_bytes(v) for k, v in o.items())
+    if isinstance(o, list):
+        return sys.getsizeof(o) + sum(_approx_bytes(v) for v in o)
+    return sys.getsizeof(o)
+
+
+def raw_payload_size(obj) -> int:
+    """Approximate bytes of the wire payload ``obj`` currently pins
+    (0 for eager objects and already-compacted views).  The sectioned
+    lazy wrappers' nested views alias subtrees of the same top-level
+    raw dict, so the top-level payload is the whole pin."""
+    d = getattr(obj, "__dict__", None)
+    raw = d.get("_lzraw") if d is not None else None
+    return _approx_bytes(raw) if raw is not None else 0
 
 
 # ---------------------------------------------------------------------------

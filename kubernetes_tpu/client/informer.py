@@ -39,6 +39,7 @@ from ..store.store import (
     ExpiredRevisionError,
     WatchEvent,
 )
+from ..utils import tracing
 from ..utils.metrics import DEFAULT_CLIENT_METRICS, ClientMetrics
 from .clientset import TypedClient
 
@@ -66,7 +67,8 @@ class Handler:
 
 class SharedInformer:
     def __init__(self, client: TypedClient, mutation_detector: bool = False,
-                 metrics: Optional[ClientMetrics] = None):
+                 metrics: Optional[ClientMetrics] = None,
+                 compact_on_resync: bool = False):
         self._client = client
         self.kind = client.kind
         self._handlers: list[Handler] = []
@@ -93,6 +95,11 @@ class SharedInformer:
                       # and promote-and-drop-raw sweeps
                       "frames": 0, "frame_events": 0, "batch_errors": 0,
                       "apply_s": 0.0, "compactions": 0}
+        # ROADMAP carried item (ISSUE 7 satellite): with the flag on,
+        # every successful relist/resync ends with a promote-and-drop-raw
+        # sweep, so a long-lived deployment's cache stops pinning wire
+        # payloads without anyone calling compact_cache() by hand
+        self.compact_on_resync = compact_on_resync
         # serializes relist(): a resync timer tick racing a GAP
         # escalation must not build two watches and leak the loser
         self._relist_mu = threading.Lock()
@@ -251,6 +258,14 @@ class SharedInformer:
         stream.  ``_relist_mu`` serializes concurrent callers (resync
         timer vs GAP escalation): the loser waits and then relists
         against the fresh state instead of leaking a live watch."""
+        tr = tracing.current()
+        with (tr.span("informer.relist", cat="ingest", kind=self.kind)
+              if tr is not None else tracing.NULL_SPAN):
+            self._relist_inner()
+        if self.compact_on_resync:
+            self.compact_cache()
+
+    def _relist_inner(self) -> None:
         with self._relist_mu:
             attempts = 0
             while True:
@@ -339,14 +354,18 @@ class SharedInformer:
             # no payload to apply; rebuild from a fresh LIST
             self._try_relist()
             return
-        t_apply = time.perf_counter()
-        try:
-            self._apply_event(ev)
-        finally:
-            # the scheduler deltas this per wave (pump APPLICATION time)
-            dt = time.perf_counter() - t_apply
-            with self._mu:
-                self.stats["apply_s"] += dt
+        tr = tracing.current()
+        with (tr.span("informer.event.apply", cat="ingest", kind=self.kind,
+                      key=ev.key, type=ev.type)
+              if tr is not None and tr.verbose else tracing.NULL_SPAN):
+            t_apply = time.perf_counter()
+            try:
+                self._apply_event(ev)
+            finally:
+                # the scheduler deltas this per wave (pump APPLICATION time)
+                dt = time.perf_counter() - t_apply
+                with self._mu:
+                    self.stats["apply_s"] += dt
 
     def _apply_event(self, ev: WatchEvent) -> None:
         if ev.revision <= self.last_revision:
@@ -457,7 +476,19 @@ class SharedInformer:
         usual per-event callbacks.  A failure before any event applied
         (the ``informer.apply_batch`` fault, broken columns) loses the
         frame as a unit and marks a gap — the existing relist path heals
-        it, exactly like a decode failure or a 410."""
+        it, exactly like a decode failure or a 410.
+
+        The frame-apply span carries the emitting txn's correlation id
+        (ISSUE 7): the store's txn span, this span, and the scheduler's
+        confirm span (which runs inside this one's handler fan-out) all
+        share it, so one trace shows the store→informer→confirm path."""
+        tr = tracing.current()
+        with (tr.span("informer.frame.apply", cat="ingest", kind=self.kind,
+                      txn=frame.txn, events=len(frame))
+              if tr is not None else tracing.NULL_SPAN) as sp:
+            self._apply_batch_inner(frame, sp)
+
+    def _apply_batch_inner(self, frame: WatchFrame, sp) -> None:
         t_apply = time.perf_counter()
         try:
             faults.hit("informer.apply_batch", kind=self.kind, n=len(frame))
@@ -522,6 +553,8 @@ class SharedInformer:
         dt = time.perf_counter() - t_apply
         with self._mu:
             self.stats["apply_s"] += dt
+        sp.set(applied=len(applied), dropped=dropped,
+               decode_errors=decode_errors, decode_s=round(decode_s, 6))
 
     # -- cache compaction (promote-and-drop-raw) ---------------------------
     def compact_cache(self) -> int:
@@ -531,19 +564,30 @@ class SharedInformer:
         payload alive for its lifetime).  Promotion is exactly what any
         reader would have triggered, so concurrent readers are safe; the
         objects' observable value is unchanged (promotion ≡ from_dict).
-        Returns the number of objects whose raw payload was dropped."""
+        Returns the number of objects whose raw payload was dropped.
+
+        Observability (ISSUE 7 satellite): each sweep counts the objects
+        it compacted (``client_informer_compactions_total``) and records
+        the approximate wire bytes it released
+        (``client_informer_compaction_freed_bytes``)."""
         with self._mu:
             objs = list(self._cache.values())
         n = 0
+        freed = 0
         for obj in objs:
             try:
+                size = lazy_mod.raw_payload_size(obj)
                 if lazy_mod.promote_and_drop_raw(obj):
                     n += 1
+                    freed += size
             except Exception:  # noqa: BLE001 - sweep is best-effort
                 logger.exception("informer %s: compaction failed for one "
                                  "object (kept as-is)", self.kind)
         with self._mu:
             self.stats["compactions"] += n
+        if n:
+            self.metrics.informer_compactions.inc(n)
+        self.metrics.informer_compaction_freed_bytes.set(freed)
         return n
 
 
@@ -554,15 +598,19 @@ class CacheMutationError(RuntimeError):
 class InformerFactory:
     """SharedInformerFactory analogue: one informer per kind per factory."""
 
-    def __init__(self, clientset, mutation_detector: bool = False):
+    def __init__(self, clientset, mutation_detector: bool = False,
+                 compact_on_resync: bool = False):
         self._clientset = clientset
         self._informers: dict[str, SharedInformer] = {}
         self._mutation_detector = mutation_detector
+        self._compact_on_resync = compact_on_resync
 
     def informer(self, kind: str) -> SharedInformer:
         if kind not in self._informers:
             self._informers[kind] = SharedInformer(
-                self._clientset.client_for(kind), mutation_detector=self._mutation_detector
+                self._clientset.client_for(kind),
+                mutation_detector=self._mutation_detector,
+                compact_on_resync=self._compact_on_resync,
             )
         return self._informers[kind]
 

@@ -129,12 +129,19 @@ def wait_forever(stop: threading.Event, tick: Optional[Callable[[], None]] = Non
 
 
 def serve_health(port: int, registry=None, host: str = "127.0.0.1"):
-    """Daemon healthz + metrics endpoint (the reference mounts /healthz,
-    /metrics and pprof on every daemon — scheduler app/server.go:149).
-    Must be started BEFORE leader election: a standby that serves no
-    health endpoint gets killed by its supervisor's liveness probe.
-    Returns the running server (.local_port, .stop()), or None when
-    port<0."""
+    """Daemon healthz + metrics + debug-trace endpoint (the reference
+    mounts /healthz, /metrics and pprof on every daemon — scheduler
+    app/server.go:149; /debug/traces is the pprof analogue for the wave
+    tracer).  Must be started BEFORE leader election: a standby that
+    serves no health endpoint gets killed by its supervisor's liveness
+    probe.  Returns the running server (.local_port, .stop()), or None
+    when port<0.
+
+    ``/debug/traces`` serves the active tracer's Chrome trace-event JSON
+    (load into chrome://tracing / Perfetto); ``/debug/flightrecorder``
+    serves every dump the recorder has taken plus the current wave ring.
+    Both answer ``{"enabled": false}`` when tracing is off — probing the
+    endpoint must never perturb the production path."""
     from .proxy.healthcheck import _HealthHTTPServer
 
     if port is None or port < 0:
@@ -147,6 +154,17 @@ def serve_health(port: int, registry=None, host: str = "127.0.0.1"):
             if path == "/metrics" and registry is not None:
                 try:
                     return 200, registry.expose()  # raw exposition text
+                except Exception as e:  # noqa: BLE001 - never crash health
+                    return 500, {"error": str(e)}
+            if path in ("/debug/traces", "/debug/flightrecorder"):
+                from .utils import tracing
+
+                tr = tracing.current()
+                if tr is None:
+                    return 200, {"enabled": False}
+                try:
+                    return 200, (tr.chrome_trace() if path == "/debug/traces"
+                                 else tr.flight_snapshot())
                 except Exception as e:  # noqa: BLE001 - never crash health
                     return 500, {"error": str(e)}
             return None

@@ -33,6 +33,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..utils import tracing
+
 
 class FaultInjected(Exception):
     """Default error raised by an ``error``-mode fault point."""
@@ -216,6 +218,11 @@ class FaultPlan:
                 break
         if fired_spec is None:
             return None
+        # flight-recorder trigger (ISSUE 7): every fired fault dumps the
+        # trace of the wave it fired into, BEFORE the site misbehaves —
+        # a raise below must not lose the recording.  Disarmed runs never
+        # reach here, so the production path is untouched.
+        tracing.notify_fault(name, ctx, fired_spec.mode)
         if fired_spec.mode == "error":
             raise (fired_spec.error_factory() if fired_spec.error_factory is not None
                    else FaultInjected(f"injected fault at {name}"))

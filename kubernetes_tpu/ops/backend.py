@@ -26,6 +26,7 @@ from typing import Optional
 
 from .. import faults
 from ..api import types as api
+from ..utils import tracing
 from ..scheduler.generic_scheduler import FitError, GenericScheduler
 from ..scheduler.nodeinfo import NodeInfo
 from ..scheduler.predicates import DEFAULT_PREDICATES
@@ -219,6 +220,9 @@ class TPUBatchBackend:
         self.stats["breaker_transitions"] += 1
         if self.breaker_counter is not None:
             self.breaker_counter.inc()
+        # every transition is a flight-recorder trigger (ISSUE 7): the
+        # dump carries the wave the rung change fired into
+        tracing.notify_breaker(kind, key, LEVELS[frm], LEVELS[to])
         logger.warning("kernel breaker %s for shape %s: %s -> %s",
                        kind, key, LEVELS[frm], LEVELS[to])
 
@@ -279,6 +283,10 @@ class TPUBatchBackend:
         self.stats["frontier_compactions"] += 1
         if self.frontier_counter is not None:
             self.frontier_counter.inc()
+        tr = tracing.current()
+        if tr is not None:
+            tr.instant("frontier.compact", width=width, new_width=width_new,
+                       alive=n_alive)
 
     def _dispatch_frontier(self, static, init):
         """Try to serve this segment through the frontier scan: seed the
@@ -562,6 +570,7 @@ class TPUBatchBackend:
             returns the segment's commit entries.  Returns None when the
             segment needs the sync split path (budget reject)."""
             seg_pods = [p for _, p in segment]
+            tr = tracing.current()
             t_tensorize = self._clock_wall()
             static = self.tensorizer.build_static(
                 seg_pods,
@@ -579,13 +588,24 @@ class TPUBatchBackend:
                 mounted_disks=mounted_disks,
             )
             if static is None:
-                self.stats["tensorize_s"] += self._clock_wall() - t_tensorize
+                t_end = self._clock_wall()
+                self.stats["tensorize_s"] += t_end - t_tensorize
+                if tr is not None:
+                    tr.complete("tensorize", t_tensorize, t_end, cat="phase",
+                                pods=len(seg_pods), rejected=True)
                 return None
             init = self.tensorizer.initial_state(
                 static, work_map, work_pctx, seg_pods,
                 round_robin=self.algorithm._round_robin, host_state=host_state,
             )
-            self.stats["tensorize_s"] += self._clock_wall() - t_tensorize
+            t_end = self._clock_wall()
+            self.stats["tensorize_s"] += t_end - t_tensorize
+            if tr is not None:
+                # same clock reads as the stats timer: the trace-derived
+                # tensorize_s IS this measurement
+                tr.complete("tensorize", t_tensorize, t_end, cat="phase",
+                            pods=len(seg_pods), groups=len(static.g_request),
+                            n_pad=int(static.n_pad))
             from .pallas_kernel import shape_key
 
             key = shape_key(static)
@@ -628,7 +648,14 @@ class TPUBatchBackend:
                             "this segment")
                         self._note_interpret_failure(static)
                         level = 2
-            self.stats["dispatch_s"] += self._clock_wall() - t_dispatch
+            t_end = self._clock_wall()
+            self.stats["dispatch_s"] += t_end - t_dispatch
+            if tr is not None:
+                # the breaker's chosen ladder rung rides on the span —
+                # "this wave quietly ran on the slow path" is trace-visible
+                tr.complete("dispatch", t_dispatch, t_end, cat="phase",
+                            rung=LEVELS[level], shape=str(key),
+                            frontier=bool(self.frontier and level == 1))
 
             device_probe = None
             if fut is not None:
@@ -641,9 +668,14 @@ class TPUBatchBackend:
             def run_segment_oracle() -> list:
                 # the ladder's floor: sequential per-pod oracle — slow,
                 # but bindings are identical by definition
+                t0 = self._clock_wall()
                 for i, pod in segment:
                     run_oracle(pod, i)
                 self.stats["oracle_segments"] += 1
+                tr2 = tracing.current()
+                if tr2 is not None:
+                    tr2.complete("oracle", t0, self._clock_wall(),
+                                 cat="phase", pods=len(segment))
                 return [(pod, assignments[i], None, None) for i, pod in segment]
 
             if level == 2:
@@ -745,7 +777,12 @@ class TPUBatchBackend:
                                 "segment")
                             self._note_interpret_failure(static)
                             return run_segment_oracle()
-                self.stats["device_wait_s"] += self._clock_wall() - t_wait
+                t_wait_end = self._clock_wall()
+                self.stats["device_wait_s"] += t_wait_end - t_wait
+                if tr is not None:
+                    tr.complete("device_wait", t_wait, t_wait_end,
+                                cat="phase", rung=LEVELS[level],
+                                pods=len(segment))
                 self.algorithm._round_robin = final_rr
                 req_vecs, nz_vecs = _segment_vecs(static)
                 group_of_pod = static.group_of_pod

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..models.snapshot import BatchStatic, InitialState
 from ..scheduler.units import FIXED_POINT_ONE, MAX_PRIORITY
+from ..utils import tracing
 
 INT32_MIN = jnp.int32(-(2**31))
 INT32_MAX = jnp.int32(2**31 - 1)
@@ -827,13 +828,18 @@ class FrontierRun:
         self._dispatch_chunk()
 
     def _dispatch_chunk(self) -> None:
-        xs = _chunk_xs(self._host_xs, self._next, self.chunk_len,
-                       int(self.static.v_state) - 1)
-        self._state, chosen = self._run(self._dev, xs, self._state)
-        chosen.copy_to_host_async()
-        self._chunks.append((chosen, self._map))
-        self._next += self.chunk_len
-        self.stats["chunks"] += 1
+        tr = tracing.current()
+        with (tr.span("frontier.chunk", cat="frontier",
+                      index=self.stats["chunks"], width=self._width,
+                      start=self._next)
+              if tr is not None else tracing.NULL_SPAN):
+            xs = _chunk_xs(self._host_xs, self._next, self.chunk_len,
+                           int(self.static.v_state) - 1)
+            self._state, chosen = self._run(self._dev, xs, self._state)
+            chosen.copy_to_host_async()
+            self._chunks.append((chosen, self._map))
+            self._next += self.chunk_len
+            self.stats["chunks"] += 1
 
     @property
     def device_probe(self):
@@ -843,7 +849,14 @@ class FrontierRun:
     def _maybe_compact(self) -> None:
         alive = jnp.any(self._state.still_ok, axis=0) & self._dev.node_exists
         n_alive = int(jnp.sum(alive))  # the one [N] reduce + sync
-        self.stats["alive_frac"].append(round(n_alive / max(self._width, 1), 4))
+        frac = round(n_alive / max(self._width, 1), 4)
+        self.stats["alive_frac"].append(frac)
+        tr = tracing.current()
+        if tr is not None:
+            # per-chunk alive fraction: the frontier's pruning trajectory
+            # is readable straight off the wave trace
+            tr.instant("frontier.alive", frac=frac, width=self._width,
+                       chunk=self.stats["chunks"])
         width_new = _pow2_width(n_alive, self.min_width)
         if width_new >= self._width or n_alive > self.compact_frac * self._width:
             return

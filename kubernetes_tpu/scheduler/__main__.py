@@ -39,6 +39,14 @@ def main(argv=None) -> int:
     ap.add_argument("--healthz-port", type=int, default=-1,
                     help="serve /healthz + /metrics (reference :10251); "
                          "-1 = off, 0 = ephemeral")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable wave tracing + the flight recorder; "
+                         "exported at /debug/traces (Chrome trace-event "
+                         "JSON) and /debug/flightrecorder on the healthz "
+                         "port")
+    ap.add_argument("--trace-dump-dir", default=None,
+                    help="with --trace: also write each flight-recorder "
+                         "dump as a JSON file under this directory")
     args = ap.parse_args(argv)
     from ..utils.features import SchedulerConfiguration, load_component_config
 
@@ -77,9 +85,17 @@ def main(argv=None) -> int:
             reg = metrics_holder.get("registry")
             return reg.expose() if reg is not None else "# standby\n"
 
+    if args.trace:
+        from ..utils import tracing
+
+        tracing.enable(dump_dir=args.trace_dump_dir)
+        logging.info("wave tracing enabled (flight recorder armed)")
+
     health = serve_health(args.healthz_port, _LazyRegistry())
     if health is not None:
-        logging.info("healthz/metrics on :%d", health.local_port)
+        logging.info("healthz/metrics%s on :%d",
+                     " + /debug/traces" if args.trace else "",
+                     health.local_port)
 
     def run(payload_stop: threading.Event) -> None:
         from .generic_scheduler import GenericScheduler

@@ -32,6 +32,7 @@ from ..client.clientset import BindConflictError, Clientset
 from ..client.informer import Handler, InformerFactory
 from ..client.record import EventBroadcaster
 from ..store.store import ADDED, MODIFIED, NotFoundError
+from ..utils import tracing
 from ..utils.metrics import SchedulerMetrics
 from ..utils.trace import Trace
 from .generic_scheduler import FitError, GenericScheduler
@@ -120,8 +121,15 @@ class Scheduler:
         self.overlap_ingest = True
         self._last_prep_s = 0.0
         # per-wave phase split of the last schedule_pending_batch call
-        # (bench.py's churn preset reports these per wave)
+        # (bench.py's churn preset reports these per wave).  With tracing
+        # enabled the tensorize/dispatch/device_wait/commit/prep keys are
+        # DERIVED from the wave's span tree (same clock reads — the two
+        # cannot disagree); disabled, they come from the backend's stats
+        # deltas as before.
         self.last_batch_phases: dict = {}
+        # attrs the batch loop stamps onto the NEXT wave's root span
+        # (queue wait / accumulation window measured before the drain)
+        self._wave_attrs_pending: dict = {}
         # async event pipeline (client-go tools/record): the hot path only
         # enqueues; correlation + store writes happen on the sink thread
         self.broadcaster = EventBroadcaster(
@@ -202,7 +210,20 @@ class Scheduler:
         compares (``SchedulerCache.confirm_many``).  Whatever the
         columnar fence rejects — and every non-confirm delta — takes the
         existing per-pod routing, so semantics are identical to per-event
-        delivery by construction."""
+        delivery by construction.
+
+        The confirm span carries the emitting txn's correlation id
+        (ISSUE 7) — the third hop of the store→informer→confirm trace."""
+        tr = tracing.current()
+        if tr is None:
+            return self._route_pod_frame(frame, deltas)
+        with tr.span("scheduler.confirm", cat="ingest", kind=frame.kind,
+                     txn=frame.txn, events=len(deltas)) as sp:
+            fb0 = self.metrics.confirm_fallbacks.value
+            self._route_pod_frame(frame, deltas)
+            sp.set(fallbacks=int(self.metrics.confirm_fallbacks.value - fb0))
+
+    def _route_pod_frame(self, frame, deltas) -> None:
         self.metrics.watch_frames.inc()
         self.metrics.watch_frame_events.inc(len(deltas))
         rest = deltas
@@ -249,10 +270,14 @@ class Scheduler:
                 self.broadcaster.start()
 
     def pump(self) -> int:
-        n = self.informers.pump_all()
-        if not self.broadcaster.running:
-            # manual drive: no sink thread, so drain events synchronously
-            self.broadcaster.flush()
+        tr = tracing.current()
+        with (tr.span("ingest.pump", cat="ingest")
+              if tr is not None else tracing.NULL_SPAN) as sp:
+            n = self.informers.pump_all()
+            if not self.broadcaster.running:
+                # manual drive: no sink thread, so drain events synchronously
+                self.broadcaster.flush()
+            sp.set(events=n)
         return n
 
     def _ingest_decode_stats(self) -> tuple[float, int]:
@@ -323,6 +348,8 @@ class Scheduler:
         if latest.spec.node_name or not _is_scheduler_pod(latest, self.scheduler_name):
             return  # bound by someone else, or became terminal
         self.metrics.bind_requeues.inc()
+        # a decided placement that did not land: flight-recorder trigger
+        tracing.notify_requeue(pod.meta.key)
         self.queue.add_after(latest, self.backoff.get_backoff(pod.meta.key))
 
     def _bind(self, pod: api.Pod, node_name: str) -> bool:
@@ -620,8 +647,14 @@ class Scheduler:
             logger.warning("overlapped prep failed (work deferred to the "
                            "next wave): %s: %s", type(e).__name__, e)
         finally:
-            self._last_prep_s = _time.perf_counter() - t0
+            t_end = _time.perf_counter()
+            self._last_prep_s = t_end - t0
             self.metrics.pipeline_prep_latency.observe(self._last_prep_s * 1e6)
+            tr = tracing.current()
+            if tr is not None:
+                # the overlapped host prep, attributed inside the wave's
+                # device shadow (same clock reads as _last_prep_s)
+                tr.complete("prep", t0, t_end, cat="phase", polled=poll)
 
     def run_batch_loop(
         self,
@@ -670,8 +703,14 @@ class Scheduler:
                 time.sleep(poll_interval)
                 self.pump()
                 ready = len(self.queue)
-            self.metrics.batch_queue_wait.observe(
-                (self._clock() - t_first) * 1e6)
+            queue_wait = self._clock() - t_first
+            self.metrics.batch_queue_wait.observe(queue_wait * 1e6)
+            # the accumulation window rides onto the next wave's root
+            # span (ISSUE 7): queue wait + how many pods the window
+            # gathered vs the min-batch target
+            self._wave_attrs_pending = {
+                "queue_wait_s": round(queue_wait, 6),
+                "accumulated": ready, "min_batch": min_batch}
             bound, _ = self.schedule_pending_batch(max_batch)
             bound_total += bound
             waves += 1
@@ -689,6 +728,7 @@ class Scheduler:
         if not pods:
             return (0, 0)
         self.metrics.batch_size.observe(len(pods))
+        tr = tracing.current()
         # Cyclic GC is paused for the whole batch (tensorize + kernel +
         # commit): at 150k pods a collection pass walks millions of live
         # objects and costs more than everything it frees (the Go
@@ -786,7 +826,13 @@ class Scheduler:
             # for exactly this reason, metrics/metrics.go:26-50)
             self.metrics.e2e_scheduling_latency.observe_many(
                 (self._clock() - start) * 1e6, len(to_bind))
-            totals["commit_s"] += time.perf_counter() - t_commit
+            t_commit_end = time.perf_counter()
+            totals["commit_s"] += t_commit_end - t_commit
+            if tr is not None:
+                # same two clock reads feed the stats timer and the span:
+                # the trace-derived commit_s below IS this measurement
+                tr.complete("commit", t_commit, t_commit_end, cat="phase",
+                            pods=len(entries), bound=len(finished))
 
         # phase accounting for the churn bench: deltas of the backend's
         # cumulative timers bracket this batch's tensorize/device split
@@ -815,6 +861,18 @@ class Scheduler:
             except (TypeError, ValueError):
                 pass
 
+        # one span tree per wave (ISSUE 7): everything this thread does
+        # for the batch — tensorize, segment dispatch/finalize, frontier
+        # chunks, commits, overlapped prep, ingest pumps — nests under
+        # this root; closed (and pushed into the flight-recorder ring)
+        # in the finally below.  Entered immediately before the try so
+        # no exception path can leak an open root on the span stack
+        # (a leaked root would adopt every later wave as a child).
+        wave_cm = wave_span = None
+        if tr is not None:
+            wave_cm = tr.wave(pods=len(pods), **self._wave_attrs_pending)
+            self._wave_attrs_pending = {}
+            wave_span = wave_cm.__enter__()
         try:
             start = self._clock()
             snapshot = self.snapshot()
@@ -852,6 +910,8 @@ class Scheduler:
             promos = post_decode[1] - pre_decode[1]
             self.last_batch_phases["decode_s"] = decode_s
             self.last_batch_phases["promotions"] = promos
+            if wave_span is not None:
+                wave_span.set(decode_s=round(decode_s, 6), promotions=promos)
             self.metrics.ingest_decode_seconds.observe(decode_s)
             if promos > 0:
                 self.metrics.ingest_promotions.inc(promos)
@@ -868,21 +928,40 @@ class Scheduler:
             self.last_batch_phases["confirm_fallbacks"] = int(
                 self.metrics.confirm_fallbacks.value - pre_fallbacks)
             self.metrics.pump_apply_seconds.observe(apply_s)
+            if wave_span is not None:
+                wave_span.set(apply_s=round(apply_s, 6), frames=frames,
+                              frame_events=frame_events)
             if pre_cols is not None:
                 dirty = ncache.stats["dirty_cols"] - pre_cols[0]
                 cols = ncache.stats["cols_total"] - pre_cols[1]
                 if cols > 0:
                     self.metrics.tensorize_upload_fraction.observe(dirty / cols)
+                    if wave_span is not None:
+                        # tensorize attribution: dirty-column diff volume
+                        # and the upload fraction of the node axis
+                        wave_span.set(dirty_cols=dirty, cols_total=cols,
+                                      upload_fraction=round(dirty / cols, 4))
             # frontier trajectory of this wave (per-segment prefilter
             # widths, alive-union fractions, compactions) for the bench
             lf = getattr(self.backend, "last_frontier", None)
             if lf:
                 self.last_batch_phases["frontier"] = [dict(seg) for seg in lf]
+                if wave_span is not None:
+                    wave_span.set(frontier=[dict(seg) for seg in lf])
                 for seg in lf:
                     fr = seg.get("alive_frac") or []
                     if fr:
                         self.metrics.frontier_alive_fraction.observe(min(fr))
         finally:
+            if wave_cm is not None:
+                wave_span.set(bound=totals["bound"], failed=totals["failed"],
+                              committed=totals["committed"])
+                wave_cm.__exit__(None, None, None)
+                # derive the phase split FROM the wave's span tree: the
+                # spans were fed by the very same clock reads as the
+                # stats timers, so the dict and the exported trace can
+                # never disagree
+                self.last_batch_phases.update(wave_span.phase_totals())
             if gc_was_enabled:
                 _gc.enable()
             # committed segments' events must survive a mid-batch failure —

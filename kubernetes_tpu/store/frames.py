@@ -65,14 +65,15 @@ class WatchFrame:
     """
 
     __slots__ = ("kind", "types", "keys", "revisions", "prev_revisions",
-                 "objects", "_node_names")
+                 "objects", "txn", "_node_names")
 
     # duck-typed dispatch marker (``ev.type == FRAME``) for consumers
     # that pull mixed WatchEvent/WatchFrame items off one watch queue
     type = FRAME
 
     def __init__(self, kind: str, types: list, keys: list, revisions: list,
-                 objects: list, prev_revisions: Optional[list] = None):
+                 objects: list, prev_revisions: Optional[list] = None,
+                 txn: Optional[str] = None):
         self.kind = kind
         self.types = types
         self.keys = keys
@@ -81,6 +82,11 @@ class WatchFrame:
         # the emitting txn knew the pre-transition revision (bind_many)
         self.prev_revisions = prev_revisions
         self.objects = objects
+        # correlation id minted by the emitting store txn (ISSUE 7):
+        # the same id appears on the store's txn span, this frame, the
+        # informer's frame-apply span, and the scheduler's confirm span,
+        # so one trace shows the store→informer→confirm propagation
+        self.txn = txn
         self._node_names: Optional[list] = None
 
     def __len__(self) -> int:
@@ -125,6 +131,8 @@ class WatchFrame:
         }
         if self.prev_revisions is not None:
             out["prevRevisions"] = self.prev_revisions
+        if self.txn is not None:
+            out["txn"] = self.txn
         return out
 
     @classmethod
@@ -157,5 +165,8 @@ class WatchFrame:
             raise FrameDecodeError("frame revisions not strictly increasing")
         if any(o is not None and not isinstance(o, dict) for o in objects):
             raise FrameDecodeError("frame payloads must be dicts")
+        txn = d.get("txn")
+        if txn is not None and not isinstance(txn, str):
+            raise FrameDecodeError("frame txn id must be a string")
         return cls(kind, list(types), list(keys), revisions, list(objects),
-                   prev_revisions=prev)
+                   prev_revisions=prev, txn=txn)

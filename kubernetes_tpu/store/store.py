@@ -34,6 +34,7 @@ from typing import Callable, Iterator, Optional
 
 from .. import faults
 from ..api.meta import new_uid
+from ..utils import tracing
 
 
 def _py_fast_deepcopy(obj):
@@ -246,36 +247,44 @@ class Store:
         batch writers (the event sink) want, and loud enough for callers
         that care to check."""
         faults.hit("store.commit", op="create_many", kind=kind)
-        results: list[Optional[dict]] = []
-        with self._mu:
-            bucket = self._objects.setdefault(kind, {})
-            events: list[WatchEvent] = []
-            for obj in objs:
-                try:
-                    meta = obj.setdefault("metadata", {})
-                    key = object_key(meta.get("namespace", "default"),
-                                     meta.get("name", ""))
-                    if key in bucket:
+        # correlation id (ISSUE 7): minted per batch txn whether or not
+        # tracing is on — it rides the watch frame to every consumer
+        txn = tracing.next_txn("create_many")
+        tr = tracing.current()
+        with (tr.span("store.txn", cat="store", op="create_many", kind=kind,
+                      txn=txn, n=len(objs))
+              if tr is not None else tracing.NULL_SPAN) as sp:
+            results: list[Optional[dict]] = []
+            with self._mu:
+                bucket = self._objects.setdefault(kind, {})
+                events: list[WatchEvent] = []
+                for obj in objs:
+                    try:
+                        meta = obj.setdefault("metadata", {})
+                        key = object_key(meta.get("namespace", "default"),
+                                         meta.get("name", ""))
+                        if key in bucket:
+                            results.append(None)
+                            continue
+                        rev = self._next_rev()
+                        data = obj if _trusted else _fast_deepcopy(obj)
+                        m = data["metadata"]
+                        m.setdefault("namespace", "default")
+                        if not m.get("uid"):
+                            m["uid"] = new_uid()
+                        m["resourceVersion"] = rev
+                        m["creationRevision"] = rev
+                        bucket[key] = _Item(data=data, revision=rev)
+                        ev_copy = _fast_deepcopy(data)
+                        events.append(WatchEvent(ADDED, kind, key, rev, ev_copy))
+                        results.append(ev_copy)
+                    except Exception:  # noqa: BLE001 - one bad item, not the batch
                         results.append(None)
-                        continue
-                    rev = self._next_rev()
-                    data = obj if _trusted else _fast_deepcopy(obj)
-                    m = data["metadata"]
-                    m.setdefault("namespace", "default")
-                    if not m.get("uid"):
-                        m["uid"] = new_uid()
-                    m["resourceVersion"] = rev
-                    m["creationRevision"] = rev
-                    bucket[key] = _Item(data=data, revision=rev)
-                    ev_copy = _fast_deepcopy(data)
-                    events.append(WatchEvent(ADDED, kind, key, rev, ev_copy))
-                    results.append(ev_copy)
-                except Exception:  # noqa: BLE001 - one bad item, not the batch
-                    results.append(None)
-            # the whole txn fans out as ONE column-packed frame per
-            # frame-aware watcher (per-event to everyone else)
-            self._emit_many(events)
-        return results
+                # the whole txn fans out as ONE column-packed frame per
+                # frame-aware watcher (per-event to everyone else)
+                self._emit_many(events, txn=txn)
+            sp.set(committed=len(events))
+            return results
 
     def update(
         self, kind: str, obj: dict, expect_rev: Optional[int] = None, _trusted: bool = False
@@ -334,6 +343,14 @@ class Store:
         containers/status structures and own fresh spec/metadata dicts —
         the only fields this path ever mutates in place."""
         faults.hit("store.commit", op="bind_many", kind="Pod")
+        txn = tracing.next_txn("bind_many")
+        tr = tracing.current()
+        with (tr.span("store.txn", cat="store", op="bind_many", kind="Pod",
+                      txn=txn, n=len(items))
+              if tr is not None else tracing.NULL_SPAN) as sp:
+            return self._bind_many_locked(items, txn, sp)
+
+    def _bind_many_locked(self, items, txn, sp) -> list[Optional[str]]:
         results: list[Optional[str]] = []
         with self._mu:
             bucket = self._objects.setdefault("Pod", {})
@@ -373,7 +390,9 @@ class Store:
                 # exactly this revision knows nothing else changed
                 prev_revs.append(prev_rev)
                 results.append(None)
-            self._emit_many(events, prev_revisions=prev_revs)
+            self._emit_many(events, prev_revisions=prev_revs, txn=txn)
+        sp.set(committed=len(events),
+               errors=sum(1 for r in results if r is not None))
         return results
 
     def guaranteed_update(
@@ -562,7 +581,8 @@ class Store:
                 q.put(ev)
 
     def _emit_many(self, events: list[WatchEvent],
-                   prev_revisions: Optional[list[int]] = None) -> None:
+                   prev_revisions: Optional[list[int]] = None,
+                   txn: Optional[str] = None) -> None:
         """Fan one correlated batch out: WAL + log stay per-event (the
         replay window and durability framing are unchanged), but every
         frame-aware watcher receives ONE column-packed
@@ -592,6 +612,7 @@ class Store:
                         [ev.revision for ev in events],
                         [ev.object for ev in events],
                         prev_revisions=prev_revisions,
+                        txn=txn,
                     )
                 q.put(frame)
             else:

@@ -71,14 +71,23 @@ class Histogram:
             return float("inf")
 
     def expose(self) -> str:
+        # one consistent snapshot: without the lock a concurrent
+        # observe() can land between the bucket walk and the _total
+        # read, exposing cumulative bucket counts that exceed (or trail)
+        # the reported _count — scrapers and the SLO checks both assume
+        # the exposition is internally consistent
+        with self._mu:
+            counts = list(self._counts)
+            total = self._total
+            total_sum = self._sum
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         acc = 0
-        for b, c in zip(self.buckets, self._counts):
+        for b, c in zip(self.buckets, counts):
             acc += c
             lines.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._total}')
-        lines.append(f"{self.name}_sum {self._sum}")
-        lines.append(f"{self.name}_count {self._total}")
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {total_sum}")
+        lines.append(f"{self.name}_count {total}")
         return "\n".join(lines)
 
 
@@ -105,18 +114,34 @@ class Counter:
 
 
 class Gauge:
+    """Last-write-wins gauge.  ``set`` takes a lock like the other
+    primitives — gauges are written from resync/compaction threads and
+    scraped from the health server's connection threads, so the
+    single-writer assumption the pre-lock version leaned on does not
+    hold for every instance (ktpu-analyze race-lint hygiene)."""
+
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self.value = 0.0
+        self._value = 0.0
+        self._mu = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = v
+        with self._mu:
+            self._value = v
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._mu:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
 
     def expose(self) -> str:
         return (
             f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
-            f"{self.name} {self.value}"
+            f"{self.name} {self._value}"
         )
 
 
@@ -191,6 +216,17 @@ class ClientMetrics:
         self.ingest_bytes = r.register(Counter(
             "scheduler_ingest_decode_bytes_total",
             "wire bytes of watch payloads delivered to informers"))
+        # cache compaction (ISSUE 7 satellite: compact_cache wired to the
+        # resync loop): objects whose pinned wire payload was released,
+        # and the approximate bytes the LAST sweep freed
+        self.informer_compactions = r.register(Counter(
+            "client_informer_compactions_total",
+            "lazy cache objects promoted-and-raw-dropped by the "
+            "resync-time compaction sweep"))
+        self.informer_compaction_freed_bytes = r.register(Gauge(
+            "client_informer_compaction_freed_bytes",
+            "approximate wire-payload bytes released by the most recent "
+            "compaction sweep"))
 
 
 # informers without an explicit metrics object aggregate here: one place

@@ -2,7 +2,15 @@
 
 Capability of the reference's ``utiltrace.Trace``
 (``apiserver/pkg/util/trace/trace.go``): the scheduler wraps every Schedule
-call with a 100ms threshold (``generic_scheduler.go:89-90``)."""
+call with a 100ms threshold (``generic_scheduler.go:89-90``).
+
+Folded onto the structured span layer (``utils/tracing.py``, ISSUE 7):
+the step bookkeeping lives in a :class:`~.tracing.Span` and the slow
+rendering is :func:`~.tracing.format_slow` — the same code path the
+tracer's slow-wave logging uses.  When tracing is enabled, the whole
+Trace additionally lands in the active tracer as a span (steps become
+instant marks in the Chrome export), so ``schedule_one`` shows up in
+wave traces without a second instrumentation."""
 
 from __future__ import annotations
 
@@ -10,29 +18,50 @@ import logging
 import time
 from typing import Callable
 
+from . import tracing
+
 logger = logging.getLogger("kubernetes_tpu.trace")
 
 
 class Trace:
-    def __init__(self, name: str, clock: Callable[[], float] = time.monotonic):
+    # default clock is time.perf_counter — the SAME default the tracer
+    # uses, so a Trace recorded into an active tracer lands in the same
+    # timestamp domain by construction (time.monotonic and perf_counter
+    # share an epoch on Linux but not on every platform)
+    def __init__(self, name: str,
+                 clock: Callable[[], float] = time.perf_counter):
         self.name = name
         self._clock = clock
-        self._start = clock()
-        self._steps: list[tuple[float, str]] = []
+        self._span = tracing.Span(name, cat="trace", t0=clock())
+
+    @property
+    def _start(self) -> float:  # kept for compatibility with older tests
+        return self._span.t0
 
     def step(self, msg: str) -> None:
-        self._steps.append((self._clock(), msg))
+        self._span.step(self._clock(), msg)
 
     def total(self) -> float:
-        return self._clock() - self._start
+        return self._clock() - self._span.t0
 
     def log_if_long(self, threshold: float) -> None:
-        total = self.total()
-        if total < threshold:
+        now = self._clock()
+        self._finish(now)
+        if now - self._span.t0 < threshold:
             return
-        lines = [f'Trace "{self.name}" (total {total * 1e3:.1f}ms):']
-        prev = self._start
-        for t, msg in self._steps:
-            lines.append(f"  +{(t - prev) * 1e3:.1f}ms {msg}")
-            prev = t
-        logger.info("\n".join(lines))
+        logger.info(tracing.format_slow(self.name, self._span.t0,
+                                        self._span.steps, now))
+
+    def _finish(self, now: float) -> None:
+        """Close the span and, when a tracer is active, record it there —
+        Trace uses its OWN injected clock, so the span is recorded with
+        explicit timestamps (meaningful only when both clocks share a
+        domain; the defaults are both ``time.perf_counter``, so they do
+        unless a caller injects a clock from another domain)."""
+        if self._span.t1 is not None:
+            return
+        self._span.t1 = now
+        tr = tracing.current()
+        if tr is not None:
+            recorded = tr.complete(self.name, self._span.t0, now, cat="trace")
+            recorded.steps = list(self._span.steps)

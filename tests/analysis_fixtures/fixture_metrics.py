@@ -1,0 +1,41 @@
+"""Seeded metrics-name violations for the MN4xx lint pass (ISSUE 7).
+
+Each section pins one code; the ``Clean`` class pins the exemptions
+(conforming names, and a ``collections.Counter`` that must NOT count as
+a metric).  Never imported by the live tree."""
+
+import collections
+
+from kubernetes_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+
+
+def build_bad_registry() -> Registry:
+    r = Registry()
+    # MN401: not snake_case
+    r.register(Counter("BadCamel_total"))
+    r.register(Gauge("scheduler-dashes-gauge"))
+    # MN402: counter without the _total suffix
+    r.register(Counter("client_things_seen"))
+    # MN403: histogram without a unit suffix
+    r.register(Histogram("scheduler_wait"))
+    return r
+
+
+def duplicate_registrations():
+    # MN404: the same literal name at two construction sites
+    first = Counter("dup_metric_total")
+    second = Counter("dup_metric_total")
+    return first, second
+
+
+class Clean:
+    """Conforming constructions: zero findings expected here."""
+
+    def __init__(self):
+        self.ok_counter = Counter("fixture_ok_events_total")
+        self.ok_hist = Histogram("fixture_ok_latency_seconds")
+        self.ok_hist_frac = Histogram("fixture_ok_alive_fraction")
+        self.ok_gauge = Gauge("fixture_ok_depth")
+        # the stdlib Counter is NOT a metric: no import from a metrics
+        # module binds this name, so the pass must ignore it
+        self.tally = collections.Counter("AbCdEf")

@@ -81,7 +81,7 @@ def test_cli_exit_codes():
         cwd=ROOT, capture_output=True, text=True, env=env,
     )
     doc = json.loads(as_json.stdout)
-    assert doc["passes"] == ["trace", "parity", "races"]
+    assert doc["passes"] == ["trace", "parity", "races", "metrics"]
     assert len(doc["findings"]) == n_suppressed, doc["findings"]
     assert as_json.returncode == (1 if n_suppressed else 0), as_json.stdout
 
@@ -259,6 +259,50 @@ def test_race_fixture_exemptions_stay_clean(race_findings):
     symbols = {f.symbol for f in race_findings}
     for clean in ("GuardedCounter", "PerRequestHandler", "AliasExemptions"):
         assert not any(s.startswith(clean) for s in symbols), sorted(symbols)
+
+
+# ---------------------------------------------------------------------------
+# metrics-name lint fixtures (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def metrics_findings():
+    report = run_analysis(
+        root=ROOT,
+        passes=["metrics"],
+        scopes={"metrics": {"paths": [f"{FIXTURES}/fixture_metrics.py"]}},
+    )
+    return report.findings
+
+
+def test_metrics_fixture_codes_and_locations(metrics_findings):
+    path = f"{FIXTURES}/fixture_metrics.py"
+    got = {(f.code, f.symbol) for f in metrics_findings}
+    expected = {
+        ("MN401", "build_bad_registry.BadCamel_total"),
+        ("MN401", "build_bad_registry.scheduler-dashes-gauge"),
+        ("MN402", "build_bad_registry.client_things_seen"),
+        ("MN403", "build_bad_registry.scheduler_wait"),
+        ("MN404", "duplicate_registrations.dup_metric_total"),
+    }
+    assert got == expected, f"got {sorted(got)}"
+    by_key = {(f.code, f.symbol): f.line for f in metrics_findings}
+    assert by_key[("MN402", "build_bad_registry.client_things_seen")] == (
+        _fixture_line(path, 'Counter("client_things_seen")'))
+    assert by_key[("MN404", "duplicate_registrations.dup_metric_total")] == (
+        _fixture_line(path, 'second = Counter("dup_metric_total")'))
+    messages = {f.symbol: f.message for f in metrics_findings}
+    # the duplicate finding names the FIRST registration site
+    assert "first registered at" in messages[
+        "duplicate_registrations.dup_metric_total"]
+
+
+def test_metrics_fixture_exemptions_stay_clean(metrics_findings):
+    symbols = {f.symbol for f in metrics_findings}
+    # conforming names, and the stdlib collections.Counter (no metrics
+    # import binds that name) must produce nothing
+    assert not any(s.startswith("Clean") for s in symbols), sorted(symbols)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,563 @@
+"""Wave tracing + flight recorder (ISSUE 7).
+
+Four tiers:
+
+1. span-layer unit tests — tree nesting, per-thread stacks, leaked-span
+   unwinding, the ring/dump bounds, the disabled path;
+2. the **end-to-end correlation** test: one scheduled batch, and the
+   ``bind_many`` txn id minted by the store appears in the store span,
+   the informer's frame-apply span, AND the scheduler's confirm span of
+   ONE exported Chrome trace;
+3. the **dump-on-fault matrix**: every registered fault point (the same
+   registry the fault matrix gates) and every kernel-breaker transition
+   produces a flight-recorder dump that contains the firing wave's
+   trace;
+4. ``utils/trace.py`` fold — ``Trace.log_if_long`` threshold/step
+   deltas under a fake clock, and the shared ``format_slow`` path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from kubernetes_tpu import faults
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.faults import FaultInjected, FaultPlan, FaultSpec
+from kubernetes_tpu.ops import TPUBatchBackend
+from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testutil import make_node, make_pod
+from kubernetes_tpu.utils import tracing
+from kubernetes_tpu.utils.trace import Trace
+
+from tests.test_faults import MATRIX, FakeClock, World
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """The tracer is process-global state: a leaked enable() would
+    silently instrument every later test in the session."""
+    yield
+    tracing.disable()
+
+
+# =====================================================================
+# 1. span-layer unit tests
+# =====================================================================
+
+
+def test_disabled_path_is_inert():
+    assert tracing.current() is None
+    # the notify hooks are the instrumented sites' whole disabled cost:
+    # one global load + None check, no exceptions, no state
+    tracing.notify_fault("store.commit", {"op": "x"}, "error")
+    tracing.notify_breaker("degrade", ("k",), "pallas", "interpret")
+    tracing.notify_requeue("default/p")
+    # txn ids are minted whether or not tracing is on (they ride the
+    # watch frame; a consumer enabling tracing mid-stream still
+    # correlates)
+    a, b = tracing.next_txn("bind_many"), tracing.next_txn("create_many")
+    assert a != b and a.startswith("bind_many-")
+
+
+def test_span_tree_nesting_and_ring():
+    clk = FakeClock()
+    tr = tracing.enable(clock=clk, ring_waves=2)
+    with tr.wave(pods=3) as w:
+        clk.advance(1.0)
+        with tr.span("tensorize", cat="phase"):
+            clk.advance(0.5)
+        with tr.span("dispatch", cat="phase", rung="interpret"):
+            clk.advance(0.25)
+            with tr.span("frontier.chunk", cat="frontier"):
+                clk.advance(0.1)
+    assert [c.name for c in w.children] == ["tensorize", "dispatch"]
+    assert w.children[1].children[0].name == "frontier.chunk"
+    assert w.t1 is not None and w.duration == pytest.approx(1.85)
+    # phase totals are wall durations of the cat="phase" spans (the
+    # frontier chunk is INSIDE dispatch, so dispatch includes it)
+    assert w.phase_totals() == {"tensorize_s": pytest.approx(0.5),
+                                "dispatch_s": pytest.approx(0.35)}
+    # ring is bounded to the last K waves
+    with tr.wave():
+        pass
+    with tr.wave():
+        pass
+    assert [s.attrs["wave"] for s in tr.ring] == [2, 3]
+    # non-wave roots land in the background ring, not the wave ring
+    with tr.span("store.txn", cat="store"):
+        pass
+    assert tr.background[-1].name == "store.txn"
+
+
+def test_leaked_open_child_is_unwound():
+    clk = FakeClock()
+    tr = tracing.enable(clock=clk)
+    cm_outer = tr.span("outer")
+    outer = cm_outer.__enter__()
+    cm_child = tr.span("child")
+    child = cm_child.__enter__()
+    clk.advance(1.0)
+    # the child's __exit__ is skipped (an exception path) — closing the
+    # outer span must close the leaked child and not corrupt parentage
+    cm_outer.__exit__(None, None, None)
+    assert child.t1 == outer.t1 == 1.0
+    with tr.span("after") as sp:
+        pass
+    assert sp in tr.background  # a fresh root, not a child of the leak
+
+
+def test_spans_on_other_threads_are_separate_roots():
+    tr = tracing.enable()
+    with tr.wave() as w:
+        def off_thread():
+            with tr.span("informer.frame.apply", cat="ingest"):
+                pass
+        t = threading.Thread(target=off_thread)
+        t.start()
+        t.join()
+    assert w.children == []  # the other thread's span did not nest here
+    assert tr.background[-1].name == "informer.frame.apply"
+    assert tr.background[-1].tid != w.tid
+
+
+def test_flight_recorder_bounds_and_dump_dir(tmp_path):
+    clk = FakeClock()
+    tr = tracing.enable(clock=clk, ring_waves=2, max_dumps=2,
+                        dump_dir=str(tmp_path))
+    with tr.wave():
+        clk.advance(1.0)
+    tr.instant("frontier.alive", frac=0.5)
+    for i in range(3):
+        tr.dump(f"reason-{i}")
+    assert len(tr.dumps) == 2 and tr.dropped_dumps == 1
+    assert [d["reason"] for d in tr.dumps] == ["reason-1", "reason-2"]
+    # every dump snapshots the wave ring + instants at dump time
+    assert all(len(d["waves"]) == 1 for d in tr.dumps)
+    assert tr.dumps[-1]["instants"][-1]["name"] == "frontier.alive"
+    # dump_dir gets one JSON file per dump, valid JSON
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == ["flight_0001.json", "flight_0002.json",
+                     "flight_0003.json"]
+    with open(tmp_path / "flight_0003.json") as f:
+        assert json.load(f)["reason"] == "reason-2"
+    # reading the recorder must not fill it
+    snap = tr.flight_snapshot()
+    assert len(tr.dumps) == 2
+    assert snap["dropped_dumps"] == 1 and len(snap["current"]["waves"]) == 1
+
+
+def test_notify_hooks_never_crash_the_call_site():
+    """The notify hooks sit on production paths (fault sites, the
+    breaker, bind handling): a recorder failure must be swallowed and
+    logged, never propagated into the behavior being observed."""
+    tr = tracing.enable()
+
+    def boom(*a, **k):
+        raise RuntimeError("recorder bug")
+
+    tr.dump = boom  # instance-level: only this tracer is broken
+    tracing.notify_fault("store.commit", {"op": "x"}, "error")
+    tracing.notify_breaker("degrade", ("k",), "pallas", "interpret")
+    tracing.notify_requeue("default/p")
+    assert len(tr.dumps) == 0  # nothing recorded, nothing raised
+
+
+def test_requeue_dumps_coalesce_per_window():
+    """A transient bind_many failure requeues every pod in the segment;
+    each requeue records an instant, but only the first in the window
+    pays for a full recorder dump — the recorder must not amplify the
+    stall it is recording."""
+    clk = FakeClock()
+    tr = tracing.enable(clock=clk)
+    for i in range(50):
+        tracing.notify_requeue(f"default/p-{i}")
+    assert len([d for d in tr.dumps if d["reason"] == "bind.requeue"]) == 1
+    assert tr.coalesced_dumps == 49
+    assert len([e for e in tr.instants
+                if e["name"] == "bind.requeue"]) == 50  # per-pod timeline
+    # a requeue in a LATER window dumps again
+    clk.advance(tracing.REQUEUE_DUMP_COALESCE_S + 0.1)
+    tracing.notify_requeue("default/p-late")
+    assert len([d for d in tr.dumps if d["reason"] == "bind.requeue"]) == 2
+    assert tr.flight_snapshot()["coalesced_dumps"] == 49
+    # coalescing is per-reason: fault dumps are not throttled by it
+    tracing.notify_fault("scheduler.bind", {}, "error")
+    tracing.notify_fault("scheduler.bind", {}, "error")
+    assert len([d for d in tr.dumps
+                if d["reason"] == "fault:scheduler.bind"]) == 2
+
+
+def test_notify_hooks_dump_with_reasons():
+    tr = tracing.enable()
+    tracing.notify_fault("scheduler.bind", {"via": "bind_many"}, "drop")
+    tracing.notify_breaker("degrade", ("shape",), "interpret", "oracle")
+    tracing.notify_requeue("default/p-0")
+    assert [d["reason"] for d in tr.dumps] == [
+        "fault:scheduler.bind", "breaker:degrade", "bind.requeue"]
+    assert tr.dumps[0]["attrs"]["mode"] == "drop"
+    assert tr.dumps[1]["attrs"]["frm"] == "interpret"
+    # the instants ring carries the same triggers for the timeline view
+    assert [e["name"] for e in tr.instants] == [
+        "fault:scheduler.bind".replace(":", "."), "breaker.degrade",
+        "bind.requeue"]
+
+
+# =====================================================================
+# 2. end-to-end correlation + Chrome export
+# =====================================================================
+
+
+def _mini_world(n_nodes=4, clock=None, **backend_kw):
+    cs = Clientset(Store())
+    for i in range(n_nodes):
+        cs.nodes.create(make_node(f"n{i}", cpu="8", memory="16Gi"))
+    algo = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo, **backend_kw)
+    kw = {"clock": clock} if clock is not None else {}
+    sched = Scheduler(cs, algorithm=algo, backend=backend, **kw)
+    sched.start()
+    return cs, sched, backend
+
+
+def _txn_spans(doc):
+    """txn id -> set of span names carrying it, from a Chrome export."""
+    out: dict[str, set] = {}
+    for ev in doc["traceEvents"]:
+        txn = (ev.get("args") or {}).get("txn")
+        if txn:
+            out.setdefault(txn, set()).add(ev["name"])
+    return out
+
+
+@pytest.mark.timeout(120)
+def test_end_to_end_txn_correlation():
+    """The acceptance path: ONE exported trace in which a ``bind_many``
+    txn id appears on the store's txn span, the informer's watch-frame
+    apply span, and the scheduler's confirm span — the full
+    store → informer → confirm propagation of one wave's binds."""
+    tr = tracing.enable()
+    cs, sched, _ = _mini_world()
+    cs.pods.create_many([make_pod(f"p{i}", cpu="100m") for i in range(12)])
+    sched.pump()
+    bound, failed = sched.schedule_pending_batch()
+    assert bound == 12 and failed == 0
+    sched.pump()  # digest the bind-confirm frame
+
+    doc = tr.chrome_trace()
+    txns = _txn_spans(doc)
+    bind_txns = [t for t in txns if t.startswith("bind_many-")]
+    assert bind_txns, f"no bind_many txn in the export: {sorted(txns)}"
+    for txn in bind_txns:
+        assert {"store.txn", "informer.frame.apply",
+                "scheduler.confirm"} <= txns[txn], (txn, txns[txn])
+    # the create txn correlates too (ADDED frame has no confirm hop
+    # required — but the store and apply spans must share the id)
+    create_txns = [t for t in txns if t.startswith("create_many-")]
+    assert any({"store.txn", "informer.frame.apply"} <= txns[t]
+               for t in create_txns)
+
+
+@pytest.mark.timeout(120)
+def test_chrome_export_validates_and_phases_derive_from_trace():
+    tr = tracing.enable()
+    cs, sched, _ = _mini_world()
+    cs.pods.create_many([make_pod(f"p{i}", cpu="100m") for i in range(8)])
+    sched.pump()
+    sched.schedule_pending_batch()
+
+    # the per-wave phase dict is DERIVED from the wave's span tree: the
+    # two can never disagree because they are the same clock reads
+    wave = tr.ring[-1]
+    totals = wave.phase_totals()
+    for key in ("tensorize_s", "dispatch_s", "device_wait_s", "commit_s"):
+        assert key in totals
+        assert sched.last_batch_phases[key] == totals[key]
+    assert wave.attrs["pods"] == 8 and wave.attrs["bound"] == 8
+
+    # Chrome trace-event format: every event is a complete X duration
+    # event or an i instant, microsecond timestamps, sorted, and the
+    # whole document survives a JSON round-trip
+    doc = tr.chrome_trace()
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    assert events
+    for ev in events:
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["ts"], float) and ev["ts"] >= 0.0
+        assert ev["pid"] == 1 and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0.0
+        else:
+            assert ev["s"] in ("t", "g")
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    round_trip = json.loads(json.dumps(doc))
+    assert len(round_trip["traceEvents"]) == len(events)
+    names = {e["name"] for e in events}
+    assert {"store.txn", "tensorize", "dispatch", "commit"} <= names
+    assert any(n.startswith("wave-") for n in names)
+
+
+@pytest.mark.timeout(60)
+def test_debug_endpoints_serve_traces_and_flightrecorder():
+    """The daemon health server's ``/debug/traces`` (Chrome export) and
+    ``/debug/flightrecorder`` endpoints — and their honest
+    ``{"enabled": false}`` answer when tracing is off, so probing them
+    never perturbs a production daemon."""
+    import urllib.request
+
+    from kubernetes_tpu.daemon import serve_health
+
+    server = serve_health(0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.local_port}{path}",
+                    timeout=5) as resp:
+                return json.loads(resp.read())
+
+        assert get("/debug/traces") == {"enabled": False}
+        assert get("/debug/flightrecorder") == {"enabled": False}
+
+        tr = tracing.enable()
+        with tr.wave(pods=1):
+            with tr.span("tensorize", cat="phase"):
+                pass
+        tr.dump("fault:store.commit", mode="error")
+        doc = get("/debug/traces")
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "wave-1" in names and "tensorize" in names
+        snap = get("/debug/flightrecorder")
+        assert snap["enabled"] is True
+        assert [d["reason"] for d in snap["dumps"]] == ["fault:store.commit"]
+        assert len(snap["current"]["waves"]) == 1
+    finally:
+        server.stop()
+
+
+# =====================================================================
+# 3. dump-on-fault: the matrix points and the breaker ladder
+# =====================================================================
+
+# points whose fire site runs INSIDE an open scheduling wave (the wave
+# span must be LIVE in the dump); everything else fires on watch/pump/
+# arrival paths where the dump carries the completed-wave ring instead
+_IN_WAVE = {"scheduler.bind", "backend.pallas.segment", "backend.compact",
+            "scheduler.pipeline.prep", "store.commit"}
+
+
+def _has_wave(span_dicts, require_open=False):
+    for d in span_dicts:
+        if d.get("cat") == "wave" and (not require_open or d["t1"] is None):
+            return True
+    return False
+
+
+def _warm_then_fire(point, scenario, tmp_path):
+    """Run the matrix scenario's world with tracing on: a fault-free
+    warm phase completes ≥1 wave into the recorder ring, then the plan
+    arms and fresh workload drives the point's natural trigger path."""
+    tr = tracing.current()
+    server = None
+    if scenario["world"] == "remote":
+        from kubernetes_tpu.apiserver import APIServer
+
+        server = APIServer(Store())
+        server.start()
+    try:
+        w = World(server=server)
+        realtime = scenario["world"] == "remote"
+        for i in range(8):
+            w.cs.pods.create(make_pod(f"warm-{i:03d}", cpu="200m",
+                                      memory="256Mi"))
+        w.drive(rounds=4, relist_every=0, realtime=realtime)
+        assert len(tr.ring) >= 1, "warm phase completed no wave"
+        plan = FaultPlan(seed=42).on(point, FaultSpec(**scenario["spec"]))
+        with plan.armed():
+            for i in range(16):
+                w.cs.pods.create(make_pod(f"work-{i:03d}", cpu="200m",
+                                          memory="256Mi"))
+            w.drive(rounds=8, relist_every=4, realtime=realtime)
+        assert plan.fired.get(point, 0) > 0, f"{point}: fault never fired"
+    finally:
+        if server is not None:
+            server.stop()
+
+
+def _wal_fire(point, tmp_path):
+    w = World(data_dir=str(tmp_path / "state"))
+    for i in range(8):
+        w.cs.pods.create(make_pod(f"warm-{i:03d}", cpu="200m",
+                                  memory="256Mi"))
+    w.drive(rounds=4, relist_every=0)
+    assert len(tracing.current().ring) >= 1
+    plan = FaultPlan(seed=3).on(point, mode="torn", value=0.5)
+    with plan.armed():
+        with pytest.raises(FaultInjected):
+            w.cs.pods.create(make_pod("marker", cpu="100m"))
+    assert plan.fired[point] == 1
+    w.store.close()
+
+
+@pytest.mark.timeout(180)
+@pytest.mark.parametrize("point", sorted(MATRIX))
+def test_every_fault_point_dumps_the_firing_waves_trace(point, tmp_path):
+    """The acceptance bar: EVERY fault-matrix point, when it fires with
+    tracing on, produces a flight-recorder dump that contains the firing
+    wave's trace — live (still-open root) for faults that fire inside
+    the wave, the completed-wave ring for watch/pump/arrival faults.
+
+    Convergence under each fault is ``test_faults``' job; this matrix
+    proves the OBSERVABILITY contract on the same scenarios."""
+    scenario = MATRIX[point]
+    tr = tracing.enable()
+    if scenario["world"] == "wal":
+        _wal_fire(point, tmp_path)
+    else:
+        _warm_then_fire(point, scenario, tmp_path)
+
+    dumps = [d for d in tr.dumps if d["reason"] == f"fault:{point}"]
+    assert dumps, (f"{point}: no flight-recorder dump "
+                   f"(saw {[d['reason'] for d in tr.dumps]})")
+    d = dumps[0]  # the FIRST firing's dump (later ones may differ)
+    assert _has_wave(d["waves"]) or _has_wave(d["live"]), (
+        f"{point}: dump carries no wave trace")
+    if point in _IN_WAVE:
+        assert _has_wave(d["live"], require_open=True), (
+            f"{point}: fault fired inside a wave but the dump has no "
+            f"live wave span")
+    if point == "scheduler.bind":
+        # the dropped bind also requeues: that is its own trigger
+        assert any(x["reason"] == "bind.requeue" for x in tr.dumps)
+
+
+@pytest.mark.timeout(180)
+def test_every_breaker_transition_dumps_the_firing_waves_trace():
+    """Degrade (interpret → oracle) and the cool-down re-probe restore
+    each produce a dump whose live section holds the open wave — one
+    dump per transition, matching the backend's transition counter."""
+    clock = FakeClock()
+    tr = tracing.enable()
+    # built explicitly (not via _mini_world): the backend needs the fake
+    # clock so the cool-down window is test-controlled
+    cs = Clientset(Store())
+    for i in range(4):
+        cs.nodes.create(make_node(f"n{i}", cpu="64", memory="128Gi"))
+    algo = GenericScheduler()
+    backend = TPUBatchBackend(algorithm=algo, kernel_impl="xla",
+                              pallas_max_failures=1, breaker_cooldown=30.0,
+                              clock=clock)
+    sched = Scheduler(cs, algorithm=algo, backend=backend, clock=clock)
+    sched.start()
+
+    def wave(tag, n=6):
+        cs.pods.create_many([make_pod(f"{tag}-{i}", cpu="100m")
+                             for i in range(n)])
+        sched.pump()
+        sched.schedule_pending_batch()
+        sched.pump()
+
+    # wave 1: injected interpret failure → one strike trips the shape
+    # to the oracle rung (degrade transition, dump taken mid-wave)
+    plan = FaultPlan().on("backend.pallas.segment", mode="error",
+                          match={"impl": "interpret"}, first_n=1)
+    with plan.armed():
+        wave("a")
+    assert backend.stats["interpret_fallbacks"] >= 1
+    assert backend.stats["breaker_transitions"] == 1
+
+    # wave 2: inside the cool-down the shape stays on oracle (no
+    # transition, no new breaker dump)
+    wave("b")
+    assert backend.stats["breaker_transitions"] == 1
+
+    # wave 3: cool-down elapsed → half-open probe succeeds → restore
+    clock.advance(31.0)
+    wave("c")
+    assert backend.stats["breaker_transitions"] == 2
+
+    breaker_dumps = [d for d in tr.dumps
+                     if d["reason"].startswith("breaker:")]
+    assert len(breaker_dumps) == backend.stats["breaker_transitions"]
+    kinds = [d["reason"] for d in breaker_dumps]
+    assert kinds[0] == "breaker:degrade" and kinds[1] == "breaker:restore"
+    for d in breaker_dumps:
+        assert _has_wave(d["live"], require_open=True), (
+            f"{d['reason']}: no live wave span in the dump")
+        assert d["attrs"]["frm"] in ("pallas", "interpret", "oracle")
+        assert d["attrs"]["to"] in ("pallas", "interpret", "oracle")
+
+
+# =====================================================================
+# 4. utils/trace.py fold — log_if_long on the shared span layer
+# =====================================================================
+
+
+def test_log_if_long_over_threshold_logs_step_deltas(caplog):
+    clk = FakeClock()
+    t = Trace("schedule_one", clock=clk)
+    clk.advance(0.120)
+    t.step("predicates done")
+    clk.advance(0.030)
+    t.step("priorities done")
+    clk.advance(0.010)
+    with caplog.at_level("INFO", logger="kubernetes_tpu.trace"):
+        t.log_if_long(0.100)
+    assert len(caplog.records) == 1
+    msg = caplog.records[0].message
+    assert 'Trace "schedule_one" (total 160.0ms):' in msg
+    assert "+120.0ms predicates done" in msg
+    assert "+30.0ms priorities done" in msg  # DELTA from the prior step
+
+
+def test_log_if_long_under_threshold_is_silent(caplog):
+    clk = FakeClock()
+    t = Trace("schedule_one", clock=clk)
+    t.step("fast")
+    clk.advance(0.010)
+    with caplog.at_level("INFO", logger="kubernetes_tpu.trace"):
+        t.log_if_long(0.100)
+    assert caplog.records == []
+
+
+def test_trace_lands_in_active_tracer_with_steps():
+    clk = FakeClock()
+    tr = tracing.enable(clock=clk)
+    t = Trace("schedule_one", clock=clk)
+    clk.advance(0.5)
+    t.step("scored")
+    t.log_if_long(10.0)  # under threshold: silent, but still recorded
+    recorded = [s for s in tr.background if s.name == "schedule_one"]
+    assert len(recorded) == 1
+    assert recorded[0].cat == "trace"
+    assert recorded[0].steps == [(0.5, "scored")]
+    assert recorded[0].duration == pytest.approx(0.5)
+    # a second log_if_long call must not double-record
+    t.log_if_long(10.0)
+    assert len([s for s in tr.background if s.name == "schedule_one"]) == 1
+
+
+def test_format_slow_is_the_shared_rendering():
+    out = tracing.format_slow("op", 1.0, [(1.2, "a"), (1.5, "b")], 1.6)
+    assert out.splitlines() == [
+        'Trace "op" (total 600.0ms):',
+        "  +200.0ms a",
+        "  +300.0ms b",
+    ]
+
+
+def test_slow_wave_logging_uses_format_slow(caplog):
+    clk = FakeClock()
+    tr = tracing.enable(clock=clk, slow_wave_s=1.0)
+    with caplog.at_level("INFO", logger="kubernetes_tpu.tracing"):
+        with tr.wave() as w:
+            clk.advance(0.2)
+            w.step(clk(), "tensorized")
+            clk.advance(1.0)
+    assert len(caplog.records) == 1
+    assert 'Trace "wave-1" (total 1200.0ms):' in caplog.records[0].message
+    assert "+200.0ms tensorized" in caplog.records[0].message

@@ -598,6 +598,38 @@ def test_informer_compact_cache_sweeps_synced_caches():
     assert inf.compact_cache() == 1
 
 
+def test_compact_on_resync_flag_sweeps_after_relist():
+    """ISSUE 7 satellite (ROADMAP carried item): with the flag on, every
+    relist/resync tick ends with the compaction sweep — counted in
+    ``client_informer_compactions_total`` with the freed bytes on the
+    gauge — and the default (flag off) still never compacts."""
+    from kubernetes_tpu.utils.metrics import ClientMetrics
+
+    cs = Clientset(Store())
+    cs.pods.create_many([make_pod(f"p{i}", cpu="100m") for i in range(4)])
+    metrics = ClientMetrics()
+    inf = SharedInformer(Clientset(cs.store).pods, metrics=metrics,
+                         compact_on_resync=True)
+    inf.start_manual()
+    assert all(inf.get(k).raw is not None for k in inf.keys())
+    inf.relist()  # the resync-timer tick (reference resyncPeriod alias)
+    assert all(inf.get(k).raw is None for k in inf.keys())
+    assert inf.stats["compactions"] == 4
+    assert metrics.informer_compactions.value == 4
+    assert metrics.informer_compaction_freed_bytes.value > 0
+    # second tick: the relist itself re-pinned fresh LIST payloads, so
+    # the sweep drops them again — steady state is one sweep per resync
+    inf.relist()
+    assert metrics.informer_compactions.value == 8
+    assert all(inf.get(k).raw is None for k in inf.keys())
+
+    # flag off (the default): relist never compacts behind your back
+    inf2 = SharedInformer(Clientset(cs.store).pods)
+    inf2.start_manual()
+    inf2.relist()
+    assert all(inf2.get(k).raw is not None for k in inf2.keys())
+
+
 def test_compaction_memory_delta():
     """The sweep must actually FREE the pinned wire payloads: raw dicts
     with unmodeled fields (the realistic wire shape — most of a real
