@@ -2,11 +2,15 @@
 
     python -m kubernetes_tpu.analysis [--json] [--pass NAME]...
                                       [--baseline PATH | --no-baseline]
+                                      [--prune-baseline] [--profile]
                                       [--root DIR] [--list-passes]
 
 Exit codes: 0 = clean (all findings baselined), 1 = unbaselined findings,
 2 = usage/baseline error.  Nonzero-on-findings is the commit-gate
 contract: `python -m kubernetes_tpu.analysis && git commit …`.
+``--prune-baseline`` rewrites the baseline file with stale entries
+removed (reasons on surviving entries preserved); exit semantics are
+unchanged — findings still fail the run after the prune.
 """
 
 from __future__ import annotations
@@ -20,6 +24,7 @@ from .core import (
     BaselineError,
     default_baseline_path,
     load_baseline,
+    prune_baseline,
     repo_root,
     run_analysis,
 )
@@ -29,6 +34,7 @@ PASS_DESCRIPTIONS = {
     "parity": "oracle↔kernel parity coverage (PC2xx: unmapped predicates/priorities, stale markers)",
     "races": "controller/kubelet race lint (RL3xx: unlocked cross-thread writes, lock-order cycles)",
     "metrics": "metrics-name lint (MN4xx: snake_case names, counters end _total, histograms carry a unit, no duplicate registrations)",
+    "tracecov": "trace-coverage lint (TC5xx: fault seams outside spans, unmirrored phase timers, span-free hot-path modules)",
 }
 
 
@@ -58,6 +64,17 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="also fail (exit 1) on stale baseline entries",
     )
+    parser.add_argument(
+        "--prune-baseline",
+        action="store_true",
+        help="rewrite the baseline file with stale entries removed "
+             "(surviving entries keep their reasons and order)",
+    )
+    parser.add_argument(
+        "--profile",
+        action="store_true",
+        help="print per-pass wall-time to stderr",
+    )
     parser.add_argument("--list-passes", action="store_true", help="list passes and exit")
     args = parser.parse_args(argv)
 
@@ -67,12 +84,17 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     baseline = None
+    baseline_path = None
+    if args.prune_baseline and args.no_baseline:
+        print("--prune-baseline needs a baseline file (conflicts with "
+              "--no-baseline)", file=sys.stderr)
+        return 2
     if not args.no_baseline:
-        path = args.baseline or default_baseline_path()
+        baseline_path = args.baseline or default_baseline_path()
         try:
-            baseline = load_baseline(path)
+            baseline = load_baseline(baseline_path)
         except FileNotFoundError:
-            print(f"baseline file not found: {path}", file=sys.stderr)
+            print(f"baseline file not found: {baseline_path}", file=sys.stderr)
             return 2
         except BaselineError as e:
             print(str(e), file=sys.stderr)
@@ -86,10 +108,22 @@ def main(argv: list[str] | None = None) -> int:
         print(str(e), file=sys.stderr)
         return 2
 
+    if args.prune_baseline and report.stale_suppressions:
+        removed = prune_baseline(baseline_path, report.stale_suppressions)
+        for key in removed:
+            print(f"pruned stale baseline entry: {key}", file=sys.stderr)
+        report.stale_suppressions = []
+
     if args.json:
-        print(json.dumps(report.to_dict(), indent=2))
+        # sort_keys: CI diffs two runs' output textually — field order
+        # must never depend on dict construction order
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
     else:
         print(report.format_text())
+    if args.profile:
+        for name in report.passes_run:
+            print(f"profile: {name:8s} {report.timings.get(name, 0.0) * 1000.0:8.1f} ms",
+                  file=sys.stderr)
     if report.findings:
         return 1
     if args.strict_baseline and report.stale_suppressions:

@@ -19,7 +19,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-PASS_NAMES = ("trace", "parity", "races", "metrics")
+PASS_NAMES = ("trace", "parity", "races", "metrics", "tracecov")
 
 
 def repo_root() -> str:
@@ -87,19 +87,62 @@ def load_baseline(path: str) -> dict[str, str]:
     return out
 
 
+def prune_baseline(path: str, stale_keys: list[str]) -> list[str]:
+    """Rewrite the baseline file with the given stale entries removed.
+
+    Surviving entries keep their order, reasons, and any extra fields;
+    top-level keys other than ``suppressions`` (the ``_comment`` header)
+    are preserved verbatim.  Returns the keys actually removed.  The file
+    is validated through :func:`load_baseline` first so a malformed
+    baseline is an error, never a silent truncation."""
+    load_baseline(path)  # raises BaselineError on anything malformed
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    stale = set(stale_keys)
+    kept, removed = [], []
+    for entry in doc["suppressions"]:
+        if entry["key"] in stale:
+            removed.append(entry["key"])
+        else:
+            kept.append(entry)
+    if removed:
+        doc["suppressions"] = kept
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return removed
+
+
 @dataclass
 class Report:
     findings: list[Finding] = field(default_factory=list)
     suppressed: list[Finding] = field(default_factory=list)
     stale_suppressions: list[str] = field(default_factory=list)
     passes_run: list[str] = field(default_factory=list)
+    timings: dict[str, float] = field(default_factory=dict)  # pass -> seconds
+
+    def counts(self) -> dict[str, dict[str, int]]:
+        """Per-pass finding/suppression totals, keyed by pass name, every
+        requested pass present (zeros included) — the stable shape CI
+        diffs between runs."""
+        out = {p: {"findings": 0, "suppressed": 0} for p in self.passes_run}
+        for bucket, fs in (("findings", self.findings),
+                           ("suppressed", self.suppressed)):
+            for f in fs:
+                p = _CODE_PREFIX_PASS.get(f.code[:2])
+                if p in out:
+                    out[p][bucket] += 1
+        return out
 
     def to_dict(self) -> dict:
         return {
             "passes": self.passes_run,
+            "counts": self.counts(),
             "findings": [f.to_dict() for f in self.findings],
             "suppressed": [f.to_dict() for f in self.suppressed],
             "stale_suppressions": self.stale_suppressions,
+            "timings_ms": {p: round(t * 1000.0, 3)
+                           for p, t in self.timings.items()},
         }
 
     def format_text(self) -> str:
@@ -129,7 +172,7 @@ class Report:
 # finding-code prefix -> the pass that can produce it (stale-entry
 # detection must not call a races suppression "stale" in a parity-only run)
 _CODE_PREFIX_PASS = {"TS": "trace", "PC": "parity", "RL": "races",
-                     "MN": "metrics"}
+                     "MN": "metrics", "TC": "tracecov"}
 
 
 def _split_baseline(
@@ -165,7 +208,9 @@ def run_analysis(
     "parity": {"oracle_paths": [...], "kernel_paths": [...]},
     "races": {"paths": [...]}}``.
     """
-    from . import metrics_lint, parity, races, trace_safety
+    import time
+
+    from . import metrics_lint, parity, races, trace_safety, tracecov
 
     root = root or repo_root()
     passes = list(passes) if passes else list(PASS_NAMES)
@@ -179,13 +224,17 @@ def run_analysis(
         "parity": lambda: parity.run(root, **scopes.get("parity", {})),
         "races": lambda: races.run(root, **scopes.get("races", {})),
         "metrics": lambda: metrics_lint.run(root, **scopes.get("metrics", {})),
+        "tracecov": lambda: tracecov.run(root, **scopes.get("tracecov", {})),
     }
     findings: list[Finding] = []
+    timings: dict[str, float] = {}
     for name in passes:
+        t0 = time.perf_counter()
         findings.extend(runners[name]())
+        timings[name] = time.perf_counter() - t0
     findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
 
-    report = Report(passes_run=passes)
+    report = Report(passes_run=passes, timings=timings)
     if baseline:
         report.findings, report.suppressed, report.stale_suppressions = _split_baseline(
             findings, baseline, passes

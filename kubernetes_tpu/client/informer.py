@@ -604,15 +604,25 @@ class InformerFactory:
         self._informers: dict[str, SharedInformer] = {}
         self._mutation_detector = mutation_detector
         self._compact_on_resync = compact_on_resync
+        # informer() is reachable from controller sync workers (the GC
+        # wiring a just-established CRD kind mid-sync): without the lock
+        # two workers can build two informers for one kind and the
+        # loser's handlers are silently dropped
+        self._mk_mu = threading.Lock()
 
     def informer(self, kind: str) -> SharedInformer:
-        if kind not in self._informers:
-            self._informers[kind] = SharedInformer(
-                self._clientset.client_for(kind),
-                mutation_detector=self._mutation_detector,
-                compact_on_resync=self._compact_on_resync,
-            )
-        return self._informers[kind]
+        inf = self._informers.get(kind)  # hit path: lock-free
+        if inf is None:
+            with self._mk_mu:
+                inf = self._informers.get(kind)
+                if inf is None:
+                    inf = SharedInformer(
+                        self._clientset.client_for(kind),
+                        mutation_detector=self._mutation_detector,
+                        compact_on_resync=self._compact_on_resync,
+                    )
+                    self._informers[kind] = inf
+        return inf
 
     def start_all(self) -> None:
         for inf in self._informers.values():

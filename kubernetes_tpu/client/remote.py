@@ -49,6 +49,7 @@ from ..store.store import (
     WatchEvent,
     object_key,
 )
+from ..utils import tracing
 from ..utils.metrics import ClientMetrics
 
 logger = logging.getLogger("kubernetes_tpu.client.remote")
@@ -146,9 +147,15 @@ class RemoteWatch:
             url += "&frames=1"
         if self._last_rev is not None:
             url += f"&resourceVersion={self._last_rev}"
-        faults.hit("remote.watch.stream", phase="connect",
-                   resource=self._resource)
-        return self._opener(url)
+        tr = tracing.current()
+        # the (re)connect is the slow, failure-prone edge of the stream —
+        # one span per dial, nothing per event
+        with (tr.span("remote.watch.connect", cat="client",
+                      resource=self._resource)
+              if tr is not None else tracing.NULL_SPAN):
+            faults.hit("remote.watch.stream", phase="connect",
+                       resource=self._resource)
+            return self._opener(url)
 
     def _run(self) -> None:
         backoff = self._min_backoff
@@ -192,6 +199,11 @@ class RemoteWatch:
                                 type(e).__name__, e)
                             self.metrics.watch_errors.inc()
                             self.metrics.watch_gaps.inc()
+                            tr = tracing.current()
+                            if tr is not None:
+                                tr.instant("remote.watch.gap",
+                                           resource=self._resource,
+                                           cause="bad-frame")
                             self._queue.put(WatchEvent(
                                 WATCH_GAP, "", "", self._last_rev or 0, {}))
                             return
@@ -219,6 +231,10 @@ class RemoteWatch:
                         "watch %s: revision %s too old (410) — emitting "
                         "gap for relist", self._resource, self._last_rev)
                     self.metrics.watch_gaps.inc()
+                    tr = tracing.current()
+                    if tr is not None:
+                        tr.instant("remote.watch.gap",
+                                   resource=self._resource, cause="410")
                     self._queue.put(WatchEvent(
                         WATCH_GAP, "", "", self._last_rev or 0, {}))
                     return
@@ -369,6 +385,13 @@ class RemoteStore:
         last_err: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt > 0:
+                tr = tracing.current()
+                if tr is not None:
+                    # retries are rare and each one is latency the caller
+                    # ate — worth a point event; the happy path pays only
+                    # the faults seam
+                    tr.instant("remote.request.retry", method=method,
+                               path=path, attempt=attempt)
                 self._sleep(self._retry_delay(attempt - 1))
                 self.metrics.remote_retries.inc()
             try:

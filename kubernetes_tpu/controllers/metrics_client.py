@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 import urllib.request
 from typing import Callable, Optional
@@ -49,6 +50,14 @@ class MetricsClient:
         # nodes are pruned each sweep)
         self._direct_bad: dict[str, int] = {}
         self.stats = {"scrapes": 0, "nodes_ok": 0, "nodes_failed": 0}
+        # utilization() runs on EVERY HPA worker thread (run_workers
+        # defaults to 2): without this lock, concurrent scrapes lose
+        # stat updates, double-roll the sample generations (defeating
+        # min_rate_window), and the eviction comprehensions can raise
+        # "dictionary changed size during iteration" mid-sync.  Held
+        # across the whole sweep — the throttle means at most one sweep
+        # per interval actually dials nodes; contenders return fast.
+        self._mu = threading.Lock()
 
     # how many sweeps a node stays demoted to the proxy before the
     # direct dial is retried (~1 min at the default 5s interval)
@@ -98,6 +107,10 @@ class MetricsClient:
     def scrape(self, force: bool = False) -> None:
         """One sweep over every node with a kubelet endpoint; throttled
         to ``scrape_interval`` unless forced."""
+        with self._mu:
+            self._scrape_locked(force)
+
+    def _scrape_locked(self, force: bool) -> None:
         now = self.clock()
         if not force and now - self._last_scrape < self.scrape_interval:
             return
@@ -157,8 +170,9 @@ class MetricsClient:
     def pod_cpu_millicores(self, pod_key: str) -> Optional[float]:
         """Observed CPU rate in millicores, from the last two samples;
         None until two samples exist."""
-        cur = self._cur.get(pod_key)
-        prev = self._prev.get(pod_key)
+        with self._mu:
+            cur = self._cur.get(pod_key)
+            prev = self._prev.get(pod_key)
         if cur is None or prev is None:
             return None
         dt = cur[0] - prev[0]
@@ -167,7 +181,8 @@ class MetricsClient:
         return max(0.0, (cur[1] - prev[1]) / dt) / 1000.0 * 1000.0  # ms/s = millicores
 
     def pod_memory_bytes(self, pod_key: str) -> Optional[int]:
-        return self._memory.get(pod_key)
+        with self._mu:
+            return self._memory.get(pod_key)
 
     def utilization(self, pod: api.Pod) -> Optional[float]:
         """CPU utilization as percent of the pod's CPU request — the

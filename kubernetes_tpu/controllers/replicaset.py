@@ -15,6 +15,7 @@ adoption patterns from ``controller_utils.go`` / ``controller_ref_manager.go``):
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Optional
 
 from ..api import types as api
@@ -26,30 +27,42 @@ _suffix = itertools.count(1)
 
 
 class Expectations:
-    """Per-RS counters of in-flight creates/deletes (controller_utils.go)."""
+    """Per-RS counters of in-flight creates/deletes (controller_utils.go).
+
+    Locked like the reference's ControllerExpectations (a ThreadSafeStore):
+    ``expect``/``forget``/``satisfied`` run on sync workers while
+    ``observe_create``/``observe_delete`` run on the informer's pod-event
+    thread — the read-decrement-write pairs lose counts without the lock.
+    """
 
     def __init__(self):
         self._exp: dict[str, tuple[int, int]] = {}
+        self._mu = threading.Lock()
 
     def expect(self, key: str, creates: int, deletes: int) -> None:
-        self._exp[key] = (creates, deletes)
+        with self._mu:
+            self._exp[key] = (creates, deletes)
 
     def observe_create(self, key: str) -> None:
-        c, d = self._exp.get(key, (0, 0))
-        if c > 0:
-            self._exp[key] = (c - 1, d)
+        with self._mu:
+            c, d = self._exp.get(key, (0, 0))
+            if c > 0:
+                self._exp[key] = (c - 1, d)
 
     def observe_delete(self, key: str) -> None:
-        c, d = self._exp.get(key, (0, 0))
-        if d > 0:
-            self._exp[key] = (c, d - 1)
+        with self._mu:
+            c, d = self._exp.get(key, (0, 0))
+            if d > 0:
+                self._exp[key] = (c, d - 1)
 
     def satisfied(self, key: str) -> bool:
-        c, d = self._exp.get(key, (0, 0))
-        return c <= 0 and d <= 0
+        with self._mu:
+            c, d = self._exp.get(key, (0, 0))
+            return c <= 0 and d <= 0
 
     def forget(self, key: str) -> None:
-        self._exp.pop(key, None)
+        with self._mu:
+            self._exp.pop(key, None)
 
 
 class ReplicaSetController(Controller):

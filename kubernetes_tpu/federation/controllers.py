@@ -41,6 +41,10 @@ class MemberRegistry:
         self.factory = factory
         # name -> ((server_address, token), clientset)
         self._cache: dict[str, tuple[tuple[str, str], Clientset]] = {}
+        # one registry is shared by the cluster-probe and federated-sync
+        # controllers' workers; the get-or-create below is a
+        # check-then-act on the cache
+        self._mu = threading.Lock()
 
     def clusters(self, only_ready: bool = True) -> list[Cluster]:
         out = []
@@ -53,12 +57,13 @@ class MemberRegistry:
         # cache keyed on the full connection identity: a rejoined or
         # re-addressed cluster must get a fresh clientset, never keep
         # syncing to the old endpoint
-        entry = self._cache.get(cluster.meta.name)
         ident = (cluster.server_address, cluster.token)
-        if entry is None or entry[0] != ident:
-            entry = (ident, self.factory(cluster))
-            self._cache[cluster.meta.name] = entry
-        return entry[1]
+        with self._mu:
+            entry = self._cache.get(cluster.meta.name)
+            if entry is None or entry[0] != ident:
+                entry = (ident, self.factory(cluster))
+                self._cache[cluster.meta.name] = entry
+            return entry[1]
 
 
 class ClusterController(Controller):

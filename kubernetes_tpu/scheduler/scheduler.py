@@ -353,6 +353,13 @@ class Scheduler:
         self.queue.add_after(latest, self.backoff.get_backoff(pod.meta.key))
 
     def _bind(self, pod: api.Pod, node_name: str) -> bool:
+        tr = tracing.current()
+        with (tr.span("scheduler.bind", cat="bind", pod=pod.meta.key,
+                      node=node_name)
+              if tr is not None else tracing.NULL_SPAN):
+            return self._bind_attempt(pod, node_name)
+
+    def _bind_attempt(self, pod: api.Pod, node_name: str) -> bool:
         start = self._clock()
         try:
             faults.hit("scheduler.bind", pod=pod.meta.key, node=node_name,

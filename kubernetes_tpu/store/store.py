@@ -211,7 +211,9 @@ class Store:
         # fault seam BEFORE the lock and any mutation: an injected commit
         # failure models apiserver/etcd overload — the write never starts
         faults.hit("store.commit", op="create", kind=kind)
-        with self._mu:
+        tr = tracing.current()
+        with (tr.span("store.txn", cat="store", op="create", kind=kind)
+              if tr is not None else tracing.NULL_SPAN), self._mu:
             meta = obj.setdefault("metadata", {})
             key = object_key(meta.get("namespace", "default"), meta.get("name", ""))
             bucket = self._objects.setdefault(kind, {})
@@ -294,7 +296,9 @@ class Store:
         marks ``obj`` as privately owned (guaranteed_update's copy), skipping
         one defensive deep copy on the hot write path."""
         faults.hit("store.commit", op="update", kind=kind)
-        with self._mu:
+        tr = tracing.current()
+        with (tr.span("store.txn", cat="store", op="update", kind=kind)
+              if tr is not None else tracing.NULL_SPAN), self._mu:
             meta = obj.get("metadata") or {}
             key = object_key(meta.get("namespace", "default"), meta.get("name", ""))
             bucket = self._objects.setdefault(kind, {})
@@ -416,7 +420,9 @@ class Store:
         deleting (``deletionRevision`` tombstone, MODIFIED event); the actual
         removal happens when an update clears the last finalizer."""
         faults.hit("store.commit", op="delete", kind=kind)
-        with self._mu:
+        tr = tracing.current()
+        with (tr.span("store.txn", cat="store", op="delete", kind=kind)
+              if tr is not None else tracing.NULL_SPAN), self._mu:
             key = object_key(namespace, name)
             bucket = self._objects.setdefault(kind, {})
             item = bucket.get(key)

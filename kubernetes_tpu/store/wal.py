@@ -37,6 +37,7 @@ from typing import Optional
 
 from .. import faults
 from ..api import wire
+from ..utils import tracing
 
 SNAPSHOT = "snapshot.bin"
 WAL = "wal.bin"
@@ -195,7 +196,11 @@ class WriteAheadLog:
         header = _LEN.pack(len(payload))
         if self._crc_format:
             header += _CRC.pack(zlib.crc32(payload))
-        with self._mu:
+        tr = tracing.current()
+        # span covers lock wait + write + fsync: the durable-append cost
+        # a slow disk charges every txn
+        with (tr.span("wal.append", cat="store", kind=kind)
+              if tr is not None else tracing.NULL_SPAN), self._mu:
             if self._f is None:
                 self.open()
             if fault is not None and fault.mode == "torn":

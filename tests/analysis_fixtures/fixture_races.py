@@ -168,3 +168,174 @@ class AliasExemptions:
     def _two_hop_param_root(self, r):
         s = r  # chain rooted in a parameter, not a container: silent
         s["k"] = 1
+
+
+def fixture_passthrough(p):
+    return p  # returns-argument summary for a MODULE function
+
+
+class LockedHelper:
+    """A collaborator with its own lock — the cross-object shapes below
+    resolve ``<attr>._mu`` through this class."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._stats = {}
+
+    def bump(self, k):
+        with self._mu:
+            self._stats[k] = 1  # guarded: silent even when entered externally
+
+
+class UnlockedHelper:
+    """No threads, no locks of its own; every mutation is reached from
+    CrossObjectDriver's worker thread (the MetricsClient shape)."""
+
+    def __init__(self):
+        self._stats = {}
+
+    def bump(self, k):
+        # RL303: external entry bump<-CrossObjectDriver._worker
+        self._stats[k] = self._stats.get(k, 0) + 1
+
+
+class CrossObjectDriver:
+    """Worker-reachable calls on attr-typed collaborators make their
+    methods external thread entries — directly, through the
+    ``injected or Default()`` typing idiom, and through a bound-method
+    alias (``self.bump = self.unlocked.bump``)."""
+
+    def __init__(self, locked=None):
+        self.unlocked = UnlockedHelper()
+        self.locked = locked or LockedHelper()
+        self.bump = self.unlocked.bump
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        self.unlocked.bump("k")
+        self.locked.bump("k")
+        self.bump("k2")
+
+
+class CrossObjectLockGuard:
+    """NOT flagged: writes guarded by the COLLABORATOR's lock
+    (``with self.queue._mu:`` — the cross-object lock-identity slice)."""
+
+    def __init__(self):
+        self.queue = LockedHelper()
+        self.count = 0
+        self._owned = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        with self.queue._mu:
+            self.count += 1
+            self._owned["k"] = 1
+
+
+class CrossObjectLockOrder:
+    def __init__(self):
+        self._a = threading.Lock()
+        self.queue = LockedHelper()
+        self.value = 0
+
+    def forward(self):
+        with self._a:
+            with self.queue._mu:
+                self.value += 1
+
+    def backward(self):
+        # RL302 across objects: queue._mu-then-_a inverts forward()
+        with self.queue._mu:
+            with self._a:
+                self.value -= 1
+
+
+class AliasThroughCall:
+    """The ISSUE 10 call/return slice: per-function return summaries
+    (returns-self-attribute, returns-argument, module functions) resolve
+    ``q = f(p)`` aliases to the underlying container."""
+
+    def __init__(self):
+        self._returned = {}
+        self._arged = {}
+        self._routed = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _get_returned(self):
+        return self._returned
+
+    def _identity(self, p):
+        return p
+
+    def _worker(self):
+        q = self._get_returned()
+        q["k"] = 1  # RL303 via returns-self-attr summary
+        r = self._identity(self._arged)
+        r["k"] = 1  # RL303 via returns-argument summary
+        s = fixture_passthrough(self._routed)
+        s["k"] = 1  # RL303 via module-function summary
+
+
+class NestedDefCapture:
+    def __init__(self):
+        self._items = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        def flush():
+            self._items["k"] = 1  # RL303: captured by a nested def
+
+        flush()
+        cb = lambda: self._items.pop("k", None)  # noqa: E731 - same attr, dedups
+        cb()
+
+
+class ContainerExtraction:
+    def __init__(self):
+        self._slots = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        slot = self._slots["a"]
+        slot.append(1)  # RL303 on _slots via one-hop element extraction
+
+
+class CallerHeldHelper:
+    """NOT flagged: every worker-reachable call edge into _slot holds the
+    lock (caller-held propagation — the PodOwnerIndex shape that used to
+    need two baseline entries)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._index = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        with self._mu:
+            self._slot("k")
+
+    def _slot(self, k):
+        self._index[k] = 1  # silent: caller holds _mu
+
+
+class CrossShapeExemptions:
+    """NOT flagged: a nested-def parameter shadows the captured alias,
+    and element extraction under the lock stays silent."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}
+        self._slots = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        items = self._items
+
+        def use(items):
+            items["k"] = 1  # parameter shadows the capture: silent
+
+        use({})
+        with self._mu:
+            slot = self._slots["a"]
+            slot.append(1)  # element alias mutated under the lock: silent

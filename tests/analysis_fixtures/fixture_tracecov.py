@@ -1,0 +1,55 @@
+"""Seeded trace-coverage violations.  Never imported.
+
+One function per TC5xx shape: a fault seam with no span anywhere
+(TC501), a helper whose only caller is uncovered (TC501 through the
+propagation rule), an unmirrored phase timer (TC502) — plus covered
+twins asserting the exemptions (own marker, caller-propagated marker,
+mirrored timer).
+"""
+
+from kubernetes_tpu import faults
+from kubernetes_tpu.utils import tracing
+
+faults.hit("fixture.module")  # TC501: module level, no enclosing function
+
+
+def unspanned_seam():
+    faults.hit("fixture.unspanned")  # TC501: no marker, no callers
+
+
+def spanned_seam():
+    tr = tracing.current()
+    with (tr.span("fixture.work") if tr is not None else tracing.NULL_SPAN):
+        faults.hit("fixture.spanned")  # silent: own marker
+
+
+def _helper_seam():
+    faults.hit("fixture.helper")  # silent: every caller is covered
+
+
+def covered_caller():
+    tr = tracing.current()
+    with (tr.span("fixture.outer") if tr is not None else tracing.NULL_SPAN):
+        _helper_seam()
+
+
+def _orphan_helper():
+    faults.hit("fixture.orphan")  # TC501: caller opens no span
+
+
+def uncovered_caller():
+    _orphan_helper()
+
+
+class PhaseTimers:
+    def __init__(self):
+        self.stats = {"good_s": 0.0, "bad_s": 0.0}
+
+    def good_phase(self, t0, t1):
+        self.stats["good_s"] += t1 - t0
+        tr = tracing.current()
+        if tr is not None:
+            tr.complete("good", t0, t1, cat="phase")  # mirrored: silent
+
+    def bad_phase(self, t0, t1):
+        self.stats["bad_s"] += t1 - t0  # TC502: no matching .complete("bad")

@@ -1,0 +1,7 @@
+"""A span-free wave-hot-path module (TC503 fixture).  Never imported:
+the tests point the tracecov pass's hot-module scope at this file, which
+neither imports the tracing layer nor opens any span."""
+
+
+def hot_loop(items):
+    return [i * 2 for i in items]
