@@ -387,6 +387,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               workload: str = "mixed", seed: int = 0, warmup: bool = True,
               pipeline: bool = True, lazy_ingest: bool = True,
               frontier: bool = True, watch_frames: bool = True,
+              device_loop: bool = True, frontier_chunk: int = 512,
               verify_oracle: bool = False, trace=None) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
@@ -414,8 +415,13 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     node-axis compaction).  ``watch_frames=False`` is the ISSUE-6 A/B
     arm (``--ab-watch``): per-event watch delivery and per-pod cache
     apply/bind confirm instead of column-packed frames, one-lock batch
-    apply, and the columnar wave confirm.  ``verify_oracle=True``
-    additionally replays
+    apply, and the columnar wave confirm.  ``device_loop=False`` is the
+    ISSUE-11 A/B arm (``--ab-loop``): the chunked HOST loop (one
+    blocking sync per chunk) instead of the device-resident
+    ``lax.while_loop`` with donated carries and on-device compaction
+    decisions; ``frontier_chunk`` sets the chunk width for both modes
+    (the chunk-count axis of the host-sync scaling evidence).
+    ``verify_oracle=True`` additionally replays
     the recorded drain batches through the per-pod CPU oracle off-clock
     and reports per-wave binding parity (``oracle_parity``).
 
@@ -443,7 +449,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     if warmup:  # compile the wave-sized segment buckets off the clock
         run_churn(n_nodes, 2 * (total_pods // waves), 2, workload, seed + 1,
                   warmup=False, pipeline=pipeline, lazy_ingest=lazy_ingest,
-                  frontier=frontier, watch_frames=watch_frames)
+                  frontier=frontier, watch_frames=watch_frames,
+                  device_loop=device_loop, frontier_chunk=frontier_chunk)
 
     lazy_was = lazy_mod.ENABLED
     frames_was = frames_mod.ENABLED
@@ -457,7 +464,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     try:
         r = _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
                              pipeline, lazy_ingest, frontier,
-                             watch_frames, verify_oracle)
+                             watch_frames, device_loop, frontier_chunk,
+                             verify_oracle)
     finally:
         lazy_mod.ENABLED = lazy_was
         frames_mod.ENABLED = frames_was
@@ -483,8 +491,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
 
 
 def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
-                     lazy_ingest, frontier, watch_frames,
-                     verify_oracle) -> dict:
+                     lazy_ingest, frontier, watch_frames, device_loop,
+                     frontier_chunk, verify_oracle) -> dict:
     import threading
 
     from kubernetes_tpu.api import lazy as lazy_mod
@@ -504,7 +512,9 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
     all_pods = make_pods(total_pods, rng, workload)
 
     algo = GenericScheduler()
-    backend = TPUBatchBackend(algorithm=algo, frontier=frontier)
+    backend = TPUBatchBackend(algorithm=algo, frontier=frontier,
+                              frontier_device_loop=device_loop,
+                              frontier_chunk=frontier_chunk)
     if not pipeline:
         backend.tensorizer = Tensorizer(sticky_buckets=False,
                                         persistent_rows=False)
@@ -583,6 +593,10 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
         ph["frame_events"] = apply_after[2] - apply_before[2]
         ph["confirm_fallbacks"] = int(
             sched.metrics.confirm_fallbacks.value - fb_before)
+        # blocking device→host round-trips of the wave (ISSUE 11): fed by
+        # the same backend seam device_wait uses; O(compactions + 1) per
+        # segment in loop mode, O(chunks) in the chunked host loop
+        ph["host_syncs"] = int(sched.last_batch_phases.get("host_syncs", 0))
         ph["bound"] = b
         fr = sched.last_batch_phases.get("frontier")
         if fr:
@@ -650,6 +664,15 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             "compactions": backend.stats["frontier_compactions"],
             "prefilter_cols": backend.stats["frontier_prefilter_cols"],
             "fallbacks": backend.stats["frontier_fallbacks"],
+            "loop_fallbacks": backend.stats["frontier_loop_fallbacks"],
+        },
+        # device-resident wave loop (ISSUE 11): blocking device→host
+        # round-trips the run actually paid, per wave and in total
+        "host_syncs": {
+            "device_loop": device_loop,
+            "chunk": frontier_chunk,
+            "total": backend.stats["host_syncs"],
+            "per_wave": [p["host_syncs"] for p in phase_timers],
         },
         "row_cache": dict(backend.tensorizer.node_rows_stats or {}),
         # zero-copy ingest (ISSUE 4): what the decode path actually did
@@ -973,6 +996,113 @@ def run_watch_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
         "bound_counts": sorted(bounds),
         "apply_s_per_run": {"A_old": a_apply, "B_new": b_apply},
         "oracle_parity": parity,
+    }
+
+
+def run_loop_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
+                waves: int = 10, pairs: int = 2, seed: int = 0) -> dict:
+    """Both-orders interleaved A/B of the device-resident wave loop
+    (ISSUE 11): B (new) = the chunked frontier scan driven as ONE
+    ``lax.while_loop`` dispatch per segment (donated carries, on-device
+    compaction flag, all-G ``still_ok`` refresh at chunk boundaries);
+    A (old) = the chunked HOST loop (one blocking sync per chunk), same
+    frontier plane, same harness, same seeds.  The first pair replays
+    both arms' recorded drain batches through the per-pod CPU oracle
+    (off-clock) and reports per-wave binding parity.  An off-clock
+    chunk-width sweep (512 → 128, a 4x chunk-count increase) records
+    per-wave ``host_syncs`` for both modes — the loop's must stay flat
+    (O(compactions + 1)) while the host loop's grow with chunk count.
+    Writes the BENCH_AB_device_loop.json ledger shape (the recorded
+    ledger uses the worktree method; this flag A/B isolates the loop
+    seam on one tree)."""
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False, device_loop=True)
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False, device_loop=False)
+
+    parity = {}
+    syncs_first = {}
+
+    def one(loop: bool, verify: bool = False) -> dict:
+        r = run_churn(n_nodes, total_pods, waves, seed=seed, warmup=False,
+                      device_loop=loop, verify_oracle=verify)
+        if verify:
+            parity["loop" if loop else "chunked_host"] = r["oracle_parity"]
+            syncs_first["loop" if loop else "chunked_host"] = r["host_syncs"]
+        return r
+
+    ab_pairs, ba_pairs = [], []
+    a_all, b_all = [], []
+    bounds = set()
+    for i in range(pairs):
+        b = one(True, verify=(i == 0))
+        a = one(False, verify=(i == 0))
+        ab_pairs.append({"B_new": b["pods_per_sec"], "A_old": a["pods_per_sec"]})
+        b_all.append(b["pods_per_sec"])
+        a_all.append(a["pods_per_sec"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-loop AB: B={b['pods_per_sec']} A={a['pods_per_sec']} "
+              f"syncs B={b['host_syncs']['total']} "
+              f"A={a['host_syncs']['total']}", file=sys.stderr)
+    for _ in range(pairs):
+        a = one(False)
+        b = one(True)
+        ba_pairs.append({"A_old": a["pods_per_sec"], "B_new": b["pods_per_sec"]})
+        a_all.append(a["pods_per_sec"])
+        b_all.append(b["pods_per_sec"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-loop BA: A={a['pods_per_sec']} B={b['pods_per_sec']}",
+              file=sys.stderr)
+    # off-clock sync-scaling sweep: same workload, chunk 512 then 128
+    # (4x the chunks per segment) in both modes — the recorded per-wave
+    # host_syncs are the O(compactions + 1) flatness evidence
+    sync_scaling = {}
+    for label, loop_on, chunk in (("loop_chunk512", True, 512),
+                                  ("loop_chunk128", True, 128),
+                                  ("chunked_chunk512", False, 512),
+                                  ("chunked_chunk128", False, 128)):
+        r = run_churn(n_nodes, total_pods, waves, seed=seed, warmup=False,
+                      device_loop=loop_on, frontier_chunk=chunk)
+        sync_scaling[label] = {
+            "per_wave_host_syncs": r["host_syncs"]["per_wave"],
+            "total_host_syncs": r["host_syncs"]["total"],
+            "segments": r["frontier"]["segments"],
+            "compactions": r["frontier"]["compactions"],
+        }
+        print(f"# ab-loop sweep {label}: total={r['host_syncs']['total']} "
+              f"per_wave={r['host_syncs']['per_wave']}", file=sys.stderr)
+    a_med = sorted(a_all)[len(a_all) // 2]
+    b_med = sorted(b_all)[len(b_all) // 2]
+    won = sum(1 for p in ab_pairs + ba_pairs if p["B_new"] > p["A_old"])
+    return {
+        "claim": ("Device-resident wave loop: the chunked frontier scan "
+                  "runs as ONE lax.while_loop dispatch per segment with "
+                  "donated ScanState carries, an on-device compaction "
+                  "flag (host re-entered only when a compaction fires), "
+                  "and the all-G still_ok refresh at chunk boundaries — "
+                  "host syncs per wave drop from O(chunks) to "
+                  "O(compactions + 1)"),
+        "method": (f"Churn {n_nodes} nodes / {total_pods} mixed pods / "
+                   f"{waves} waves, arrival thread + run_batch_loop serving "
+                   "(both arms), events on; interleaved pairs in BOTH "
+                   "orders, one shared process, per-arm warm-up compiles "
+                   "paid up front; A = chunked host loop (device_loop off, "
+                   "pre-ISSUE-11), B = device-resident while_loop; first "
+                   "pair of each arm replayed off-clock through the "
+                   "per-pod CPU oracle per drained wave; off-clock chunk "
+                   "sweep 512/128 records host-sync scaling in both modes"),
+        "pairs_order_AB_first": ab_pairs,
+        "pairs_order_BA_first": ba_pairs,
+        "A_old_all": a_all,
+        "B_new_all": b_all,
+        "A_median": a_med,
+        "B_median": b_med,
+        "win_pct": round((b_med - a_med) / a_med * 100, 1) if a_med else None,
+        "b_won_pairs": f"{won}/{len(ab_pairs) + len(ba_pairs)} (both orders)",
+        "bound_counts": sorted(bounds),
+        "oracle_parity": parity,
+        "host_syncs_first_run": syncs_first,
+        "host_sync_scaling": sync_scaling,
     }
 
 
@@ -1304,6 +1434,16 @@ def main() -> None:
         "scale and pair count",
     )
     parser.add_argument(
+        "--ab-loop", nargs="?", const="BENCH_AB_device_loop.json",
+        default=None, metavar="PATH",
+        help="run the both-orders device-resident-wave-loop A/B "
+        "(lax.while_loop with donated carries + on-device compaction "
+        "decisions vs the chunked host loop) and write the ledger JSON "
+        "to PATH (default BENCH_AB_device_loop.json); includes an "
+        "off-clock chunk-width sweep recording host-sync scaling; "
+        "--nodes/--pods/--trials override scale and pair count",
+    )
+    parser.add_argument(
         "--trace", nargs="?", const="BENCH_trace_churn.json",
         default=None, metavar="PATH",
         help="enable the wave tracer + flight recorder for the churn "
@@ -1325,7 +1465,7 @@ def main() -> None:
     args = parser.parse_args()
 
     if (args.ab_churn or args.ab_pump or args.ab_frontier or args.ab_watch
-            or args.ab_trace):
+            or args.ab_loop or args.ab_trace):
         import datetime
 
         kw = {}
@@ -1336,21 +1476,32 @@ def main() -> None:
         if args.trials:
             kw["pairs"] = args.trials
         runner = (run_trace_ab if args.ab_trace
+                  else run_loop_ab if args.ab_loop
                   else run_watch_ab if args.ab_watch
                   else run_frontier_ab if args.ab_frontier
                   else run_pump_ab if args.ab_pump else run_churn_ab)
-        path = (args.ab_trace or args.ab_watch or args.ab_frontier
-                or args.ab_pump or args.ab_churn)
+        path = (args.ab_trace or args.ab_loop or args.ab_watch
+                or args.ab_frontier or args.ab_pump or args.ab_churn)
         metric = ("trace-enabled-overhead-pct" if args.ab_trace
+                  else "device-loop-win-pct" if args.ab_loop
                   else "watch-frames-win-pct" if args.ab_watch
                   else "frontier-scan-win-pct" if args.ab_frontier
                   else "pump-ingest-win-pct" if args.ab_pump
                   else "churn-pipeline-win-pct")
         ledger = runner(**kw)
         ledger["date"] = datetime.date.today().isoformat()
-        with open(path, "w") as f:
-            json.dump(ledger, f, indent=1)
-            f.write("\n")
+        # the medians below are only quotable WITH the ledger artifact
+        # behind them (ISSUE 11): if the JSON cannot be written, refuse
+        # to print them and exit non-zero instead of reporting numbers
+        # that nothing on disk substantiates
+        try:
+            with open(path, "w") as f:
+                json.dump(ledger, f, indent=1)
+                f.write("\n")
+        except OSError as e:
+            print(f"# REFUSING to print A/B medians: ledger write to "
+                  f"{path!r} failed ({e})", file=sys.stderr)
+            sys.exit(1)
         print(json.dumps({
             "metric": metric,
             "value": ledger["win_pct"],

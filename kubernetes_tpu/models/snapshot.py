@@ -874,6 +874,51 @@ _INIT_NODE_FIELDS = {
 }
 
 
+def monotone_plane(static: BatchStatic, requested: np.ndarray,
+                   pod_count: np.ndarray, ports_used: np.ndarray,
+                   dm: "np.ndarray | None" = None,
+                   downer: "np.ndarray | None" = None) -> np.ndarray:
+    """The MONOTONE feasibility plane [G, N] at an arbitrary dynamic
+    state — the refresh-plane builder shared by :func:`frontier_seed`
+    (step-0 state) and the device-resident loop's periodic all-G
+    ``still_ok`` refresh (whose jnp twin is
+    ``ops.batch_kernel.monotone_plane_device``; tests cross-check the
+    two against each other on materialized mid-segment states).
+
+    Only components that can never improve as the carry grows belong
+    here: resource fit, pod-count, ports, placed-owner symmetric
+    required-anti hits (``downer > 0``), and own required-anti hits
+    (``dm > 0``).  The own required-AFFINITY terms and the first-pod
+    rule are non-monotone (a landing pod can turn them ON) and are
+    deliberately excluded — the plane must over-approximate every
+    FUTURE pod's feasibility, never under."""
+    # kernel: implements GeneralPredicates
+    # (the plane evaluates the same resource/pod-count/port masks the
+    # step computes, vectorized over [G, N] at the given state)
+    g_request = static.g_request  # full-width: r_sel only trims the device
+    fit = np.all(
+        (requested[None, :, :] + g_request[:, None, :]
+         <= static.node_alloc[None, :, :]) | (g_request[:, None, :] <= 0),
+        axis=2)  # [G, N]
+    pods_ok = pod_count + 1 <= static.node_alloc_pods  # [N]
+    mono = static.static_ok & static.node_exists[None, :] & fit & pods_ok[None, :]
+    if static.use_ports:
+        ports_bad = (ports_used[None, :, :]
+                     & static.g_ports[:, None, :]).any(axis=2)  # [G, N]
+        mono &= ~ports_bad
+    if static.terms and dm is not None:
+        # own required-anti terms violated by matching pods already in
+        # the node's domain
+        raa_bad = static.own_raa.astype(np.int32) @ (dm > 0).astype(np.int32) > 0
+        mono &= ~raa_bad
+    if static.terms and downer is not None:
+        # placed owners' symmetric required-anti terms forbid their
+        # domains for every matching signature (predicates.go:1146)
+        sym = (static.term_matches_sig & static.is_raa[:, None]).astype(np.int32)
+        mono &= ~(sym.T @ (downer > 0).astype(np.int32) > 0)
+    return mono
+
+
 def frontier_seed(static: BatchStatic, init: InitialState) -> np.ndarray:
     """Compute the step-0 MONOTONE feasibility plane [G, N] and seed
     ``init.still_ok`` with it; returns the G-union alive mask [N].
@@ -882,33 +927,15 @@ def frontier_seed(static: BatchStatic, init: InitialState) -> np.ndarray:
     within the segment: static_ok never changes, requested/pod_count/
     ports_used only grow (fit/pods/ports only get worse), and the
     required-anti-affinity hit (``dm > 0`` on an own-RAA term) is
-    monotone because placements only add matching pods.  The own
-    required-AFFINITY terms and the first-pod rule are non-monotone
-    (a landing pod can turn them ON) and are deliberately excluded —
-    still_ok must over-approximate feasibility, never under.  A column
+    monotone because placements only add matching pods.  A column
     False for EVERY signature is therefore provably inert: every
     normalization, tie set, and n_feasible in the kernel ranges over
     feasible columns only, so dropping it is bit-exact."""
-    # kernel: implements GeneralPredicates
-    # (the prefilter evaluates the same resource/pod-count/port masks the
-    # step computes, vectorized over [G, N] at step-0 state)
-    g_request = static.g_request  # full-width: r_sel only trims the device
-    fit0 = np.all(
-        (init.requested[None, :, :] + g_request[:, None, :]
-         <= static.node_alloc[None, :, :]) | (g_request[:, None, :] <= 0),
-        axis=2)  # [G, N]
-    pods_ok0 = init.pod_count + 1 <= static.node_alloc_pods  # [N]
-    mono = static.static_ok & static.node_exists[None, :] & fit0 & pods_ok0[None, :]
-    if static.use_ports:
-        ports_bad0 = (init.ports_used[None, :, :]
-                      & static.g_ports[:, None, :]).any(axis=2)  # [G, N]
-        mono &= ~ports_bad0
-    if static.terms and init.dm is not None:
-        # own required-anti terms already violated by EXISTING pods'
-        # domain counts (downer starts at zero — placed-owner symmetry
-        # cannot have fired yet)
-        raa_bad0 = static.own_raa.astype(np.int32) @ (init.dm > 0).astype(np.int32) > 0
-        mono &= ~raa_bad0
+    # downer is omitted: it starts at zero (placed-owner symmetry cannot
+    # have fired before the segment's first step)
+    mono = monotone_plane(
+        static, init.requested, init.pod_count, init.ports_used,
+        dm=init.dm if static.terms and init.dm is not None else None)
     init.still_ok = mono
     return mono.any(axis=0)
 

@@ -153,6 +153,13 @@ class TPUBatchBackend:
         frontier_chunk: int = 512,
         frontier_compact_frac: float = 0.5,
         frontier_min_width: int = 128,
+        # Device-resident wave loop: drive the chunked frontier scan as
+        # ONE lax.while_loop dispatch with donated carries and a
+        # device-computed compaction flag — host syncs per segment drop
+        # from O(chunks) to O(compactions + 1).  Any loop failure falls
+        # back to the chunked host loop (same carry plane), then to the
+        # full-width scan; the breaker is never involved.
+        frontier_device_loop: bool = True,
         # chunked still_ok mode engages when the prefilter's alive
         # fraction is at or below this.  Default 1.0 = always chunk when
         # the segment is big enough: measured on the north churn preset
@@ -189,6 +196,7 @@ class TPUBatchBackend:
         self.frontier_compact_frac = frontier_compact_frac
         self.frontier_min_width = frontier_min_width
         self.frontier_engage_frac = frontier_engage_frac
+        self.frontier_device_loop = frontier_device_loop
         # wired to scheduler_frontier_compactions_total
         self.frontier_counter = None
         # per-batch frontier trajectory: one entry per frontier segment
@@ -205,6 +213,13 @@ class TPUBatchBackend:
                       # and full-width retries after a frontier failure
                       "frontier_segments": 0, "frontier_compactions": 0,
                       "frontier_prefilter_cols": 0, "frontier_fallbacks": 0,
+                      # device-resident loop: segments that degraded from
+                      # the while_loop form to the chunked host loop
+                      "frontier_loop_fallbacks": 0,
+                      # blocking device→host round-trips on the finalize
+                      # path (cumulative) — the scheduler deltas this per
+                      # wave next to the phase timers below
+                      "host_syncs": 0,
                       # steady-state phase timers (seconds, cumulative):
                       # host tensorize, device dispatch, device wait
                       # (finalize block) — bench deltas these per wave
@@ -288,6 +303,20 @@ class TPUBatchBackend:
             tr.instant("frontier.compact", width=width, new_width=width_new,
                        alive=n_alive)
 
+    def _on_frontier_loop(self, run_index: int, width: int,
+                          start_chunk: int) -> None:
+        # fault seam BEFORE every device-loop dispatch (initial AND each
+        # re-entry after a compaction): an injected failure at run 0
+        # degrades the segment to the chunked host loop; at a re-entry it
+        # aborts finalize and the segment retries full-width — either
+        # way parity holds, only time is lost
+        faults.hit("backend.compact", phase="loop", run=run_index,
+                   width=width, start_chunk=start_chunk)
+        tr = tracing.current()
+        if tr is not None:
+            tr.instant("frontier.loop_enter", run=run_index, width=width,
+                       start_chunk=start_chunk)
+
     def _dispatch_frontier(self, static, init):
         """Try to serve this segment through the frontier scan: seed the
         monotone step-0 plane, compact the node axis at tensorize time
@@ -329,12 +358,30 @@ class TPUBatchBackend:
                     cstatic, cinit, node_cache=self.device_node_cache)
                 self.stats["frontier_segments"] += 1
                 return _PrefilteredScan(cstatic, fut)
-            run = FrontierRun(
-                cstatic, cinit, node_cache=self.device_node_cache,
-                chunk_len=self.frontier_chunk,
-                compact_frac=self.frontier_compact_frac,
-                min_width=self.frontier_min_width,
-                on_compact=self._on_frontier_compact)
+            run = None
+            use_loop = (self.frontier_device_loop and self.frontier_chunk > 0
+                        and self.frontier_chunk & (self.frontier_chunk - 1) == 0)
+            if use_loop:
+                try:
+                    run = FrontierRun(
+                        cstatic, cinit, node_cache=self.device_node_cache,
+                        chunk_len=self.frontier_chunk,
+                        compact_frac=self.frontier_compact_frac,
+                        min_width=self.frontier_min_width,
+                        on_compact=self._on_frontier_compact,
+                        device_loop=True, on_loop=self._on_frontier_loop)
+                except Exception:
+                    logger.exception(
+                        "device-resident loop dispatch failed; the segment "
+                        "degrades to the chunked host loop")
+                    self.stats["frontier_loop_fallbacks"] += 1
+            if run is None:
+                run = FrontierRun(
+                    cstatic, cinit, node_cache=self.device_node_cache,
+                    chunk_len=self.frontier_chunk,
+                    compact_frac=self.frontier_compact_frac,
+                    min_width=self.frontier_min_width,
+                    on_compact=self._on_frontier_compact)
             run.prefilter_width = (static.n_pad, cstatic.n_pad)
             self.stats["frontier_segments"] += 1
             return run
@@ -692,6 +739,7 @@ class TPUBatchBackend:
 
                     try:
                         chosen, final_rr = finalize_batch_pallas(static, *fut)
+                        self.stats["host_syncs"] += 1
                         self.stats["pallas_segments"] += 1
                         self.breaker.record_success(key, 0)
                     except Exception:
@@ -701,6 +749,7 @@ class TPUBatchBackend:
                         level = 1
                         try:
                             chosen, final_rr = schedule_batch_arrays(static, init)
+                            self.stats["host_syncs"] += 1
                             self.breaker.record_success(key, 1)
                         except Exception:
                             logger.exception(
@@ -721,6 +770,7 @@ class TPUBatchBackend:
                         def finalize_primary():
                             chosen, rr = finalize_batch_arrays(
                                 fut.static, *fut.fut)
+                            self.stats["host_syncs"] += 1
                             self.last_frontier.append({
                                 "prefilter": [static.n_pad,
                                               fut.static.n_pad],
@@ -728,12 +778,15 @@ class TPUBatchBackend:
                                 "alive_frac": [],
                                 "chunks": 1,
                                 "compactions": 0,
+                                "mode": "plain",
+                                "host_syncs": 1,
                             })
                             return chosen, rr, fut.static
                         frontier_retry = True
                     elif isinstance(fut, FrontierRun):
                         def finalize_primary():
                             chosen, rr = fut.finalize()
+                            self.stats["host_syncs"] += fut.stats["host_syncs"]
                             self.last_frontier.append({
                                 "prefilter": list(
                                     getattr(fut, "prefilter_width",
@@ -742,12 +795,16 @@ class TPUBatchBackend:
                                 "alive_frac": fut.stats["alive_frac"],
                                 "chunks": fut.stats["chunks"],
                                 "compactions": fut.stats["compactions"],
+                                "mode": ("loop" if fut.device_loop
+                                         else "chunked"),
+                                "host_syncs": fut.stats["host_syncs"],
                             })
                             return chosen, rr, fut.static
                         frontier_retry = True
                     else:
                         def finalize_primary():
                             chosen, rr = finalize_batch_arrays(static, *fut)
+                            self.stats["host_syncs"] += 1
                             return chosen, rr, static
                         frontier_retry = False
 
@@ -769,6 +826,7 @@ class TPUBatchBackend:
                         try:
                             chosen, final_rr = schedule_batch_arrays(
                                 static, init)
+                            self.stats["host_syncs"] += 1
                             names_static = static
                             self.breaker.record_success(key, 1)
                         except Exception:

@@ -623,6 +623,37 @@ def make_step(
     return step
 
 
+def monotone_plane_device(dev: StaticArrays, state: ScanState,
+                          use_terms: bool, use_ports: bool) -> jnp.ndarray:
+    """Device twin of ``models.snapshot.monotone_plane``: the [G, N]
+    monotone-component feasibility plane at the CURRENT carry state.
+    ANDed into ``still_ok`` at chunk boundaries inside the device loop
+    (the ROADMAP's periodic all-G refresh): the per-step update only
+    tightens the current pod's signature row, so rows of signatures that
+    stopped appearing would otherwise never learn that the carry grew
+    past them.  Pure over-approximation tightening — every component
+    here can only get WORSE as the carry grows, so a False is a
+    permanent truth and compaction semantics are unchanged."""
+    # kernel: implements GeneralPredicates
+    # (same resource/pod-count/port masks as the step, vectorized [G, N])
+    fit = jnp.all(
+        (state.requested[None, :, :] + dev.g_request[:, None, :]
+         <= dev.node_alloc[None, :, :]) | (dev.g_request[:, None, :] <= 0),
+        axis=2)  # [G, N]
+    pods_ok = state.pod_count + 1 <= dev.node_alloc_pods  # [N]
+    mono = dev.static_ok & dev.node_exists[None, :] & fit & pods_ok[None, :]
+    if use_ports:
+        mono = mono & ~jnp.any(
+            state.ports_used[None, :, :] & dev.g_ports[:, None, :], axis=2)
+    if use_terms:
+        raa_bad = (dev.own_raa.astype(jnp.int32)
+                   @ (state.dm > 0).astype(jnp.int32)) > 0  # [G, N]
+        sym = (dev.term_matches_sig & dev.is_raa[:, None]).astype(jnp.int32)
+        sym_bad = (sym.T @ (state.downer > 0).astype(jnp.int32)) > 0  # [G, N]
+        mono = mono & ~raa_bad & ~sym_bad
+    return mono
+
+
 @lru_cache(maxsize=64)
 def _runner(num_zones: int, weights: tuple, use_terms: bool = True,
             use_vols: bool = True, use_ports: bool = True,
@@ -637,6 +668,78 @@ def _runner(num_zones: int, weights: tuple, use_terms: bool = True,
         return jax.lax.scan(step, state, xs)
 
     return run
+
+
+@lru_cache(maxsize=64)
+def _loop_runner(num_zones: int, weights: tuple, use_terms: bool,
+                 use_vols: bool, use_ports: bool, chunk_len: int):
+    """The device-resident wave loop: a ``lax.while_loop`` that advances
+    the frontier scan chunk by chunk entirely on device and exits only
+    when the segment is done OR a compaction is worth taking — the host
+    is re-entered O(compactions + 1) times per segment, independent of
+    chunk count.
+
+    Carry = (ScanState, chosen buffer [P_pad], chunk cursor, stop flag).
+    ``state`` and ``chosen_buf`` are DONATED (the XLA executable reuses
+    their buffers in place across iterations); callers must treat the
+    passed-in arrays as consumed and must never fall back onto them —
+    the backend's retry ladder re-derives everything from host arrays.
+    The compaction decision is computed ON DEVICE: after each chunk the
+    all-G ``still_ok`` refresh runs (see ``monotone_plane_device``) and
+    the alive-union count is compared against ``compact_thresh`` (a
+    host-precomputed int equivalent to the ``_pow2_width``/
+    ``compact_frac`` rule; -1 = never fires).  ``n_chunks`` is a device
+    operand, not a Python constant, so the pow-2 pod-axis bucket padding
+    never adds loop trips."""
+    w = dict(zip(WEIGHT_KEYS, weights))
+
+    def run(dev: StaticArrays, xs_full, state: ScanState, chosen_buf,
+            start_chunk, n_chunks, compact_thresh):
+        step = make_step(dev, num_zones, w, use_terms=use_terms,
+                         use_vols=use_vols, use_ports=use_ports,
+                         use_frontier=True)
+
+        def alive_of(st):
+            alive = jnp.any(st.still_ok, axis=0) & dev.node_exists
+            return alive, jnp.sum(alive.astype(jnp.int32))
+
+        def cond(carry):
+            _, _, c, want = carry
+            return (c < n_chunks) & ~want
+
+        def body(carry):
+            st, buf, c, _ = carry
+            start = c * jnp.int32(chunk_len)
+            with jax.named_scope("ktpu.wave_chunk"):
+                xs_c = tuple(
+                    jax.lax.dynamic_slice_in_dim(a, start, chunk_len, axis=0)
+                    for a in xs_full)
+                st, chosen = jax.lax.scan(step, st, xs_c)
+                buf = jax.lax.dynamic_update_slice(buf, chosen, (start,))
+            with jax.named_scope("ktpu.still_ok_refresh"):
+                st = st._replace(still_ok=st.still_ok & monotone_plane_device(
+                    dev, st, use_terms, use_ports))
+            _, n_alive = alive_of(st)
+            return (st, buf, c + jnp.int32(1), n_alive <= compact_thresh)
+
+        carry = (state, chosen_buf, start_chunk, jnp.bool_(False))
+        state, chosen_buf, c, want = jax.lax.while_loop(cond, body, carry)
+        alive, n_alive = alive_of(state)
+        return state, chosen_buf, c, want, alive, n_alive
+
+    return jax.jit(run, donate_argnums=(2, 3))
+
+
+def _loop_runner_for(static: BatchStatic, chunk_len: int):
+    weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
+    return _loop_runner(
+        int(static.num_zones),
+        weights,
+        bool(static.terms),
+        bool(static.use_vols),
+        bool(getattr(static, "use_ports", True)),
+        int(chunk_len),
+    )
 
 
 def _runner_for(static: BatchStatic, use_frontier: bool = False):
@@ -661,7 +764,10 @@ def dispatch_batch_arrays(static: BatchStatic, init: InitialState,
     state = state_to_device(init, r_sel=getattr(static, "r_sel", None))
     xs = batch_xs(static)
     run = _runner_for(static)
-    final_state, chosen = run(dev, xs, state)
+    # XLA-profiler attribution: device time of this dispatch shows up
+    # under this annotation (host-side trace spans stay as they are)
+    with jax.profiler.TraceAnnotation("ktpu.wave_scan"):
+        final_state, chosen = run(dev, xs, state)
     # enqueue the D2H transfer behind the scan (see dispatch_batch_pallas)
     chosen.copy_to_host_async()
     final_state.round_robin.copy_to_host_async()
@@ -787,45 +893,182 @@ def _chunk_xs(host_xs, start: int, chunk_len: int, v_sentinel: int):
 
 
 class FrontierRun:
-    """One segment's frontier execution: the scan split into fixed-length
-    chunks; between chunks the alive-union fraction (one [N] reduce over
-    the ``still_ok`` carry) decides whether to compact the node axis on
-    device and resume at a power-of-two width N' ≪ N.
+    """One segment's frontier execution.  Two drive modes share the same
+    carry plane, compaction rule, and parity contract:
 
-    ``__init__`` dispatches the FIRST chunk and returns (the async seam
-    the backend commits prior segments in — ``device_probe`` polls it);
-    ``finalize()`` drives the remaining chunks, applies compactions, and
-    returns chosen indices in the ORIGINAL node axis plus the final
-    round-robin counter and the per-chunk alive trajectory."""
+    - ``device_loop=True`` (the device-resident wave loop): ONE
+      ``lax.while_loop`` dispatch advances every chunk on device with
+      donated carries; the compaction decision is a device-computed
+      flag checked inside the loop, so the host is re-entered only when
+      a compaction is worth taking (it performs the dynamic-shape
+      ``gather_node_axis`` and re-enters the loop at the new
+      power-of-two width).  Host syncs per segment: one control read
+      per loop run + the final result read = O(compactions + 1),
+      independent of chunk count.
+    - ``device_loop=False`` (the chunked host loop, also the fallback
+      when the loop form fails): the host dispatches each chunk,
+      reading the alive-union count back between chunks — O(chunks)
+      syncs.
+
+    ``__init__`` dispatches the first loop run / chunk and returns (the
+    async seam the backend commits prior segments in — ``device_probe``
+    polls it); ``finalize()`` drives the rest and returns chosen
+    indices in the ORIGINAL node axis plus the final round-robin
+    counter.  ``stats["host_syncs"]`` counts every blocking
+    device→host round-trip this run performed — the seam the
+    scheduler's per-wave ``host_syncs`` accounting deltas.
+
+    Donation contract (loop mode): the ScanState and the chosen buffer
+    are donated to each loop dispatch — after a dispatch the previous
+    arrays are dead, and any failure path must rebuild from HOST data
+    (the backend's full-width retry re-tensorizes from the original
+    static/init, which donation never touches)."""
 
     def __init__(self, static: BatchStatic, init: InitialState,
                  node_cache: "DeviceNodeCache | None" = None,
                  chunk_len: int = 512, compact_frac: float = 0.5,
-                 min_width: int = 128, on_compact=None):
+                 min_width: int = 128, on_compact=None,
+                 device_loop: bool = False, on_loop=None):
         self.static = static
         self.chunk_len = chunk_len
         self.compact_frac = compact_frac
         self.min_width = min_width
         self.on_compact = on_compact
+        self.on_loop = on_loop
+        self.device_loop = bool(device_loop)
         self._p_real = len(static.group_of_pod)
-        self._run = _runner_for(static, use_frontier=True)
         self._dev = to_device(static, node_cache=node_cache)
         self._state = state_to_device(
             init, r_sel=getattr(static, "r_sel", None), use_frontier=True)
         if self._state.still_ok is None:
             raise ValueError("frontier run requires init.still_ok (seed the "
                              "InitialState via models.snapshot.frontier_seed)")
-        self._host_xs = _host_xs(static)
         self._width = int(static.n_pad)
         # cumulative permutation: current column position -> original
         # full-axis index (chosen indices map back through the snapshot
-        # of this array taken at each chunk's dispatch)
+        # of this array taken at each dispatch)
         self._map = np.arange(self._width, dtype=np.int64)
-        self._chunks: list = []  # (chosen_dev, map_snapshot)
-        self._next = 0
         self.stats = {"chunks": 0, "compactions": 0,
-                      "alive_frac": [], "widths": [self._width]}
-        self._dispatch_chunk()
+                      "alive_frac": [], "widths": [self._width],
+                      "host_syncs": 0, "loop_runs": 0}
+        if self.device_loop:
+            if chunk_len <= 0 or chunk_len & (chunk_len - 1):
+                raise ValueError(
+                    "device_loop requires a power-of-two chunk_len (the "
+                    "pod-axis bucket must be chunk-divisible)")
+            # whole-segment xs uploaded ONCE: the pod axis is invariant
+            # under node compaction, so every re-entry reuses this upload
+            self._xs_full = batch_xs(static)
+            p_pad = int(self._xs_full[0].shape[0])  # pow2, >= chunk bucket
+            self._chunk_eff = min(chunk_len, p_pad)
+            self._loop = _loop_runner_for(static, self._chunk_eff)
+            self._n_chunks = -(-self._p_real // self._chunk_eff)
+            self._buf = jnp.full((p_pad,), -1, dtype=jnp.int32)
+            self._c = 0  # chunks completed (host mirror, updated at syncs)
+            self._regions: list = []  # (start pod index, map snapshot)
+            self._pending = None
+            self._dispatch_loop()
+        else:
+            self._run = _runner_for(static, use_frontier=True)
+            self._host_xs = _host_xs(static)
+            self._chunks: list = []  # (chosen_dev, map_snapshot)
+            self._next = 0
+            self._dispatch_chunk()
+
+    # -- device-resident loop drive ------------------------------------
+
+    def _loop_thresh(self) -> int:
+        """The device-side compaction trigger, as one int32: fire iff
+        ``n_alive <= thresh``.  Exactly the host rule — ``_pow2_width``
+        can shrink a pow-2 width iff n_alive <= width // 2 (and the
+        floor allows it), and the frac gate is ``n_alive <=
+        floor(compact_frac * width)`` for integer n_alive."""
+        if self.min_width >= self._width:
+            return -1  # width floor: no smaller pow-2 exists
+        return min(self._width // 2, int(self.compact_frac * self._width))
+
+    def _dispatch_loop(self) -> None:
+        if self.on_loop is not None:
+            # fault/trace seam BEFORE the dispatch: an injected loop
+            # failure aborts the run and the segment falls back
+            self.on_loop(self.stats["loop_runs"], self._width, self._c)
+        tr = tracing.current()
+        with (tr.span("frontier.loop", cat="frontier",
+                      index=self.stats["loop_runs"], width=self._width,
+                      start_chunk=self._c, n_chunks=self._n_chunks)
+              if tr is not None else tracing.NULL_SPAN):
+            with jax.profiler.TraceAnnotation("ktpu.frontier.loop"):
+                out = self._loop(
+                    self._dev, self._xs_full, self._state, self._buf,
+                    jnp.int32(self._c), jnp.int32(self._n_chunks),
+                    jnp.int32(self._loop_thresh()))
+            # the donated state/buf are dead the moment the call returns;
+            # rebind to the outputs before anything can raise
+            self._state, self._buf = out[0], out[1]
+            self._pending = out[2:]  # (c, want, alive, n_alive)
+            self._regions.append((self._c * self._chunk_eff, self._map))
+            self.stats["loop_runs"] += 1
+            for a in self._pending[:2]:
+                a.copy_to_host_async()
+
+    def _sync_loop(self) -> tuple[bool, "jnp.ndarray", int]:
+        """ONE blocking control read per loop run: the exit cursor, the
+        compaction flag, and the alive count/mask arrive together (the
+        loop already finished computing all of them — a single stall,
+        then ready-buffer copies)."""
+        c_dev, want_dev, alive, n_alive_dev = self._pending
+        self._pending = None
+        c_exit = int(c_dev)  # blocks until the loop run completes
+        self.stats["host_syncs"] += 1
+        want = bool(want_dev)
+        n_alive = int(n_alive_dev)
+        self.stats["chunks"] += c_exit - self._c
+        self._c = c_exit
+        frac = round(n_alive / max(self._width, 1), 4)
+        self.stats["alive_frac"].append(frac)
+        tr = tracing.current()
+        if tr is not None:
+            # one instant per loop EXIT (not per chunk): the pruning
+            # trajectory at every host re-entry
+            tr.instant("frontier.alive", frac=frac, width=self._width,
+                       chunk=self._c)
+        return want, alive, n_alive
+
+    def _finalize_loop(self) -> tuple[np.ndarray, int]:
+        while True:
+            want, alive, n_alive = self._sync_loop()
+            if self._c >= self._n_chunks:
+                break
+            if want:
+                width_new = _pow2_width(n_alive, self.min_width)
+                if (width_new < self._width
+                        and n_alive <= self.compact_frac * self._width):
+                    if self.on_compact is not None:
+                        self.on_compact(self._width, width_new, n_alive)
+                    js = np.nonzero(np.asarray(alive))[0]
+                    self._dev, self._state = gather_node_axis(
+                        self._dev, self._state, js, width_new)
+                    self._map = self._map[js]
+                    self._width = width_new
+                    self.stats["compactions"] += 1
+                    self.stats["widths"].append(width_new)
+            self._dispatch_loop()
+        # final result read: the whole segment's chosen buffer at once
+        buf_host = np.asarray(self._buf)
+        rr = int(self._state.round_robin)
+        self.stats["host_syncs"] += 1
+        chosen_full = np.empty(self._p_real, dtype=np.int64)
+        bounds = [start for start, _ in self._regions] + [self._p_real]
+        for (start, map_snap), end in zip(self._regions, bounds[1:]):
+            end = min(end, self._p_real)
+            if end <= start:
+                continue
+            part = buf_host[start:end].astype(np.int64)
+            safe = np.clip(part, 0, len(map_snap) - 1)
+            chosen_full[start:end] = np.where(part >= 0, map_snap[safe], -1)
+        return chosen_full, rr
+
+    # -- chunked host-loop drive (and loop-failure fallback) -----------
 
     def _dispatch_chunk(self) -> None:
         tr = tracing.current()
@@ -833,22 +1076,26 @@ class FrontierRun:
                       index=self.stats["chunks"], width=self._width,
                       start=self._next)
               if tr is not None else tracing.NULL_SPAN):
-            xs = _chunk_xs(self._host_xs, self._next, self.chunk_len,
-                           int(self.static.v_state) - 1)
-            self._state, chosen = self._run(self._dev, xs, self._state)
-            chosen.copy_to_host_async()
+            with jax.profiler.TraceAnnotation("ktpu.frontier.chunk"):
+                xs = _chunk_xs(self._host_xs, self._next, self.chunk_len,
+                               int(self.static.v_state) - 1)
+                self._state, chosen = self._run(self._dev, xs, self._state)
+                chosen.copy_to_host_async()
             self._chunks.append((chosen, self._map))
             self._next += self.chunk_len
             self.stats["chunks"] += 1
 
     @property
     def device_probe(self):
-        cand = self._chunks[0][0]
+        cand = (self._pending[0] if self.device_loop and self._pending
+                else self._chunks[0][0] if not self.device_loop
+                else None)
         return cand if hasattr(cand, "is_ready") else None
 
     def _maybe_compact(self) -> None:
         alive = jnp.any(self._state.still_ok, axis=0) & self._dev.node_exists
         n_alive = int(jnp.sum(alive))  # the one [N] reduce + sync
+        self.stats["host_syncs"] += 1
         frac = round(n_alive / max(self._width, 1), 4)
         self.stats["alive_frac"].append(frac)
         tr = tracing.current()
@@ -871,6 +1118,8 @@ class FrontierRun:
         self.stats["widths"].append(width_new)
 
     def finalize(self) -> tuple[np.ndarray, int]:
+        if self.device_loop:
+            return self._finalize_loop()
         while self._next < self._p_real:
             self._maybe_compact()
             self._dispatch_chunk()
@@ -878,6 +1127,7 @@ class FrontierRun:
         pos = 0
         for chosen_dev, map_snap in self._chunks:
             part = np.asarray(chosen_dev)
+            self.stats["host_syncs"] += 1
             n = min(len(part), self._p_real - pos)
             part = part[:n].astype(np.int64)
             safe = np.clip(part, 0, len(map_snap) - 1)

@@ -847,6 +847,11 @@ class Scheduler:
         phase_keys = ("tensorize_s", "dispatch_s", "device_wait_s")
         pre_phases = ({k: bstats.get(k, 0.0) for k in phase_keys}
                       if isinstance(bstats, dict) else None)
+        # blocking device→host round-trips: same pre/post-delta seam as
+        # the phase timers (the device-resident loop drives this to
+        # O(compactions + 1) per wave; the chunked host loop is O(chunks))
+        pre_syncs = (bstats.get("host_syncs", 0)
+                     if isinstance(bstats, dict) else None)
         ncache = getattr(self.backend, "device_node_cache", None)
         pre_cols = ((ncache.stats["dirty_cols"], ncache.stats["cols_total"],
                      ncache.stats["reuses"])
@@ -909,6 +914,13 @@ class Scheduler:
                 self.last_batch_phases["prep_s"] = self._last_prep_s
                 self.metrics.pipeline_device_wait.observe(
                     self.last_batch_phases["device_wait_s"] * 1e6)
+            if pre_syncs is not None:
+                wave_syncs = int(bstats.get("host_syncs", 0) - pre_syncs)
+                self.last_batch_phases["host_syncs"] = wave_syncs
+                if wave_syncs > 0:
+                    self.metrics.host_syncs.inc(wave_syncs)
+                if wave_span is not None:
+                    wave_span.set(host_syncs=wave_syncs)
             # ingest-decode split of the wave (ISSUE 4): informer decode
             # seconds + lazy promotions since the last snapshot — the
             # churn bench's pump-phase companion timers

@@ -355,6 +355,14 @@ class SchedulerMetrics:
             "(1.0 = no column ever died; small = heavy pruning)",
             buckets=[i / 20 for i in range(21)],
         ))
+        # device-resident wave loop (ISSUE 11): blocking device→host
+        # round-trips on the finalize path — O(compactions + 1) per wave
+        # with the while_loop form, O(chunks) with the chunked host loop
+        self.host_syncs = r.register(Counter(
+            "scheduler_host_syncs_total",
+            "blocking device→host round-trips performed by batch "
+            "finalize (control reads + result copies)",
+        ))
         # preemption (the PostFilter phase)
         self.preemption_attempts = r.register(Counter(
             "scheduler_preemption_attempts_total"))

@@ -840,9 +840,11 @@ MATRIX = {
     # the frontier prefilter seed dies on the first kernel segment: the
     # segment is served by the full-width scan from the SAME state, so
     # the pod→node map matches the oracle exactly — only the pruning win
-    # is lost, visible in the fallback counter.  (The gather-phase twin,
-    # which needs a cluster that saturates mid-segment to even attempt a
-    # compaction, is exercised in tests/test_frontier.py.)
+    # is lost, visible in the fallback counter.  (The gather- and
+    # loop-phase twins — the mid-segment compaction and the
+    # device-resident while_loop dispatch/re-entry, which need a cluster
+    # that saturates mid-segment to even fire — are exercised in
+    # tests/test_frontier.py.)
     "backend.compact": dict(
         spec=dict(mode="error", match={"phase": "seed"}, first_n=1),
         world="local", exact=True,

@@ -22,11 +22,15 @@ from kubernetes_tpu.models.snapshot import (
     Tensorizer,
     compact_segment,
     frontier_seed,
+    monotone_plane,
 )
 from kubernetes_tpu.ops import TPUBatchBackend
 from kubernetes_tpu.ops.batch_kernel import (
     FrontierRun,
+    monotone_plane_device,
     schedule_batch_arrays,
+    state_to_device,
+    to_device,
 )
 from kubernetes_tpu.scheduler import GenericScheduler, PriorityContext
 from kubernetes_tpu.scheduler.generic_scheduler import FitError
@@ -291,6 +295,252 @@ def test_randomized_frontier_parity_with_aggressive_compaction():
             pods, nim,
             backend_kwargs=dict(frontier_chunk=16, frontier_min_width=8,
                                 frontier_compact_frac=0.9))
+
+
+# ---------------------------------------------------------------------------
+# device-resident wave loop (ISSUE 11): while_loop vs chunked-host parity,
+# donation safety, still_ok refresh
+# ---------------------------------------------------------------------------
+
+
+def _seeded_segment(pods, nim):
+    pctx = PriorityContext(nim)
+    tz = Tensorizer()
+    static = tz.build_static(pods, nim, pctx)
+    init = tz.initial_state(static, nim, pctx, pods)
+    frontier_seed(static, init)
+    return static, init
+
+
+def _loop_vs_chunked(static, init, **kwargs):
+    """Run the same seeded segment through both drive modes and the plain
+    full-width scan; all three must agree on bindings AND the round-robin
+    counter (pinned by exact comparison of the final value)."""
+    loop = FrontierRun(static, init, device_loop=True, **kwargs)
+    l_chosen, l_rr = loop.finalize()
+    host = FrontierRun(static, init, device_loop=False, **kwargs)
+    h_chosen, h_rr = host.finalize()
+    p_chosen, p_rr = schedule_batch_arrays(static, init)
+    np.testing.assert_array_equal(l_chosen, h_chosen)
+    np.testing.assert_array_equal(l_chosen, p_chosen)
+    assert l_rr == h_rr == p_rr
+    # the loop's host-sync budget is structural: one control read per
+    # loop run (= compactions + 1, plus any declined device flags) and
+    # one final result read — never a function of chunk count
+    assert loop.stats["host_syncs"] <= loop.stats["loop_runs"] + 1
+    assert loop.stats["loop_runs"] >= loop.stats["compactions"] + 1
+    return loop, host
+
+
+def test_device_loop_equivalence_forced_tie():
+    """Forced-tie fixture through compactions: identical nodes tie on
+    every score while staggered caps kill columns — the while_loop and
+    the chunked host loop must agree bit-for-bit."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    static, init = _seeded_segment(pods, nim)
+    loop, host = _loop_vs_chunked(static, init, chunk_len=16, min_width=8)
+    assert loop.stats["compactions"] >= 1
+    # O(compactions + 1) vs O(chunks): at 7 chunks the host loop pays a
+    # sync per chunk boundary + per chunk result, the loop pays per
+    # compaction + 1
+    assert host.stats["host_syncs"] > loop.stats["host_syncs"]
+
+
+def test_device_loop_equivalence_n_feasible_one():
+    """Selector-pinned pods (the n_feasible==1 fast path: counter must
+    NOT advance) interleaved with tie pods, through compactions."""
+    nim = tie_cluster(16)
+    pinned = make_node("zz-pinned", cpu="32", memory="64Gi", pods=110,
+                       labels={"kubernetes.io/hostname": "zz-pinned",
+                               "disk": "ssd"})
+    nim[pinned.meta.name] = NodeInfo(pinned)
+    pods = []
+    for i in range(80):
+        if i % 5 == 0:
+            pods.append(make_pod(f"pin-{i:03d}", cpu="100m", memory="64Mi",
+                                 labels={"app": "db"},
+                                 node_selector={"disk": "ssd"}))
+        else:
+            pods.append(make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                                 labels={"app": "web"}))
+    static, init = _seeded_segment(pods, nim)
+    loop, _ = _loop_vs_chunked(static, init, chunk_len=16, min_width=8)
+    assert loop.stats["compactions"] >= 1
+
+
+def test_device_loop_equivalence_randomized():
+    """Randomized sweep at stress settings (tiny chunks, tiny width
+    floor, eager compaction)."""
+    for seed in range(2):
+        rng = random.Random(500 + seed)
+        nim = {}
+        for i in range(rng.randrange(12, 24)):
+            n = make_node(f"node-{i:03d}", cpu=rng.choice(["1", "2", "8"]),
+                          memory=rng.choice(["4Gi", "16Gi"]), pods=20,
+                          labels={"kubernetes.io/hostname": f"node-{i:03d}",
+                                  ZONE: f"zone-{i % 3}"})
+            nim[n.meta.name] = NodeInfo(n)
+        templates = [
+            dict(cpu="500m", memory="128Mi", labels={"app": "web"}),
+            dict(cpu="1", memory="256Mi", labels={"app": "db"}),
+        ]
+        pods = [make_pod(f"p-{i:04d}", **rng.choice(templates))
+                for i in range(rng.randrange(60, 120))]
+        static, init = _seeded_segment(pods, nim)
+        _loop_vs_chunked(static, init, chunk_len=16, min_width=8,
+                         compact_frac=0.9)
+
+
+def test_device_loop_backend_parity_and_sync_stats():
+    """End-to-end through the backend: the default path is the device
+    loop, oracle parity holds, and the per-segment host_syncs recorded
+    in last_frontier are O(compactions + 1)."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    backend = assert_frontier_parity(
+        pods, nim,
+        backend_kwargs=dict(frontier_chunk=16, frontier_min_width=8))
+    assert backend.stats["frontier_loop_fallbacks"] == 0
+    seg = backend.last_frontier[0]
+    assert seg["mode"] == "loop"
+    assert seg["host_syncs"] <= seg["compactions"] + 2
+    assert backend.stats["host_syncs"] >= seg["host_syncs"]
+
+
+def test_monotone_plane_device_matches_host_at_seed():
+    """The device refresh plane is the jnp twin of the host builder: at
+    the step-0 state the two must be EQUAL (r_sel trimming on the device
+    side is inert — dropped slots have g_req <= 0 on the host side
+    too)."""
+    nim = tiny_cluster(n_small=6, n_big=4)
+    pods = [make_pod(f"p-{i:03d}", cpu="500m", memory="128Mi",
+                     labels={"app": "web"},
+                     host_ports=[8080] if i % 3 == 0 else None)
+            for i in range(24)]
+    static, init = _seeded_segment(pods, nim)
+    want = monotone_plane(static, init.requested, init.pod_count,
+                          init.ports_used, dm=init.dm, downer=init.downer)
+    dev = to_device(static)
+    st = state_to_device(init, r_sel=getattr(static, "r_sel", None),
+                         use_frontier=True)
+    got = np.asarray(monotone_plane_device(
+        dev, st, bool(static.terms), bool(static.use_ports)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_still_ok_refresh_never_resurrects():
+    """Property: across every loop exit, (a) the alive union mapped to
+    the ORIGINAL axis is monotone non-increasing — the refresh only
+    tightens, a dead column never comes back — and (b) the refreshed
+    plane stays inside the host-built monotone plane at the materialized
+    carry state (the device twin never over-approximates the host
+    rule)."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    static, init = _seeded_segment(pods, nim)
+    n_full = int(init.requested.shape[0])  # the padded node axis (n_pad)
+    r_sel = getattr(static, "r_sel", None)
+    snapshots = []
+
+    class Rec(FrontierRun):
+        def _sync_loop(self):
+            out = super()._sync_loop()
+            # state here is post-refresh, pre-gather: current axis maps
+            # to the original through self._map (len <= width; padding
+            # beyond it is node_exists=False)
+            m = self._map
+            k = len(m)
+            cur_still = np.asarray(self._state.still_ok)[:, :k]
+            req = np.array(init.requested)
+            if r_sel is not None:
+                req[np.ix_(m, np.asarray(r_sel))] = np.asarray(
+                    self._state.requested)[:k]
+            else:
+                req[m] = np.asarray(self._state.requested)[:k]
+            pc = np.array(init.pod_count)
+            pc[m] = np.asarray(self._state.pod_count)[:k]
+            pu = np.array(init.ports_used)
+            pu[m] = np.asarray(self._state.ports_used)[:k]
+            dm = np.array(init.dm)
+            dm[:, m] = np.asarray(self._state.dm)[:, :k]
+            downer = np.array(init.downer)
+            downer[:, m] = np.asarray(self._state.downer)[:, :k]
+            still_full = np.zeros((cur_still.shape[0], n_full), dtype=bool)
+            still_full[:, m] = cur_still
+            plane = monotone_plane(static, req, pc, pu, dm=dm,
+                                   downer=downer)
+            snapshots.append((still_full, plane))
+            return out
+
+    run = Rec(static, init, device_loop=True, chunk_len=16, min_width=8)
+    chosen, rr = run.finalize()
+    assert run.stats["compactions"] >= 1 and len(snapshots) >= 2
+    for (prev, _), (cur, _) in zip(snapshots, snapshots[1:]):
+        resurrected = cur.any(axis=0) & ~prev.any(axis=0)
+        assert not resurrected.any(), "a dead column came back alive"
+    for still_full, plane in snapshots:
+        escaped = still_full & ~plane
+        assert not escaped.any(), (
+            "device refresh kept a column the host monotone plane kills")
+    # and the run stays exact
+    p_chosen, p_rr = schedule_batch_arrays(static, init)
+    np.testing.assert_array_equal(chosen, p_chosen)
+    assert rr == p_rr
+
+
+def test_loop_fault_at_dispatch_degrades_to_chunked_host():
+    """backend.compact phase="loop", first hit (the initial dispatch):
+    the segment must degrade to the chunked host loop — same carry
+    plane, same parity — with no full-width retry."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(100)]
+    pctx = PriorityContext(nim)
+    a, b = GenericScheduler(), GenericScheduler()
+    want = oracle_batch(pods, nim, pctx, a)
+    backend = TPUBatchBackend(algorithm=b, frontier_chunk=16,
+                              frontier_min_width=8)
+    plan = FaultPlan(seed=1).on("backend.compact", mode="error",
+                                match={"phase": "loop"}, first_n=1)
+    with plan.armed():
+        got = backend.schedule_batch(pods, nim, pctx)
+    assert plan.fired["backend.compact"] == 1
+    assert backend.stats["frontier_loop_fallbacks"] >= 1
+    assert backend.stats["frontier_fallbacks"] == 0
+    assert backend.last_frontier[0]["mode"] == "chunked"
+    assert [g for g in got] == want
+    assert a._round_robin == b._round_robin
+
+
+def test_loop_fault_at_reentry_retries_full_width_donation_safe():
+    """backend.compact phase="loop", SECOND hit — the re-entry dispatch
+    after a compaction, i.e. after the first loop run already DONATED
+    its carry buffers.  The fallback must retry the segment full-width
+    from host arrays (never touching the donated device buffers: a
+    use-after-donate would raise and break parity) with the breaker
+    uninvolved — a loop bug costs time, never parity."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    pctx = PriorityContext(nim)
+    a, b = GenericScheduler(), GenericScheduler()
+    want = oracle_batch(pods, nim, pctx, a)
+    backend = TPUBatchBackend(algorithm=b, frontier_chunk=16,
+                              frontier_min_width=8)
+    plan = FaultPlan(seed=1).on("backend.compact", mode="error",
+                                match={"phase": "loop"}, nth=2)
+    with plan.armed():
+        got = backend.schedule_batch(pods, nim, pctx)
+    assert plan.fired["backend.compact"] == 1
+    assert backend.stats["frontier_fallbacks"] >= 1
+    assert [g for g in got] == want
+    assert a._round_robin == b._round_robin
+    # breaker NOT involved: the full-width XLA scan served the segment
+    assert backend.stats["oracle_segments"] == 0
 
 
 # ---------------------------------------------------------------------------
