@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
@@ -388,7 +389,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               pipeline: bool = True, lazy_ingest: bool = True,
               frontier: bool = True, watch_frames: bool = True,
               device_loop: bool = True, frontier_chunk: int = 512,
-              verify_oracle: bool = False, trace=None) -> dict:
+              verify_oracle: bool = False, trace=None,
+              telemetry=None) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
     created the moment wave w leaves the queue, the density.go shape
@@ -431,6 +433,14 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
     artifact there (load into chrome://tracing / Perfetto), and the
     result carries a ``trace`` summary block either way.
 
+    ``telemetry`` (ISSUE 13): truthy enables the continuous-telemetry
+    stack for the TIMED run — the time-series scraper over the
+    scheduler registry, the burn-rate SLO monitor over DEFAULT_SLOS,
+    and the off-box shipper.  A string value ships the run's records
+    (JSON-lines) to that path; truthy-non-string ships to ``os.devnull``
+    (the A/B arm: full pipeline cost, no artifact).  The result carries
+    a ``telemetry`` summary block with per-SLO burn-rate verdicts.
+
     The default preset is NORTH-scale churn (5,000 nodes — VERDICT r4
     directive 4): the returned dict carries an SLO verdict
     (``slo_pass``) gating e2e p99 ≤ 5s (the reference pod-startup SLO)
@@ -465,7 +475,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
         r = _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
                              pipeline, lazy_ingest, frontier,
                              watch_frames, device_loop, frontier_chunk,
-                             verify_oracle)
+                             verify_oracle, telemetry)
     finally:
         lazy_mod.ENABLED = lazy_was
         frames_mod.ENABLED = frames_was
@@ -473,6 +483,14 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
             from kubernetes_tpu.utils import tracing
 
             tracing.disable()
+        if telemetry:
+            # belt and braces: the timed run disables these itself on
+            # the happy path; a raise mid-run must not leak the globals
+            from kubernetes_tpu.utils import telemetry as telemetry_mod
+            from kubernetes_tpu.utils import timeseries as timeseries_mod
+
+            telemetry_mod.disable()
+            timeseries_mod.disable()
     if tracer is not None:
         doc = tracer.chrome_trace()
         r["trace"] = {
@@ -492,7 +510,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
 
 def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
                      lazy_ingest, frontier, watch_frames, device_loop,
-                     frontier_chunk, verify_oracle) -> dict:
+                     frontier_chunk, verify_oracle, telemetry=None) -> dict:
     import threading
 
     from kubernetes_tpu.api import lazy as lazy_mod
@@ -522,6 +540,25 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
     sched.overlap_ingest = pipeline
     sched.start()
     sched.broadcaster.start()
+
+    # continuous telemetry over the TIMED run (ISSUE 13): scraper over
+    # the scheduler registry, burn-rate monitor on the standing SLOs,
+    # shipper to the artifact path (or devnull for the cost-only arm).
+    # run_churn's finally tears these globals down on any raise.
+    ts_store = slo_ev = shipper = None
+    if telemetry:
+        from kubernetes_tpu.utils import slo as slo_mod
+        from kubernetes_tpu.utils import telemetry as telemetry_mod
+        from kubernetes_tpu.utils import timeseries as timeseries_mod
+
+        ts_store = timeseries_mod.enable(sched.metrics.registry,
+                                         interval_s=0.25)
+        slo_ev = slo_mod.monitor(store=ts_store)
+        sink = telemetry_mod.FileSink(
+            telemetry if isinstance(telemetry, str) else os.devnull)
+        shipper = telemetry_mod.enable(sink,
+                                       registry=sched.metrics.registry)
+        ts_store.add_observer(telemetry_mod.timeseries_observer(shipper))
 
     per_wave = total_pods // waves
     # per-wave pump timing (the loop pumps internally; wrap to attribute)
@@ -624,6 +661,36 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
     ncache = backend.device_node_cache.stats
     lazy_post = lazy_mod.stats_snapshot()
     pod_inf = sched.informers.informer("Pod").stats
+    telem_block = None
+    if ts_store is not None:
+        from kubernetes_tpu.utils import telemetry as telemetry_mod
+        from kubernetes_tpu.utils import timeseries as timeseries_mod
+
+        ts_store.sample_once()  # one final scrape so the tail is in-ring
+        telemetry_mod.disable()  # drains the queue through the sink
+        timeseries_mod.disable()
+        verdicts = {}
+        for s in (slo_ev.slos if slo_ev is not None else []):
+            fast = s.sli.bad_fraction(ts_store, s.fast_window_s)
+            slow = s.sli.bad_fraction(ts_store, s.slow_window_s)
+            verdicts[s.name] = {
+                "breached": slo_ev.state(s.name)["breached"],
+                "objective": s.objective,
+                "fast_burn": round(fast / s.error_budget, 2)
+                if fast is not None else None,
+                "slow_burn": round(slow / s.error_budget, 2)
+                if slow is not None else None,
+            }
+        telem_block = {
+            "enabled": True,
+            "artifact": telemetry if isinstance(telemetry, str) else None,
+            "scrapes": ts_store.scrapes,
+            "tracks": len(ts_store.tracks()),
+            "shipper": shipper.stats(),
+            "breaches_fired": slo_ev.breaches_fired if slo_ev else 0,
+            "slo_verdicts": verdicts,
+        }
+
     oracle_parity = None
     if verify_oracle:
         oracle_parity = _oracle_replay_waves(
@@ -696,6 +763,9 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             "confirm_fallbacks": int(sched.metrics.confirm_fallbacks.value),
         },
         "oracle_parity": oracle_parity,
+        # continuous-telemetry summary (ISSUE 13): scrape/ship counters
+        # and per-SLO burn-rate verdicts; None when the stack was off
+        "telemetry": telem_block,
         "slo_p99_ms": CHURN_SLO_P99_MS,
         "floor_pods_per_sec": CHURN_FLOOR_PODS_PER_SEC,
         "slo_pass": bool(p99 is not None and p99 <= CHURN_SLO_P99_MS
@@ -1174,6 +1244,79 @@ def run_trace_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
     }
 
 
+def run_telemetry_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
+                     waves: int = 10, pairs: int = 2, seed: int = 0) -> dict:
+    """Both-orders interleaved A/B pricing continuous telemetry (ISSUE
+    13): A = scraper/monitor/shipper disabled (the production default —
+    producer sites cost one global load + None check), B = the full
+    stack ENABLED for the whole timed run: 0.25 s scrape cadence over
+    the scheduler registry, burn-rate evaluation of the standing SLOs
+    on every scrape, and the shipper draining every scrape delta through
+    a devnull file sink.  Like ``--ab-trace`` this is an overhead PRICE
+    report, not a win claim: the DISABLED path's "within noise of
+    pre-PR" claim is the worktree ledger
+    (BENCH_AB_telemetry_overhead.json), because the instrumentation
+    exists in both arms here."""
+    run_churn(n_nodes, 2 * (total_pods // waves), 2, seed=seed + 1,
+              warmup=False)
+
+    def one(enabled: bool) -> dict:
+        return run_churn(n_nodes, total_pods, waves, seed=seed,
+                         warmup=False, telemetry=enabled)
+
+    ab_pairs, ba_pairs = [], []
+    a_all, b_all = [], []
+    telemetry_stats = []
+    bounds = set()
+    for _ in range(pairs):
+        b = one(True)
+        a = one(False)
+        ab_pairs.append({"B_on": b["pods_per_sec"], "A_off": a["pods_per_sec"]})
+        b_all.append(b["pods_per_sec"])
+        a_all.append(a["pods_per_sec"])
+        telemetry_stats.append(b["telemetry"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-telemetry AB: on={b['pods_per_sec']} "
+              f"off={a['pods_per_sec']} "
+              f"scrapes={b['telemetry']['scrapes']}", file=sys.stderr)
+    for _ in range(pairs):
+        a = one(False)
+        b = one(True)
+        ba_pairs.append({"A_off": a["pods_per_sec"], "B_on": b["pods_per_sec"]})
+        a_all.append(a["pods_per_sec"])
+        b_all.append(b["pods_per_sec"])
+        telemetry_stats.append(b["telemetry"])
+        bounds.update((a["bound"], b["bound"]))
+        print(f"# ab-telemetry BA: off={a['pods_per_sec']} "
+              f"on={b['pods_per_sec']}", file=sys.stderr)
+    a_med = sorted(a_all)[len(a_all) // 2]
+    b_med = sorted(b_all)[len(b_all) // 2]
+    return {
+        "claim": ("Continuous telemetry: registry scraper + burn-rate "
+                  "SLO monitor + off-box shipper — priced ENABLED vs "
+                  "disabled on the same tree (the disabled path's "
+                  "no-regression claim is the worktree ledger)"),
+        "method": (f"Churn {n_nodes} nodes / {total_pods} mixed pods / "
+                   f"{waves} waves, arrival thread + run_batch_loop "
+                   "serving (both arms), events on; interleaved pairs in "
+                   "BOTH orders, one shared process, warm-up compiles "
+                   "paid up front; A = telemetry disabled, B = scraper "
+                   "(0.25 s cadence) + SLO monitor + devnull shipper "
+                   "enabled for the whole timed run"),
+        "pairs_order_AB_first": ab_pairs,
+        "pairs_order_BA_first": ba_pairs,
+        "A_off_all": a_all,
+        "B_on_all": b_all,
+        "A_median": a_med,
+        "B_median": b_med,
+        # sign convention matches the other ledgers (B vs A): a NEGATIVE
+        # value here is the enabled-telemetry slowdown
+        "win_pct": round((b_med - a_med) / a_med * 100, 1) if a_med else None,
+        "bound_counts": sorted(bounds),
+        "telemetry_stats": telemetry_stats,
+    }
+
+
 def run_preemption(n_nodes: int = 2_000) -> dict:
     """Priority-preemption workload (VERDICT r4 directive 6: measure
     preemption cost at all).  Saturate every node's CPU with priority-0
@@ -1462,10 +1605,32 @@ def main() -> None:
         "(BENCH_AB_trace_overhead.json); --nodes/--pods/--trials "
         "override scale and pair count",
     )
+    parser.add_argument(
+        "--telemetry", nargs="?", const="BENCH_telemetry_churn.ndjson",
+        default=None, metavar="PATH",
+        help="enable continuous telemetry for the churn measurement "
+        "(time-series scraper + burn-rate SLO monitor + off-box "
+        "shipper) and ship the run's records as JSON-lines to PATH "
+        "(default BENCH_telemetry_churn.ndjson); the churn block gains "
+        "per-SLO burn-rate verdicts, only quotable with the artifact "
+        "behind them",
+    )
+    parser.add_argument(
+        "--ab-telemetry", nargs="?",
+        const="BENCH_AB_telemetry_enabled.json",
+        default=None, metavar="PATH",
+        help="run the both-orders telemetry-overhead A/B (scraper + SLO "
+        "monitor + shipper enabled vs disabled, same tree) and write "
+        "the ledger JSON to PATH (default "
+        "BENCH_AB_telemetry_enabled.json); a negative win_pct is the "
+        "enabled-telemetry slowdown — the disabled path's no-regression "
+        "claim is the worktree ledger (BENCH_AB_telemetry_overhead."
+        "json); --nodes/--pods/--trials override scale and pair count",
+    )
     args = parser.parse_args()
 
     if (args.ab_churn or args.ab_pump or args.ab_frontier or args.ab_watch
-            or args.ab_loop or args.ab_trace):
+            or args.ab_loop or args.ab_trace or args.ab_telemetry):
         import datetime
 
         kw = {}
@@ -1475,14 +1640,17 @@ def main() -> None:
             kw["total_pods"] = args.pods
         if args.trials:
             kw["pairs"] = args.trials
-        runner = (run_trace_ab if args.ab_trace
+        runner = (run_telemetry_ab if args.ab_telemetry
+                  else run_trace_ab if args.ab_trace
                   else run_loop_ab if args.ab_loop
                   else run_watch_ab if args.ab_watch
                   else run_frontier_ab if args.ab_frontier
                   else run_pump_ab if args.ab_pump else run_churn_ab)
-        path = (args.ab_trace or args.ab_loop or args.ab_watch
-                or args.ab_frontier or args.ab_pump or args.ab_churn)
-        metric = ("trace-enabled-overhead-pct" if args.ab_trace
+        path = (args.ab_telemetry or args.ab_trace or args.ab_loop
+                or args.ab_watch or args.ab_frontier or args.ab_pump
+                or args.ab_churn)
+        metric = ("telemetry-enabled-overhead-pct" if args.ab_telemetry
+                  else "trace-enabled-overhead-pct" if args.ab_trace
                   else "device-loop-win-pct" if args.ab_loop
                   else "watch-frames-win-pct" if args.ab_watch
                   else "frontier-scan-win-pct" if args.ab_frontier
@@ -1608,12 +1776,33 @@ def main() -> None:
     # under continuous creation; VERDICT r3 Missing #5)
     churn = None
     if not args.oracle and args.preset == "north" and args.churn:
-        churn = run_churn(seed=0, trace=args.trace)
+        churn = run_churn(seed=0, trace=args.trace,
+                          telemetry=args.telemetry)
         if args.trace:
             tr = churn["trace"]
             print(f"# trace: {tr['events']} events over "
                   f"{tr['waves_recorded']} waves -> {tr['artifact']} "
                   f"({tr['flight_dumps']} flight dumps)", file=sys.stderr)
+        if args.telemetry:
+            # the no-ledger-no-numbers guard, extended to the SLO
+            # verdict block (ISSUE 13): burn-rate verdicts are only
+            # quotable with the shipped JSON-lines artifact behind them
+            tb = churn.get("telemetry") or {}
+            art = tb.get("artifact")
+            shipped = (tb.get("shipper") or {}).get("shipped", 0)
+            if not art or not os.path.exists(art) or shipped == 0:
+                churn["telemetry"] = None
+                print(f"# REFUSING to print SLO verdicts: telemetry "
+                      f"artifact {art!r} missing or empty "
+                      f"(shipped={shipped})", file=sys.stderr)
+                sys.exit(1)
+            verdicts = ", ".join(
+                f"{name}={'BREACH' if v['breached'] else 'ok'}"
+                for name, v in sorted(tb["slo_verdicts"].items()))
+            print(f"# telemetry: {tb['scrapes']} scrapes over "
+                  f"{tb['tracks']} tracks -> {art} (shipped {shipped}, "
+                  f"dead {tb['shipper']['dead_lettered']}); "
+                  f"verdicts: {verdicts}", file=sys.stderr)
         print(
             f"# churn[{churn['nodes']} nodes]: {churn['bound']} bound / "
             f"{churn['unbound']} unbound over "

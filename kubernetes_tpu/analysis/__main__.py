@@ -33,8 +33,8 @@ PASS_DESCRIPTIONS = {
     "trace": "trace-safety over ops/ (TS1xx: host escapes, Python branches on traced values, set-order nondeterminism)",
     "parity": "oracle↔kernel parity coverage (PC2xx: unmapped predicates/priorities, stale markers)",
     "races": "controller/kubelet race lint (RL3xx: unlocked cross-thread writes, lock-order cycles)",
-    "metrics": "metrics-name lint (MN4xx: snake_case names, counters end _total, histograms carry a unit, no duplicate registrations)",
-    "tracecov": "trace-coverage lint (TC5xx: fault seams outside spans, unmirrored phase timers, span-free hot-path modules)",
+    "metrics": "metrics-name lint (MN4xx: snake_case names, counters end _total, histograms carry a unit, no duplicate registrations, SLO specs resolve to registered metrics)",
+    "tracecov": "trace-coverage lint (TC5xx: fault seams outside spans, unmirrored phase timers, span-free hot-path modules, wave-phase spans outside the hot scope)",
 }
 
 

@@ -25,6 +25,12 @@ Rules
   phase.
 - **TC503** — a wave-hot-path module with no trace marker at all: a new
   subsystem on the hot path must open at least one span before it ships.
+- **TC504** — the inverse of TC503: a module that opens *wave-phase*
+  spans (a ``.wave(`` call, or ``.complete(..., cat="phase")``) but is
+  missing from ``HOT_PATH_MODULES``.  Wave phases feed the SLO burn-rate
+  engine and the per-wave profile; a module emitting them from outside
+  the declared hot set silently escapes the TC501/TC503 coverage gates,
+  so the scope list must grow with the code — loudly.
 
 Like every pass here the analysis is lexical and over-approximates
 toward SILENCE: a marker anywhere in the function counts, whether or not
@@ -188,6 +194,26 @@ def _phase_timer_key(node: ast.AugAssign) -> Optional[str]:
     return sl.value[:-2]
 
 
+def _wave_phase_marker_line(tree: ast.Module) -> Optional[int]:
+    """First line opening a *wave-phase* span — a ``.wave(`` call or a
+    ``.complete(..., cat="phase")`` call — or None.  ``cat="trace"`` and
+    other categories are background instrumentation, not wave phases."""
+    best: Optional[int] = None
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        hit = node.func.attr == "wave"
+        if not hit and node.func.attr == "complete":
+            hit = any(kw.arg == "cat"
+                      and isinstance(kw.value, ast.Constant)
+                      and kw.value.value == "phase"
+                      for kw in node.keywords)
+        if hit and (best is None or node.lineno < best):
+            best = node.lineno
+    return best
+
+
 def _completes_in(fn: ast.FunctionDef) -> set[str]:
     out: set[str] = set()
     for node in ast.walk(fn):
@@ -300,6 +326,22 @@ def run(
                     "flight recorder"
                 ),
             ))
+
+        # TC504: wave-phase spans opened outside the declared hot set
+        if rel not in hot:
+            ln = _wave_phase_marker_line(tree)
+            if ln is not None:
+                findings.append(Finding(
+                    code="TC504", path=rel, line=ln, symbol="<module>",
+                    message=(
+                        "module opens wave-phase spans (`.wave(` / "
+                        "`.complete(..., cat=\"phase\")`) but is not "
+                        "listed in HOT_PATH_MODULES — it escapes the "
+                        "TC501/TC503 coverage gates and its phases feed "
+                        "the SLO engine unaudited; add it to the hot "
+                        "scope (or the scope override)"
+                    ),
+                ))
 
     # a hot/phase scope entry that matches no scanned file is a config
     # error of THIS pass: fail loud, mirroring iter_py_files's contract

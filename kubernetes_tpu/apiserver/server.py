@@ -33,11 +33,13 @@ import json
 import logging
 import sys
 import threading
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..admission.framework import AdmissionDenied
+from ..utils.health import handle_debug_path
 from ..store.store import (
     AlreadyExistsError,
     ConflictError,
@@ -142,6 +144,15 @@ class APIServer:
         self.request_latency = self.registry.register(
             Histogram("apiserver_request_latencies_microseconds")
         )
+        # /telemetry ingest (ISSUE 13): records shipped by daemons'
+        # TelemetryShipper HTTP sinks.  Bounded — a chatty hollow fleet
+        # must not grow the apiserver without bound; overflow evicts the
+        # oldest and counts, mirroring the shipper's own drop posture.
+        self.telemetry_records: deque = deque(maxlen=4096)
+        self.telemetry_accepted = self.registry.register(Counter(
+            "apiserver_telemetry_accepted_total",
+            "telemetry records accepted at /telemetry"))
+        self._telemetry_mu = threading.Lock()
         handler = _make_handler(self)
         if tls is not None:
             # The handshake must run in the per-connection worker thread,
@@ -192,6 +203,19 @@ class APIServer:
         if self._thread:
             self._thread.join(timeout=5)
 
+    def ingest_telemetry(self, records: list) -> int:
+        """Append shipped records to the bounded ring; returns accepted
+        count (deque eviction handles overflow silently — the shipper
+        side counts its own drops)."""
+        with self._telemetry_mu:
+            self.telemetry_records.extend(records)
+        self.telemetry_accepted.inc(len(records))
+        return len(records)
+
+    def telemetry_snapshot(self) -> list:
+        with self._telemetry_mu:
+            return list(self.telemetry_records)
+
 
 def _make_handler(server: APIServer):
     class Handler(BaseHTTPRequestHandler):
@@ -235,6 +259,32 @@ def _make_handler(server: APIServer):
                 else:
                     self._cached_body = json.loads(raw) if raw else {}
             return self._cached_body
+
+        def _serve_telemetry_ingest(self) -> None:
+            # the shipper POSTs ndjson (one JSON record per line); plain
+            # JSON documents ({"items": [...]}, a bare list, or a single
+            # record) are accepted so curl debugging stays easy
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) if length else b""
+            self._cached_body = {}  # raw body consumed here, not JSON
+            ctype = self.headers.get("Content-Type", "")
+            try:
+                text = raw.decode()
+                if "ndjson" in ctype:
+                    records = [json.loads(line)
+                               for line in text.splitlines() if line.strip()]
+                else:
+                    doc = json.loads(text) if text.strip() else []
+                    if isinstance(doc, dict):
+                        records = doc.get("items", [doc])
+                    else:
+                        records = list(doc)
+            except (UnicodeDecodeError, ValueError) as e:
+                return self._error(400, "BadRequest",
+                                   f"undecodable telemetry payload: {e}")
+            accepted = server.ingest_telemetry(records)
+            self._send(200, {"kind": "Status", "code": 200,
+                             "accepted": accepted})
 
         def _request_info(self, method: str):
             """(verb, resource, namespace, name) — the request-info filter
@@ -971,10 +1021,31 @@ def _make_handler(server: APIServer):
 
             if url.path == "/healthz":
                 return self._send(200, {"status": "ok"})
-            if url.path == "/metrics":
-                text = server.registry.expose().encode()
-                self._last_code = 200
-                self.send_response(200)
+            if url.path == "/telemetry":
+                # off-box shipper ingest (ISSUE 13): POST accepts ndjson
+                # (one record per line, the shipper's wire shape) or a
+                # JSON {"items": [...]} document; GET snapshots the ring
+                if method == "POST":
+                    return self._serve_telemetry_ingest()
+                if method == "GET":
+                    records = server.telemetry_snapshot()
+                    return self._send(200, {"kind": "TelemetryRecordList",
+                                            "count": len(records),
+                                            "items": records})
+                return self._error(405, "MethodNotAllowed", method)
+            # the shared daemon debug surface (utils/health.py): /metrics,
+            # /debug/traces, /debug/flightrecorder, /debug/timeseries —
+            # identical routes on every component, the apiserver included
+            shared = handle_debug_path(url.path, server.registry)
+            if shared is not None:
+                if method != "GET":
+                    return self._error(405, "MethodNotAllowed", method)
+                code, payload = shared
+                if not isinstance(payload, str):
+                    return self._send(code, payload)
+                text = payload.encode()
+                self._last_code = code
+                self.send_response(code)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(text)))
                 self.end_headers()

@@ -36,7 +36,15 @@ def main(argv=None) -> int:
     ap.add_argument("--node-monitor-period", type=float, default=5.0)
     ap.add_argument("--feature-gates", default="")
     ap.add_argument("--healthz-port", type=int, default=-1,
-                    help="serve /healthz (reference :10252); -1 = off")
+                    help="serve /healthz + /metrics + /debug/* (reference "
+                         ":10252); -1 = off")
+    ap.add_argument("--timeseries", action="store_true",
+                    help="scrape the client-metrics registry into "
+                         "time-series rings (served at /debug/timeseries)")
+    ap.add_argument("--timeseries-interval", type=float, default=1.0)
+    ap.add_argument("--telemetry-sink", default=None,
+                    help="ship flight dumps + time-series deltas off-box "
+                         "(collector URL or JSON-lines file path)")
     args = ap.parse_args(argv)
     from ..utils.features import DEFAULT_FEATURE_GATES
 
@@ -64,12 +72,23 @@ def main(argv=None) -> int:
         mgr.stop()
 
     stop = install_signal_stop()
-    # health BEFORE leader election: standbys must answer liveness probes
+    # health BEFORE leader election: standbys must answer liveness probes.
+    # The controller manager's observable surface is the client transport
+    # (retries, relists, watch gaps) — the process-wide client registry.
     from ..daemon import serve_health
+    from ..utils.metrics import DEFAULT_CLIENT_METRICS
 
-    health = serve_health(args.healthz_port)
+    health = serve_health(args.healthz_port,
+                          DEFAULT_CLIENT_METRICS.registry)
     if health is not None:
-        logging.info("healthz on :%d", health.local_port)
+        logging.info("healthz/metrics on :%d", health.local_port)
+    if args.timeseries or args.telemetry_sink:
+        from ..daemon import enable_continuous_telemetry
+
+        enable_continuous_telemetry(
+            DEFAULT_CLIENT_METRICS.registry,
+            interval_s=args.timeseries_interval,
+            sink_spec=args.telemetry_sink)
     try:
         run_with_leader_election(
             cs, "kube-controller-manager", f"kcm-{os.getpid()}", run, stop,

@@ -128,48 +128,64 @@ def wait_forever(stop: threading.Event, tick: Optional[Callable[[], None]] = Non
         stop.wait(interval)
 
 
+def telemetry_sink(spec: str):
+    """``--telemetry-sink`` parsing, shared by every daemon: an
+    ``http(s)://`` URL is a collector (the apiserver's ``/telemetry``
+    ingest), anything else is a JSON-lines file path."""
+    from .utils.telemetry import FileSink, HTTPSink
+
+    if spec.startswith("http://") or spec.startswith("https://"):
+        return HTTPSink(spec)
+    return FileSink(spec)
+
+
+def enable_continuous_telemetry(registry, interval_s: float = 1.0,
+                                sink_spec: Optional[str] = None,
+                                slos: bool = True):
+    """One-call wiring for the continuous-telemetry stack, shared by
+    every daemon ``__main__``: start the time-series scraper over
+    ``registry``, attach the burn-rate SLO monitor (a breach fires the
+    flight recorder), and — when a sink is given — the off-box shipper
+    fed with flight dumps (via the recorder's dump hook) and per-scrape
+    time-series deltas.  Returns the store (``timeseries.disable()`` /
+    ``telemetry.disable()`` tear the stack down)."""
+    from .utils import slo, telemetry, timeseries
+
+    store = timeseries.enable(registry, interval_s=interval_s)
+    if slos:
+        slo.monitor(store=store)
+    if sink_spec:
+        shipper = telemetry.enable(telemetry_sink(sink_spec),
+                                   registry=registry)
+        store.add_observer(telemetry.timeseries_observer(shipper))
+    return store
+
+
 def serve_health(port: int, registry=None, host: str = "127.0.0.1"):
-    """Daemon healthz + metrics + debug-trace endpoint (the reference
-    mounts /healthz, /metrics and pprof on every daemon — scheduler
+    """Daemon healthz + metrics + debug endpoints (the reference mounts
+    /healthz, /metrics and pprof on every daemon — scheduler
     app/server.go:149; /debug/traces is the pprof analogue for the wave
     tracer).  Must be started BEFORE leader election: a standby that
     serves no health endpoint gets killed by its supervisor's liveness
     probe.  Returns the running server (.local_port, .stop()), or None
     when port<0.
 
-    ``/debug/traces`` serves the active tracer's Chrome trace-event JSON
-    (load into chrome://tracing / Perfetto); ``/debug/flightrecorder``
-    serves every dump the recorder has taken plus the current wave ring.
-    Both answer ``{"enabled": false}`` when tracing is off — probing the
+    The route set is the shared :mod:`kubernetes_tpu.utils.health`
+    contract — identical on every daemon: ``/healthz``, ``/metrics``,
+    ``/debug/traces``, ``/debug/flightrecorder``, ``/debug/timeseries``.
+    Disabled subsystems answer ``{"enabled": false}`` — probing an
     endpoint must never perturb the production path."""
     from .proxy.healthcheck import _HealthHTTPServer
+    from .utils.health import DebugRoutesMixin
 
     if port is None or port < 0:
         return None
 
-    class _DaemonHealth(_HealthHTTPServer):
-        def handle(self, path: str):
-            if path == "/healthz":
-                return 200, {"status": "ok"}
-            if path == "/metrics" and registry is not None:
-                try:
-                    return 200, registry.expose()  # raw exposition text
-                except Exception as e:  # noqa: BLE001 - never crash health
-                    return 500, {"error": str(e)}
-            if path in ("/debug/traces", "/debug/flightrecorder"):
-                from .utils import tracing
-
-                tr = tracing.current()
-                if tr is None:
-                    return 200, {"enabled": False}
-                try:
-                    return 200, (tr.chrome_trace() if path == "/debug/traces"
-                                 else tr.flight_snapshot())
-                except Exception as e:  # noqa: BLE001 - never crash health
-                    return 500, {"error": str(e)}
-            return None
+    class _DaemonHealth(DebugRoutesMixin, _HealthHTTPServer):
+        pass
 
     server = _DaemonHealth(host=host, port=port)
+    server.registry = registry
     server.start()
     server.local_port = server.port
     return server

@@ -35,6 +35,10 @@ backend.compact          frontier-scan prefilter seed /     segment retries on t
                          mid-segment node-axis gather       full-width scan from the same
                          (TPUBatchBackend / FrontierRun)    state — identical bindings,
                                                             only the pruning win is lost
+telemetry.ship           TelemetryShipper._ship_batch       retry + backoff; exhausted
+                         (one batch through the sink)       batches degrade to the local
+                                                            dead ring — a dead collector
+                                                            never stalls a wave
 ======================== ================================== ===========================
 """
 
@@ -88,6 +92,11 @@ register("scheduler.pipeline.prep",
          "overlapped host prep (informer pump + signature warming) run in "
          "the device's shadow between waves — error: the prep step dies "
          "mid-wave; the wave still completes and prep re-runs synchronously")
+register("telemetry.ship",
+         "one telemetry batch through the sink (file append or collector "
+         "POST) — error: the collector is down; retry + backoff, then the "
+         "batch degrades to the shipper's local dead ring (never blocks "
+         "the pipeline)")
 register("backend.compact",
          "frontier-scan node-axis compaction (phase=seed: the tensorize-"
          "time monotone prefilter; phase=gather: the mid-segment device "

@@ -28,17 +28,41 @@ def main(argv=None) -> None:
     parser.add_argument("--federation-name", default="myfed")
     parser.add_argument("--dns-zone", default="example.com")
     parser.add_argument("--sync-interval", type=float, default=1.0)
+    parser.add_argument("--healthz-port", type=int, default=-1,
+                        help="serve /healthz + /metrics + /debug/* for the "
+                             "federation control plane; -1 = off")
+    parser.add_argument("--timeseries", action="store_true",
+                        help="scrape the apiserver registry into "
+                             "time-series rings (/debug/timeseries)")
+    parser.add_argument("--timeseries-interval", type=float, default=1.0)
+    parser.add_argument("--telemetry-sink", default=None,
+                        help="ship flight dumps + time-series deltas "
+                             "off-box (collector URL or JSON-lines path)")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     from ..apiserver import APIServer
     from ..store import Store
     from ..client import Clientset
+    from ..daemon import serve_health
     from .manager import FederationControllerManager
 
     server = APIServer(Store(), port=args.port)
     server.start()
     logging.info("federation-apiserver serving at %s", server.url)
+
+    # the shared daemon health surface over the embedded apiserver's
+    # registry (the apiserver port serves the same routes; this one
+    # stays answerable even while the API is saturated)
+    health = serve_health(args.healthz_port, server.registry)
+    if health is not None:
+        logging.info("healthz/metrics on :%d", health.local_port)
+    if args.timeseries or args.telemetry_sink:
+        from ..daemon import enable_continuous_telemetry
+
+        enable_continuous_telemetry(
+            server.registry, interval_s=args.timeseries_interval,
+            sink_spec=args.telemetry_sink)
 
     cs = Clientset(server.store)
     mgr = FederationControllerManager(
@@ -54,6 +78,8 @@ def main(argv=None) -> None:
             time.sleep(args.sync_interval)
     except KeyboardInterrupt:
         server.stop()
+        if health is not None:
+            health.stop()
 
 
 if __name__ == "__main__":

@@ -47,6 +47,16 @@ def main(argv=None) -> int:
                     "static pods)")
     ap.add_argument("--feature-gates", default="",
                     help="A=true,B=false (e.g. DynamicKubeletConfig=true)")
+    ap.add_argument("--healthz-port", type=int, default=-1,
+                    help="serve /healthz + /metrics + /debug/* (reference "
+                         ":10248); -1 = off, 0 = ephemeral")
+    ap.add_argument("--timeseries", action="store_true",
+                    help="scrape the client-metrics registry into "
+                         "time-series rings (served at /debug/timeseries)")
+    ap.add_argument("--timeseries-interval", type=float, default=1.0)
+    ap.add_argument("--telemetry-sink", default=None,
+                    help="ship flight dumps + time-series deltas off-box "
+                         "(collector URL or JSON-lines file path)")
     args = ap.parse_args(argv)
     if args.feature_gates:
         from ..utils.features import DEFAULT_FEATURE_GATES
@@ -118,6 +128,23 @@ def main(argv=None) -> int:
         pf.start()
         proxies.append(pf)
 
+    # the shared daemon health surface (the reference kubelet's :10248):
+    # hollow nodes observe through the client transport registry
+    from ..daemon import serve_health
+    from ..utils.metrics import DEFAULT_CLIENT_METRICS
+
+    health = serve_health(args.healthz_port,
+                          DEFAULT_CLIENT_METRICS.registry)
+    if health is not None:
+        logging.info("healthz/metrics on :%d", health.local_port)
+    if args.timeseries or args.telemetry_sink:
+        from ..daemon import enable_continuous_telemetry
+
+        enable_continuous_telemetry(
+            DEFAULT_CLIENT_METRICS.registry,
+            interval_s=args.timeseries_interval,
+            sink_spec=args.telemetry_sink)
+
     logging.info("hollow node(s) running: %d kubelet(s), proxy=%s",
                  len(kubelets), bool(proxies))
 
@@ -132,7 +159,11 @@ def main(argv=None) -> int:
             logging.exception("hollow tick failed (will retry)")
 
     stop = install_signal_stop()
-    wait_forever(stop, tick=one_tick, interval=args.tick)
+    try:
+        wait_forever(stop, tick=one_tick, interval=args.tick)
+    finally:
+        if health is not None:
+            health.stop()
     return 0
 
 

@@ -47,6 +47,18 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-dump-dir", default=None,
                     help="with --trace: also write each flight-recorder "
                          "dump as a JSON file under this directory")
+    ap.add_argument("--timeseries", action="store_true",
+                    help="scrape the metrics registry into in-process "
+                         "time-series rings (served at /debug/timeseries) "
+                         "and run the burn-rate SLO monitor — a breach "
+                         "fires the flight recorder")
+    ap.add_argument("--timeseries-interval", type=float, default=1.0,
+                    help="scrape cadence in seconds (with --timeseries)")
+    ap.add_argument("--telemetry-sink", default=None,
+                    help="ship flight dumps + time-series deltas off-box: "
+                         "an http(s):// collector URL (the apiserver's "
+                         "/telemetry ingest) or a JSON-lines file path; "
+                         "implies --timeseries")
     args = ap.parse_args(argv)
     from ..utils.features import SchedulerConfiguration, load_component_config
 
@@ -114,6 +126,17 @@ def main(argv=None) -> int:
         sched = Scheduler(cs, algorithm=algo, backend=backend,
                           scheduler_name=args.scheduler_name)
         metrics_holder["registry"] = sched.metrics.registry
+        if args.timeseries or args.telemetry_sink:
+            from ..daemon import enable_continuous_telemetry
+
+            enable_continuous_telemetry(
+                sched.metrics.registry,
+                interval_s=args.timeseries_interval,
+                sink_spec=args.telemetry_sink)
+            logging.info("continuous telemetry enabled (scrape %.2fs%s)",
+                         args.timeseries_interval,
+                         f", sink={args.telemetry_sink}"
+                         if args.telemetry_sink else "")
         sched.start(manual=False)  # threaded informers + event sink
         logging.info("scheduler running (backend=%s)", args.backend)
         while not payload_stop.is_set():
@@ -135,6 +158,11 @@ def main(argv=None) -> int:
                     continue
         sched.informers.stop_all()
         sched.broadcaster.stop()
+        if args.timeseries or args.telemetry_sink:
+            from ..utils import telemetry, timeseries
+
+            timeseries.disable()
+            telemetry.disable()  # final drain before exit
 
     stop = install_signal_stop()
     try:

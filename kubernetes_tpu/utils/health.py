@@ -1,0 +1,77 @@
+"""Shared daemon debug/health routes (ISSUE 13 satellite).
+
+Before this module each daemon hand-rolled its health routes: the
+scheduler was the only one with debug endpoints, the apiserver served
+``/metrics`` inline, kubelet and federation served nothing.  Now one
+handler implements the contract everywhere:
+
+- ``/healthz``                 — liveness (200 ``{"status": "ok"}``)
+- ``/metrics``                 — Prometheus text from the daemon registry
+- ``/debug/traces``            — Chrome trace-event JSON (Perfetto)
+- ``/debug/flightrecorder``    — every dump + the current wave ring
+- ``/debug/timeseries``        — the TSDB-lite rings as JSON
+
+:func:`handle_debug_path` is the pure routing core — usable from any
+server shape (the apiserver's request handler calls it directly);
+:class:`DebugRoutesMixin` binds it to the ``_HealthHTTPServer``
+``handle(path) -> (code, body) | None`` contract for the standalone
+health servers (``daemon.serve_health``).
+
+Probing any endpoint must never perturb the production path: tracing or
+time-series disabled answer ``{"enabled": false}``, and every handler is
+wrapped so an export bug returns a 500 body instead of killing the
+connection thread.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def handle_debug_path(path: str, registry=None) -> Optional[tuple]:
+    """Route one GET path; ``None`` means "not one of ours" (404 or the
+    caller's own routes).  String bodies are raw text (Prometheus
+    exposition); dicts are JSON."""
+    if path == "/healthz":
+        return 200, {"status": "ok"}
+    if path == "/metrics":
+        if registry is None:
+            return None
+        try:
+            return 200, registry.expose()  # raw exposition text
+        except Exception as e:  # noqa: BLE001 - never crash health
+            return 500, {"error": str(e)}
+    if path in ("/debug/traces", "/debug/flightrecorder"):
+        from . import tracing
+
+        tr = tracing.current()
+        if tr is None:
+            return 200, {"enabled": False}
+        try:
+            return 200, (tr.chrome_trace() if path == "/debug/traces"
+                         else tr.flight_snapshot())
+        except Exception as e:  # noqa: BLE001 - never crash health
+            return 500, {"error": str(e)}
+    if path == "/debug/timeseries":
+        from . import timeseries
+
+        ts = timeseries.current()
+        if ts is None:
+            return 200, {"enabled": False}
+        try:
+            return 200, ts.to_dict()
+        except Exception as e:  # noqa: BLE001 - never crash health
+            return 500, {"error": str(e)}
+    return None
+
+
+class DebugRoutesMixin:
+    """Binds :func:`handle_debug_path` to the ``_HealthHTTPServer``
+    contract.  Subclasses set ``registry`` (or leave it None to serve no
+    ``/metrics``) and may override :meth:`handle` to layer extra routes
+    before delegating up."""
+
+    registry = None
+
+    def handle(self, path: str):
+        return handle_debug_path(path, self.registry)

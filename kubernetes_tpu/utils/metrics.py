@@ -70,6 +70,14 @@ class Histogram:
                     return self.buckets[i] if i < len(self.buckets) else float("inf")
             return float("inf")
 
+    def state(self) -> tuple[list[int], int, float]:
+        """One consistent ``(bucket_counts, total, sum)`` snapshot under a
+        single lock round — the time-series scraper derives several
+        quantile tracks per scrape, and three ``quantile()`` calls could
+        each see a different population."""
+        with self._mu:
+            return list(self._counts), self._total, self._sum
+
     def expose(self) -> str:
         # one consistent snapshot: without the lock a concurrent
         # observe() can land between the bucket walk and the _total
@@ -156,11 +164,24 @@ class Registry:
         return metric
 
     def get(self, name: str):
-        return self._metrics.get(name)
+        with self._mu:
+            return self._metrics.get(name)
+
+    def snapshot(self) -> list:
+        """The registered metrics as a list, captured under the registry
+        lock.  Daemons register metrics lazily (first use), so a scrape
+        racing a registration must not iterate the mutating dict — both
+        ``expose()`` and the time-series scraper walk this snapshot
+        instead, outside the lock."""
+        with self._mu:
+            return list(self._metrics.values())
 
     def expose(self) -> str:
-        with self._mu:
-            return "\n".join(m.expose() for m in self._metrics.values()) + "\n"
+        # per-metric expose() takes each metric's own lock; holding the
+        # registry lock across that walk would nest registry-lock →
+        # metric-lock against every observe() in flight — snapshot the
+        # dict under the lock, render outside it
+        return "\n".join(m.expose() for m in self.snapshot()) + "\n"
 
 
 class ClientMetrics:

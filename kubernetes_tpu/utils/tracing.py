@@ -429,6 +429,19 @@ class Tracer:
 
                 logging.getLogger("kubernetes_tpu.tracing").exception(
                     "flight-recorder dump write failed (in-memory copy kept)")
+        # off-box shipping (outside _mu: offer() takes the shipper's own
+        # lock, and a slow sink must never serialize the recorder).  Lazy
+        # import — telemetry imports tracing, so the edge must point this
+        # way only at call time.
+        try:
+            from . import telemetry
+
+            shp = telemetry.current()
+            if shp is not None:
+                shp.offer({"kind": "flight_dump", "reason": reason,
+                           "dump": snap})
+        except Exception:  # noqa: BLE001 - recording must never crash
+            pass
         return snap
 
     def flight_snapshot(self) -> dict:

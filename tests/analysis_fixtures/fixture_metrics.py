@@ -7,6 +7,7 @@ a metric).  Never imported by the live tree."""
 import collections
 
 from kubernetes_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+from kubernetes_tpu.utils.slo import QuantileSLI, RatioSLI
 
 
 def build_bad_registry() -> Registry:
@@ -28,6 +29,16 @@ def duplicate_registrations():
     return first, second
 
 
+def slo_specs():
+    # MN405: SLIs over metric names no scanned file registers — by
+    # keyword (QuantileSLI) and by position + keyword mix (RatioSLI)
+    missing_q = QuantileSLI(metric="fixture_missing_latency_microseconds",
+                            threshold=1.0)
+    missing_r = RatioSLI("fixture_missing_bad_total",
+                         total_metric="fixture_missing_all_total")
+    return missing_q, missing_r
+
+
 class Clean:
     """Conforming constructions: zero findings expected here."""
 
@@ -39,3 +50,8 @@ class Clean:
         # the stdlib Counter is NOT a metric: no import from a metrics
         # module binds this name, so the pass must ignore it
         self.tally = collections.Counter("AbCdEf")
+        # SLIs over names registered above resolve: MN405 stays silent
+        self.ok_sli_q = QuantileSLI("fixture_ok_latency_seconds",
+                                    threshold=2.0, quantile="p99")
+        self.ok_sli_r = RatioSLI(bad_metric="fixture_ok_events_total",
+                                 total_metric="fixture_ok_depth")

@@ -1,0 +1,29 @@
+"""A module opening wave-phase spans from OUTSIDE the declared hot
+scope (TC504 fixture).  Never imported: the tests add this file to the
+tracecov pass's scanned paths but NOT to ``hot_modules``, so its
+``.wave(`` / ``.complete(..., cat="phase")`` calls escape the
+TC501/TC503 gates — exactly the drift TC504 exists to catch.
+
+The ``cat="trace"`` complete BEFORE the wave call pins the exemption:
+background categories are not wave phases, so the finding anchors at the
+``.wave(`` line, not here."""
+
+from kubernetes_tpu.utils import tracing
+
+
+def background_marker(t0, t1):
+    tr = tracing.current()
+    if tr is not None:
+        tr.complete("background", t0, t1, cat="trace")  # NOT a wave phase
+
+
+def rogue_wave(pods):
+    tr = tracing.current()
+    with (tr.wave(len(pods)) if tr is not None else tracing.NULL_SPAN):
+        return len(pods)
+
+
+def rogue_phase(t0, t1):
+    tr = tracing.current()
+    if tr is not None:
+        tr.complete("rogue", t0, t1, cat="phase")

@@ -850,6 +850,10 @@ MATRIX = {
         world="local", exact=True,
         check=lambda w, plan: w.backend.stats["frontier_fallbacks"] > 0),
     "store.wal.append": dict(world="wal"),  # special-cased crash/recover run
+    # special-cased collector-down run: shipping is OFF the decision
+    # path, so the invariant is exact oracle bindings + degraded-to-
+    # local-ring visibility (ISSUE 13)
+    "telemetry.ship": dict(world="telemetry"),
     "remote.request": dict(
         spec=dict(mode="error", first_n=2,
                   error_factory=lambda: urllib.error.URLError(
@@ -902,12 +906,75 @@ def _run_wal_matrix(tmp_path, oracle_bindings):
     store2.close()
 
 
+def _run_telemetry_matrix(oracle_bindings):
+    """Collector dead for the whole run: every ship attempt faults, the
+    batches degrade to the shipper's local dead ring after retry +
+    backoff, the local flight recorder keeps its dumps, and the wave
+    pipeline neither stalls nor diverges — a dead collector must never
+    stall a wave."""
+    from kubernetes_tpu.utils import telemetry, timeseries, tracing
+
+    class _NeverSink:
+        def ship(self, batch):  # the armed fault fires before the sink
+            raise AssertionError("sink reached while collector fault armed")
+
+    w = World()
+    tracing.enable()
+    plan = FaultPlan(seed=7).on("telemetry.ship", mode="error")
+    try:
+        store = timeseries.enable(w.sched.metrics.registry, interval_s=1.0,
+                                  clock=w.clock, start_thread=False)
+        shp = telemetry.enable(_NeverSink(),
+                               registry=w.sched.metrics.registry,
+                               start_thread=False, retries=2,
+                               backoff_s=0.0, sleep=lambda s: None)
+        store.add_observer(telemetry.timeseries_observer(shp))
+        with plan.armed():
+            w.create_workload()
+            w.drive()
+            assert w.converged(), "cluster never converged under dead collector"
+            store.sample_once()  # scrape -> observer -> offer
+            snap = tracing.current().dump("telemetry-matrix",
+                                          txn="telemetry-matrix-corr")
+            shp.drain_all()  # every ship attempt faults -> dead ring
+        assert plan.fired["telemetry.ship"] > 0, "fault never fired"
+        # convergence unaffected AND decisions untouched: shipping is off
+        # the decision path entirely, so the map matches the oracle bit
+        # for bit
+        assert w.bindings() == oracle_bindings
+        stats = shp.stats()
+        assert stats["shipped"] == 0
+        assert stats["dead_lettered"] > 0, "batches did not degrade to the ring"
+        assert stats["ship_retries"] > 0, "retry+backoff never engaged"
+        assert stats["queued"] == 0, "drain left records queued (stall risk)"
+        # the local ring holds what the collector never got — flight dump
+        # included, still correlated by the attrs it was taken with
+        dead_kinds = {r.get("kind") for r in shp.dead}
+        assert "flight_dump" in dead_kinds and "timeseries" in dead_kinds
+        # the fault notification's own flight dump (fault:telemetry.ship,
+        # taken from INSIDE the failing ship attempt) is refused — that
+        # feedback edge would otherwise keep the queue non-empty forever
+        assert stats["feedback_dropped"] > 0
+        assert all(r.get("reason") != "fault:telemetry.ship"
+                   for r in shp.dead)
+        # and the in-process recorder itself is intact
+        assert snap in list(tracing.current().dumps)
+        assert snap["attrs"]["txn"] == "telemetry-matrix-corr"
+    finally:
+        telemetry.disable()
+        timeseries.disable()
+        tracing.disable()
+
+
 @pytest.mark.parametrize("point", sorted(MATRIX))
 def test_fault_matrix_converges_to_oracle_bindings(point, oracle_bindings,
                                                   tmp_path):
     scenario = MATRIX[point]
     if scenario["world"] == "wal":
         _run_wal_matrix(tmp_path, oracle_bindings)
+        return
+    if scenario["world"] == "telemetry":
+        _run_telemetry_matrix(oracle_bindings)
         return
 
     server = None

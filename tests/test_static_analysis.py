@@ -435,6 +435,10 @@ def test_metrics_fixture_codes_and_locations(metrics_findings):
         ("MN402", "build_bad_registry.client_things_seen"),
         ("MN403", "build_bad_registry.scheduler_wait"),
         ("MN404", "duplicate_registrations.dup_metric_total"),
+        # SLIs over unregistered metric names: keyword and positional
+        ("MN405", "slo_specs.fixture_missing_latency_microseconds"),
+        ("MN405", "slo_specs.fixture_missing_bad_total"),
+        ("MN405", "slo_specs.fixture_missing_all_total"),
     }
     assert got == expected, f"got {sorted(got)}"
     by_key = {(f.code, f.symbol): f.line for f in metrics_findings}
@@ -446,6 +450,9 @@ def test_metrics_fixture_codes_and_locations(metrics_findings):
     # the duplicate finding names the FIRST registration site
     assert "first registered at" in messages[
         "duplicate_registrations.dup_metric_total"]
+    # the blind-SLO finding says what it means for the burn-rate engine
+    assert "permanently blind" in messages[
+        "slo_specs.fixture_missing_latency_microseconds"]
 
 
 def test_metrics_fixture_exemptions_stay_clean(metrics_findings):
@@ -461,8 +468,11 @@ def test_metrics_fixture_exemptions_stay_clean(metrics_findings):
 
 TC_PATH = f"{FIXTURES}/fixture_tracecov.py"
 TC_HOT_PATH = f"{FIXTURES}/fixture_tracecov_hot.py"
+TC_PHASE_PATH = f"{FIXTURES}/fixture_tracecov_phase.py"
 TC_SCOPE = {
-    "paths": [TC_PATH, TC_HOT_PATH],
+    # the phase fixture is SCANNED but deliberately absent from
+    # hot_modules: its wave-phase spans must trip TC504
+    "paths": [TC_PATH, TC_HOT_PATH, TC_PHASE_PATH],
     "hot_modules": [TC_PATH, TC_HOT_PATH],
     "phase_files": [TC_PATH],
 }
@@ -493,13 +503,22 @@ def test_tracecov_fixture_codes_and_locations(tracecov_findings):
         # the marker-free hot-path module; the marker-BEARING hot module
         # (fixture_tracecov.py itself is in the hot scope) stays silent
         ("TC503", TC_HOT_PATH, "<module>"): 1,
+        # wave-phase spans from outside the hot scope anchor at the FIRST
+        # wave-phase marker — the .wave( call, NOT the earlier
+        # cat="trace" complete (background categories are exempt)
+        ("TC504", TC_PHASE_PATH, "<module>"): _fixture_line(
+            TC_PHASE_PATH, "with (tr.wave(len(pods))"),
     }
     assert got == expected, f"got {sorted(got)}"
-    messages = {f.symbol: f.message for f in tracecov_findings}
+    messages = {f.path + ":" + f.symbol: f.message for f in tracecov_findings}
     assert "dump-on-fault here has no trace context" in messages[
-        "unspanned_seam.fixture.unspanned"]
-    assert "`.complete('bad', ...)`" in messages["PhaseTimers.bad_phase.bad_s"]
-    assert "the tracing layer is not even imported" in messages["<module>"]
+        TC_PATH + ":unspanned_seam.fixture.unspanned"]
+    assert "`.complete('bad', ...)`" in messages[
+        TC_PATH + ":PhaseTimers.bad_phase.bad_s"]
+    assert "the tracing layer is not even imported" in messages[
+        TC_HOT_PATH + ":<module>"]
+    assert "not listed in HOT_PATH_MODULES" in messages[
+        TC_PHASE_PATH + ":<module>"]
 
 
 def test_tracecov_fixture_exemptions_stay_clean(tracecov_findings):

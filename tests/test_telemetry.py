@@ -1,0 +1,728 @@
+"""Continuous telemetry (ISSUE 13): the in-process time-series store,
+the multi-window burn-rate SLO engine, the off-box shipper, and the
+shared daemon health surface.
+
+Four tiers:
+
+1. time-series units — scrape-ring shapes per metric kind, windowed
+   queries/deltas, ring bounds, and ring correctness under concurrent
+   ``observe()`` / ``observe_many()`` writers;
+2. burn-rate units on a fake clock — fast/slow window interaction (both
+   must burn), recovery hysteresis, no-data-is-never-a-breach;
+3. shipper units — retry/backoff classification, the dead ring, queue
+   overflow, the ship-time feedback guard, file + HTTP sinks;
+4. end to end — an SLO breach fires a flight dump whose txn-correlated
+   contents arrive at the apiserver's ``/telemetry`` ingest, and every
+   daemon's health server answers the shared route contract.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.utils import slo, telemetry, timeseries, tracing
+from kubernetes_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+from kubernetes_tpu.utils.slo import (
+    SLO,
+    BurnRateEvaluator,
+    QuantileSLI,
+    RatioSLI,
+)
+from kubernetes_tpu.utils.telemetry import FileSink, HTTPSink, TelemetryShipper
+from kubernetes_tpu.utils.timeseries import TimeSeriesStore
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def advance(self, dt):
+        self.now += dt
+
+    def __call__(self):
+        return self.now
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_globals():
+    yield
+    telemetry.disable()
+    timeseries.disable()
+    tracing.disable()
+
+
+def _store(registry, clock):
+    return TimeSeriesStore(registry, interval_s=1.0, capacity=600,
+                           clock=clock)
+
+
+# =====================================================================
+# 1. time-series store units
+# =====================================================================
+
+def test_scrape_tracks_per_metric_kind():
+    clock = FakeClock()
+    r = Registry()
+    c = r.register(Counter("work_done_total"))
+    g = r.register(Gauge("queue_depth"))
+    h = r.register(Histogram("op_latency_microseconds"))
+    store = _store(r, clock)
+
+    c.inc(3)
+    g.set(7)
+    h.observe(2000.0)
+    clock.advance(1.0)
+    out = store.sample_once()
+
+    tracks = store.tracks()
+    assert "work_done_total" in tracks
+    assert "queue_depth" in tracks
+    for suffix in (":p50", ":p90", ":p99", ":count", ":sum"):
+        assert f"op_latency_microseconds{suffix}" in tracks
+    assert store.last("work_done_total") == 3.0
+    assert store.last("queue_depth") == 7.0
+    assert store.last("op_latency_microseconds:count") == 1.0
+    assert store.last("op_latency_microseconds:sum") == 2000.0
+    # the scrape returns exactly what it appended (the shipper's batch)
+    assert {s[0] for s in out} == set(tracks)
+
+
+def test_query_window_delta_and_rate():
+    clock = FakeClock()
+    r = Registry()
+    c = r.register(Counter("events_total"))
+    store = _store(r, clock)
+    for _ in range(10):
+        clock.advance(1.0)
+        c.inc(2)
+        store.sample_once()
+    # full ring vs window (window edge is inclusive: t >= now - w)
+    assert len(store.query("events_total")) == 10
+    assert len(store.query("events_total", window_s=3.0)) == 4
+    assert store.delta("events_total", window_s=5.0) == pytest.approx(10.0)
+    assert store.rate("events_total", window_s=5.0) == pytest.approx(2.0)
+    # fewer than two samples in the window: no data, not a crash
+    assert store.delta("events_total", window_s=0.5) == 0.0
+    assert store.delta("missing_track", window_s=5.0) == 0.0
+
+
+def test_ring_capacity_bounds_memory():
+    clock = FakeClock()
+    r = Registry()
+    c = r.register(Counter("events_total"))
+    store = TimeSeriesStore(r, capacity=5, clock=clock)
+    for _ in range(20):
+        clock.advance(1.0)
+        c.inc()
+        store.sample_once()
+    samples = store.query("events_total")
+    assert len(samples) == 5
+    assert samples[-1][1] == 20.0  # newest kept, oldest evicted
+
+
+def test_to_dict_serializes_nonfinite_as_none():
+    clock = FakeClock()
+    r = Registry()
+    h = r.register(Histogram("lat_microseconds", buckets=[1.0, 2.0]))
+    store = _store(r, clock)
+    h.observe(1e9)  # beyond the last bucket: quantile is +inf
+    clock.advance(1.0)
+    store.sample_once()
+    doc = store.to_dict()
+    assert doc["enabled"] and doc["scrapes"] == 1
+    p99 = doc["tracks"]["lat_microseconds:p99"]
+    assert p99[-1][1] is None  # not Infinity
+    json.dumps(doc)  # strict-JSON serializable end to end
+
+
+def test_observer_errors_never_kill_the_scrape():
+    clock = FakeClock()
+    r = Registry()
+    r.register(Counter("events_total"))
+    store = _store(r, clock)
+    seen = []
+    store.add_observer(lambda samples: seen.append(len(samples)))
+    store.add_observer(lambda samples: 1 / 0)
+    clock.advance(1.0)
+    store.sample_once()
+    clock.advance(1.0)
+    store.sample_once()
+    assert store.scrapes == 2
+    assert store.observer_errors == 2
+    assert len(seen) == 2  # the healthy observer still ran every scrape
+
+
+def test_scrape_ring_correct_under_concurrent_writers():
+    """Counters/histograms hammered from writer threads while a scraper
+    thread samples: every scraped value is a consistent snapshot — the
+    count track is monotonic, sum tracks count (no torn read between a
+    histogram's buckets, total and sum), and the final scrape sees the
+    final totals."""
+    clock = FakeClock()
+    r = Registry()
+    c = r.register(Counter("hits_total"))
+    h = r.register(Histogram("work_microseconds"))
+    store = _store(r, clock)
+    stop = threading.Event()
+    N, VAL = 200, 3.0
+
+    def writer():
+        for i in range(N):
+            c.inc()
+            if i % 2:
+                h.observe(VAL)
+            else:
+                h.observe_many(VAL, 3)
+
+    def scraper():
+        while not stop.is_set():
+            clock.advance(0.01)
+            store.sample_once()
+
+    writers = [threading.Thread(target=writer) for _ in range(4)]
+    st = threading.Thread(target=scraper)
+    st.start()
+    for w in writers:
+        w.start()
+    for w in writers:
+        w.join()
+    stop.set()
+    st.join()
+    clock.advance(0.01)
+    store.sample_once()  # final scrape after quiescence
+
+    counts = [v for _, v in store.query("work_microseconds:count")]
+    sums = [v for _, v in store.query("work_microseconds:sum")]
+    hits = [v for _, v in store.query("hits_total")]
+    assert counts == sorted(counts) and hits == sorted(hits)  # monotonic
+    # every (count, sum) pair is one consistent state() snapshot: all
+    # observations carry the same value, so sum == count * VAL exactly
+    n_obs_per_writer = (N // 2) + (N - N // 2) * 3
+    assert counts[-1] == 4 * n_obs_per_writer
+    assert hits[-1] == 4 * N
+    for cnt, sm in zip(counts, sums):
+        assert sm == pytest.approx(cnt * VAL)
+
+
+def test_registry_expose_snapshots_under_lock():
+    """Registry.expose()/snapshot() race a concurrent register(): no
+    RuntimeError from dict mutation mid-walk, and the rendered text is
+    parseable exposition output."""
+    r = Registry()
+    errs = []
+
+    def registrar():
+        for i in range(300):
+            r.register(Counter(f"late_metric_{i}_total"))
+
+    def exposer():
+        try:
+            for _ in range(300):
+                text = r.expose()
+                assert text.endswith("\n") or text == ""
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=registrar),
+               threading.Thread(target=exposer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert "late_metric_299_total" in r.expose()
+
+
+# =====================================================================
+# 2. burn-rate evaluator units (fake clock)
+# =====================================================================
+
+def _ratio_world(objective=0.99, fast=10.0, slow=50.0,
+                 fast_burn=14.4, slow_burn=6.0, recovery=3):
+    clock = FakeClock()
+    r = Registry()
+    bad = r.register(Counter("bad_total"))
+    total = r.register(Counter("all_total"))
+    store = _store(r, clock)
+    spec = SLO(name="x", sli=RatioSLI(bad_metric="bad_total",
+                                      total_metric="all_total"),
+               objective=objective, fast_window_s=fast, slow_window_s=slow,
+               fast_burn=fast_burn, slow_burn=slow_burn,
+               recovery_evals=recovery)
+    ev = BurnRateEvaluator(slos=[spec], store=store)
+    return clock, bad, total, store, ev
+
+
+def test_no_data_is_never_a_breach():
+    clock, bad, total, store, ev = _ratio_world()
+    for _ in range(60):
+        clock.advance(1.0)
+        store.sample_once()
+        assert ev.evaluate() == []  # zero traffic: None fraction, no event
+    assert not ev.state("x")["breached"]
+
+
+def test_fast_window_alone_does_not_page():
+    """A cliff shorter than the slow window: the fast burn exceeds its
+    threshold but the slow window, averaged over mostly-good traffic,
+    stays under — no breach (the multi-window AND)."""
+    clock, bad, total, store, ev = _ratio_world()
+    # 50 ticks of clean traffic to fill the slow window
+    for _ in range(50):
+        clock.advance(1.0)
+        total.inc(100)
+        store.sample_once()
+        assert ev.evaluate() == []
+    # 2 bad ticks at 80% errors: the fast window (10 ticks) sees
+    # 160/1000 = 16x burn at the 1% budget, but the slow window dilutes
+    # to 160/5000 = 3.2x < 6x — still silent
+    for _ in range(2):
+        clock.advance(1.0)
+        total.inc(100)
+        bad.inc(80)
+        store.sample_once()
+    fast_frac = ev.slos[0].sli.bad_fraction(store, 10.0)
+    slow_frac = ev.slos[0].sli.bad_fraction(store, 50.0)
+    assert fast_frac / 0.01 >= 14.4  # fast window IS burning
+    assert slow_frac / 0.01 < 6.0    # slow window is not
+    assert ev.evaluate() == []
+    assert not ev.state("x")["breached"]
+
+
+def test_sustained_burn_breaches_and_recovery_has_hysteresis():
+    clock, bad, total, store, ev = _ratio_world()
+    events = []
+    # sustained 100% bad traffic until both windows burn
+    for _ in range(60):
+        clock.advance(1.0)
+        total.inc(10)
+        bad.inc(10)
+        store.sample_once()
+        events += ev.evaluate()
+    breaches = [e for e in events if e["type"] == "breach"]
+    assert len(breaches) == 1  # latched: one page, not one per scrape
+    assert breaches[0]["slo"] == "x"
+    assert breaches[0]["fast_burn"] >= 14.4
+    assert breaches[0]["slow_burn"] >= 6.0
+    assert ev.state("x")["breached"]
+    assert ev.breaches_fired == 1
+
+    # clean traffic again: the fast window clears quickly, but recovery
+    # needs `recovery_evals` CONSECUTIVE clean evaluations
+    events = []
+    cleared_at = None
+    for i in range(60):
+        clock.advance(1.0)
+        total.inc(10)
+        store.sample_once()
+        got = ev.evaluate()
+        events += got
+        if got and got[-1]["type"] == "recovered" and cleared_at is None:
+            cleared_at = i
+    assert [e["type"] for e in events] == ["recovered"]
+    assert not ev.state("x")["breached"]
+    # hysteresis: recovery waited for 3 clean evals after the burn
+    # condition first cleared, not the first clean tick
+    assert cleared_at is not None and cleared_at >= 2
+
+
+def test_oscillating_burn_does_not_refire_every_scrape():
+    """Burn flaps around the threshold while breached: the clean counter
+    resets on every burning eval, so the breach stays latched and fires
+    no second dump."""
+    clock, bad, total, store, ev = _ratio_world(recovery=3)
+    for _ in range(60):
+        clock.advance(1.0)
+        total.inc(10)
+        bad.inc(10)
+        store.sample_once()
+        ev.evaluate()
+    assert ev.breaches_fired == 1
+    # alternate clean/bad ticks: never 3 consecutive clean evals
+    for i in range(20):
+        clock.advance(1.0)
+        total.inc(10)
+        if i % 2:
+            bad.inc(10)
+        store.sample_once()
+        ev.evaluate()
+    assert ev.breaches_fired == 1  # still the one page
+    assert ev.state("x")["breached"]
+
+
+def test_quantile_sli_reads_the_scraped_track():
+    clock = FakeClock()
+    r = Registry()
+    h = r.register(Histogram("lat_microseconds"))
+    store = _store(r, clock)
+    sli = QuantileSLI(metric="lat_microseconds", threshold=5000.0)
+    assert sli.bad_fraction(store, 10.0) is None  # no samples yet
+    for v in (1000.0, 1000.0, 900000.0, 900000.0):
+        h.observe_many(v, 50)
+        clock.advance(1.0)
+        store.sample_once()
+    frac = sli.bad_fraction(store, 10.0)
+    assert frac is not None and 0.0 < frac <= 1.0
+
+
+def test_breach_fires_flight_dump_with_window_attached():
+    tracing.enable()
+    clock, bad, total, store, ev = _ratio_world()
+    for _ in range(60):
+        clock.advance(1.0)
+        total.inc(10)
+        bad.inc(10)
+        store.sample_once()
+        ev.evaluate()
+    tr = tracing.current()
+    dumps = [d for d in tr.dumps if d["reason"] == "slo:x"]
+    assert len(dumps) == 1
+    attrs = dumps[0]["attrs"]
+    assert attrs["fast_burn"] >= 14.4 and attrs["slow_burn"] >= 6.0
+    assert set(attrs["window"]) == {"bad_total", "all_total"}
+    assert attrs["window"]["bad_total"]  # the offending samples ride along
+
+
+def test_monitor_attaches_to_the_active_store():
+    clock = FakeClock()
+    r = Registry()
+    total = r.register(Counter("scheduler_schedule_attempts_total"))
+    store = timeseries.enable(r, clock=clock, start_thread=False)
+    ev = slo.monitor(store=store)
+    assert ev is not None and ev.store is store
+    # evaluation now rides every scrape via the observer hook
+    clock.advance(1.0)
+    total.inc()
+    store.sample_once()
+    assert timeseries.current() is store
+    assert slo.monitor(store=None, slos=[]) is not None  # active store found
+
+
+# =====================================================================
+# 3. shipper units
+# =====================================================================
+
+class _FlakySink:
+    def __init__(self, fail_times, exc=None):
+        self.fail_times = fail_times
+        self.exc = exc or ConnectionResetError("collector hiccup")
+        self.batches = []
+
+    def ship(self, batch):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise self.exc
+        self.batches.append(list(batch))
+
+
+def test_shipper_retries_transient_failures_then_delivers():
+    sink = _FlakySink(fail_times=2)
+    shp = TelemetryShipper(sink, retries=3, backoff_s=0.0,
+                           sleep=lambda s: None)
+    assert shp.offer({"kind": "x"})
+    assert shp.drain_all() == 1
+    s = shp.stats()
+    assert s["shipped"] == 1 and s["ship_retries"] == 2
+    assert s["dead_lettered"] == 0 and s["dead"] == 0
+
+
+def test_shipper_dead_letters_after_retry_exhaustion():
+    sink = _FlakySink(fail_times=99)
+    shp = TelemetryShipper(sink, retries=2, backoff_s=0.0,
+                           sleep=lambda s: None)
+    shp.offer({"kind": "x"})
+    shp.offer({"kind": "y"})
+    assert shp.drain_all() == 0
+    s = shp.stats()
+    assert s["dead_lettered"] == 2 and s["dead"] == 2
+    assert s["ship_retries"] == 2  # one batch, two re-attempts
+    assert [r["kind"] for r in shp.dead] == ["x", "y"]
+
+
+def test_shipper_fatal_http_4xx_skips_retries():
+    err = urllib.error.HTTPError("u", 400, "Bad Request", None, None)
+    sink = _FlakySink(fail_times=99, exc=err)
+    shp = TelemetryShipper(sink, retries=5, backoff_s=0.0,
+                           sleep=lambda s: None)
+    shp.offer({"kind": "x"})
+    shp.drain_all()
+    s = shp.stats()
+    assert s["dead_lettered"] == 1
+    assert s["ship_retries"] == 0  # fatal classification: no retry burn
+
+
+def test_shipper_backoff_doubles_and_caps():
+    sleeps = []
+    sink = _FlakySink(fail_times=99)
+    shp = TelemetryShipper(sink, retries=4, backoff_s=0.1, backoff_max_s=0.3,
+                           sleep=sleeps.append)
+    shp.offer({"kind": "x"})
+    shp.drain_all()
+    assert sleeps == pytest.approx([0.1, 0.2, 0.3, 0.3])
+
+
+def test_shipper_overflow_drops_and_counts():
+    shp = TelemetryShipper(_FlakySink(0), queue_max=2)
+    assert shp.offer({"n": 1}) and shp.offer({"n": 2})
+    assert not shp.offer({"n": 3})
+    assert shp.stats()["overflow"] == 1
+    assert shp.pending() == 2
+
+
+def test_dead_ring_is_bounded():
+    sink = _FlakySink(fail_times=10 ** 6)
+    shp = TelemetryShipper(sink, retries=0, dead_max=4, batch_max=1,
+                           backoff_s=0.0, sleep=lambda s: None)
+    for i in range(10):
+        shp.offer({"n": i})
+    shp.drain_all()
+    assert len(shp.dead) == 4
+    assert [r["n"] for r in shp.dead] == [6, 7, 8, 9]  # newest kept
+
+
+def test_feedback_records_from_inside_ship_are_refused():
+    """Instrumentation fired from inside a ship attempt (fault hooks,
+    dump-on-fault) must not feed the queue being drained — the guard
+    drops it and counts it."""
+    shp = TelemetryShipper(None, retries=0, backoff_s=0.0,
+                           sleep=lambda s: None)
+
+    class _ReentrantSink:
+        def ship(self, batch):
+            assert not shp.offer({"kind": "feedback"})  # refused
+
+    shp.sink = _ReentrantSink()
+    shp.offer({"kind": "x"})
+    assert shp.drain_all() == 1
+    assert shp.stats()["feedback_dropped"] == 1
+    assert shp.pending() == 0
+
+
+def test_file_sink_writes_json_lines(tmp_path):
+    path = str(tmp_path / "telemetry.ndjson")
+    shp = TelemetryShipper(FileSink(path))
+    shp.offer({"kind": "a", "n": 1})
+    shp.offer({"kind": "b", "n": 2})
+    assert shp.drain_all() == 2
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert [r["kind"] for r in lines] == ["a", "b"]
+
+
+def test_worker_thread_ships_without_explicit_drains(tmp_path):
+    path = str(tmp_path / "telemetry.ndjson")
+    shp = telemetry.enable(FileSink(path), flush_interval_s=0.01)
+    for i in range(5):
+        shp.offer({"n": i})
+    deadline = threading.Event()
+    for _ in range(200):
+        if shp.stats()["shipped"] == 5:
+            break
+        deadline.wait(0.01)
+    telemetry.disable()  # stop() drains the tail
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert [r["n"] for r in lines] == [0, 1, 2, 3, 4]
+
+
+def test_timeseries_observer_wraps_scrape_batches():
+    clock = FakeClock()
+    r = Registry()
+    c = r.register(Counter("events_total"))
+    store = _store(r, clock)
+    shp = TelemetryShipper(_FlakySink(0))
+    store.add_observer(telemetry.timeseries_observer(shp))
+    c.inc()
+    clock.advance(1.0)
+    store.sample_once()
+    shp.drain_all()
+    [batch] = shp.sink.batches
+    [rec] = batch
+    assert rec["kind"] == "timeseries"
+    assert ["events_total", 1.0, 1.0] in rec["samples"]
+
+
+# =====================================================================
+# 4. end to end: health surface + off-box breach shipping
+# =====================================================================
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        body = resp.read()
+    return ctype, body
+
+
+def test_serve_health_exposes_the_shared_route_contract():
+    """Every daemon goes through daemon.serve_health: one server shape,
+    five routes, disabled subsystems answer {"enabled": false}."""
+    from kubernetes_tpu.daemon import serve_health
+
+    r = Registry()
+    r.register(Counter("daemon_things_total")).inc(3)
+    srv = serve_health(0, r)
+    try:
+        base = f"http://127.0.0.1:{srv.local_port}"
+        _, body = _get(base + "/healthz")
+        assert json.loads(body) == {"status": "ok"}
+        ctype, body = _get(base + "/metrics")
+        assert "text/plain" in ctype
+        assert "daemon_things_total 3" in body.decode()
+        for route in ("/debug/traces", "/debug/flightrecorder",
+                      "/debug/timeseries"):
+            _, body = _get(base + route)
+            assert json.loads(body) == {"enabled": False}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/not-a-route")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_serve_health_serves_live_timeseries_and_traces():
+    from kubernetes_tpu.daemon import serve_health
+
+    clock = FakeClock()
+    r = Registry()
+    c = r.register(Counter("daemon_things_total"))
+    tracing.enable()
+    store = timeseries.enable(r, clock=clock, start_thread=False)
+    srv = serve_health(0, r)
+    try:
+        c.inc()
+        clock.advance(1.0)
+        store.sample_once()
+        tracing.current().dump("probe")
+        base = f"http://127.0.0.1:{srv.local_port}"
+        _, body = _get(base + "/debug/timeseries")
+        doc = json.loads(body)
+        assert doc["enabled"] and "daemon_things_total" in doc["tracks"]
+        _, body = _get(base + "/debug/flightrecorder")
+        doc = json.loads(body)
+        assert [d["reason"] for d in doc["dumps"]] == ["probe"]
+    finally:
+        srv.stop()
+
+
+def test_apiserver_serves_the_same_debug_routes():
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.store import Store
+
+    server = APIServer(Store())
+    server.start()
+    try:
+        ctype, body = _get(server.url + "/metrics")
+        assert "text/plain" in ctype
+        assert "apiserver_request_count" in body.decode()
+        _, body = _get(server.url + "/debug/timeseries")
+        assert json.loads(body) == {"enabled": False}
+        # debug routes are GET-only on the apiserver
+        req = urllib.request.Request(server.url + "/metrics", data=b"x",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 405
+    finally:
+        server.stop()
+
+
+def test_telemetry_ingest_rejects_undecodable_payloads():
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.store import Store
+
+    server = APIServer(Store())
+    server.start()
+    try:
+        req = urllib.request.Request(server.url + "/telemetry",
+                                     data=b"\xff{not json", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+        assert server.telemetry_snapshot() == []
+    finally:
+        server.stop()
+
+
+def test_e2e_breach_ships_correlated_flight_dump_off_process():
+    """The acceptance path: scraped rings -> burn-rate breach -> flight
+    dump carrying the txn-correlated wave spans -> HTTP sink -> the
+    apiserver's /telemetry ring, queryable over the wire."""
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.store import Store
+
+    server = APIServer(Store())
+    server.start()
+    clock = FakeClock()
+    r = Registry()
+    bad = r.register(Counter("scheduler_bind_requeues_total"))
+    total = r.register(Counter("scheduler_schedule_attempts_total"))
+    try:
+        tracer = tracing.enable(clock=clock)
+        store = timeseries.enable(r, clock=clock, start_thread=False)
+        ev = slo.monitor(
+            slos=[SLO(name="bind_requeue_rate",
+                      sli=RatioSLI(
+                          bad_metric="scheduler_bind_requeues_total",
+                          total_metric="scheduler_schedule_attempts_total"),
+                      fast_window_s=10.0, slow_window_s=50.0)],
+            store=store)
+        shp = telemetry.enable(HTTPSink(server.url + "/telemetry"),
+                               registry=r, start_thread=False)
+        store.add_observer(telemetry.timeseries_observer(shp))
+
+        # a wave span correlated by txn rides in the recorder ring
+        with tracer.wave(txn="txn-breach-042"):
+            pass
+        for _ in range(60):  # sustained burn: every attempt requeues
+            clock.advance(1.0)
+            total.inc(10)
+            bad.inc(10)
+            store.sample_once()
+        assert ev.breaches_fired == 1
+        shp.drain_all()
+        assert shp.stats()["dead_lettered"] == 0
+
+        dumps = [rec for rec in server.telemetry_snapshot()
+                 if rec.get("kind") == "flight_dump"]
+        assert [d["reason"] for d in dumps] == ["slo:bind_requeue_rate"]
+        dump = dumps[0]["dump"]
+        assert dump["attrs"]["window"]["scheduler_bind_requeues_total"]
+        # the wave that burned the budget is IN the shipped dump, still
+        # carrying its correlation id
+        txns = [w["attrs"].get("txn") for w in dump["waves"]]
+        assert "txn-breach-042" in txns
+        # and the same dump is queryable over the wire (GET /telemetry)
+        _, body = _get(server.url + "/telemetry")
+        doc = json.loads(body)
+        assert doc["kind"] == "TelemetryRecordList"
+        assert any(rec.get("kind") == "flight_dump" for rec in doc["items"])
+    finally:
+        server.stop()
+
+
+def test_enable_continuous_telemetry_wires_the_full_stack(tmp_path):
+    from kubernetes_tpu.daemon import enable_continuous_telemetry
+
+    r = Registry()
+    c = r.register(Counter("daemon_things_total"))
+    sink_path = str(tmp_path / "out.ndjson")
+    store = enable_continuous_telemetry(r, interval_s=999.0,
+                                        sink_spec=sink_path)
+    assert timeseries.current() is store
+    shp = telemetry.current()
+    assert shp is not None and isinstance(shp.sink, FileSink)
+    c.inc()
+    store.sample_once()  # observer chain: scrape -> shipper queue
+    telemetry.disable()  # final drain on stop
+    timeseries.disable()
+    lines = [json.loads(l) for l in open(sink_path) if l.strip()]
+    assert lines and lines[0]["kind"] == "timeseries"
+
+
+def test_telemetry_sink_spec_parsing():
+    from kubernetes_tpu.daemon import telemetry_sink
+
+    assert isinstance(telemetry_sink("http://host:1/telemetry"), HTTPSink)
+    assert isinstance(telemetry_sink("https://host/t"), HTTPSink)
+    assert isinstance(telemetry_sink("/tmp/x.ndjson"), FileSink)

@@ -19,6 +19,7 @@ Four tiers:
 from __future__ import annotations
 
 import json
+import os
 import threading
 
 import pytest
@@ -403,6 +404,36 @@ def _wal_fire(point, tmp_path):
     w.store.close()
 
 
+def _telemetry_fire(point):
+    """telemetry.ship fires inside the shipper's drain, off every wave
+    path: warm waves fill the ring first, then a scrape batch is
+    offered and drained synchronously with the plan armed."""
+    from kubernetes_tpu.utils import telemetry, timeseries
+
+    w = World()
+    for i in range(8):
+        w.cs.pods.create(make_pod(f"warm-{i:03d}", cpu="200m",
+                                  memory="256Mi"))
+    w.drive(rounds=4, relist_every=0)
+    assert len(tracing.current().ring) >= 1, "warm phase completed no wave"
+    plan = FaultPlan(seed=3).on(point, mode="error")
+    try:
+        store = timeseries.enable(w.sched.metrics.registry, interval_s=1.0,
+                                  clock=w.clock, start_thread=False)
+        shp = telemetry.enable(telemetry.FileSink(os.devnull),
+                               registry=w.sched.metrics.registry,
+                               start_thread=False, retries=1,
+                               backoff_s=0.0, sleep=lambda s: None)
+        store.add_observer(telemetry.timeseries_observer(shp))
+        with plan.armed():
+            store.sample_once()  # scrape -> observer -> offer
+            shp.drain_all()  # every ship attempt hits the armed point
+        assert plan.fired[point] > 0, f"{point}: fault never fired"
+    finally:
+        telemetry.disable()
+        timeseries.disable()
+
+
 @pytest.mark.timeout(180)
 @pytest.mark.parametrize("point", sorted(MATRIX))
 def test_every_fault_point_dumps_the_firing_waves_trace(point, tmp_path):
@@ -417,6 +448,8 @@ def test_every_fault_point_dumps_the_firing_waves_trace(point, tmp_path):
     tr = tracing.enable()
     if scenario["world"] == "wal":
         _wal_fire(point, tmp_path)
+    elif scenario["world"] == "telemetry":
+        _telemetry_fire(point)
     else:
         _warm_then_fire(point, scenario, tmp_path)
 
