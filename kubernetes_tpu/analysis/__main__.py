@@ -3,6 +3,7 @@
     python -m kubernetes_tpu.analysis [--json] [--pass NAME]...
                                       [--baseline PATH | --no-baseline]
                                       [--prune-baseline] [--profile]
+                                      [--changed[=REF]]
                                       [--root DIR] [--list-passes]
 
 Exit codes: 0 = clean (all findings baselined), 1 = unbaselined findings,
@@ -17,9 +18,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 
 from .core import (
+    _CODE_PREFIX_PASS,
     PASS_NAMES,
     BaselineError,
     default_baseline_path,
@@ -29,12 +32,38 @@ from .core import (
     run_analysis,
 )
 
+
+def _changed_files(root: str, ref: str) -> set[str]:
+    """Repo-relative paths changed vs ``ref`` plus untracked files.
+
+    Raises ValueError on a bad ref (surfaced as exit 2): a typo'd ref
+    must not silently report zero files as 'nothing changed'."""
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=root, capture_output=True, text=True,
+    )
+    if diff.returncode != 0:
+        raise ValueError(
+            f"--changed: git diff against {ref!r} failed: "
+            f"{diff.stderr.strip() or 'unknown git error'}"
+        )
+    out = {line.strip() for line in diff.stdout.splitlines() if line.strip()}
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=root, capture_output=True, text=True,
+    )
+    if untracked.returncode == 0:
+        out.update(line.strip() for line in untracked.stdout.splitlines()
+                   if line.strip())
+    return out
+
 PASS_DESCRIPTIONS = {
     "trace": "trace-safety over ops/ (TS1xx: host escapes, Python branches on traced values, set-order nondeterminism)",
     "parity": "oracle↔kernel parity coverage (PC2xx: unmapped predicates/priorities, stale markers)",
     "races": "controller/kubelet race lint (RL3xx: unlocked cross-thread writes, lock-order cycles)",
     "metrics": "metrics-name lint (MN4xx: snake_case names, counters end _total, histograms carry a unit, no duplicate registrations, SLO specs resolve to registered metrics)",
     "tracecov": "trace-coverage lint (TC5xx: fault seams outside spans, unmirrored phase timers, span-free hot-path modules, wave-phase spans outside the hot scope)",
+    "device": "device-contract lint (DC6xx: use-after-donate, unsanctioned host syncs on the wave hot path, shape-bearing values at jit boundaries, snapshot writes bypassing clone-on-write)",
 }
 
 
@@ -69,6 +98,17 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="rewrite the baseline file with stale entries removed "
              "(surviving entries keep their reasons and order)",
+    )
+    parser.add_argument(
+        "--changed",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        metavar="REF",
+        help="report findings only for files changed vs REF (default: HEAD) "
+             "plus untracked files — the full scope is still scanned, so "
+             "cross-file summaries and stale-baseline detection stay exact; "
+             "only the REPORT is diff-scoped",
     )
     parser.add_argument(
         "--profile",
@@ -111,8 +151,20 @@ def main(argv: list[str] | None = None) -> int:
     if args.prune_baseline and report.stale_suppressions:
         removed = prune_baseline(baseline_path, report.stale_suppressions)
         for key in removed:
-            print(f"pruned stale baseline entry: {key}", file=sys.stderr)
+            code = key.split(":", 1)[0]
+            pass_name = _CODE_PREFIX_PASS.get(code[:2], "unknown")
+            print(f"pruned stale baseline entry [{pass_name} {code}]: {key}",
+                  file=sys.stderr)
         report.stale_suppressions = []
+
+    if args.changed is not None:
+        try:
+            changed = _changed_files(args.root or repo_root(), args.changed)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        report.findings = [f for f in report.findings if f.path in changed]
+        report.suppressed = [f for f in report.suppressed if f.path in changed]
 
     if args.json:
         # sort_keys: CI diffs two runs' output textually — field order

@@ -19,7 +19,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-PASS_NAMES = ("trace", "parity", "races", "metrics", "tracecov")
+PASS_NAMES = ("trace", "parity", "races", "metrics", "tracecov", "device")
 
 
 def repo_root() -> str:
@@ -172,7 +172,7 @@ class Report:
 # finding-code prefix -> the pass that can produce it (stale-entry
 # detection must not call a races suppression "stale" in a parity-only run)
 _CODE_PREFIX_PASS = {"TS": "trace", "PC": "parity", "RL": "races",
-                     "MN": "metrics", "TC": "tracecov"}
+                     "MN": "metrics", "TC": "tracecov", "DC": "device"}
 
 
 def _split_baseline(
@@ -210,7 +210,7 @@ def run_analysis(
     """
     import time
 
-    from . import metrics_lint, parity, races, trace_safety, tracecov
+    from . import device_contracts, metrics_lint, parity, races, trace_safety, tracecov
 
     root = root or repo_root()
     passes = list(passes) if passes else list(PASS_NAMES)
@@ -225,6 +225,7 @@ def run_analysis(
         "races": lambda: races.run(root, **scopes.get("races", {})),
         "metrics": lambda: metrics_lint.run(root, **scopes.get("metrics", {})),
         "tracecov": lambda: tracecov.run(root, **scopes.get("tracecov", {})),
+        "device": lambda: device_contracts.run(root, **scopes.get("device", {})),
     }
     findings: list[Finding] = []
     timings: dict[str, float] = {}

@@ -669,7 +669,10 @@ def _local_aliases(
     names (``p = self._pending; q = p``) resolved by fixed point, or —
     the ISSUE 10 slice — a call whose return summary resolves to the
     container (``q = self._get_pending()``, ``q = self._identity(p)``,
-    ``q = ident(p)`` for a module-level helper).  An element alias is a
+    ``q = ident(p)`` for a module-level helper).  Tuple unpacking with
+    matching arity and no starred target (``a, b = self._x, self._y``)
+    aliases pairwise — each (target, value) pair is handled exactly as
+    its standalone assignment would be.  An element alias is a
     ONE-HOP extraction ``x = self._items[k]`` (directly or through a
     container alias).  Any other binding of ANY name in a chain — a
     second assignment, a for/with target, a parameter — breaks the chain
@@ -737,33 +740,46 @@ def _local_aliases(
             return resolve_call(arg, depth + 1)
         return None
 
+    def handle_pair(t: ast.expr, value: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            attr = _is_self_attr(value)
+            if attr is not None and attr in containers:
+                cand[t.id] = attr
+            elif isinstance(value, ast.Name):
+                # `q = p`: a name-to-name link — resolved to a
+                # container only if the whole chain survives the
+                # single-assignment filter below
+                links[t.id] = value.id
+            elif isinstance(value, ast.Call):
+                got = resolve_call(value)
+                if got is not None:
+                    if got[0] == "attr" and got[1] in containers:
+                        cand[t.id] = got[1]
+                    elif got[0] == "name":
+                        links[t.id] = got[1]
+            elif (isinstance(value, ast.Subscript)
+                    and not isinstance(value.value, ast.Subscript)):
+                # one-hop element extraction: x = self._items[k]
+                # or x = p[k]; resolved below once container
+                # aliases are known
+                elem_reads[t.id] = value.value
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            # tuple unpacking with matching arity and no starred
+            # element: each (target, value) pair aliases exactly as the
+            # standalone assignment would; any other unpacking shape
+            # stays unmodeled (silence)
+            if (isinstance(value, (ast.Tuple, ast.List))
+                    and len(value.elts) == len(t.elts)
+                    and not any(isinstance(el, ast.Starred)
+                                for el in list(t.elts) + list(value.elts))):
+                for sub_t, sub_v in zip(t.elts, value.elts):
+                    handle_pair(sub_t, sub_v)
+
     class V(ast.NodeVisitor):
         def visit_Assign(self, node: ast.Assign) -> None:
             for t in node.targets:
                 bind_target(t)
-                if isinstance(t, ast.Name):
-                    attr = _is_self_attr(node.value)
-                    if attr is not None and attr in containers:
-                        cand[t.id] = attr
-                    elif isinstance(node.value, ast.Name):
-                        # `q = p`: a name-to-name link — resolved to a
-                        # container only if the whole chain survives the
-                        # single-assignment filter below
-                        links[t.id] = node.value.id
-                    elif isinstance(node.value, ast.Call):
-                        got = resolve_call(node.value)
-                        if got is not None:
-                            if got[0] == "attr" and got[1] in containers:
-                                cand[t.id] = got[1]
-                            elif got[0] == "name":
-                                links[t.id] = got[1]
-                    elif (isinstance(node.value, ast.Subscript)
-                            and not isinstance(node.value.value,
-                                               ast.Subscript)):
-                        # one-hop element extraction: x = self._items[k]
-                        # or x = p[k]; resolved below once container
-                        # aliases are known
-                        elem_reads[t.id] = node.value.value
+                handle_pair(t, node.value)
             self.generic_visit(node)
 
         def visit_AugAssign(self, node: ast.AugAssign) -> None:

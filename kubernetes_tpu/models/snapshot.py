@@ -1061,7 +1061,7 @@ class Tensorizer:
         n_real = len(node_names)
         if n_real == 0 or not pods:
             return None
-        n_pad = _pad_to(n_real, self.pad_multiple)
+        n_pad = _pad_to(n_real, self.pad_multiple)  # device: static — pad_multiple buckets the node axis at build time
         infos = [node_info_map[n] for n in node_names]
 
         # signatures

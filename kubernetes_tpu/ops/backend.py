@@ -335,7 +335,7 @@ class TPUBatchBackend:
             faults.hit("backend.compact", phase="seed")
             alive = frontier_seed(static, init)
             n_alive = int(alive.sum())
-            width = _pow2_width(n_alive, self.frontier_min_width)
+            width = _pow2_width(n_alive, self.frontier_min_width)  # device: static — pow2 buckets bound compiles to log2(N)
             cstatic, cinit = static, init
             if (width < static.n_pad
                     and n_alive <= self.frontier_compact_frac * static.n_pad):

@@ -1018,10 +1018,10 @@ class FrontierRun:
         then ready-buffer copies)."""
         c_dev, want_dev, alive, n_alive_dev = self._pending
         self._pending = None
-        c_exit = int(c_dev)  # blocks until the loop run completes
+        c_exit = int(c_dev)  # device: sync — blocks until the loop run completes; the one control stall per run
         self.stats["host_syncs"] += 1
-        want = bool(want_dev)
-        n_alive = int(n_alive_dev)
+        want = bool(want_dev)  # device: sync — compaction flag rides the same ready transfer as the cursor
+        n_alive = int(n_alive_dev)  # device: sync — alive count, already host-side once the cursor read returned
         self.stats["chunks"] += c_exit - self._c
         self._c = c_exit
         frac = round(n_alive / max(self._width, 1), 4)
@@ -1040,7 +1040,7 @@ class FrontierRun:
             if self._c >= self._n_chunks:
                 break
             if want:
-                width_new = _pow2_width(n_alive, self.min_width)
+                width_new = _pow2_width(n_alive, self.min_width)  # device: static — pow2 buckets bound compiles to log2(N)
                 if (width_new < self._width
                         and n_alive <= self.compact_frac * self._width):
                     if self.on_compact is not None:
@@ -1054,8 +1054,8 @@ class FrontierRun:
                     self.stats["widths"].append(width_new)
             self._dispatch_loop()
         # final result read: the whole segment's chosen buffer at once
-        buf_host = np.asarray(self._buf)
-        rr = int(self._state.round_robin)
+        buf_host = np.asarray(self._buf)  # device: sync — the whole segment's chosen buffer, once per wave
+        rr = int(self._state.round_robin)  # device: sync — round-robin cursor rides the final result read
         self.stats["host_syncs"] += 1
         chosen_full = np.empty(self._p_real, dtype=np.int64)
         bounds = [start for start, _ in self._regions] + [self._p_real]
@@ -1094,7 +1094,7 @@ class FrontierRun:
 
     def _maybe_compact(self) -> None:
         alive = jnp.any(self._state.still_ok, axis=0) & self._dev.node_exists
-        n_alive = int(jnp.sum(alive))  # the one [N] reduce + sync
+        n_alive = int(jnp.sum(alive))  # device: sync — the one [N] reduce + sync per chunk
         self.stats["host_syncs"] += 1
         frac = round(n_alive / max(self._width, 1), 4)
         self.stats["alive_frac"].append(frac)
@@ -1104,12 +1104,12 @@ class FrontierRun:
             # is readable straight off the wave trace
             tr.instant("frontier.alive", frac=frac, width=self._width,
                        chunk=self.stats["chunks"])
-        width_new = _pow2_width(n_alive, self.min_width)
+        width_new = _pow2_width(n_alive, self.min_width)  # device: static — pow2 buckets bound compiles to log2(N)
         if width_new >= self._width or n_alive > self.compact_frac * self._width:
             return
         if self.on_compact is not None:
             self.on_compact(self._width, width_new, n_alive)
-        js = np.nonzero(np.asarray(alive))[0]
+        js = np.nonzero(np.asarray(alive))[0]  # device: sync — compaction gather indices (mask already reduced)
         self._dev, self._state = gather_node_axis(
             self._dev, self._state, js, width_new)
         self._map = self._map[js]
@@ -1126,7 +1126,7 @@ class FrontierRun:
         chosen_full = np.empty(self._p_real, dtype=np.int64)
         pos = 0
         for chosen_dev, map_snap in self._chunks:
-            part = np.asarray(chosen_dev)
+            part = np.asarray(chosen_dev)  # device: sync — per-chunk result read; D2H copy was pre-staged async
             self.stats["host_syncs"] += 1
             n = min(len(part), self._p_real - pos)
             part = part[:n].astype(np.int64)
@@ -1134,4 +1134,4 @@ class FrontierRun:
             chosen_full[pos:pos + n] = np.where(
                 part >= 0, map_snap[safe], -1)
             pos += n
-        return chosen_full, int(self._state.round_robin)
+        return chosen_full, int(self._state.round_robin)  # device: sync — round-robin cursor, once per segment

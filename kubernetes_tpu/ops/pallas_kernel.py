@@ -680,6 +680,7 @@ def dispatch_batch_pallas(static: BatchStatic, init: InitialState):
     unmaterialized device arrays (see dispatch_batch_arrays)."""
     scalars, ins, p_pad = _pack(static, init)
     weights = tuple(int(static.weights.get(kk, 0)) for kk in WEIGHT_KEYS)
+    # device: static — grid/shape keys are BatchStatic fields, frozen per segment build
     run = _pallas_runner(
         static.n_pad,
         static.static_ok.shape[0],

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Local CI gate, in the order CI runs it:
+#   1. ktpu-analyze — all six passes over the live tree; exits 1 on any
+#      unbaselined finding, 2 on config/baseline errors.
+#   2. the tier-1 analyzer gate tests (fixture pins + live-tree-clean +
+#      wall-time budget), so a pass regression fails even when the live
+#      tree happens to be clean.
+#
+# Usage: scripts/check.sh [ktpu-analyze args...]
+# Extra args are forwarded to ktpu-analyze — e.g. `scripts/check.sh
+# --changed` for a diff-scoped dev loop (full scope still scanned; only
+# the report is filtered to files changed vs HEAD).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== ktpu-analyze =="
+python -m kubernetes_tpu.analysis --profile "$@"
+
+echo "== analyzer gate tests =="
+python -m pytest tests/test_static_analysis.py -q -p no:cacheprovider

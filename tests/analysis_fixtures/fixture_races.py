@@ -339,3 +339,54 @@ class CrossShapeExemptions:
         with self._mu:
             slot = self._slots["a"]
             slot.append(1)  # element alias mutated under the lock: silent
+
+
+class TupleUnpackAliases:
+    """The ISSUE 15 slice: single-assignment tuple unpacking
+    (``a, b = self._x, self._y``) aliases pairwise — mutations through
+    the unpacked names are RL303 findings on the attributes."""
+
+    def __init__(self):
+        self._tup_a = {}
+        self._tup_b = []
+        self._tup_elems = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        a, b = self._tup_a, self._tup_b
+        a["k"] = 1  # RL303 on _tup_a via tuple unpacking
+        b.append("k")  # RL303 on _tup_b via tuple unpacking
+        _k, e = "a", self._tup_elems["a"]
+        e.append(1)  # RL303 on _tup_elems via element pair in an unpack
+
+
+class TupleUnpackExemptions:
+    """NOT flagged: arity mismatch, starred targets, rebinding one of the
+    unpacked names, and unpacking a non-literal RHS all break the alias
+    (over-approximate toward silence)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._x = {}
+        self._y = {}
+        self._z = {}
+        self._w = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _pair(self):
+        return self._x, self._y
+
+    def _worker(self):
+        # non-literal RHS: the call's tuple is not unpacked pairwise
+        a, b = self._pair()
+        a["k"] = 1
+        b["k"] = 1
+        # starred target: unmodeled shape
+        c, *rest = self._z, self._w, 0
+        c["k"] = 1
+        # rebinding d after the unpack breaks the alias
+        d, e = self._x, self._y
+        d = {}
+        d["k"] = 1
+        with self._mu:
+            e["k"] = 1  # under the lock: silent either way

@@ -348,6 +348,35 @@ def test_device_loop_equivalence_forced_tie():
     assert host.stats["host_syncs"] > loop.stats["host_syncs"]
 
 
+def test_host_sync_budget_matches_static_sanction_count():
+    """DC602's runtime cross-check (ISSUE 15): the static sync budget —
+    the `# device: sync` sites the device-contract pass sanctions on the
+    dispatched path — is an upper bound on the wave's dynamic
+    `host_syncs` stat.  A new un-annotated sync site fails the analyzer
+    gate; a new *annotated* site that drives the dynamic count past the
+    static budget fails here — the declared budget and the measured one
+    can only move together."""
+    from kubernetes_tpu.analysis.core import repo_root
+    from kubernetes_tpu.analysis.device_contracts import sanctioned_sync_sites
+
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    static, init = _seeded_segment(pods, nim)
+    loop = FrontierRun(static, init, device_loop=True, chunk_len=16,
+                       min_width=8)
+    loop.finalize()
+    assert loop.stats["compactions"] >= 1  # a multi-compaction wave
+
+    sites = sanctioned_sync_sites(repo_root())[
+        "kubernetes_tpu/ops/batch_kernel.py"]
+    # dispatched path: _sync_loop runs once per loop run, _finalize_loop's
+    # tail sites once per wave
+    static_budget = (sites["FrontierRun._sync_loop"] * loop.stats["loop_runs"]
+                     + sites["FrontierRun._finalize_loop"])
+    assert loop.stats["host_syncs"] <= static_budget, (loop.stats, sites)
+
+
 def test_device_loop_equivalence_n_feasible_one():
     """Selector-pinned pods (the n_feasible==1 fast path: counter must
     NOT advance) interleaved with tie pods, through compactions."""

@@ -1,14 +1,15 @@
 """ktpu-analyze: the tier-1 gate plus the analyzer's own fixture tests.
 
 ``test_live_tree_clean`` is the commit gate: every future PR runs all
-five passes against the whole tree and fails on any unbaselined finding
+six passes against the whole tree and fails on any unbaselined finding
 (ISSUE 1 acceptance); ``test_analyzer_wall_time_budget`` keeps the gate
 cheap enough to stay in tier 1.  The fixture tests pin the analyzer's
 behavior to seeded violations with exact codes and locations, and pin
 the exemptions (static bool flags, ``is None``, sorted() iteration,
 lock-guarded writes, per-connection HTTP handlers, caller-held locks,
-shadowed aliases, span-covered helpers) so analyzer regressions fail
-loudly in both directions.
+shadowed aliases, span-covered helpers, rebind-first donation use,
+sanctioned sync sites, sticky-bucketed pads) so analyzer regressions
+fail loudly in both directions.
 """
 
 from __future__ import annotations
@@ -67,7 +68,7 @@ def test_live_tree_clean(live_report):
 
 def test_analyzer_wall_time_budget(live_report):
     """The gate stays tier-1 only while it stays cheap: every pass must
-    report a timing, and the whole five-pass run must fit the budget
+    report a timing, and the whole six-pass run must fit the budget
     (generous vs the ~4 s it takes today, tight enough to catch an
     accidental fixed-point blowup turning the lint quadratic)."""
     assert set(live_report.timings) == set(ana_core.PASS_NAMES)
@@ -102,7 +103,8 @@ def test_cli_exit_codes():
         cwd=ROOT, capture_output=True, text=True, env=env,
     )
     doc = json.loads(as_json.stdout)
-    assert doc["passes"] == ["trace", "parity", "races", "metrics", "tracecov"]
+    assert doc["passes"] == ["trace", "parity", "races", "metrics", "tracecov",
+                             "device"]
     assert len(doc["findings"]) == n_suppressed, doc["findings"]
     assert as_json.returncode == (1 if n_suppressed else 0), as_json.stdout
     # stable key order: the emitted text IS the sorted serialization, so
@@ -143,7 +145,10 @@ def test_cli_prune_baseline_round_trip(tmp_path):
         cwd=ROOT, capture_output=True, text=True, env=env,
     )
     assert pruned.returncode == 0, pruned.stdout + pruned.stderr
-    assert f"pruned stale baseline entry: {ghost['key']}" in pruned.stderr
+    # the prune report names the pass and code so retired entries are
+    # auditable straight from the PR diff / CI log
+    assert (f"pruned stale baseline entry [races RL999]: {ghost['key']}"
+            in pruned.stderr)
     after = json.loads(p.read_text())
     assert after["_comment"] == doc["_comment"]
     assert after["suppressions"] == doc["suppressions"][:-1]  # order + reasons kept
@@ -343,6 +348,10 @@ def test_race_fixture_codes_and_locations(race_findings):
         # ISSUE 10: cross-object reachability — the unlocked collaborator
         # is flagged at ITS class, with the external entry in the message
         ("RL303", "UnlockedHelper.bump._stats"),
+        # ISSUE 15: single-assignment tuple unpacking aliases pairwise
+        ("RL303", "TupleUnpackAliases._worker._tup_a"),
+        ("RL303", "TupleUnpackAliases._worker._tup_b"),
+        ("RL303", "TupleUnpackAliases._worker._tup_elems"),
     }
     assert got == expected, f"got {sorted(got)}"
     by_symbol = {f.symbol: f.line for f in race_findings}
@@ -376,6 +385,15 @@ def test_race_fixture_codes_and_locations(race_findings):
     assert by_symbol["UnlockedHelper.bump._stats"] == _fixture_line(
         path, "self._stats[k] = self._stats.get(k, 0) + 1"
     )
+    assert by_symbol["TupleUnpackAliases._worker._tup_a"] == _fixture_line(
+        path, 'a["k"] = 1  # RL303 on _tup_a via tuple unpacking'
+    )
+    assert by_symbol["TupleUnpackAliases._worker._tup_b"] == _fixture_line(
+        path, 'b.append("k")  # RL303 on _tup_b via tuple unpacking'
+    )
+    assert by_symbol["TupleUnpackAliases._worker._tup_elems"] == _fixture_line(
+        path, "e.append(1)  # RL303 on _tup_elems via element pair in an unpack"
+    )
     messages = {f.symbol: f.message for f in race_findings}
     assert "via alias `u`" in messages["TwoHopAliasedMutations._worker._twohop"]
     assert "via alias `c`" in messages["TwoHopAliasedMutations._worker._threehop"]
@@ -407,6 +425,9 @@ def test_race_fixture_exemptions_stay_clean(race_findings):
         "CrossObjectLockGuard",
         "CallerHeldHelper",
         "CrossShapeExemptions",
+        # ISSUE 15 silences: call-returned tuples, starred targets,
+        # rebound unpacked names, and lock-guarded unpacked aliases
+        "TupleUnpackExemptions",
     ):
         assert not any(s.startswith(clean) for s in symbols), sorted(symbols)
 
@@ -598,3 +619,211 @@ def test_finding_keys_are_line_independent():
             "baseline keys must not embed line numbers (they'd rot on every "
             f"edit above the finding): {f.key}"
         )
+
+
+# ---------------------------------------------------------------------------
+# device-contract fixtures (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+DC_PATH = f"{FIXTURES}/fixture_device_contracts.py"
+DC_SCOPE = {"paths": [DC_PATH], "hot_modules": [DC_PATH]}
+
+
+@pytest.fixture(scope="module")
+def device_findings():
+    report = run_analysis(
+        root=ROOT, passes=["device"], scopes={"device": DC_SCOPE}
+    )
+    return report.findings
+
+
+def test_device_fixture_codes_and_locations(device_findings):
+    got = {(f.code, f.symbol): f.line for f in device_findings}
+    ann_stale = _fixture_line(DC_PATH, "# device: sync — nothing materializes")
+    ann_reasonless = _fixture_line(DC_PATH, "# device: sync\n")
+    ann_static = _fixture_line(DC_PATH, "# device: static\n")
+    expected = {
+        # DC601: donated carry read after dispatch, before the rebind —
+        # directly and through a one-hop callee
+        ("DC601", "FixtureLoop.dispatch_bad._state"): _fixture_line(
+            DC_PATH, "stale = self._state"),
+        ("DC601", "FixtureLoop.dispatch_callee_bad._state._peek"): _fixture_line(
+            DC_PATH, "self._peek()"),
+        # DC602: unsanctioned host materialization of a device value
+        ("DC602", "FixtureLoop.sync_bad._state"): _fixture_line(
+            DC_PATH, "n = int(jnp.sum(self._state))"),
+        ("DC602", "reasonless_sync.dev"): _fixture_line(
+            DC_PATH, "n = int(jnp.sum(dev))"),
+        # DC603: bare pad, pow2 width, un-normalized compile key
+        ("DC603", "pad_bad._pad_to"): _fixture_line(
+            DC_PATH, "return _pad_to(n, 8)"),
+        ("DC603", "width_bad._pow2_width"): _fixture_line(
+            DC_PATH, "return _pow2_width(n, 8)"),
+        ("DC603", "factory_call_bad._fixture_runner.static.chunk"): _fixture_line(
+            DC_PATH, "run = _fixture_runner(static.chunk)"),
+        # DC604: snapshot NodeInfo mutated without mutable_info — mutator
+        # through a local, a direct map subscript, and an attribute store
+        ("DC604", "fixture_schedule.apply_bad.raw.add_pod"): _fixture_line(
+            DC_PATH, "raw.add_pod(pod)"),
+        ("DC604", "fixture_schedule.apply_bad.work_map.remove_pod"): _fixture_line(
+            DC_PATH, "work_map[name].remove_pod(pod)"),
+        ("DC604", "fixture_schedule.apply_bad.raw.node"): _fixture_line(
+            DC_PATH, "raw.node = None"),
+        # DC605: stale sync, reasonless sync, unused static
+        ("DC605", f"stale_sync_annotation.L{ann_stale}"): ann_stale,
+        ("DC605", f"reasonless_sync.L{ann_reasonless}"): ann_reasonless,
+        ("DC605", f"stale_static_annotation.L{ann_static}"): ann_static,
+    }
+    assert got == expected, f"got {sorted(got)}"
+    messages = {f.symbol: f.message for f in device_findings}
+    # the donation finding names the donated arg and the dispatch line
+    assert "was donated" in messages["FixtureLoop.dispatch_bad._state"]
+    assert "rebind" in messages["FixtureLoop.dispatch_bad._state"]
+    # the callee-hop finding names the callee that reads the dead buffer
+    assert "FixtureLoop._peek" in messages[
+        "FixtureLoop.dispatch_callee_bad._state._peek"]
+    # the sync finding teaches the annotation grammar
+    assert "# device: sync — <reason>" in messages["FixtureLoop.sync_bad._state"]
+    # the CoW finding names the sanctioned route
+    assert "mutable_info" in messages["fixture_schedule.apply_bad.raw.add_pod"]
+
+
+def test_device_fixture_exemptions_stay_clean(device_findings):
+    symbols = {f.symbol for f in device_findings}
+    for clean in (
+        "FixtureLoop.dispatch_ok",   # rebind-first donation use
+        "FixtureLoop.sync_ok",       # sanctioned sync site
+        "pad_ok_sticky",             # pad routed through _sticky_pad
+        "pad_ok_annotated",          # pad under a # device: static
+        "width_ok",                  # width under a # device: static
+        "factory_call_ok",           # int()-normalized compile key
+        "fixture_schedule.apply_ok",  # mutation through mutable_info
+    ):
+        assert not any(s.startswith(clean) for s in symbols), sorted(symbols)
+
+
+def test_device_pass_catches_seeded_donation_bug(tmp_path):
+    """Re-introducing the donated-carry-reuse bug into a copy of the real
+    batch_kernel (reading self._state after the loop dispatch but before
+    the rebind) is caught; the untouched copy is clean — so the finding
+    is the seeded bug, not scanner noise."""
+    from kubernetes_tpu.analysis import device_contracts as dc
+
+    with open(os.path.join(ROOT, "kubernetes_tpu/ops/batch_kernel.py"),
+              encoding="utf-8") as f:
+        src = f.read()
+    (tmp_path / "bk_clean.py").write_text(src)
+    assert dc.run(str(tmp_path), paths=["bk_clean.py"]) == []
+    rebind = "self._state, self._buf = out[0], out[1]"
+    assert rebind in src
+    (tmp_path / "bk_bug.py").write_text(src.replace(
+        rebind, "stale_probe = jnp.sum(self._state)\n            " + rebind, 1))
+    got = {(f.code, f.symbol)
+           for f in dc.run(str(tmp_path), paths=["bk_bug.py"])}
+    assert ("DC601", "FrontierRun._dispatch_loop._state") in got, got
+
+
+def test_device_pass_catches_seeded_cow_bypass(tmp_path):
+    """Replacing backend.schedule_batch's `mutable_info(...)` with a raw
+    `work_map.get(...)` — the exact regression the ROADMAP caveat warned
+    about — is caught at both mutation sites; the untouched copy is
+    clean."""
+    from kubernetes_tpu.analysis import device_contracts as dc
+
+    with open(os.path.join(ROOT, "kubernetes_tpu/ops/backend.py"),
+              encoding="utf-8") as f:
+        src = f.read()
+    (tmp_path / "be_clean.py").write_text(src)
+    assert dc.run(str(tmp_path), paths=["be_clean.py"]) == []
+    sanctioned = "info = mutable_info(node_name)"
+    assert sanctioned in src
+    (tmp_path / "be_bug.py").write_text(src.replace(
+        sanctioned, "info = work_map.get(node_name)", 1))
+    got = {(f.code, f.symbol)
+           for f in dc.run(str(tmp_path), paths=["be_bug.py"])}
+    symbols = {s for c, s in got if c == "DC604"}
+    assert any(s.endswith("info.add_pod_counted") for s in symbols), got
+    assert any(s.endswith("info.add_pod") for s in symbols), got
+
+
+def test_sanctioned_sync_sites_counts():
+    """The static sync budget the runtime cross-check leans on: every
+    live annotation in FrontierRun is counted under its function, and
+    invalid (stale/reasonless) annotations never count."""
+    from kubernetes_tpu.analysis.device_contracts import sanctioned_sync_sites
+
+    sites = sanctioned_sync_sites(ROOT)
+    bk = sites["kubernetes_tpu/ops/batch_kernel.py"]
+    assert bk["FrontierRun._sync_loop"] == 3
+    assert bk["FrontierRun._finalize_loop"] == 2
+    assert bk["FrontierRun._maybe_compact"] == 2
+    assert bk["FrontierRun.finalize"] == 2
+    fx = sanctioned_sync_sites(ROOT, paths=[DC_PATH])[DC_PATH]
+    assert fx == {"FixtureLoop.sync_ok": 1}
+
+
+# ---------------------------------------------------------------------------
+# --changed: git-diff-scoped reporting (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_changed_files_unit(tmp_path):
+    from kubernetes_tpu.analysis.__main__ import _changed_files
+
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    (tmp_path / "a.py").write_text("x = 1\n")
+    subprocess.run(["git", "add", "a.py"], cwd=tmp_path, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=t@example.com", "-c", "user.name=t",
+         "commit", "-q", "-m", "seed"],
+        cwd=tmp_path, check=True,
+    )
+    (tmp_path / "a.py").write_text("x = 2\n")   # modified vs HEAD
+    (tmp_path / "b.py").write_text("y = 1\n")   # untracked
+    assert _changed_files(str(tmp_path), "HEAD") == {"a.py", "b.py"}
+    with pytest.raises(ValueError):
+        _changed_files(str(tmp_path), "definitely-not-a-ref")
+
+
+def test_cli_changed_scopes_report_to_diff():
+    """--changed filters the REPORT to files changed vs the ref (plus
+    untracked), while the full scope still runs — all six passes, full
+    timings; a bad ref is exit 2, never a silently-empty green run."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    bad = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis",
+         "--changed=definitely-not-a-ref"],
+        cwd=ROOT, capture_output=True, text=True, env=env,
+    )
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+    assert "--changed" in bad.stderr
+
+    full = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis", "--json",
+         "--no-baseline"],
+        cwd=ROOT, capture_output=True, text=True, env=env,
+    )
+    full_doc = json.loads(full.stdout)
+    scoped = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis", "--json",
+         "--no-baseline", "--changed=HEAD", "--profile"],
+        cwd=ROOT, capture_output=True, text=True, env=env,
+    )
+    doc = json.loads(scoped.stdout)
+    # compute the changed set exactly as the CLI does, so the expectation
+    # is deterministic whatever state the working tree is in
+    diff = subprocess.run(["git", "diff", "--name-only", "HEAD", "--"],
+                          cwd=ROOT, capture_output=True, text=True)
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=ROOT, capture_output=True, text=True)
+    changed = {ln.strip() for ln in diff.stdout.splitlines() if ln.strip()}
+    changed |= {ln.strip() for ln in untracked.stdout.splitlines() if ln.strip()}
+    expected = [f for f in full_doc["findings"] if f["path"] in changed]
+    assert doc["findings"] == expected
+    assert scoped.returncode == (1 if expected else 0), scoped.stdout
+    # the whole scope still ran: every pass reports, timings included,
+    # and --profile output is preserved alongside --changed
+    assert doc["passes"] == list(ana_core.PASS_NAMES)
+    assert set(doc["timings_ms"]) == set(ana_core.PASS_NAMES)
+    assert scoped.stderr.count("profile:") == len(ana_core.PASS_NAMES)
