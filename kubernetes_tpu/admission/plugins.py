@@ -16,6 +16,8 @@ Capability equivalents of the reference's default plugin set for this era
 
 from __future__ import annotations
 
+import logging
+
 from ..api.quantity import Quantity
 from ..store.store import NotFoundError
 from ..api.types import (CPU, MEMORY, HOSTNAME_LABEL,
@@ -291,8 +293,16 @@ class ResourceQuota(AdmissionPlugin):
                 for prev in charged:
                     try:
                         self._charge(attrs, prev, usage, release=True)
-                    except Exception:
-                        pass
+                    except Exception as undo_err:  # noqa: BLE001
+                        # an inflated quota self-heals at the controller's
+                        # next resync; warn so the interim over-restriction
+                        # has a visible cause (the ORIGINAL error re-raises
+                        # below — the undo failure must not mask it)
+                        logging.getLogger("kubernetes_tpu.admission").warning(
+                            "quota undo failed for %s/%s (%s); controller "
+                            "resync will reconcile",
+                            attrs.namespace, prev["metadata"]["name"],
+                            undo_err)
                 raise
             if not release:
                 charged.append(rq)

@@ -64,6 +64,7 @@ PASS_DESCRIPTIONS = {
     "metrics": "metrics-name lint (MN4xx: snake_case names, counters end _total, histograms carry a unit, no duplicate registrations, SLO specs resolve to registered metrics)",
     "tracecov": "trace-coverage lint (TC5xx: fault seams outside spans, unmirrored phase timers, span-free hot-path modules, wave-phase spans outside the hot scope)",
     "device": "device-contract lint (DC6xx: use-after-donate, unsanctioned host syncs on the wave hot path, shape-bearing values at jit boundaries, snapshot writes bypassing clone-on-write)",
+    "concurrency": "concurrency-hazard & resource-lifecycle lint (CH7xx: blocking calls under held locks, swallowed exceptions, unjoined threads / unclosed handles, callbacks invoked under locks, unbounded growth on daemon paths)",
 }
 
 

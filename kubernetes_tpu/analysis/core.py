@@ -19,7 +19,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-PASS_NAMES = ("trace", "parity", "races", "metrics", "tracecov", "device")
+PASS_NAMES = ("trace", "parity", "races", "metrics", "tracecov", "device",
+              "concurrency")
 
 
 def repo_root() -> str:
@@ -172,7 +173,8 @@ class Report:
 # finding-code prefix -> the pass that can produce it (stale-entry
 # detection must not call a races suppression "stale" in a parity-only run)
 _CODE_PREFIX_PASS = {"TS": "trace", "PC": "parity", "RL": "races",
-                     "MN": "metrics", "TC": "tracecov", "DC": "device"}
+                     "MN": "metrics", "TC": "tracecov", "DC": "device",
+                     "CH": "concurrency"}
 
 
 def _split_baseline(
@@ -210,7 +212,8 @@ def run_analysis(
     """
     import time
 
-    from . import device_contracts, metrics_lint, parity, races, trace_safety, tracecov
+    from . import (concurrency_hazards, device_contracts, metrics_lint,
+                   parity, races, trace_safety, tracecov)
 
     root = root or repo_root()
     passes = list(passes) if passes else list(PASS_NAMES)
@@ -226,6 +229,8 @@ def run_analysis(
         "metrics": lambda: metrics_lint.run(root, **scopes.get("metrics", {})),
         "tracecov": lambda: tracecov.run(root, **scopes.get("tracecov", {})),
         "device": lambda: device_contracts.run(root, **scopes.get("device", {})),
+        "concurrency": lambda: concurrency_hazards.run(
+            root, **scopes.get("concurrency", {})),
     }
     findings: list[Finding] = []
     timings: dict[str, float] = {}

@@ -572,8 +572,12 @@ def _return_summaries(
     table plus its module's top-level functions.  Values:
     ``("attr", name)`` — every return is ``self.<name>`` (possibly
     through further summarized calls); ``("arg", pname)`` — every return
-    is the same parameter; ``("self",)`` — returns self.  A function
-    whose returns disagree or return anything else has no summary."""
+    is the same parameter; ``("self",)`` — returns self;
+    ``("tuple", (elem, ...))`` — every return is a same-arity tuple
+    LITERAL, each element summarized positionally (an element whose
+    returns disagree or resolve to nothing is ``None`` — that position
+    simply aliases nothing).  A function whose returns disagree or
+    return anything else has no summary."""
     fns: dict[tuple, tuple[ast.FunctionDef, bool]] = {}
     for meth, (_ci, fn) in table.items():
         fns[("m", meth)] = (fn, True)
@@ -631,6 +635,22 @@ def _return_summaries(
             params = set(_param_names(fn))
             if is_method:
                 params.discard("self")
+            # tuple-literal returns summarize positionally (ISSUE 16):
+            # `return self._q, self._mu` feeds `a, b = self._pair()`
+            if all(isinstance(r.value, ast.Tuple) for r in returns):
+                arities = {len(r.value.elts) for r in returns}
+                has_star = any(isinstance(el, ast.Starred)
+                               for r in returns for el in r.value.elts)
+                if len(arities) == 1 and not has_star:
+                    elems = []
+                    for i in range(arities.pop()):
+                        vals = {resolve(r.value.elts[i], params, 0)
+                                for r in returns}
+                        elems.append(vals.pop() if len(vals) == 1 else None)
+                    if any(e is not None for e in elems):
+                        summaries[key] = ("tuple", tuple(elems))
+                        changed = True
+                continue
             resolved = {resolve(r.value, params, 0) for r in returns}
             if len(resolved) == 1:
                 val = resolved.pop()
@@ -740,6 +760,40 @@ def _local_aliases(
             return resolve_call(arg, depth + 1)
         return None
 
+    def resolve_call_tuple(value: ast.Call) -> Optional[list]:
+        """Per-position aliases for a call with a ``("tuple", ...)``
+        summary: each element becomes ("attr", a) / ("name", local) /
+        None (that position aliases nothing)."""
+        callee_key = None
+        meth = _is_self_attr(value.func)
+        if meth is not None and ("m", meth) in fns:
+            callee_key = ("m", meth)
+        elif isinstance(value.func, ast.Name) and ("f", value.func.id) in fns:
+            callee_key = ("f", value.func.id)
+        if callee_key is None:
+            return None
+        summary = summaries.get(callee_key)
+        if summary is None or summary[0] != "tuple":
+            return None
+        callee_fn, is_method = fns[callee_key]
+        out: list = []
+        for elem in summary[1]:
+            if elem is None or elem[0] == "self":
+                out.append(None)
+            elif elem[0] == "attr":
+                out.append(elem)
+            else:  # ("arg", pname): the alias IS whatever was passed
+                arg = _call_arg_for_param(value, callee_fn, elem[1],
+                                          is_method=is_method)
+                attr = _is_self_attr(arg) if arg is not None else None
+                if attr is not None:
+                    out.append(("attr", attr))
+                elif isinstance(arg, ast.Name):
+                    out.append(("name", arg.id))
+                else:
+                    out.append(None)
+        return out
+
     def handle_pair(t: ast.expr, value: ast.expr) -> None:
         if isinstance(t, ast.Name):
             attr = _is_self_attr(value)
@@ -764,16 +818,45 @@ def _local_aliases(
                 # aliases are known
                 elem_reads[t.id] = value.value
         elif isinstance(t, (ast.Tuple, ast.List)):
-            # tuple unpacking with matching arity and no starred
-            # element: each (target, value) pair aliases exactly as the
-            # standalone assignment would; any other unpacking shape
-            # stays unmodeled (silence)
+            starred = [i for i, el in enumerate(t.elts)
+                       if isinstance(el, ast.Starred)]
             if (isinstance(value, (ast.Tuple, ast.List))
-                    and len(value.elts) == len(t.elts)
                     and not any(isinstance(el, ast.Starred)
-                                for el in list(t.elts) + list(value.elts))):
-                for sub_t, sub_v in zip(t.elts, value.elts):
-                    handle_pair(sub_t, sub_v)
+                                for el in value.elts)):
+                if not starred and len(value.elts) == len(t.elts):
+                    # matching arity, no stars: each (target, value) pair
+                    # aliases exactly as the standalone assignment would
+                    for sub_t, sub_v in zip(t.elts, value.elts):
+                        handle_pair(sub_t, sub_v)
+                elif len(starred) == 1 and len(value.elts) >= len(t.elts) - 1:
+                    # one starred TARGET against a literal value (ISSUE
+                    # 16): positions before the star align with the value
+                    # prefix, positions after with the value suffix; the
+                    # starred name binds a FRESH list and aliases nothing
+                    s = starred[0]
+                    n_suffix = len(t.elts) - s - 1
+                    for sub_t, sub_v in zip(t.elts[:s], value.elts[:s]):
+                        handle_pair(sub_t, sub_v)
+                    if n_suffix:
+                        for sub_t, sub_v in zip(
+                                t.elts[s + 1:],
+                                value.elts[len(value.elts) - n_suffix:]):
+                            handle_pair(sub_t, sub_v)
+            elif isinstance(value, ast.Call) and not starred:
+                # call-returned tuple unpacking (ISSUE 16): a callee
+                # whose every return is a same-arity tuple literal
+                # aliases positionally; arity mismatch, starred targets,
+                # or an unsummarized callee stay unmodeled (silence)
+                got = resolve_call_tuple(value)
+                if got is not None and len(got) == len(t.elts):
+                    for sub_t, elem in zip(t.elts, got):
+                        if elem is None or not isinstance(sub_t, ast.Name):
+                            continue
+                        if elem[0] == "attr" and elem[1] in containers:
+                            cand[sub_t.id] = elem[1]
+                        elif elem[0] == "name":
+                            links[sub_t.id] = elem[1]
+            # any other unpacking shape stays unmodeled (silence)
 
     class V(ast.NodeVisitor):
         def visit_Assign(self, node: ast.Assign) -> None:

@@ -17,6 +17,7 @@ speaks.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 from ..api import types as api
@@ -49,7 +50,11 @@ def _schema_for(value) -> dict:
 def _definition(kind: str, cls) -> Optional[dict]:
     try:
         wire = cls().to_dict()
-    except Exception:
+    except Exception as e:  # noqa: BLE001 - kind omitted, doc still serves
+        # a kind with no zero-arg construction silently vanishing from
+        # /openapi would be a confusing hole — name the omission
+        logging.getLogger("kubernetes_tpu.apiserver").debug(
+            "openapi: kind %s has no zero-arg schema (%s); omitted", kind, e)
         return None
     schema = _schema_for(wire)
     if cls.__doc__:

@@ -152,6 +152,14 @@ class APIServer:
         self.telemetry_accepted = self.registry.register(Counter(
             "apiserver_telemetry_accepted_total",
             "telemetry records accepted at /telemetry"))
+        # tolerated-failure visibility (ktpu-analyze CH702): best-effort
+        # paths may fail, but never invisibly
+        self.error_write_failures = self.registry.register(Counter(
+            "apiserver_error_write_failures_total",
+            "error responses that could not be written (client hung up)"))
+        self.apiservice_status_failures = self.registry.register(Counter(
+            "apiserver_apiservice_status_failures_total",
+            "best-effort APIService availability updates that failed"))
         self._telemetry_mu = threading.Lock()
         handler = _make_handler(self)
         if tls is not None:
@@ -488,8 +496,10 @@ def _make_handler(server: APIServer):
                 logger.exception("handler panic")
                 try:
                     self._error(500, "InternalError", str(e))
-                except Exception:
-                    pass
+                except Exception:  # noqa: BLE001 - client gone mid-error
+                    # the 500 is already logged above; the write failing
+                    # means the peer hung up — count it, don't re-panic
+                    server.error_write_failures.inc()
             finally:
                 if acquired:
                     server._inflight.release()
@@ -934,8 +944,13 @@ def _make_handler(server: APIServer):
                     return d
 
                 server.store.guaranteed_update("APIService", "", name, _set)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - status is best-effort
+                # availability is advisory (the next proxy attempt
+                # re-observes it); a write that keeps failing should
+                # still be visible somewhere
+                logger.debug("APIService %s availability update failed: %s",
+                             name, e)
+                server.apiservice_status_failures.inc()
 
         def _proxy_aggregated(self, method: str, group: str, url) -> None:
             """The kube-aggregator seam (``staging/src/k8s.io/

@@ -18,8 +18,13 @@ import base64
 import hashlib
 import hmac
 import json
+import logging
 from dataclasses import dataclass, field
 from typing import Optional
+
+# rejection causes log at debug and never include credential material —
+# auth failures are normal traffic, but a systematic one needs a trail
+logger = logging.getLogger("kubernetes_tpu.auth")
 
 
 @dataclass
@@ -197,7 +202,9 @@ class X509CertificateAuthenticator(Authenticator):
             return None
         try:
             pem = _unb64(raw)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 - bad credential => 401
+            logger.debug("x509: undecodable %s payload (%s): rejected",
+                         self.HEADER, type(e).__name__)
             return None
         return self._verify_pem(pem)
 
@@ -209,7 +216,11 @@ class X509CertificateAuthenticator(Authenticator):
             cert = cx509.load_pem_x509_certificate(pem)
             ca = cx509.load_pem_x509_certificate(self.ca_pem)
             cert.verify_directly_issued_by(ca)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 - bad credential => 401
+            # unparseable cert, signature mismatch, or no cryptography
+            # module at all — every case reads as a rejected credential
+            logger.debug("x509: certificate verification failed (%s): "
+                         "rejected", type(e).__name__)
             return None
         import datetime
 
@@ -370,9 +381,11 @@ class OIDCAuthenticator(Authenticator):
                 groups = [groups]
             return UserInfo(name=self.username_prefix + str(name),
                             groups=[str(g) for g in groups])
-        except Exception:
+        except Exception as e:  # noqa: BLE001
             # malformed claims must read as a bad credential (401), never
             # crash the request thread
+            logger.debug("oidc: malformed token/claims (%s): rejected",
+                         type(e).__name__)
             return None
 
     def _verify_sig(self, alg: str, signed: bytes, sig: bytes) -> bool:

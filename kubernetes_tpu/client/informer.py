@@ -110,11 +110,18 @@ class SharedInformer:
 
     # -- registration ------------------------------------------------------
     def add_handler(self, handler: Handler) -> None:
+        # snapshot under the lock, replay OUTSIDE it (the same contract
+        # _deliver's callers follow): handler code under _mu could call
+        # back into get()/list() and deadlock, or stall every other
+        # informer client behind a slow on_add.  A delta applied between
+        # the release and the replay may reach the handler before its
+        # replayed add — the same at-least-once ordering client-go's
+        # shared informers give a late-registered handler.
         with self._mu:
             self._handlers.append(handler)
-            if self._synced.is_set():
-                for obj in list(self._cache.values()):
-                    self._deliver(handler.on_add, obj)
+            replay = list(self._cache.values()) if self._synced.is_set() else []
+        for obj in replay:
+            self._deliver(handler.on_add, obj)
 
     # -- cache reads (the Lister/Indexer surface) --------------------------
     def get(self, key: str):
@@ -685,6 +692,7 @@ class PodNodeIndex:
         with self._mu:
             if old is not None and old.spec.node_name and old.spec.node_name != new.spec.node_name:
                 self._by_node.get(old.spec.node_name, {}).pop(old.meta.key, None)
+                self._shed(old.spec.node_name)
             if new.spec.node_name:
                 self._by_node.setdefault(new.spec.node_name, {})[new.meta.key] = new
 
@@ -692,6 +700,14 @@ class PodNodeIndex:
         if pod.spec.node_name:
             with self._mu:
                 self._by_node.get(pod.spec.node_name, {}).pop(pod.meta.key, None)
+                self._shed(pod.spec.node_name)
+
+    def _shed(self, node_name: str) -> None:
+        # caller holds _mu: drop the per-node dict once its last pod is
+        # gone, or node churn (scale-down, spot reclaim) pins an empty
+        # dict per node name the cluster has ever seen
+        if not self._by_node.get(node_name):
+            self._by_node.pop(node_name, None)
 
     def pods_on(self, node_name: str) -> list:
         with self._mu:
@@ -731,11 +747,24 @@ class PodOwnerIndex:
         with self._mu:
             if old is not None:
                 self._slot(old).pop(old.meta.key, None)
+                self._shed(old)
             self._slot(new)[new.meta.key] = new
 
     def _drop(self, pod) -> None:
         with self._mu:
             self._slot(pod).pop(pod.meta.key, None)
+            self._shed(pod)
+
+    def _shed(self, pod) -> None:
+        # caller holds _mu: drop the slot itself once its last pod is
+        # gone, or dead owner UIDs and emptied namespaces pin an empty
+        # dict forever (every RS the cluster has ever run)
+        ref = pod.meta.controller_ref()
+        if ref is not None:
+            if not self._by_owner.get(ref.uid):
+                self._by_owner.pop(ref.uid, None)
+        elif not self._orphans.get(pod.meta.namespace):
+            self._orphans.pop(pod.meta.namespace, None)
 
     def owned_by(self, uid: str) -> list:
         with self._mu:

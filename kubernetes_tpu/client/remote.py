@@ -256,8 +256,10 @@ class RemoteWatch:
                             self._resp = None
                     try:
                         resp.close()
-                    except Exception:
-                        pass
+                    except Exception:  # noqa: BLE001 - close is best-effort
+                        # the stream is being torn down either way; count
+                        # it so a systematically failing close is visible
+                        self.metrics.watch_close_errors.inc()
 
     def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         try:
@@ -281,8 +283,8 @@ class RemoteWatch:
         if resp is not None:
             try:
                 resp.close()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 - close is best-effort
+                self.metrics.watch_close_errors.inc()
         self._queue.put(None)
 
 
@@ -405,8 +407,11 @@ class RemoteStore:
                     try:
                         e.read()
                         e.close()
-                    except Exception:
-                        pass
+                    except Exception:  # noqa: BLE001 - drain is best-effort
+                        # the retry opens a fresh connection regardless;
+                        # count the failed drain so a pool that stops
+                        # reusing sockets has a visible cause
+                        self.metrics.remote_drain_errors.inc()
                     last_err = e
                     logger.warning("%s %s: retryable HTTP %d (attempt %d/%d)",
                                    method, path, e.code, attempt + 1,

@@ -73,6 +73,9 @@ class GarbageCollector(Controller):
             with self._graph_mu:
                 if kind in self.kinds or kind in _EXCLUDED_KINDS:
                     continue
+                # handler wiring is permanent by design (shared informers
+                # are never unwired in the reference either)
+                # bounded: one entry per registry/CRD kind ever established
                 self.kinds.append(kind)
             self.informers.informer(kind).add_handler(Handler(
                 on_add=lambda obj, k=kind: self._observe(k, obj),

@@ -77,6 +77,7 @@ class MetricsClient:
         # tunnel-only node before the call that actually works
         if url and demoted_at is None:
             try:
+                # blocking-ok — the sweep dials under _mu by design (see _mu's init comment): the interval throttle means contenders return fast instead of racing duplicate sweeps
                 with urllib.request.urlopen(f"{url}/stats/summary", timeout=5) as r:
                     return json.loads(r.read())
             except Exception as e:  # noqa: BLE001 — a down node must not stop the sweep

@@ -149,6 +149,10 @@ class ProcessContainerManager:
             logf = open(log, "ab", buffering=0)
             try:
                 try:
+                    # the spawn must stay inside the idempotency check's
+                    # lock hold: releasing between _alive_locked and Popen
+                    # would let two concurrent sync sweeps double-start it
+                    # blocking-ok — atomic check-then-spawn under _mu IS the idempotency contract
                     proc = subprocess.Popen(
                         cmd, cwd=rootfs, env=full_env,
                         stdout=logf, stderr=logf,
@@ -163,6 +167,7 @@ class ProcessContainerManager:
                     # kernel-observed, restart policy cycles it, the
                     # error is in the log.
                     logf.write(f"spawn failed: {e}\n".encode())
+                    # blocking-ok — same lock-hold contract as the spawn above
                     proc = subprocess.Popen(
                         ["/bin/sh", "-c", "exit 127"], cwd=rootfs,
                         env=full_env, stdout=logf, stderr=logf,

@@ -17,8 +17,11 @@ Tick-driven with an injected clock (the kubelet's syncLoop ticks,
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Optional
+
+logger = logging.getLogger("kubernetes_tpu.kubelet")
 
 from ..api import types as api
 from ..api.meta import ObjectMeta
@@ -165,13 +168,19 @@ class HollowKubelet:
     def _fetch_configmap(self, ns: str, name: str):
         try:
             return self.clientset.client_for("ConfigMap").get(name, ns).data
-        except Exception:  # noqa: BLE001 — missing source: keep last payload
+        except Exception as e:  # noqa: BLE001 — missing source: keep last payload
+            logger.debug("%s: configmap %s/%s unavailable (%s); keeping "
+                         "last payload", self.node_name, ns, name,
+                         type(e).__name__)
             return None
 
     def _fetch_secret(self, ns: str, name: str):
         try:
             return self.clientset.client_for("Secret").get(name, ns).data
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — missing source: keep last payload
+            logger.debug("%s: secret %s/%s unavailable (%s); keeping last "
+                         "payload", self.node_name, ns, name,
+                         type(e).__name__)
             return None
 
     def _rootfs_path(self, pod_key: str, container: str, path: str):
@@ -876,8 +885,10 @@ class HollowKubelet:
             cidr = ""
             try:
                 cidr = self.clientset.nodes.get(self.node_name).spec.pod_cidr
-            except Exception:  # noqa: BLE001 - fall through to the hash base
-                pass
+            except Exception as e:  # noqa: BLE001 - fall through to the hash base
+                logger.debug("%s: podCIDR probe failed (%s); using hash "
+                             "fallback base", self.node_name,
+                             type(e).__name__)
             if self.network is None or (cidr and "/" in cidr):
                 self.network = KubenetPlugin(self.node_name, cidr)
         return self.network

@@ -24,6 +24,9 @@ _SRC_PATH = os.path.join(_CSRC, "labelmatch.cpp")
 _lib = None
 _lib_mu = threading.Lock()
 _build_failed = False
+# finalizer close failures (ktpu-analyze CH702): __del__ may run during
+# interpreter teardown where logging is unsafe — count, never log there
+_del_close_failures = 0
 
 OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT, OP_EQ = range(7)
 _OP_BY_NAME = {
@@ -187,8 +190,9 @@ class MatchEngine:
     def __del__(self):  # best-effort
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 - teardown: logging is unsafe here
+            global _del_close_failures
+            _del_close_failures += 1
 
     @property
     def native(self) -> bool:

@@ -212,6 +212,9 @@ class WriteAheadLog:
                 self._f.write(payload[:cut])
                 self._f.flush()
                 if self.fsync:
+                    # torn-write fault: flush the partial record like the
+                    # dying process would, under the same lock hold
+                    # blocking-ok — fault path mirrors the real append's durability point
                     os.fsync(self._f.fileno())
                 raise faults.FaultInjected(
                     f"torn WAL append for {kind}/{key} (crash mid-write: "
@@ -220,6 +223,9 @@ class WriteAheadLog:
             self._f.write(payload)
             self._f.flush()
             if self.fsync:
+                # no caller may observe this txn before its bytes are on
+                # disk, so the fsync completes inside the append's lock hold
+                # blocking-ok — WAL durability IS the commit point
                 os.fsync(self._f.fileno())
             self._records_since_snapshot += 1
 
@@ -238,6 +244,7 @@ class WriteAheadLog:
             with open(tmp, "wb") as f:
                 f.write(blob)
                 f.flush()
+                # blocking-ok — snapshot durable before the rename that retires the WAL
                 os.fsync(f.fileno())
             os.replace(tmp, self._snap_path)
             if self._f is not None:

@@ -214,6 +214,16 @@ class ClientMetrics:
         self.watch_errors = r.register(Counter(
             "client_watch_errors_total",
             "classified watch-stream errors (transport + HTTP)"))
+        # best-effort cleanup visibility (ktpu-analyze CH702): a close or
+        # drain that fails is tolerated by design, but never invisibly
+        self.watch_close_errors = r.register(Counter(
+            "client_watch_close_errors_total",
+            "watch response closes that raised (half-open stream torn "
+            "down anyway)"))
+        self.remote_drain_errors = r.register(Counter(
+            "client_remote_drain_errors_total",
+            "keep-alive body drains that raised before a retry (socket "
+            "abandoned to the pool's cleanup)"))
         self.informer_relists = r.register(Counter(
             "client_informer_relists_total",
             "full LIST + watch restarts (gap escalation or resync)"))

@@ -120,6 +120,7 @@ class TimeSeriesStore:
     def _append(self, out: list, t: float, track: str, value: float) -> None:
         ring = self._tracks.get(track)
         if ring is None:
+            # bounded: one ring per registered metric name; rings evict via maxlen
             ring = self._tracks[track] = deque(maxlen=self.capacity)
         ring.append((t, value))
         out.append((track, t, value))

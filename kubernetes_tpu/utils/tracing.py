@@ -441,7 +441,11 @@ class Tracer:
                 shp.offer({"kind": "flight_dump", "reason": reason,
                            "dump": snap})
         except Exception:  # noqa: BLE001 - recording must never crash
-            pass
+            import logging
+
+            logging.getLogger("kubernetes_tpu.tracing").debug(
+                "flight-dump telemetry offer failed (in-memory copy kept)",
+                exc_info=True)
         return snap
 
     def flight_snapshot(self) -> dict:

@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
 # Local CI gate, in the order CI runs it:
-#   1. ktpu-analyze — all six passes over the live tree; exits 1 on any
-#      unbaselined finding, 2 on config/baseline errors.
-#   2. the tier-1 analyzer gate tests (fixture pins + live-tree-clean +
+#   1. ktpu-analyze — all seven passes over the live tree; exits 1 on
+#      any unbaselined finding, 2 on config/baseline errors.
+#   2. check_ledgers — evidence-integrity gate: every BENCH_AB_*.json
+#      cited by README/CHANGES/COVERAGE/ROADMAP or bench.py must exist
+#      in the tree (demote with "never committed" on the citing line).
+#   3. the tier-1 analyzer gate tests (fixture pins + live-tree-clean +
 #      wall-time budget), so a pass regression fails even when the live
 #      tree happens to be clean.
 #
@@ -17,6 +20,9 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 echo "== ktpu-analyze =="
 python -m kubernetes_tpu.analysis --profile "$@"
+
+echo "== check_ledgers =="
+python scripts/check_ledgers.py
 
 echo "== analyzer gate tests =="
 python -m pytest tests/test_static_analysis.py -q -p no:cacheprovider

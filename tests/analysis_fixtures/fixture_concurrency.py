@@ -1,0 +1,380 @@
+"""Seeded concurrency-hazard violations for the CH7xx pass (never imported).
+
+Each class/function seeds exactly the shapes the pass claims to catch —
+blocking calls under held locks (lexical and caller-held), swallowed
+exceptions, leaked threads/handles/armed context managers, callbacks
+invoked under locks, unbounded growth on daemon paths — next to the
+exemptions that must stay silent (Condition.wait, str.join, nested defs,
+reasoned annotations, classified handlers, joined/daemon threads,
+escaping handles, the informer deliver-outside contract, bounded deques,
+fixed-vocabulary counters, non-worker growth).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+from collections import deque
+
+
+def _noop():
+    pass
+
+
+def _pump(sock):
+    sock.close()
+
+
+# ---------------------------------------------------------------------------
+# CH701 — blocking calls under held locks
+# ---------------------------------------------------------------------------
+
+
+class BlockingUnderLock:
+    """Blocking shapes under ``self._mu`` — lexically, and in a private
+    helper the caller-held fixed point proves always runs locked."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._evt = threading.Event()
+        self._arr = None
+        self._sock = None
+        self._fd = 0
+        self._cb = None
+        self._t = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        with self._mu:
+            time.sleep(0.05)  # CH701: sleep while holding _mu
+            self._evt.wait()  # CH701: Event.wait does not release _mu
+            n = self._arr.item()  # CH701: device materialization under _mu
+        with self._cv:
+            self._cv.wait()  # exempt: Condition.wait releases the lock
+        return n
+
+    def flush(self):
+        with self._mu:
+            self._drain()
+
+    def _drain(self):
+        # lexically bare, but its only caller holds _mu: the caller-held
+        # fixed point carries the lock into this helper
+        self._sock.sendall(b"x")  # CH701: caller-held _mu blocks the send
+
+    def shutdown(self):
+        with self._mu:
+            self._t.join()  # CH701: thread join while holding _mu
+
+    def persist(self):
+        with self._mu:
+            # blocking-ok — fixture: durability inside the lock IS the contract
+            os.fsync(self._fd)
+
+    def persist_bad(self):
+        with self._mu:
+            # blocking-ok
+            os.fsync(self._fd)  # CH701: a reasonless annotation sanctions nothing
+
+    def label(self, parts):
+        with self._mu:
+            return ", ".join(parts)  # exempt: str.join, one non-numeric arg
+
+    def spawn_later(self):
+        with self._mu:
+            def later():
+                time.sleep(0.01)  # exempt: a nested def runs at an unknown time
+            self._cb = later
+
+
+# ---------------------------------------------------------------------------
+# CH702 — swallowed exceptions
+# ---------------------------------------------------------------------------
+
+
+def fixture_swallow_module():
+    try:
+        _noop()
+    except Exception:  # CH702: module-function swallow
+        pass
+
+
+class SwallowedExceptions:
+    """Broad handlers that do nothing vs classified/counted/logged ones."""
+
+    def __init__(self):
+        self.stats = {}
+
+    def _step(self):
+        pass
+
+    def _next(self):
+        pass
+
+    def poll(self):
+        while True:
+            try:
+                self._step()
+            except:  # CH702: bare swallow in the poll loop
+                continue
+
+    def drain(self):
+        for _ in range(3):
+            try:
+                self._next()
+            except (KeyError, Exception):  # CH702: broad member in the tuple
+                break
+
+    def quiet_return(self):
+        try:
+            self._step()
+        except Exception:  # CH702: a valueless return still swallows
+            return
+
+    def counted(self):
+        try:
+            self._step()
+        except Exception:
+            self.stats["errors"] = self.stats.get("errors", 0) + 1  # counted: handled
+
+    def reraise(self):
+        try:
+            self._step()
+        except Exception:
+            raise
+
+    def logged(self):
+        try:
+            self._step()
+        except Exception:
+            logging.getLogger(__name__).debug("step failed", exc_info=True)
+
+    def narrow(self):
+        try:
+            self._step()
+        except ValueError:
+            pass
+        except (KeyError, IndexError):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# CH703 — resource lifecycle
+# ---------------------------------------------------------------------------
+
+
+def fixture_leaky_thread():
+    t = threading.Thread(target=_noop)  # CH703: started, never joined here
+    t.start()
+
+
+def fixture_fire_and_forget():
+    threading.Thread(target=_noop).start()  # CH703: never joinable
+
+
+def fixture_joined_thread():
+    t = threading.Thread(target=_noop)
+    t.start()
+    t.join()
+
+
+def fixture_daemon_thread():
+    t = threading.Thread(target=_noop, daemon=True)
+    t.start()
+    t2 = threading.Thread(target=_noop)
+    t2.daemon = True
+    t2.start()
+
+
+def fixture_leaky_open(path):
+    fh = open(path)  # CH703: never closed, never escapes
+    return fh.read()
+
+
+def fixture_with_open(path):
+    with open(path) as fh:
+        return fh.read()
+
+
+def fixture_closed_open(path):
+    fh = open(path)
+    try:
+        return fh.read()
+    finally:
+        fh.close()
+
+
+def fixture_escaping_open(path):
+    fh = open(path)
+    return fh  # ownership transfers to the caller
+
+
+def fixture_handoff_socket(addr):
+    sock = socket.create_connection(addr)
+    # the tuple argument hands the socket to the pump thread, which owns
+    # its close — an escape, not a leak
+    threading.Thread(target=_pump, args=(sock,), daemon=True).start()
+
+
+def fixture_manual_enter(plan):
+    plan.__enter__()  # CH703: armed, no __exit__ in this function
+    return True
+
+
+def fixture_manual_enter_released(plan):
+    plan.__enter__()
+    try:
+        return True
+    finally:
+        plan.__exit__(None, None, None)
+
+
+class AttrThreadLeak:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)  # CH703: no join anywhere in the class
+
+    def start(self):
+        self._t.start()
+
+    def _run(self):
+        pass
+
+
+class AttrThreadJoined:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+
+    def start(self):
+        self._t.start()
+
+    def stop(self):
+        self._t.join()
+
+    def _run(self):
+        pass
+
+
+class ArmedPlanLeak:
+    def __init__(self, plan):
+        self._plan = plan
+
+    def arm(self):
+        self._plan.__enter__()  # CH703: armed, no __exit__ anywhere in the class
+
+
+class ArmedPlanReleased:
+    def __init__(self, plan):
+        self._plan = plan
+
+    def arm(self):
+        self._plan.__enter__()
+
+    def disarm(self):
+        self._plan.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# CH704 — third-party callbacks under held locks
+# ---------------------------------------------------------------------------
+
+
+class CallbacksUnderLock:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._handlers = []
+        self._hooks = []
+        self._watchers = []
+
+    def add(self, handler):
+        with self._mu:
+            self._handlers.append(handler)  # exempt: registration passes the bare object
+
+    def fire_direct(self, obj):
+        with self._mu:
+            for h in self._handlers:
+                h.on_add(obj)  # CH704: bound-method call under _mu
+
+    def fire_dispatch(self, obj):
+        with self._mu:
+            for h in self._handlers:
+                self._deliver(h.on_add, obj)  # CH704: bound method handed to a dispatcher under _mu
+
+    def fire_param(self, callback):
+        with self._mu:
+            callback()  # CH704: callbackish parameter invoked under _mu
+
+    def fire_alias(self, obj):
+        hooks = list(self._hooks)
+        with self._mu:
+            for h in hooks:
+                h(obj)  # CH704: alias of a callbackish container, invoked under _mu
+
+    def deliver_outside(self, obj):
+        with self._mu:
+            snapshot = list(self._handlers)
+        for h in snapshot:
+            h.on_add(obj)  # exempt: the informer contract — deliver outside the lock
+
+    def ping_watchers(self):
+        with self._mu:
+            for w in self._watchers:
+                w.ping()  # exempt: "watcher" is deliberately not callbackish
+
+    def _deliver(self, fn, obj):
+        fn(obj)
+
+
+# ---------------------------------------------------------------------------
+# CH705 — unbounded growth on daemon paths
+# ---------------------------------------------------------------------------
+
+
+class UnboundedGrowth:
+    """A thread-entry class: unbounded queues and grow-without-shrink
+    containers flag; bounded/annotated/shrunk/non-worker shapes do not."""
+
+    def __init__(self):
+        self._q = queue.Queue()  # CH705: no maxsize on a daemon path
+        self._sq = queue.SimpleQueue()  # CH705: SimpleQueue has no bound at all
+        self._bounded_q = queue.Queue(maxsize=64)
+        self._backlog = []
+        self._seen = {}
+        self._stats = {}
+        self._buf = []
+        self._window = deque(maxlen=128)
+        self._ledger = []
+        self._cold = []
+        self._t = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        item = self._q.get()
+        self._backlog.append(item)  # CH705: grows and nothing ever shrinks it
+        self._seen[item.key] = True  # CH705: variable-key store, never evicted
+        self._stats["polls"] = self._stats.get("polls", 0) + 1  # exempt: fixed vocabulary
+        self._buf.append(item)  # exempt: drain() clears it
+        self._window.append(item)  # exempt: deque(maxlen=...) evicts on append
+        # bounded: fixture — one entry per registered kind ever seen
+        self._ledger.append(item.kind)
+
+    def drain(self):
+        out = list(self._buf)
+        self._buf.clear()
+        return out
+
+    def note(self, x):
+        self._cold.append(x)  # exempt: not reachable from the worker
+
+
+class NoThreadGrowth:
+    """No thread entries: growth follows the caller's lifecycle, not a
+    daemon path — CH705 does not apply."""
+
+    def __init__(self):
+        self._log = []
+
+    def record(self, x):
+        self._log.append(x)

@@ -360,10 +360,61 @@ class TupleUnpackAliases:
         e.append(1)  # RL303 on _tup_elems via element pair in an unpack
 
 
+class CallTupleUnpackAliases:
+    """The ISSUE 16 slice: a callee whose every return is a same-arity
+    tuple LITERAL summarizes positionally, so ``a, b = self._pair()``
+    aliases each target to the matching element (attr elements directly,
+    arg elements through whatever the call site passed)."""
+
+    def __init__(self):
+        self._ct_a = {}
+        self._ct_b = []
+        self._ct_routed = {}
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _pair(self):
+        return self._ct_a, self._ct_b
+
+    def _route(self, p):
+        return p, self._ct_b
+
+    def _worker(self):
+        a, b = self._pair()
+        a["k"] = 1  # RL303 on _ct_a via call-returned tuple unpacking
+        b.append("k")  # RL303 on _ct_b via call-returned tuple unpacking
+        r, _s = self._route(self._ct_routed)
+        r["k"] = 1  # RL303 on _ct_routed via arg element of a tuple summary
+
+
+class StarredUnpackAliases:
+    """The ISSUE 16 slice: one starred TARGET against a tuple literal —
+    prefix targets align with the value prefix, suffix targets with the
+    value suffix; the starred name binds a fresh list and aliases
+    nothing."""
+
+    def __init__(self):
+        self._st_head = {}
+        self._st_tail = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        head, *mid, tail = self._st_head, 0, 1, self._st_tail
+        head["k"] = 1  # RL303 on _st_head via starred-unpack prefix
+        tail.append("k")  # RL303 on _st_tail via starred-unpack suffix
+        mid.append(2)  # silent: the starred name is a fresh list
+
+
+def fixture_disagreeing_pair(flag, a, b):
+    if flag:
+        return a, b
+    return b, a
+
+
 class TupleUnpackExemptions:
-    """NOT flagged: arity mismatch, starred targets, rebinding one of the
-    unpacked names, and unpacking a non-literal RHS all break the alias
-    (over-approximate toward silence)."""
+    """NOT flagged: arity mismatch, a callee whose tuple returns
+    disagree, a starred target against a CALL (element positions are
+    unknowable), starred elements on the VALUE side, rebinding one of
+    the unpacked names (over-approximate toward silence)."""
 
     def __init__(self):
         self._mu = threading.Lock()
@@ -377,16 +428,23 @@ class TupleUnpackExemptions:
         return self._x, self._y
 
     def _worker(self):
-        # non-literal RHS: the call's tuple is not unpacked pairwise
-        a, b = self._pair()
+        # arity mismatch against the callee's tuple summary: unmodeled
+        a, b, c = (*self._pair(), 0)
         a["k"] = 1
         b["k"] = 1
-        # starred target: unmodeled shape
-        c, *rest = self._z, self._w, 0
-        c["k"] = 1
-        # rebinding d after the unpack breaks the alias
-        d, e = self._x, self._y
-        d = {}
+        # disagreeing tuple returns: the callee has no summary
+        d, e = fixture_disagreeing_pair(True, self._z, self._w)
         d["k"] = 1
+        e["k"] = 1
+        # starred target against a call: unmodeled shape
+        f, *rest = self._pair()
+        f["k"] = 1
+        # starred element on the VALUE side: unmodeled shape
+        g, h = (*self._pair(),)
+        g["k"] = 1
+        # rebinding i after the unpack breaks the alias
+        i, j = self._x, self._y
+        i = {}
+        i["k"] = 1
         with self._mu:
-            e["k"] = 1  # under the lock: silent either way
+            j["k"] = 1  # under the lock: silent either way
