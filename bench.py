@@ -26,6 +26,7 @@ identical cluster and asserts assignment-for-assignment equality (the
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import random
@@ -1391,6 +1392,388 @@ def run_preemption(n_nodes: int = 2_000) -> dict:
     }
 
 
+def run_overload(n_nodes: int = 320, surge_mult: float = 3.0,
+                 surge_pods_cap: int = 60_000, max_surge_s: float = 20.0,
+                 goodput_deadline_s: float = 5.0, seed: int = 0,
+                 fast_window_s: float = 0.5, slow_window_s: float = 1.5,
+                 step_hold_s: float = 0.5) -> dict:
+    """Overload-control surge bench (ISSUE 17): drive arrivals at
+    ``surge_mult``x the measured drain capacity through the apiserver's
+    create path and record what the degradation ladder does about it.
+
+    Phases:
+
+    1. **calibrate** — two direct-store batches through the serving loop
+       (the first warms the wave-shape compiles); the second's rate is
+       the drain capacity every other number is relative to.
+    2. **surge** — three arrival threads (batch prio 0 / standard 5 /
+       critical 9, at 50/30/20%) pace paced batch-creates through
+       per-tier ``RemoteStore`` clients at ``surge_mult``x capacity.
+       The ladder engages off the queue-depth gauge; rung 3 throttles
+       the batch tier at the apiserver (429 + Retry-After, honored by
+       the client, rejected when the budget runs out).  Per-pod e2e is
+       stamped create-attempt -> bind (the wave-relative e2e histogram
+       can't see queue backlog or throttle delay).
+    3. **recover** — arrivals stop; the backlog drains; the run clocks
+       how long the ladder takes to walk back to rung 0 (the gauge SLI
+       keeps sampling at zero traffic, so recovery needs no probes).
+    4. **steady-state parity** — a tail batch binds at rung 0 and is
+       replayed through the per-pod CPU oracle seeded with the live
+       world's bound state AND its select_host tie counter (scores are
+       fixed-point integers, so ties are routine and the rotation
+       offset matters), so the tail must match the oracle exactly —
+       occupancy invariants are the verdict gate, the exact map rides
+       along as evidence.
+
+    The verdict block gates: ladder engaged (rung > 0), top-tier p99
+    and goodput strictly better than the batch tier's, full recovery
+    to rung 0, and post-recovery occupancy parity."""
+    import threading
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.client.remote import RemoteStore, RetryExhaustedError
+    from kubernetes_tpu.ops import TPUBatchBackend
+    from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
+    from kubernetes_tpu.store import Store
+    from kubernetes_tpu.testutil import make_node, make_pod
+    from kubernetes_tpu.utils import timeseries as timeseries_mod
+    from kubernetes_tpu.utils.overload import (AdmissionThrottle,
+                                               DegradationLadder,
+                                               overload_slos)
+
+    store = Store(event_log_window=400_000)
+    server = APIServer(store)
+    server.start()
+    cs = Clientset(store)
+    # distinct memories do NOT break score ties (scores are fixed-point
+    # integers); exact replay instead relies on seeding the oracle with
+    # the live select_host tie counter, captured at tail time below.
+    # Generous per-node pod caps stretch the slot budget so the surge
+    # can outlast the SLO windows even at high drain rates.
+    pods_per_node = 200
+    for i in range(n_nodes):
+        cs.nodes.create(make_node(
+            f"node-{i:05d}", cpu="8", memory=f"{16_384 + i}Mi",
+            pods=pods_per_node,
+            labels={"kubernetes.io/hostname": f"node-{i:05d}",
+                    ZONE: f"zone-{i % 3}"}))
+    algo = GenericScheduler()
+    sched = Scheduler(cs, algorithm=algo,
+                      backend=TPUBatchBackend(algorithm=algo),
+                      emit_events=False)
+    sched.start()
+
+    t_create: dict[str, float] = {}
+    t_bind: dict[str, float] = {}
+    rejected: set[str] = set()
+    drain_batches: list[list[str]] = []
+    orig_drain = sched.queue.drain
+
+    def recording_drain(max_n=None):
+        out = orig_drain(max_n)
+        if out:
+            drain_batches.append([p.meta.name for p in out])
+        return out
+
+    sched.queue.drain = recording_drain
+    orig_spb = sched.schedule_pending_batch
+
+    def stamping_spb(max_batch=None):
+        # probe only the pods this wave drained (a full list() per wave
+        # holds the store lock long enough to starve the HTTP handlers
+        # and the arrival threads behind them); failed pods re-queue and
+        # get re-probed when a later wave re-drains them
+        mark = len(drain_batches)
+        r = orig_spb(max_batch)
+        now = time.perf_counter()
+        for batch in drain_batches[mark:]:
+            for n in batch:
+                if n in t_bind:
+                    continue
+                p = cs.pods.get(n)
+                if p is not None and p.spec.node_name:
+                    t_bind[n] = now
+        return r
+
+    sched.schedule_pending_batch = stamping_spb
+
+    stop = threading.Event()
+    max_batch = 384
+    serve = threading.Thread(
+        target=lambda: sched.run_batch_loop(
+            min_batch=32, max_wait=0.05, poll_interval=0.002,
+            max_batch=max_batch, stop=stop),
+        daemon=True)
+    serve.start()
+
+    def _tmpl(name, prio=0):
+        p = make_pod(name, cpu="10m", memory="16Mi")
+        if prio:
+            p.spec.priority = prio
+        return p
+
+    def _wait_all_bound(names, timeout):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if all(n in t_bind for n in names):
+                return True
+            time.sleep(0.02)
+        return False
+
+    try:
+        # -- phase 1: calibrate drain capacity (first batch warms XLA) --
+        cal_rate = None
+        for attempt in range(2):
+            names = [f"cal{attempt}-{i:05d}" for i in range(768)]
+            t0 = time.perf_counter()
+            for n in names:
+                t_create[n] = t0
+            cs.pods.create_many_nowait([_tmpl(n) for n in names])
+            assert _wait_all_bound(names, 120), "calibration never drained"
+            cal_rate = len(names) / (max(t_bind[n] for n in names) - t0)
+        print(f"# overload: drain capacity {cal_rate:.0f} pods/s",
+              file=sys.stderr)
+
+        # -- wire the ladder + throttle (absent during calibration) -----
+        pending_threshold = max(32.0, cal_rate * 0.5)
+        ts_store = timeseries_mod.enable(sched.metrics.registry,
+                                         interval_s=0.1, capacity=4_096)
+        ladder = DegradationLadder(
+            slos=overload_slos(pending_threshold=pending_threshold,
+                               fast_window_s=fast_window_s,
+                               slow_window_s=slow_window_s,
+                               recovery_evals=2),
+            step_hold_s=step_hold_s, recover_hold_s=1.0)
+        sched.attach_overload(ladder)
+        ladder.attach(ts_store)
+        server.admission_throttle = AdmissionThrottle(ladder,
+                                                      retry_after_s=0.75)
+
+        # -- phase 2: the surge ----------------------------------------
+        # sized from a DURATION target, not a pod count: the gauge SLI
+        # only breaches once the windowed means sustain past the slow
+        # window plus the step holds, so a pod cap that silently
+        # shortens the surge below that never engages the ladder.  The
+        # per-node pod cap bounds how many arrivals can ever bind (the
+        # calibration pods and the tail are already on the nodes).
+        arrival_rate = surge_mult * cal_rate
+        slot_budget = n_nodes * pods_per_node - 2 * 768 - 600
+        surge_s_target = min(max_surge_s, slot_budget / arrival_rate)
+        surge_pods = min(surge_pods_cap,
+                         max(900, int(arrival_rate * surge_s_target)))
+        print(f"# overload: surge {surge_pods} pods @ {arrival_rate:.0f}"
+              f"/s (~{surge_pods / arrival_rate:.1f}s, slow window"
+              f" {slow_window_s}s)", file=sys.stderr)
+        tiers = {
+            "batch": dict(prio=0, frac=0.5),
+            "standard": dict(prio=5, frac=0.3),
+            "critical": dict(prio=9, frac=0.2),
+        }
+        clients = {}
+        per_tier_chunks = {}
+        for tname, cfg in tiers.items():
+            n = int(surge_pods * cfg["frac"])
+            rs = RemoteStore(
+                server.url, max_retries=2, retry_backoff=0.05,
+                retry_backoff_max=1.0, retry_seed=seed + cfg["prio"])
+            clients[tname] = rs
+            rcs = Clientset(rs)
+            pods = [_tmpl(f"{tname}-{i:05d}", cfg["prio"]) for i in range(n)]
+            cfg["names"] = [p.meta.name for p in pods]
+            per_tier_chunks[tname] = (rcs, [pods[i:i + 25]
+                                            for i in range(0, n, 25)])
+        # largest-deficit interleave: one shared chunk schedule keeps
+        # the tier mix constant across the whole surge.  Per-tier
+        # arrival threads don't — the un-throttled tiers flood in
+        # early and eat the deepest backlog while the throttled tier's
+        # retry sleeps push its pods into the drained aftermath, which
+        # INVERTS the ordering the throttle exists to produce.
+        schedule = []
+        emitted = {t: 0 for t in tiers}
+        total_chunks = sum(len(c) for _, c in per_tier_chunks.values())
+        for k in range(total_chunks):
+            pick = max(
+                (t for t in tiers if emitted[t] < len(per_tier_chunks[t][1])),
+                key=lambda t: tiers[t]["frac"] * (k + 1) - emitted[t])
+            rcs, chunks = per_tier_chunks[pick]
+            schedule.append((rcs, chunks[emitted[pick]]))
+            emitted[pick] += 1
+        next_idx = [0]
+        idx_lock = threading.Lock()
+        surge_t0 = time.perf_counter()
+
+        def worker():
+            while True:
+                with idx_lock:
+                    k = next_idx[0]
+                    if k >= len(schedule):
+                        return
+                    next_idx[0] = k + 1
+                rcs, chunk = schedule[k]
+                target = surge_t0 + (k * 25) / arrival_rate
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                stamp = time.perf_counter()
+                for p in chunk:
+                    t_create[p.meta.name] = stamp
+                try:
+                    rcs.pods.create_many(chunk)
+                except RetryExhaustedError:
+                    # throttled past the retry budget: load shed
+                    for p in chunk:
+                        rejected.add(p.meta.name)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        surge_end = time.perf_counter()
+
+        # -- phase 3: recovery -----------------------------------------
+        recovery_s = None
+        deadline = surge_end + 180
+        while time.perf_counter() < deadline:
+            if ladder.rung == 0 and len(sched.queue) == 0:
+                recovery_s = round(time.perf_counter() - surge_end, 2)
+                break
+            time.sleep(0.05)
+        accepted = [n for cfg in tiers.values() for n in cfg["names"]
+                    if n not in rejected]
+        _wait_all_bound(accepted, 60)
+
+        # -- phase 4: post-recovery steady state + oracle replay -------
+        tail_mark = len(drain_batches)
+        # all scores are fixed-point integers, so ties are common and
+        # select_host rotates through them with a persistent counter
+        # (reference lastNodeIndex).  The oracle must start its replay
+        # from the live counter or every tied choice lands one rotation
+        # off — captured here, before the tail waves advance it.
+        rr_at_tail = algo._round_robin
+        tail_names = [f"tail-{i:05d}" for i in range(300)]
+        t0 = time.perf_counter()
+        for n in tail_names:
+            t_create[n] = t0
+        cs.pods.create_many_nowait([_tmpl(n) for n in tail_names])
+        tail_bound = _wait_all_bound(tail_names, 60)
+        pods_live, _ = cs.pods.list()
+        live_map = {p.meta.name: p.spec.node_name for p in pods_live}
+    finally:
+        stop.set()
+        sched.queue.close()
+        serve.join(timeout=30)
+        timeseries_mod.disable()
+        server.stop()
+
+    # oracle replay of the tail waves over the live pre-tail state
+    cs_o = Clientset(Store())
+    for i in range(n_nodes):
+        cs_o.nodes.create(make_node(
+            f"node-{i:05d}", cpu="8", memory=f"{16_384 + i}Mi",
+            pods=pods_per_node,
+            labels={"kubernetes.io/hostname": f"node-{i:05d}",
+                    ZONE: f"zone-{i % 3}"}))
+    tail_set = set(tail_names)
+    prebound = [(n, node) for n, node in live_map.items()
+                if node and n not in tail_set]
+    cs_o.pods.create_many_nowait(
+        [make_pod(n, cpu="10m", memory="16Mi", node_name=node)
+         for n, node in prebound])
+    algo_o = GenericScheduler()
+    algo_o._round_robin = rr_at_tail
+    sched_o = Scheduler(cs_o, algorithm=algo_o, emit_events=False)
+    sched_o.start()
+    for batch in drain_batches[tail_mark:]:
+        cs_o.pods.create_many_nowait(
+            [_tmpl(n) for n in batch if n in tail_set])
+        sched_o.pump()
+        sched_o.run_pending()
+    pods_o, _ = cs_o.pods.list()
+    oracle_tail = {p.meta.name: p.spec.node_name for p in pods_o
+                   if p.meta.name in tail_set}
+    live_tail = {n: live_map.get(n) for n in tail_names}
+    tail_counts = collections.Counter(live_tail.values())
+    oracle_counts = collections.Counter(oracle_tail.values())
+    occupancy_parity = (tail_bound and all(live_tail.values())
+                        and tail_counts == oracle_counts)
+    exact_parity = live_tail == oracle_tail
+
+    def _tier_stats(cfg):
+        names = cfg["names"]
+        e2e = sorted(t_bind[n] - t_create[n] for n in names if n in t_bind)
+        good = sum(1 for n in names
+                   if n in t_bind
+                   and t_bind[n] - t_create[n] <= goodput_deadline_s)
+        return {
+            "arrivals": len(names),
+            "rejected": sum(1 for n in names if n in rejected),
+            "bound": len(e2e),
+            "goodput": round(good / max(len(names), 1), 4),
+            "e2e_ms": {
+                "p50": round(e2e[len(e2e) // 2] * 1e3, 1) if e2e else None,
+                "p99": round(e2e[int(len(e2e) * 0.99)] * 1e3, 1)
+                if e2e else None,
+            },
+        }
+
+    tier_stats = {t: _tier_stats(cfg) for t, cfg in tiers.items()}
+    crit, batch = tier_stats["critical"], tier_stats["batch"]
+    tier_p99_ok = (crit["e2e_ms"]["p99"] is not None
+                   and batch["e2e_ms"]["p99"] is not None
+                   and crit["e2e_ms"]["p99"] < batch["e2e_ms"]["p99"])
+    verdict = {
+        "ladder_engaged": ladder.max_rung_seen > 0,
+        "max_rung": ladder.max_rung_seen,
+        "reached_throttle_rung": ladder.max_rung_seen >= 3,
+        "tier_p99_ok": tier_p99_ok,
+        "tier_goodput_ok": crit["goodput"] > batch["goodput"],
+        "recovered": recovery_s is not None,
+        "recovery_s": recovery_s,
+        "post_recovery_occupancy_parity": occupancy_parity,
+        "post_recovery_exact_parity": exact_parity,
+    }
+    verdict["pass"] = all((
+        verdict["ladder_engaged"], verdict["tier_p99_ok"],
+        verdict["tier_goodput_ok"], verdict["recovered"],
+        verdict["post_recovery_occupancy_parity"]))
+    throttle = server.admission_throttle.stats()
+    return {
+        "nodes": n_nodes,
+        "drain_capacity_pods_per_sec": round(cal_rate, 1),
+        "surge_mult": surge_mult,
+        "surge_pods": surge_pods,
+        "surge_s": round(surge_end - surge_t0, 2),
+        "pending_threshold": pending_threshold,
+        "goodput_deadline_s": goodput_deadline_s,
+        "tiers": tier_stats,
+        "rung_timeline": [(round(t, 3), r) for t, r in ladder.history()],
+        "transitions": ladder.transitions,
+        "degradation_transitions_total":
+            sched.metrics.degradation_transitions.value,
+        "score_plane_sheds": sched.metrics.score_plane_sheds.value,
+        "admission": {
+            "admitted": throttle["admitted"],
+            "throttled": throttle["throttled"],
+            "throttled_by_tier": {str(k): v for k, v in
+                                  throttle["throttled_by_tier"].items()},
+            "server_throttled_total": server.admission_throttled.value,
+            "retry_after_honored": {
+                t: clients[t].metrics.retry_after_honored.value
+                for t in tiers},
+        },
+        "tail": {
+            "pods": len(tail_names),
+            "bound": sum(1 for v in live_tail.values() if v),
+            "exact_mismatches": sum(1 for n in tail_names
+                                    if live_tail.get(n) != oracle_tail.get(n)),
+        },
+        "verdict": verdict,
+    }
+
+
 PREFIX_PARITY_K = 2_000
 
 
@@ -1627,7 +2010,70 @@ def main() -> None:
         "claim is the worktree ledger (BENCH_AB_telemetry_overhead."
         "json); --nodes/--pods/--trials override scale and pair count",
     )
+    parser.add_argument(
+        "--overload", nargs="?", const="BENCH_overload.json",
+        default=None, metavar="PATH",
+        help="run the overload-control surge bench (ISSUE 17): arrivals "
+        "at 2-5x measured drain capacity through the apiserver, the "
+        "degradation ladder engaging rung by rung, per-tier goodput/p99, "
+        "post-surge recovery time, and a post-recovery oracle parity "
+        "check; writes the artifact JSON to PATH (default "
+        "BENCH_overload.json) — verdicts are only printed with the "
+        "artifact behind them; --nodes overrides scale",
+    )
+    parser.add_argument(
+        "--overload-mult", type=float, default=3.0, metavar="X",
+        help="surge arrival rate as a multiple of measured drain "
+        "capacity for --overload (default 3.0; the verdict requires "
+        ">= 2.0)",
+    )
     args = parser.parse_args()
+
+    if args.overload is not None:
+        if args.overload_mult < 2.0:
+            parser.error("--overload-mult must be >= 2.0 (the ladder "
+                         "verdict is only meaningful past drain capacity)")
+        res = run_overload(n_nodes=args.nodes or 320,
+                           surge_mult=args.overload_mult)
+        # the no-artifact-no-verdict guard (same contract as --telemetry
+        # and the A/B ledgers): if the JSON cannot be written, refuse to
+        # print the verdict block and exit non-zero — a quoted verdict
+        # with nothing on disk behind it is not evidence
+        try:
+            with open(args.overload, "w") as f:
+                json.dump(res, f, indent=1)
+                f.write("\n")
+        except OSError as e:
+            print(f"# REFUSING to print overload verdicts: artifact "
+                  f"write to {args.overload!r} failed ({e})",
+                  file=sys.stderr)
+            sys.exit(1)
+        v = res["verdict"]
+        t = res["tiers"]
+        print(f"# overload: capacity={res['drain_capacity_pods_per_sec']} "
+              f"pods/s, surge {res['surge_mult']}x for {res['surge_s']}s "
+              f"({res['surge_pods']} pods), max_rung={v['max_rung']}, "
+              f"recovery={v['recovery_s']}s", file=sys.stderr)
+        for name in ("critical", "standard", "batch"):
+            s = t[name]
+            print(f"# overload tier {name}: goodput={s['goodput']} "
+                  f"p99={s['e2e_ms']['p99']}ms rejected={s['rejected']}",
+                  file=sys.stderr)
+        print(f"# overload admission: throttled="
+              f"{res['admission']['throttled']} "
+              f"retry_after_honored={res['admission']['retry_after_honored']}",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": "overload-verdict",
+            "value": 1 if v["pass"] else 0,
+            "unit": "pass",
+            "vs_baseline": 1,
+            "max_rung": v["max_rung"],
+            "recovery_s": v["recovery_s"],
+            "verdict": v,
+            "artifact": args.overload,
+        }))
+        sys.exit(0 if v["pass"] else 1)
 
     if (args.ab_churn or args.ab_pump or args.ab_frontier or args.ab_watch
             or args.ab_loop or args.ab_trace or args.ab_telemetry):

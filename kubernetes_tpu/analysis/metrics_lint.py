@@ -58,6 +58,7 @@ _SLI_CLASSES: dict[str, tuple[tuple[int, ...], frozenset[str]]] = {
     "RatioSLI": ((0, 1), frozenset(
         {"bad_metric", "total_metric", "good_metric"})),
     "QuantileSLI": ((0,), frozenset({"metric"})),
+    "GaugeSLI": ((0,), frozenset({"metric"})),
 }
 
 

@@ -57,6 +57,7 @@ HOT_PATH_MODULES = [
     "kubernetes_tpu/scheduler/scheduler.py",
     "kubernetes_tpu/ops/backend.py",
     "kubernetes_tpu/ops/batch_kernel.py",
+    "kubernetes_tpu/utils/overload.py",
 ]
 
 #: files whose ``*_s`` stats timers must mirror to the trace layer (TC502)

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import sys
 import threading
 from collections import deque
@@ -38,7 +39,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from .. import faults
 from ..admission.framework import AdmissionDenied
+from ..utils import tracing
 from ..utils.health import handle_debug_path
 from ..store.store import (
     AlreadyExistsError,
@@ -160,6 +163,16 @@ class APIServer:
         self.apiservice_status_failures = self.registry.register(Counter(
             "apiserver_apiservice_status_failures_total",
             "best-effort APIService availability updates that failed"))
+        # overload control (ISSUE 17): an AdmissionThrottle (or anything
+        # with .admit(resource, bodies) -> Optional[retry_after_s]) gates
+        # the create paths at rung 3; None = always admit.  Distinct from
+        # the validating admission chain (admission/framework.py): this
+        # one answers 429 + Retry-After, not 400.
+        self.admission_throttle = None
+        self.admission_throttled = self.registry.register(Counter(
+            "apiserver_admission_throttled_total",
+            "create requests answered 429 + Retry-After by the overload "
+            "admission gate (priority tier below the protected floor)"))
         self._telemetry_mu = threading.Lock()
         handler = _make_handler(self)
         if tls is not None:
@@ -248,10 +261,19 @@ def _make_handler(server: APIServer):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in getattr(self, "_extra_headers", ()) or ():
+                self.send_header(k, v)
+            self._extra_headers = ()
             self.end_headers()
             self.wfile.write(data)
 
-        def _error(self, code: int, reason: str, message: str) -> None:
+        def _error(self, code: int, reason: str, message: str,
+                   retry_after: Optional[float] = None) -> None:
+            if retry_after is not None:
+                # RFC 7231 delta-seconds; ceil so a sub-second hint never
+                # rounds down to an immediate retry
+                self._extra_headers = (
+                    ("Retry-After", str(max(1, math.ceil(retry_after)))),)
             self._send(code, {"kind": "Status", "code": code, "reason": reason, "message": message})
 
         def _body(self) -> dict:
@@ -267,6 +289,35 @@ def _make_handler(server: APIServer):
                 else:
                     self._cached_body = json.loads(raw) if raw else {}
             return self._cached_body
+
+        def _admission_gate(self, resource: str, bodies: list) -> bool:
+            """Overload admission (ISSUE 17): rung-3 throttling of create
+            paths.  Returns False when the request was throttled — the
+            429 + Retry-After response is already written (RemoteStore
+            classifies it retryable and honors the hint).  The fault
+            point ``apiserver.admit`` injects a throttle surge here (drop
+            mode; the fault's value is the Retry-After seconds)."""
+            retry_after: Optional[float] = None
+            fault = faults.hit("apiserver.admit", resource=resource,
+                               verb="create", n=len(bodies))
+            if fault is not None and fault.mode == "drop":
+                retry_after = float(fault.value or 1.0)
+            else:
+                gate = server.admission_throttle
+                if gate is not None:
+                    retry_after = gate.admit(resource, bodies)
+            if retry_after is None:
+                return True
+            server.admission_throttled.inc()
+            tr = tracing.current()
+            if tr is not None:
+                tr.instant("apiserver.admit.throttle", resource=resource,
+                           n=len(bodies), retry_after=retry_after)
+            self._error(429, "TooManyRequests",
+                        f"admission throttled under overload "
+                        f"({len(bodies)} {resource})",
+                        retry_after=retry_after)
+            return False
 
         def _serve_telemetry_ingest(self) -> None:
             # the shipper POSTs ndjson (one JSON record per line); plain
@@ -1099,6 +1150,8 @@ def _make_handler(server: APIServer):
                 kind = _kind_for(res)
                 if kind is None:
                     return self._error(404, "NotFound", f"unknown resource {res}")
+                if not self._admission_gate(res, self._body().get("items", [])):
+                    return
                 from ..api.scheme import convert_to_internal
 
                 items = [convert_to_internal(d)
@@ -1151,6 +1204,8 @@ def _make_handler(server: APIServer):
                         return  # error already written
                     return self._send(200, {"items": items, "resourceVersion": rev})
                 if method == "POST":
+                    if not self._admission_gate(parts[0], [self._body()]):
+                        return
                     from ..api.scheme import convert_to_internal
 
                     body = convert_to_internal(self._body())
@@ -1176,6 +1231,8 @@ def _make_handler(server: APIServer):
                         return  # error already written
                     return self._send(200, {"items": items, "resourceVersion": rev})
                 if method == "POST":
+                    if not self._admission_gate(parts[2], [self._body()]):
+                        return
                     from ..api.scheme import convert_to_internal
 
                     body = convert_to_internal(self._body())

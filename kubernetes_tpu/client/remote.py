@@ -93,6 +93,22 @@ def _raise_for_status(body: dict) -> None:
     raise RemoteError(f"{code}: {msg}")
 
 
+def _parse_retry_after(headers) -> Optional[float]:
+    """Server backoff hint from a 429/503 response (ISSUE 17: the
+    apiserver's overload admission gate sends one).  Delta-seconds form
+    only (RFC 7231 §7.1.3) — our servers send integers; the HTTP-date
+    form is ignored.  None = no usable hint."""
+    if headers is None:
+        return None
+    value = headers.get("Retry-After")
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return None
+
+
 class RemoteWatch:
     """Chunked-stream consumer with auto-reconnect from the last revision.
 
@@ -238,6 +254,16 @@ class RemoteWatch:
                     self._queue.put(WatchEvent(
                         WATCH_GAP, "", "", self._last_rev or 0, {}))
                     return
+                # a throttled reconnect (429/503) carries the server's
+                # Retry-After hint: honor it — never shorter than our own
+                # backoff, clamped to max_backoff (ISSUE 17)
+                sleep_s = backoff
+                if (isinstance(e, urllib.error.HTTPError)
+                        and e.code in (429, 503)):
+                    hint = _parse_retry_after(e.headers)
+                    if hint is not None:
+                        sleep_s = min(max(hint, backoff), self._max_backoff)
+                        self.metrics.retry_after_honored.inc()
                 # warn once on the transition into the broken state; the
                 # retries of an outage that persists log at debug (a dead
                 # server would otherwise emit a warning every backoff)
@@ -245,8 +271,8 @@ class RemoteWatch:
                        else logger.debug)
                 log("watch %s: transient %s: %s — reconnecting from "
                     "revision %s in %.2fs", self._resource,
-                    type(e).__name__, e, self._last_rev, backoff)
-                self._sleep(backoff)
+                    type(e).__name__, e, self._last_rev, sleep_s)
+                self._sleep(sleep_s)
                 backoff = min(backoff * 2, self._max_backoff)
                 self.metrics.watch_reconnects.inc()
             finally:
@@ -368,10 +394,18 @@ class RemoteStore:
         reason = getattr(e, "reason", e)
         return isinstance(reason, ConnectionRefusedError)
 
-    def _retry_delay(self, attempt: int) -> float:
+    def _retry_delay(self, attempt: int,
+                     retry_after: Optional[float] = None) -> float:
         """Exponential backoff with jitter in [0.5x, 1.5x) of the nominal
-        step — deterministic per client (seeded RNG)."""
-        nominal = min(self.retry_backoff * (2 ** attempt), self.retry_backoff_max)
+        step — deterministic per client (seeded RNG).  When the server
+        sent a ``Retry-After`` hint (429/503), the hint replaces the
+        exponential step — clamped to ``retry_backoff_max`` — with the
+        SAME seeded jitter applied, so throttled herds still
+        desynchronize instead of re-converging on the hint."""
+        if retry_after is not None:
+            nominal = min(max(retry_after, 0.0), self.retry_backoff_max)
+        else:
+            nominal = min(self.retry_backoff * (2 ** attempt), self.retry_backoff_max)
         return nominal * (0.5 + self._retry_rng.random())
 
     def _request_with_retries(self, send: Callable[[], "object"], method: str,
@@ -385,6 +419,7 @@ class RemoteStore:
         to the caller for body decoding (the Status body carries the real
         reason: AlreadyExists vs Conflict, etc.)."""
         last_err: Optional[BaseException] = None
+        retry_after: Optional[float] = None
         for attempt in range(self.max_retries + 1):
             if attempt > 0:
                 tr = tracing.current()
@@ -394,7 +429,10 @@ class RemoteStore:
                     # the faults seam
                     tr.instant("remote.request.retry", method=method,
                                path=path, attempt=attempt)
-                self._sleep(self._retry_delay(attempt - 1))
+                self._sleep(self._retry_delay(attempt - 1, retry_after))
+                if retry_after is not None:
+                    self.metrics.retry_after_honored.inc()
+                retry_after = None
                 self.metrics.remote_retries.inc()
             try:
                 faults.hit("remote.request", method=method, path=path,
@@ -402,6 +440,10 @@ class RemoteStore:
                 return send()
             except urllib.error.HTTPError as e:
                 if e.code in RETRYABLE_STATUS:
+                    # the throttle hint must be read BEFORE the drain
+                    # below invalidates the response object
+                    if e.code in (429, 503):
+                        retry_after = _parse_retry_after(e.headers)
                     # drain + close: keep-alive sockets with pending bodies
                     # cannot be reused, and the retry opens a fresh one
                     try:

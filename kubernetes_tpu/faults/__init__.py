@@ -39,6 +39,10 @@ telemetry.ship           TelemetryShipper._ship_batch       retry + backoff; exh
                          (one batch through the sink)       batches degrade to the local
                                                             dead ring — a dead collector
                                                             never stalls a wave
+apiserver.admit          APIServer create-path admission    client retries honoring
+                         gate (429 + Retry-After)           Retry-After; delayed pods
+                                                            re-decide — occupancy
+                                                            invariants converge
 ======================== ================================== ===========================
 """
 
@@ -97,6 +101,11 @@ register("telemetry.ship",
          "POST) — error: the collector is down; retry + backoff, then the "
          "batch degrades to the shipper's local dead ring (never blocks "
          "the pipeline)")
+register("apiserver.admit",
+         "the apiserver's overload admission gate on create paths — "
+         "drop: the request is throttled with 429 + Retry-After (the "
+         "fault's value is the hint in seconds); clients classify it "
+         "retryable, honor the hint, and the delayed pods re-decide")
 register("backend.compact",
          "frontier-scan node-axis compaction (phase=seed: the tensorize-"
          "time monotone prefilter; phase=gather: the mid-segment device "

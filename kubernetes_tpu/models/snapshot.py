@@ -1023,9 +1023,15 @@ class Tensorizer:
         # Cross-wave node-static row cache (see NodeStaticRows).
         self.persistent_rows = persistent_rows
         self._node_rows: Optional[NodeStaticRows] = None
+        # Overload ladder rung 1 (ISSUE 17): a live multiplier on every
+        # bucket multiple.  Coarser buckets mean fewer distinct compiled
+        # shapes while a surge churns the axis sizes; padding UP is
+        # semantically inert, and the sticky high-water discipline means
+        # scaling back to 1 never shrinks a shape mid-run.
+        self.bucket_scale = 1
 
     def _bucket(self, axis: str, n: int, multiple: int) -> int:
-        return self._sticky_pad(axis, _pad_to(n, multiple))
+        return self._sticky_pad(axis, _pad_to(n, multiple * max(1, int(self.bucket_scale))))
 
     def _sticky_pad(self, axis: str, pad: int) -> int:
         """One high-water discipline for every axis — including the vols

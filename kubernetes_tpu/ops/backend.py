@@ -199,6 +199,14 @@ class TPUBatchBackend:
         self.frontier_device_loop = frontier_device_loop
         # wired to scheduler_frontier_compactions_total
         self.frontier_counter = None
+        # overload ladder rung 2 (ISSUE 17): when set, _kernel_weights
+        # zeroes the preferred interpod-affinity SCORE plane — feasibility
+        # predicates (incl. required affinity) are untouched, so occupancy
+        # invariants vs the oracle still hold; only preferred-placement
+        # quality degrades.  Wired by the scheduler per wave.
+        self.shed_score_planes = False
+        # wired to scheduler_score_plane_sheds_total
+        self.shed_counter = None
         # per-batch frontier trajectory: one entry per frontier segment
         # ({"widths": [...], "alive_frac": [...], ...}); bench snapshots it
         self.last_frontier: list = []
@@ -473,6 +481,16 @@ class TPUBatchBackend:
             if key is None:
                 return None
             weights[key] += weight
+        if self.shed_score_planes and weights["interpod"]:
+            # overload rung 2: the interpod score plane is the kernel's
+            # most expensive priority (pairwise term matching); shedding
+            # it changes WHICH feasible node wins, never whether a pod
+            # fits — counted so the degradation is stated, not silent
+            weights["interpod"] = 0
+            self.stats["score_plane_sheds"] = (
+                self.stats.get("score_plane_sheds", 0) + 1)
+            if self.shed_counter is not None:
+                self.shed_counter.inc()
         return weights
 
     def _config_supported(self) -> Optional[dict]:

@@ -110,6 +110,11 @@ class Scheduler:
             backend.breaker_counter = self.metrics.kernel_breaker_transitions
         if backend is not None and hasattr(backend, "frontier_counter"):
             backend.frontier_counter = self.metrics.frontier_compactions
+        if backend is not None and hasattr(backend, "shed_counter"):
+            backend.shed_counter = self.metrics.score_plane_sheds
+        # overload control (ISSUE 17): a DegradationLadder wired via
+        # attach_overload; None = full fidelity always
+        self.overload = None
         self.emit_events = emit_events
         self.enable_preemption = enable_preemption
         self._clock = clock
@@ -424,10 +429,18 @@ class Scheduler:
         if latest.spec.node_name or not _is_scheduler_pod(latest, self.scheduler_name):
             return  # bound by someone else, or became terminal
         if self.enable_preemption and latest.spec.priority > 0:
-            if preempt_cohort is not None:
+            # overload ladder (ISSUE 17): at rung >= 2 the batched
+            # PostFilter pass is reserved for the critical tier — lower
+            # tiers take the plain backoff requeue below, so top-tier
+            # preemption work is never diluted by standard-tier churn
+            ov = self.overload
+            if (ov is not None
+                    and ov.classifier.tier_of(latest) < ov.preempt_tier_floor):
+                self.metrics.preemption_sheds.inc()
+            elif preempt_cohort is not None:
                 preempt_cohort.append(latest)  # requeue decided at cohort time
                 return
-            if self._try_preempt(latest):
+            elif self._try_preempt(latest):
                 self.queue.add(latest)  # victims evicted; retry immediately
                 return
         delay = self.backoff.get_backoff(pod.meta.key)
@@ -663,6 +676,43 @@ class Scheduler:
                 # device shadow (same clock reads as _last_prep_s)
                 tr.complete("prep", t0, t_end, cat="phase", polled=poll)
 
+    # -- overload control (ISSUE 17) ---------------------------------------
+    def attach_overload(self, ladder) -> None:
+        """Wire a ``utils.overload.DegradationLadder``: its rung lands in
+        this scheduler's gauge/counter, and the batch loop consults it
+        every iteration for effective accumulation knobs, score-plane
+        shedding, and the preemption tier floor."""
+        self.overload = ladder
+        ladder.gauge = self.metrics.degradation_rung
+        ladder.transition_counter = self.metrics.degradation_transitions
+
+    def _apply_overload_knobs(self) -> None:
+        """Push the ladder's rung-1/2 knobs onto the backend before a
+        wave: score-plane shedding and sticky-bucket coarsening.  Cheap
+        and idempotent — called once per wave."""
+        ov = self.overload
+        if ov is None or self.backend is None:
+            return
+        if hasattr(self.backend, "shed_score_planes"):
+            self.backend.shed_score_planes = ov.shed_score_planes
+        tz = getattr(self.backend, "tensorizer", None)
+        if tz is not None and hasattr(tz, "bucket_scale"):
+            tz.bucket_scale = ov.bucket_scale
+
+    def _top_tier_ready(self) -> bool:
+        """True when a critical-tier pod is waiting in the queue — under
+        overload the accumulation window breaks early for it (the top
+        tier never waits the widened window).  O(pending) scan; callers
+        rate-limit it."""
+        ov = self.overload
+        if ov is None:
+            return False
+        cls = ov.classifier
+        for pod in self.queue.snapshot_pending():
+            if cls.tier_of(pod) >= cls.CRITICAL:
+                return True
+        return False
+
     def run_batch_loop(
         self,
         min_batch: int = 1,
@@ -695,14 +745,24 @@ class Scheduler:
         while not stopped() and (max_waves is None or waves < max_waves):
             self.pump()
             ready = len(self.queue)
+            self.metrics.pending_pods.set(float(ready))
             if ready == 0:
                 if idle_deadline is not None and self._clock() >= idle_deadline:
                     break
                 self.queue.wait_ready(timeout=poll_interval)
                 continue
+            # overload ladder (ISSUE 17): knobs are re-read every
+            # iteration, so a rung change takes effect on the NEXT wave
+            # without restarting the loop
+            ov = self.overload
+            eff_min_batch, eff_max_wait = min_batch, max_wait
+            if ov is not None:
+                ov.poll()
+                eff_min_batch, eff_max_wait = ov.batch_knobs(min_batch, max_wait)
             t_first = self._clock()
-            while (ready < min_batch and not stopped()
-                   and self._clock() - t_first < max_wait):
+            tier_check_at = t_first  # rate-limits the O(pending) tier scan
+            while (ready < eff_min_batch and not stopped()
+                   and self._clock() - t_first < eff_max_wait):
                 # plain sleep, NOT wait_ready: something is already ready
                 # (that's how we got here), so wait_ready would return
                 # immediately and turn the accumulation window into a
@@ -710,14 +770,23 @@ class Scheduler:
                 time.sleep(poll_interval)
                 self.pump()
                 ready = len(self.queue)
+                if ov is not None and ov.rung >= 1:
+                    now = self._clock()
+                    if now >= tier_check_at:
+                        tier_check_at = now + 0.025
+                        if self._top_tier_ready():
+                            break  # critical pods never wait the widened window
             queue_wait = self._clock() - t_first
             self.metrics.batch_queue_wait.observe(queue_wait * 1e6)
+            self.metrics.pending_pods.set(float(ready))
             # the accumulation window rides onto the next wave's root
             # span (ISSUE 7): queue wait + how many pods the window
             # gathered vs the min-batch target
             self._wave_attrs_pending = {
                 "queue_wait_s": round(queue_wait, 6),
-                "accumulated": ready, "min_batch": min_batch}
+                "accumulated": ready, "min_batch": eff_min_batch}
+            if ov is not None:
+                self._wave_attrs_pending["overload_rung"] = ov.rung
             bound, _ = self.schedule_pending_batch(max_batch)
             bound_total += bound
             waves += 1
@@ -734,6 +803,7 @@ class Scheduler:
         pods = self.queue.drain(max_batch)
         if not pods:
             return (0, 0)
+        self._apply_overload_knobs()
         self.metrics.batch_size.observe(len(pods))
         tr = tracing.current()
         # Cyclic GC is paused for the whole batch (tensorize + kernel +

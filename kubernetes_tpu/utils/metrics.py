@@ -258,6 +258,13 @@ class ClientMetrics:
             "client_informer_compaction_freed_bytes",
             "approximate wire-payload bytes released by the most recent "
             "compaction sweep"))
+        # overload control (ISSUE 17): retries whose backoff came from a
+        # server Retry-After hint (429/503) instead of the client-side
+        # exponential schedule
+        self.retry_after_honored = r.register(Counter(
+            "client_retry_after_honored_total",
+            "retry sleeps that honored a server Retry-After header "
+            "(clamped to the client's max backoff, jitter preserved)"))
 
 
 # informers without an explicit metrics object aggregate here: one place
@@ -401,3 +408,27 @@ class SchedulerMetrics:
             "scheduler_preemption_victims_total"))
         self.preemption_latency = r.register(Histogram(
             "scheduler_preemption_latency_microseconds"))
+        # overload control (ISSUE 17): the degradation ladder's state and
+        # its shed actions.  pending_pods is the ladder's input signal
+        # (GaugeSLI windowed mean — sampled every scrape, so the ladder
+        # can recover even with zero traffic); the rest are its outputs.
+        self.pending_pods = r.register(Gauge(
+            "scheduler_pending_pods",
+            "ready pods in the scheduling queue at the last batch-loop "
+            "iteration (the overload ladder's queue-depth signal)"))
+        self.degradation_rung = r.register(Gauge(
+            "scheduler_degradation_rung",
+            "current overload degradation rung (0=full fidelity, "
+            "1=widened batching, 2=score planes shed, 3=admission "
+            "throttled)"))
+        self.degradation_transitions = r.register(Counter(
+            "scheduler_degradation_transitions_total",
+            "degradation-ladder rung changes (engage, step, recover)"))
+        self.score_plane_sheds = r.register(Counter(
+            "scheduler_score_plane_sheds_total",
+            "batches scheduled with preferred interpod-affinity score "
+            "planes shed (rung >= 2; feasibility untouched)"))
+        self.preemption_sheds = r.register(Counter(
+            "scheduler_preemption_sheds_total",
+            "preemption-eligible pods denied the PostFilter pass because "
+            "their tier is below the ladder's floor (rung >= 2)"))

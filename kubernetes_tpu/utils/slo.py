@@ -6,7 +6,9 @@ SLI derived from the time-series rings (``utils/timeseries.py``):
 - :class:`RatioSLI` — bad/total counter deltas over a window (the
   bind-requeue rate, watch-gap rate);
 - :class:`QuantileSLI` — the fraction of a histogram quantile track's
-  samples above a threshold over a window (wave e2e latency p99).
+  samples above a threshold over a window (wave e2e latency p99);
+- :class:`GaugeSLI` — the windowed mean of a gauge track graded against
+  a threshold (queue depth for overload control).
 
 Evaluation is the SRE multi-window burn-rate recipe: the *burn rate* is
 ``bad_fraction / error_budget`` and a breach fires only when BOTH the
@@ -81,6 +83,34 @@ class QuantileSLI:
 
 
 @dataclass(frozen=True)
+class GaugeSLI:
+    """Windowed mean of a gauge track against a threshold, graded: the
+    bad fraction is how far the mean exceeds the threshold (clamped to
+    [0, 1]), so the burn rate scales with severity instead of stepping.
+    Gauges are sampled every scrape regardless of traffic, so this SLI
+    keeps producing data — and can therefore *recover* — even when the
+    pipeline goes quiet, unlike counter-delta ratios (the property the
+    degradation ladder in ``utils/overload.py`` needs to step back down
+    after a surge drains)."""
+
+    metric: str
+    threshold: float
+
+    def bad_fraction(self, store: TimeSeriesStore,
+                     window_s: float) -> Optional[float]:
+        samples = store.query(self.metric, window_s)
+        if not samples:
+            return None
+        mean = sum(v for _, v in samples) / len(samples)
+        if self.threshold <= 0:
+            return 1.0 if mean > 0 else 0.0
+        return max(0.0, min(1.0, mean / self.threshold - 1.0))
+
+    def tracks(self) -> list[str]:
+        return [self.metric]
+
+
+@dataclass(frozen=True)
 class SLO:
     """One objective over one SLI, with its burn-rate policy.  The
     default thresholds are the classic SRE pairing: 14.4x on a short
@@ -88,7 +118,7 @@ class SLO:
     catches a slow leak — both must agree before anyone is paged."""
 
     name: str
-    sli: object  # RatioSLI | QuantileSLI
+    sli: object  # RatioSLI | QuantileSLI | GaugeSLI
     objective: float = 0.99
     fast_window_s: float = 60.0
     slow_window_s: float = 300.0

@@ -8,6 +8,8 @@
 #   3. the tier-1 analyzer gate tests (fixture pins + live-tree-clean +
 #      wall-time budget), so a pass regression fails even when the live
 #      tree happens to be clean.
+#   4. a fast smoke of the overload degradation-ladder unit tests (the
+#      fake-clock ladder semantics — seconds, not the full suite).
 #
 # Usage: scripts/check.sh [ktpu-analyze args...]
 # Extra args are forwarded to ktpu-analyze — e.g. `scripts/check.sh
@@ -26,3 +28,6 @@ python scripts/check_ledgers.py
 
 echo "== analyzer gate tests =="
 python -m pytest tests/test_static_analysis.py -q -p no:cacheprovider
+
+echo "== overload ladder smoke =="
+python -m pytest tests/test_overload.py -q -p no:cacheprovider -k "ladder"

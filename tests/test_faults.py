@@ -866,6 +866,12 @@ MATRIX = {
                   error_factory=lambda: ConnectionResetError("cut")),
         world="remote", exact=True,
         check=lambda w, plan: w.remote.metrics.watch_reconnects.value > 0),
+    # special-cased throttle-surge run (ISSUE 17): the apiserver's
+    # overload admission gate answers 429 + Retry-After on create paths;
+    # the client retries honoring the hint, the delayed pods re-decide,
+    # and occupancy invariants converge (re-decision class — same
+    # rationale as informer.decode)
+    "apiserver.admit": dict(world="admit"),
 }
 
 
@@ -966,6 +972,71 @@ def _run_telemetry_matrix(oracle_bindings):
         tracing.disable()
 
 
+def _run_admit_matrix(oracle_bindings):
+    """Throttle surge on the create path: the apiserver's overload
+    admission gate answers 429 + Retry-After for the first few create
+    attempts, the RemoteStore client classifies them retryable and
+    honors the hint (clamped to its max backoff), the delayed pods
+    arrive mid-run and re-decide — per-node occupancy must converge to
+    the fault-free oracle's (re-decision class, like informer.decode),
+    and the recovery must be visible in both the server throttle
+    counter and the client's Retry-After counter."""
+    from kubernetes_tpu.apiserver import APIServer
+
+    server = APIServer(Store())
+    server.start()
+    w = None
+    try:
+        w = World(server=server)
+        # a SECOND remote client for the workload: World.create_workload
+        # uses the direct store handle, which never crosses the wire —
+        # the admission gate only sees HTTP create paths
+        rcs_store = _fast_store(
+            server, sleep=lambda s: _time.sleep(min(s, 0.02)))
+        rcs = Clientset(rcs_store)
+        # first_n=2 < the client's retry budget (4 attempts), so the
+        # throttled create succeeds on its 3rd attempt instead of
+        # exhausting; value is the Retry-After hint in seconds
+        plan = FaultPlan(seed=11).on(
+            "apiserver.admit", mode="drop", value=0.05, first_n=2)
+        with plan.armed():
+            # phase 1: half the workload, with the surge armed — the
+            # first create eats both throttles, then lands
+            for i in range(N_PODS // 2):
+                rcs.pods.create(make_pod(f"work-{i:03d}", cpu="200m",
+                                         memory="256Mi"))
+            w.drive(rounds=6, realtime=True)
+            # phase 2: the rest arrives while scheduling is underway,
+            # so the delayed pods genuinely re-decide against a
+            # partially packed fleet
+            for i in range(N_PODS // 2, N_PODS):
+                rcs.pods.create(make_pod(f"work-{i:03d}", cpu="200m",
+                                         memory="256Mi"))
+            w.drive(realtime=True)
+        if not w.converged():
+            _wait(lambda: (w.sched.pump(), w.drive(rounds=5, realtime=True),
+                           w.converged())[-1], timeout=10.0)
+        assert w.converged(), "cluster never converged after throttle surge"
+        assert plan.fired["apiserver.admit"] == 2, "throttle fault never fired"
+        got = w.bindings()
+        # re-decision class: identical pods make per-node occupancy the
+        # invariant (the delayed creates legitimately reorder the queue)
+        assert _counts(got) == _counts(oracle_bindings), (
+            "occupancy diverged from the fault-free oracle post-recovery")
+        assert set(got) == set(oracle_bindings)
+        # recovery visible in the new counters on both sides of the wire
+        assert server.admission_throttled.value == 2
+        assert rcs_store.metrics.retry_after_honored.value == 2, (
+            "client did not honor the Retry-After hint on retry")
+    finally:
+        # stop the remote watch threads BEFORE the server: an orphaned
+        # watcher retrying a dead port emits reconnect instants into
+        # whatever tracing context later tests enable
+        if w is not None:
+            w.sched.informers.stop_all()
+        server.stop()
+
+
 @pytest.mark.parametrize("point", sorted(MATRIX))
 def test_fault_matrix_converges_to_oracle_bindings(point, oracle_bindings,
                                                   tmp_path):
@@ -976,6 +1047,9 @@ def test_fault_matrix_converges_to_oracle_bindings(point, oracle_bindings,
     if scenario["world"] == "telemetry":
         _run_telemetry_matrix(oracle_bindings)
         return
+    if scenario["world"] == "admit":
+        _run_admit_matrix(oracle_bindings)
+        return
 
     server = None
     if scenario["world"] == "remote":
@@ -983,6 +1057,7 @@ def test_fault_matrix_converges_to_oracle_bindings(point, oracle_bindings,
 
         server = APIServer(Store())
         server.start()
+    w = None
     try:
         w = World(server=server)
         plan = FaultPlan(seed=42).on(point, FaultSpec(**scenario["spec"]))
@@ -1007,6 +1082,10 @@ def test_fault_matrix_converges_to_oracle_bindings(point, oracle_bindings,
             f"{point}: recovery path not visible in metrics")
     finally:
         if server is not None:
+            # watchers first: an orphaned watcher retrying a dead port
+            # emits reconnect instants into later tests' tracing
+            if w is not None:
+                w.sched.informers.stop_all()
             server.stop()
 
 

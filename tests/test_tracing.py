@@ -369,6 +369,7 @@ def _warm_then_fire(point, scenario, tmp_path):
 
         server = APIServer(Store())
         server.start()
+    w = None
     try:
         w = World(server=server)
         realtime = scenario["world"] == "remote"
@@ -386,6 +387,10 @@ def _warm_then_fire(point, scenario, tmp_path):
         assert plan.fired.get(point, 0) > 0, f"{point}: fault never fired"
     finally:
         if server is not None:
+            # watchers first: an orphaned watcher retrying a dead port
+            # emits reconnect instants into later tests' tracing
+            if w is not None:
+                w.sched.informers.stop_all()
             server.stop()
 
 
@@ -434,6 +439,35 @@ def _telemetry_fire(point):
         timeseries.disable()
 
 
+def _admit_fire(point):
+    """apiserver.admit fires in the HTTP handler's admission gate, off
+    every wave path: warm waves fill the recorder ring first, then a
+    remote create hits the armed gate (dropped to 429 + Retry-After;
+    the client's retry lands it)."""
+    from kubernetes_tpu.apiserver import APIServer
+
+    server = APIServer(Store())
+    server.start()
+    w = None
+    try:
+        w = World(server=server)
+        for i in range(8):
+            w.cs.pods.create(make_pod(f"warm-{i:03d}", cpu="200m",
+                                      memory="256Mi"))
+        w.drive(rounds=4, relist_every=0, realtime=True)
+        assert len(tracing.current().ring) >= 1, "warm phase completed no wave"
+        plan = FaultPlan(seed=3).on(point, mode="drop", value=0.05,
+                                    first_n=1)
+        rcs = Clientset(w.remote)  # the gate only sees HTTP create paths
+        with plan.armed():
+            rcs.pods.create(make_pod("admit-marker", cpu="100m"))
+        assert plan.fired.get(point, 0) == 1, f"{point}: fault never fired"
+    finally:
+        if w is not None:
+            w.sched.informers.stop_all()
+        server.stop()
+
+
 @pytest.mark.timeout(180)
 @pytest.mark.parametrize("point", sorted(MATRIX))
 def test_every_fault_point_dumps_the_firing_waves_trace(point, tmp_path):
@@ -450,6 +484,8 @@ def test_every_fault_point_dumps_the_firing_waves_trace(point, tmp_path):
         _wal_fire(point, tmp_path)
     elif scenario["world"] == "telemetry":
         _telemetry_fire(point)
+    elif scenario["world"] == "admit":
+        _admit_fire(point)
     else:
         _warm_then_fire(point, scenario, tmp_path)
 
