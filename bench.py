@@ -348,7 +348,7 @@ def _oracle_replay_waves(drain_batches: list, final_assignments: dict,
     flat = [k for b in drain_batches for k in b]
     if len(set(flat)) != len(flat):
         return {"mode": "skipped (requeues present)",
-                "checked": 0, "mismatches": -1}
+                "checked": 0, "mismatches": -1, "round_robin": None}
     from kubernetes_tpu.client import Clientset
     from kubernetes_tpu.scheduler import GenericScheduler, Scheduler
     from kubernetes_tpu.store import Store
@@ -381,8 +381,13 @@ def _oracle_replay_waves(drain_batches: list, final_assignments: dict,
                 if len(sample) < 5:
                     sample.append((key, got.get(key),
                                    final_assignments.get(key)))
+    # the oracle's final select_host tie-rotation counter: the sharded
+    # loop's cross-shard tie-break must leave the timed run's counter at
+    # exactly this value or every later tied choice lands one rotation
+    # off (the --multichip ledger gates on the comparison)
     return {"mode": "exact per-wave replay", "checked": checked,
-            "mismatches": mismatches, "sample": sample}
+            "mismatches": mismatches, "sample": sample,
+            "round_robin": sched.algorithm._round_robin}
 
 
 def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
@@ -391,7 +396,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               frontier: bool = True, watch_frames: bool = True,
               device_loop: bool = True, frontier_chunk: int = 512,
               verify_oracle: bool = False, trace=None,
-              telemetry=None) -> dict:
+              telemetry=None, mesh: bool = False) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
     created the moment wave w leaves the queue, the density.go shape
@@ -461,7 +466,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
         run_churn(n_nodes, 2 * (total_pods // waves), 2, workload, seed + 1,
                   warmup=False, pipeline=pipeline, lazy_ingest=lazy_ingest,
                   frontier=frontier, watch_frames=watch_frames,
-                  device_loop=device_loop, frontier_chunk=frontier_chunk)
+                  device_loop=device_loop, frontier_chunk=frontier_chunk,
+                  mesh=mesh)
 
     lazy_was = lazy_mod.ENABLED
     frames_was = frames_mod.ENABLED
@@ -476,7 +482,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
         r = _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
                              pipeline, lazy_ingest, frontier,
                              watch_frames, device_loop, frontier_chunk,
-                             verify_oracle, telemetry)
+                             verify_oracle, telemetry, mesh)
     finally:
         lazy_mod.ENABLED = lazy_was
         frames_mod.ENABLED = frames_was
@@ -511,7 +517,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
 
 def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
                      lazy_ingest, frontier, watch_frames, device_loop,
-                     frontier_chunk, verify_oracle, telemetry=None) -> dict:
+                     frontier_chunk, verify_oracle, telemetry=None,
+                     mesh=False) -> dict:
     import threading
 
     from kubernetes_tpu.api import lazy as lazy_mod
@@ -531,9 +538,13 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
     all_pods = make_pods(total_pods, rng, workload)
 
     algo = GenericScheduler()
+    # mesh=True forces the sharded wave loop (frontier_mesh default is
+    # "auto", which stays single-device on the CPU backend); everything
+    # else about the harness is identical, so a mesh run is A/B-comparable
     backend = TPUBatchBackend(algorithm=algo, frontier=frontier,
                               frontier_device_loop=device_loop,
-                              frontier_chunk=frontier_chunk)
+                              frontier_chunk=frontier_chunk,
+                              frontier_mesh=(True if mesh else "auto"))
     if not pipeline:
         backend.tensorizer = Tensorizer(sticky_buckets=False,
                                         persistent_rows=False)
@@ -641,6 +652,11 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             # per-wave alive-union trajectory (the ISSUE 5 artifact):
             # prefilter width + per-chunk alive fractions per segment
             ph["frontier"] = fr
+        mw = sched.last_batch_phases.get("mesh")
+        if mw:
+            # sharded-wave attribution (ISSUE 18): shard count, per-shard
+            # upload fractions, and the alive-fraction skew of the wave
+            ph["mesh"] = mw
         phase_timers.append(ph)
     elapsed = time.perf_counter() - t0
     arr.join(timeout=10)
@@ -698,6 +714,13 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             drain_batches, {p.meta.key: p.spec.node_name or None
                             for p in pods_final},
             n_nodes, total_pods, workload, seed)
+        # rr tie-counter parity: the deterministic cross-shard tie-break
+        # must advance the timed run's rotation counter exactly as the
+        # sequential oracle does
+        oracle_parity["round_robin_timed"] = algo._round_robin
+        oracle_parity["round_robin_match"] = (
+            oracle_parity["round_robin"] is not None
+            and oracle_parity["round_robin"] == algo._round_robin)
     return {
         "nodes": n_nodes,
         "pods": total_pods,
@@ -723,6 +746,15 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             "col_updates": ncache["col_updates"],
             "dirty_fraction": round(
                 ncache["dirty_cols"] / max(ncache["cols_total"], 1), 4),
+            # per-shard cumulative upload attribution (ISSUE 18): only
+            # populated when the node cache served a sharded mesh
+            **({"shard_dirty_cols": list(ncache["shard_dirty_cols"]),
+                "shard_cols_total": list(ncache["shard_cols_total"]),
+                "shard_upload_fractions": [
+                    round(d / max(c, 1), 4)
+                    for d, c in zip(ncache["shard_dirty_cols"],
+                                    ncache["shard_cols_total"])]}
+               if ncache.get("shard_cols_total") else {}),
         },
         # frontier scan (ISSUE 5): segments served, device compactions,
         # tensorize-time column drops, full-width retries
@@ -733,6 +765,16 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
             "prefilter_cols": backend.stats["frontier_prefilter_cols"],
             "fallbacks": backend.stats["frontier_fallbacks"],
             "loop_fallbacks": backend.stats["frontier_loop_fallbacks"],
+            "fallback_modes": dict(backend.stats["frontier_fallback_modes"]),
+        },
+        # sharded wave loop (ISSUE 18): requested mode, observed shard
+        # count, and the per-wave attribution attrs (also on each
+        # phase_timers[w]["mesh"])
+        "mesh": {
+            "requested": bool(mesh),
+            "n_shards": max((p["mesh"]["n_shards"] for p in phase_timers
+                             if p.get("mesh")), default=0),
+            "waves_sharded": sum(1 for p in phase_timers if p.get("mesh")),
         },
         # device-resident wave loop (ISSUE 11): blocking device→host
         # round-trips the run actually paid, per wave and in total
@@ -1774,6 +1816,171 @@ def run_overload(n_nodes: int = 320, surge_mult: float = 3.0,
     }
 
 
+MULTICHIP_DEVICE_COUNTS = (1, 2, 4, 8)
+
+
+def run_multichip_child(cfg: dict) -> dict:
+    """One ``--multichip`` measurement in a FRESH process: force an
+    ``n_devices``-way virtual CPU platform before jax initializes (the
+    parent also sets ``XLA_FLAGS``/``JAX_PLATFORMS`` in the child env —
+    belt and braces), run the churn harness with the sharded wave loop
+    forced on (n >= 2; n = 1 is the single-device loop baseline), and
+    report the parity / host-sync / upload-attribution evidence the
+    ledger gates on.  One process per device count is mandatory: the
+    device count is fixed at jax initialization."""
+    from kubernetes_tpu.utils.platform import force_virtual_cpu
+
+    nd = int(cfg["n_devices"])
+    force_virtual_cpu(nd)
+    r = run_churn(n_nodes=int(cfg["nodes"]), total_pods=int(cfg["pods"]),
+                  waves=int(cfg["waves"]),
+                  workload=cfg.get("workload", "mixed"),
+                  seed=int(cfg.get("seed", 0)),
+                  frontier_chunk=int(cfg.get("chunk", 128)),
+                  verify_oracle=True, mesh=(nd > 1))
+    par = r["oracle_parity"] or {}
+    return {
+        "n_devices": nd,
+        "pods_per_sec": r["pods_per_sec"],
+        "bound": r["bound"],
+        "unbound": r["unbound"],
+        "mesh": r["mesh"],
+        "host_syncs": r["host_syncs"],
+        "frontier": r["frontier"],
+        "node_upload": r["node_upload"],
+        "oracle_parity": {k: par.get(k) for k in (
+            "mode", "checked", "mismatches", "round_robin",
+            "round_robin_timed", "round_robin_match")},
+        "per_wave_mesh": [p.get("mesh") for p in r["phase_timers"]],
+    }
+
+
+def run_multichip(device_counts=MULTICHIP_DEVICE_COUNTS, n_nodes: int = 512,
+                  total_pods: int = 4_000, waves: int = 5, chunk: int = 128,
+                  seed: int = 0) -> dict:
+    """The sharded-wave-loop churn ledger (ISSUE 18): run the churn
+    harness at each device count in ``device_counts`` — one subprocess
+    each, with ``--xla_force_host_platform_device_count=N`` on the CPU
+    backend — and gate a single verdict on what the sharded loop must
+    preserve:
+
+    - **per-wave oracle parity, exact**, at every shard count, including
+      the select_host tie-rotation counter (the deterministic cross-shard
+      tie-break's observable);
+    - **host syncs O(compactions + 1)** per segment (<= 2 per segment +
+      1 per compaction — dispatch and the loop-exit cursor read), never
+      O(chunks), at every shard count;
+    - **per-shard upload attribution** present on every >= 2-device
+      config (shard count == device count, non-empty per-shard upload
+      fractions, zero mesh-mode fallbacks).
+
+    This graduates MULTICHIP from the compile-and-collective dryrun
+    shapes of earlier rounds to a real sharded *churn* ledger: the full
+    store -> informer -> backend -> bind path under the mesh."""
+    import subprocess
+
+    configs = []
+    for nd in device_counts:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={nd}").strip()
+        cfg = {"n_devices": nd, "nodes": n_nodes, "pods": total_pods,
+               "waves": waves, "chunk": chunk, "seed": seed}
+        print(f"# multichip: {nd}-device child ({n_nodes} nodes x "
+              f"{total_pods} pods x {waves} waves)", file=sys.stderr)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--multichip-child", json.dumps(cfg)],
+            env=env, capture_output=True, text=True, timeout=3_600)
+        entry = {"n_devices": nd, "rc": proc.returncode,
+                 "ok": proc.returncode == 0}
+        if proc.returncode == 0:
+            try:
+                entry.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+            except (ValueError, IndexError) as e:
+                entry["ok"] = False
+                entry["tail"] = f"unparseable child stdout: {e}"
+        else:
+            entry["tail"] = proc.stderr[-2_000:]
+        configs.append(entry)
+        if entry["ok"]:
+            par = entry["oracle_parity"]
+            print(f"# multichip {nd}-device: {entry['pods_per_sec']} pods/s, "
+                  f"parity {par['mismatches']}/{par['checked']} mismatches "
+                  f"rr_match={par['round_robin_match']}, host_syncs="
+                  f"{entry['host_syncs']['total']} (segments="
+                  f"{entry['frontier']['segments']}, compactions="
+                  f"{entry['frontier']['compactions']}), n_shards="
+                  f"{entry['mesh']['n_shards']}", file=sys.stderr)
+        else:
+            print(f"# multichip {nd}-device: FAILED rc={entry['rc']}",
+                  file=sys.stderr)
+
+    def _gate(c: dict) -> list:
+        if not c["ok"]:
+            return ["child failed"]
+        bad = []
+        par = c["oracle_parity"]
+        if par["mode"] != "exact per-wave replay" or par["mismatches"] != 0:
+            bad.append("oracle parity not exact")
+        if not par["round_robin_match"]:
+            bad.append("rr tie counter diverged")
+        fr = c["frontier"]
+        if c["host_syncs"]["total"] > 2 * fr["segments"] + fr["compactions"]:
+            bad.append("host syncs exceed O(compactions+1) budget")
+        if c["n_devices"] >= 2:
+            if c["mesh"]["n_shards"] != c["n_devices"]:
+                bad.append("shard count != device count")
+            if not c["node_upload"].get("shard_upload_fractions"):
+                bad.append("no per-shard upload attribution")
+            if "mesh" in fr["fallback_modes"]:
+                bad.append("mesh-mode fallbacks fired")
+        return bad
+
+    failures = {str(c["n_devices"]): _gate(c) for c in configs}
+    failures = {k: v for k, v in failures.items() if v}
+    verdict = {
+        "device_counts": list(device_counts),
+        "parity_exact_all": all(
+            c["ok"] and c["oracle_parity"]["mismatches"] == 0
+            and c["oracle_parity"]["round_robin_match"] for c in configs),
+        "host_sync_budget_all": all(
+            c["ok"] and c["host_syncs"]["total"]
+            <= 2 * c["frontier"]["segments"] + c["frontier"]["compactions"]
+            for c in configs),
+        "sharded_attribution_all": all(
+            bool(c["ok"] and c["node_upload"].get("shard_upload_fractions")
+                 and c["mesh"]["n_shards"] == c["n_devices"])
+            for c in configs if c["n_devices"] >= 2),
+        "failures": failures,
+        "pass": not failures,
+    }
+    return {
+        "claim": ("Sharded node axis: the device-resident wave loop runs "
+                  "under shard_map over a 1-D node-axis mesh with in-loop "
+                  "cross-shard reductions (psum/pmax alive + score "
+                  "reduces, deterministic (score, global index) tie-break "
+                  "with the cross-shard rotation prefix) — per-wave "
+                  "bindings and the rr tie counter EXACT vs the CPU "
+                  "oracle at every shard count, host syncs still "
+                  "O(compactions + 1), per-shard upload attribution on "
+                  "the node cache"),
+        "method": (f"Churn {n_nodes} nodes / {total_pods} mixed pods / "
+                   f"{waves} waves (arrival thread + run_batch_loop, "
+                   f"events on, chunk {chunk}), one FRESH subprocess per "
+                   f"device count {list(device_counts)} with "
+                   "--xla_force_host_platform_device_count=N on the CPU "
+                   "backend (mesh forced on at N >= 2; N = 1 is the "
+                   "single-device loop baseline); every run's drained "
+                   "waves replayed off-clock through the per-pod CPU "
+                   "oracle"),
+        "configs": configs,
+        "verdict": verdict,
+    }
+
+
 PREFIX_PARITY_K = 2_000
 
 
@@ -2022,12 +2229,68 @@ def main() -> None:
         "artifact behind them; --nodes overrides scale",
     )
     parser.add_argument(
+        "--multichip", nargs="?", const="MULTICHIP_churn.json",
+        default=None, metavar="PATH",
+        help="run the sharded-wave-loop churn ledger (ISSUE 18): the "
+        "churn preset at 1/2/4/8 forced CPU devices (one subprocess "
+        "each), gating per-wave oracle parity (incl. the rr tie "
+        "counter), the O(compactions+1) host-sync budget, and per-shard "
+        "upload attribution at every shard count; writes the ledger "
+        "JSON to PATH (default MULTICHIP_churn.json) — verdicts are "
+        "only printed with the artifact behind them; --nodes/--pods "
+        "override scale",
+    )
+    parser.add_argument(
+        "--multichip-child", default=None, metavar="JSON",
+        help=argparse.SUPPRESS,  # internal: one forced-device-count run
+    )
+    parser.add_argument(
         "--overload-mult", type=float, default=3.0, metavar="X",
         help="surge arrival rate as a multiple of measured drain "
         "capacity for --overload (default 3.0; the verdict requires "
         ">= 2.0)",
     )
     args = parser.parse_args()
+
+    if args.multichip_child is not None:
+        # internal half of --multichip: ONE forced-device-count churn run
+        # in this (fresh) process; the parent parses the JSON line below
+        print(json.dumps(run_multichip_child(json.loads(args.multichip_child))))
+        return
+
+    if args.multichip is not None:
+        import datetime
+
+        kw = {}
+        if args.nodes:
+            kw["n_nodes"] = args.nodes
+        if args.pods:
+            kw["total_pods"] = args.pods
+        ledger = run_multichip(**kw)
+        ledger["date"] = datetime.date.today().isoformat()
+        # the no-artifact-no-verdict guard (same contract as --overload
+        # and --telemetry): if the JSON cannot be written, refuse to
+        # print the verdict block and exit non-zero
+        try:
+            with open(args.multichip, "w") as f:
+                json.dump(ledger, f, indent=1)
+                f.write("\n")
+        except OSError as e:
+            print(f"# REFUSING to print multichip verdicts: artifact "
+                  f"write to {args.multichip!r} failed ({e})",
+                  file=sys.stderr)
+            sys.exit(1)
+        v = ledger["verdict"]
+        print(json.dumps({
+            "metric": "multichip-churn-verdict",
+            "value": 1 if v["pass"] else 0,
+            "unit": "pass",
+            "vs_baseline": 1,
+            "device_counts": v["device_counts"],
+            "verdict": v,
+            "artifact": args.multichip,
+        }))
+        sys.exit(0 if v["pass"] else 1)
 
     if args.overload is not None:
         if args.overload_mult < 2.0:

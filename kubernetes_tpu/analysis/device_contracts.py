@@ -73,7 +73,11 @@ from typing import Optional
 from .core import Finding, iter_py_files
 from .tracecov import HOT_PATH_MODULES
 
-DEFAULT_PATHS = ["kubernetes_tpu/ops", "kubernetes_tpu/models/snapshot.py"]
+DEFAULT_PATHS = [
+    "kubernetes_tpu/ops",
+    "kubernetes_tpu/models/snapshot.py",
+    "kubernetes_tpu/parallel",
+]
 
 #: NodeInfo's mutating surface (scheduler/nodeinfo.py); ``clone()`` is
 #: deliberately absent — cloning IS the sanctioned CoW step.

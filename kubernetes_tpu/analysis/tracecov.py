@@ -58,6 +58,7 @@ HOT_PATH_MODULES = [
     "kubernetes_tpu/ops/backend.py",
     "kubernetes_tpu/ops/batch_kernel.py",
     "kubernetes_tpu/utils/overload.py",
+    "kubernetes_tpu/parallel/mesh.py",
 ]
 
 #: files whose ``*_s`` stats timers must mirror to the trace layer (TC502)

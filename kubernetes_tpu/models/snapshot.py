@@ -965,7 +965,12 @@ def compact_segment(static: BatchStatic, init: InitialState,
     s_fields["node_exists"][k:] = False
     cstatic = dataclasses.replace(
         static,
-        node_names=[static.node_names[j] for j in js],
+        # js past the named range are pre-existing pad columns (the name
+        # list covers real nodes only); they keep node_exists False and
+        # can never be chosen, so dropping their (nonexistent) names is
+        # safe — chosen indices always land inside the named prefix
+        node_names=[static.node_names[j] for j in js
+                    if j < len(static.node_names)],
         n_pad=width,
         node_token=None,
         node_dirty=None,
@@ -976,6 +981,23 @@ def compact_segment(static: BatchStatic, init: InitialState,
                 if getattr(init, f) is not None}
     cinit = dataclasses.replace(init, **i_fields)
     return cstatic, cinit
+
+
+def pad_segment_to_multiple(static: BatchStatic, init: InitialState,
+                            multiple: int
+                            ) -> tuple[BatchStatic, InitialState]:
+    """Pad the node axis up to the next multiple of ``multiple`` (the
+    sharded loop needs every shard to own an equal slice).  Identity when
+    it already divides.  Padding rides ``compact_segment`` with the full
+    identity column set, so the padded columns get ``node_exists`` /
+    ``still_ok`` forced False — they are infeasible for every signature
+    and can never surface as phantom feasible columns in any reduce."""
+    n = int(static.n_pad)
+    m = max(int(multiple), 1)
+    if n % m == 0:
+        return static, init
+    width = -(-n // m) * m
+    return compact_segment(static, init, np.arange(n), width)
 
 
 class Tensorizer:

@@ -167,6 +167,19 @@ class TPUBatchBackend:
         # with zero compactions (3/3 interleaved runs), so the knob
         # exists for experiments, not as a cost gate.
         frontier_engage_frac: float = 1.0,
+        # Node-axis mesh (the shard_map wave loop): "auto" engages only
+        # on a real multi-device accelerator platform — forced host
+        # devices (tests/bench) opt in with True; False disables.  When
+        # on, the device loop runs under shard_map over a 1-D mesh
+        # partitioning the node axis; the in-loop reductions become
+        # cross-shard collectives and the host-sync budget stays
+        # O(compactions + 1) per wave.  Mesh-construction or dispatch
+        # failure falls back breaker-style to the single-device loop
+        # (frontier_loop_fallbacks, mode "mesh").
+        frontier_mesh="auto",
+        # cap on the shard count; the largest power of two <= min(cap,
+        # device count) is used (None = all devices)
+        mesh_devices: Optional[int] = None,
     ):
         self.algorithm = algorithm or GenericScheduler()
         self.tensorizer = tensorizer or Tensorizer()
@@ -197,6 +210,10 @@ class TPUBatchBackend:
         self.frontier_min_width = frontier_min_width
         self.frontier_engage_frac = frontier_engage_frac
         self.frontier_device_loop = frontier_device_loop
+        self.frontier_mesh = frontier_mesh
+        self.mesh_devices = mesh_devices
+        self._mesh = None
+        self._mesh_failed = False
         # wired to scheduler_frontier_compactions_total
         self.frontier_counter = None
         # overload ladder rung 2 (ISSUE 17): when set, _kernel_weights
@@ -222,8 +239,12 @@ class TPUBatchBackend:
                       "frontier_segments": 0, "frontier_compactions": 0,
                       "frontier_prefilter_cols": 0, "frontier_fallbacks": 0,
                       # device-resident loop: segments that degraded from
-                      # the while_loop form to the chunked host loop
+                      # the while_loop form to the chunked host loop, and
+                      # the degradation modes by name ("mesh" = sharded
+                      # dispatch -> single-device loop, "loop" =
+                      # while_loop form -> chunked host loop)
                       "frontier_loop_fallbacks": 0,
+                      "frontier_fallback_modes": {},
                       # blocking device→host round-trips on the finalize
                       # path (cumulative) — the scheduler deltas this per
                       # wave next to the phase timers below
@@ -325,6 +346,63 @@ class TPUBatchBackend:
             tr.instant("frontier.loop_enter", run=run_index, width=width,
                        start_chunk=start_chunk)
 
+    def _note_frontier_fallback(self, mode: str) -> None:
+        """One loop-form degradation, by mode: ``"mesh"`` = sharded
+        dispatch → single-device loop, ``"loop"`` = while_loop form →
+        chunked host loop.  Both ride the existing
+        ``frontier_loop_fallbacks`` counter (the mode split is additive
+        bookkeeping, not a second ladder)."""
+        self.stats["frontier_loop_fallbacks"] += 1
+        modes = self.stats.setdefault("frontier_fallback_modes", {})
+        modes[mode] = modes.get(mode, 0) + 1
+
+    def _mesh_enabled(self) -> bool:
+        if self.frontier_mesh == "auto":
+            # auto: only a real accelerator mesh is worth the collectives
+            # (forced host devices are a test/bench construct — those
+            # callers pass frontier_mesh=True explicitly)
+            import jax
+
+            return _device_platform() == "tpu" and len(jax.devices()) > 1
+        return bool(self.frontier_mesh)
+
+    def _frontier_mesh(self):
+        """The node-axis mesh, built once per backend: the largest
+        power-of-two shard count <= min(mesh_devices, device count), >= 2
+        required.  None when disabled or after a failure — mesh
+        construction trips ``_mesh_failed`` breaker-style (the
+        single-device loop is always correct, so there is no probe-back:
+        a broken device topology does not heal mid-process)."""
+        if self._mesh is not None:
+            return self._mesh
+        if self._mesh_failed or not self._mesh_enabled():
+            return None
+        try:
+            import jax
+
+            from ..parallel.mesh import make_mesh
+
+            n = len(jax.devices())
+            if self.mesh_devices is not None:
+                n = min(n, int(self.mesh_devices))
+            p = 1
+            while p * 2 <= n:
+                p *= 2
+            if p < 2:
+                raise ValueError(
+                    f"sharded loop needs >= 2 devices, have {n}")
+            self._mesh = make_mesh(p)
+            self.device_node_cache.set_mesh(self._mesh)
+            return self._mesh
+        except Exception:
+            logger.exception(
+                "mesh construction failed; the sharded loop is disabled "
+                "for this backend (single-device loop serves all segments)")
+            self._mesh_failed = True
+            self._note_frontier_fallback("mesh")
+            self.device_node_cache.set_mesh(None)
+            return None
+
     def _dispatch_frontier(self, static, init):
         """Try to serve this segment through the frontier scan: seed the
         monotone step-0 plane, compact the node axis at tensorize time
@@ -370,6 +448,36 @@ class TPUBatchBackend:
             use_loop = (self.frontier_device_loop and self.frontier_chunk > 0
                         and self.frontier_chunk & (self.frontier_chunk - 1) == 0)
             if use_loop:
+                mesh = self._frontier_mesh()
+                if mesh is not None:
+                    try:
+                        from ..models.snapshot import pad_segment_to_multiple
+                        from ..parallel.mesh import mesh_dispatch_span
+
+                        mstatic, minit = pad_segment_to_multiple(
+                            cstatic, cinit, int(mesh.size))
+                        with mesh_dispatch_span(mesh, int(mstatic.n_pad)):
+                            run = FrontierRun(
+                                mstatic, minit,
+                                node_cache=self.device_node_cache,
+                                chunk_len=self.frontier_chunk,
+                                compact_frac=self.frontier_compact_frac,
+                                min_width=self.frontier_min_width,
+                                on_compact=self._on_frontier_compact,
+                                device_loop=True,
+                                on_loop=self._on_frontier_loop, mesh=mesh)
+                        cstatic = mstatic
+                    except Exception:
+                        logger.exception(
+                            "sharded loop dispatch failed; the segment "
+                            "degrades to the single-device loop and the "
+                            "mesh path is disabled")
+                        self._note_frontier_fallback("mesh")
+                        self._mesh = None
+                        self._mesh_failed = True
+                        self.device_node_cache.set_mesh(None)
+                        run = None
+            if run is None and use_loop:
                 try:
                     run = FrontierRun(
                         cstatic, cinit, node_cache=self.device_node_cache,
@@ -382,7 +490,7 @@ class TPUBatchBackend:
                     logger.exception(
                         "device-resident loop dispatch failed; the segment "
                         "degrades to the chunked host loop")
-                    self.stats["frontier_loop_fallbacks"] += 1
+                    self._note_frontier_fallback("loop")
             if run is None:
                 run = FrontierRun(
                     cstatic, cinit, node_cache=self.device_node_cache,
@@ -805,7 +913,7 @@ class TPUBatchBackend:
                         def finalize_primary():
                             chosen, rr = fut.finalize()
                             self.stats["host_syncs"] += fut.stats["host_syncs"]
-                            self.last_frontier.append({
+                            entry = {
                                 "prefilter": list(
                                     getattr(fut, "prefilter_width",
                                             (static.n_pad, static.n_pad))),
@@ -813,10 +921,18 @@ class TPUBatchBackend:
                                 "alive_frac": fut.stats["alive_frac"],
                                 "chunks": fut.stats["chunks"],
                                 "compactions": fut.stats["compactions"],
-                                "mode": ("loop" if fut.device_loop
+                                "mode": ("mesh" if fut.mesh is not None
+                                         else "loop" if fut.device_loop
                                          else "chunked"),
                                 "host_syncs": fut.stats["host_syncs"],
-                            })
+                            }
+                            if fut.mesh is not None:
+                                # per-shard attribution rides the SAME
+                                # per-segment entry (no second format)
+                                entry["n_shards"] = fut.stats["n_shards"]
+                                entry["shard_alive_frac"] = (
+                                    fut.stats["shard_alive_frac"])
+                            self.last_frontier.append(entry)
                             return chosen, rr, fut.static
                         frontier_retry = True
                     else:

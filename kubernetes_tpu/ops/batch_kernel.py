@@ -121,8 +121,68 @@ class DeviceNodeCache:
         self._token = None
         self._arrays = None
         self._host = None  # host-side copies backing the device arrays
+        self._mesh = None
+        self._mesh_key = None
         self.stats = {"reuses": 0, "col_updates": 0, "uploads": 0,
-                      "dirty_cols": 0, "cols_total": 0}
+                      "dirty_cols": 0, "cols_total": 0,
+                      "shard_dirty_cols": [], "shard_cols_total": []}
+
+    def set_mesh(self, mesh) -> None:
+        """Bind (or clear, ``mesh=None``) the node-axis mesh uploads are
+        committed to.  The mesh identity joins the cache token, so
+        sharded and single-device entries never alias; binding a
+        different mesh simply misses on the next lookup and re-uploads.
+        Also (re)sets the per-shard dirty/total column counters the
+        scheduler's per-shard upload-fraction attribution reads."""
+        if mesh is None:
+            key, n_shards = None, 0
+        else:
+            key = (tuple(mesh.shape.items()),
+                   tuple(int(d.id) for d in mesh.devices.flat))
+            n_shards = int(mesh.size)
+        if key != self._mesh_key:
+            self._mesh = mesh
+            self._mesh_key = key
+            self.stats["shard_dirty_cols"] = [0] * n_shards
+            self.stats["shard_cols_total"] = [0] * n_shards
+
+    def _note_shard_dirty(self, js, n: int) -> None:
+        """Attribute dirty columns to the shard that will receive the
+        upload bytes (``js=None`` = full-plane rewrite)."""
+        ns = len(self.stats["shard_dirty_cols"])
+        if not ns or n % ns:
+            return
+        n_loc = n // ns
+        if js is None:
+            for s in range(ns):
+                self.stats["shard_dirty_cols"][s] += n_loc
+        else:
+            counts = np.bincount(
+                np.asarray(js, dtype=np.int64) // n_loc, minlength=ns)
+            for s in range(ns):
+                self.stats["shard_dirty_cols"][s] += int(counts[s])
+
+    def _note_shard_total(self, n: int) -> None:
+        ns = len(self.stats["shard_cols_total"])
+        if not ns or n % ns:
+            return
+        n_loc = n // ns
+        for s in range(ns):
+            self.stats["shard_cols_total"][s] += n_loc
+
+    def _shard_put(self, arr):
+        """Host→device with the node axis partitioned over the bound
+        mesh.  Widths that don't divide the shard count fall back to a
+        plain transfer (the sharded dispatch path pads segment widths to
+        the shard count, so this only triggers for cache users outside
+        the sharded loop — correct either way, GSPMD follows whatever
+        sharding the inputs carry)."""
+        if self._mesh is None or int(arr.shape[0]) % max(int(self._mesh.size), 1):
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+        axis = tuple(self._mesh.shape.keys())[0]
+        spec = PartitionSpec(*([axis] + [None] * (arr.ndim - 1)))
+        return jax.device_put(np.asarray(arr), NamedSharding(self._mesh, spec))
 
     @staticmethod
     def _host_val(static: BatchStatic, f: str):
@@ -136,18 +196,20 @@ class DeviceNodeCache:
             arr = arr[:, r_sel]
         return arr
 
-    @staticmethod
-    def _token_for(static: BatchStatic):
+    def _token_for(self, static: BatchStatic):
         tok = static.node_token
         r_sel = getattr(static, "r_sel", None)
         if tok is not None and r_sel is not None:
             # a changed resource selection changes the cached node_alloc
             # SHAPE — it must never alias a same-(epoch, version) entry
             tok = tok + (tuple(int(r) for r in r_sel),)
+        if tok is not None and self._mesh_key is not None:
+            # sharded placements must never alias single-device entries
+            tok = tok + (self._mesh_key,)
         return tok
 
     def _upload(self, static: BatchStatic) -> tuple:
-        return tuple(jnp.asarray(self._host_val(static, f))
+        return tuple(self._shard_put(self._host_val(static, f))
                      for f in self.FIELDS)
 
     @staticmethod
@@ -167,8 +229,11 @@ class DeviceNodeCache:
             self.stats["uploads"] += 1
             self.stats["dirty_cols"] += n
             self.stats["cols_total"] += n
+            self._note_shard_dirty(None, n)
+            self._note_shard_total(n)
             return self._upload(static)
         self.stats["cols_total"] += n
+        self._note_shard_total(n)
         if self._token == tok and self._arrays is not None:
             self.stats["reuses"] += 1
             return self._arrays
@@ -183,13 +248,17 @@ class DeviceNodeCache:
             for new_h, old_h, arr in zip(host, self._host, self._arrays):
                 js = self._changed_cols(new_h, old_h)
                 dirty_total += len(js)
+                self._note_shard_dirty(js, n)
                 if len(js) == 0:
                     arrays.append(arr)
                 elif len(js) <= max(1, n // 8):
+                    # in-place column scatter: GSPMD keeps the result on
+                    # the input's (possibly node-sharded) placement, so
+                    # only the owning shards receive update bytes
                     jdev = jnp.asarray(js.astype(np.int32))
                     arrays.append(arr.at[jdev].set(jnp.asarray(new_h[js])))
                 else:
-                    arrays.append(jnp.asarray(new_h))
+                    arrays.append(self._shard_put(new_h))
             arrays = tuple(arrays)
             self.stats["col_updates"] += 1
             self.stats["dirty_cols"] += dirty_total
@@ -197,6 +266,7 @@ class DeviceNodeCache:
             arrays = self._upload(static)
             self.stats["uploads"] += 1
             self.stats["dirty_cols"] += n
+            self._note_shard_dirty(None, n)
         self._token = tok
         self._arrays = arrays
         self._host = host
@@ -356,10 +426,52 @@ def _balanced_score(cpu_req, cpu_cap, mem_req, mem_cap):
     return jnp.where(bad, 0, score)
 
 
-def _normalized_max(raw, feasible, reverse: bool):
+# -- cross-shard collective seams -------------------------------------------
+# Identity when ``axis_name`` is None (the single-device path): the same
+# step serves both the plain jit and the shard_map-wrapped wave loop, and
+# these helpers are the ONLY points where shards communicate — everything
+# else in the step is elementwise on the local node columns.
+
+
+def _ax_sum(x, axis_name):
+    return x if axis_name is None else jax.lax.psum(x, axis_name)
+
+
+def _ax_max(x, axis_name):
+    return x if axis_name is None else jax.lax.pmax(x, axis_name)
+
+
+def _ax_min(x, axis_name):
+    return x if axis_name is None else jax.lax.pmin(x, axis_name)
+
+
+def _ax_any(mask, axis_name):
+    """``jnp.any`` over the (possibly sharded) trailing node axis."""
+    if axis_name is None:
+        return jnp.any(mask, axis=-1)
+    return _ax_sum(jnp.sum(mask.astype(jnp.int32), axis=-1), axis_name) > 0
+
+
+def _ax_first_true(mask, offset, axis_name):
+    """Global node index of the FIRST true column in GLOBAL node order:
+    ``argmax`` on one device, a deterministic min-over-global-index tree
+    reduce across shards (each shard offers ``offset + local_argmax`` or
+    INT32_MAX when it has no hit).  Ordering by global index — never by
+    shard arrival — is what keeps round-robin tie rotation bit-exact
+    against the CPU oracle.  All-false masks yield INT32_MAX (sharded) /
+    0 (single device); every caller guards on feasibility counts before
+    consuming the result."""
+    local = jnp.argmax(mask).astype(jnp.int32)
+    if axis_name is None:
+        return local
+    cand = jnp.where(jnp.any(mask), offset + local, INT32_MAX)
+    return _ax_min(cand, axis_name)
+
+
+def _normalized_max(raw, feasible, reverse: bool, axis_name=None):
     """NormalizeReduce: 10*raw//max over feasible (0 if max==0); reversed
     variant returns 10 when max==0."""
-    max_c = jnp.max(jnp.where(feasible, raw, 0))
+    max_c = _ax_max(jnp.max(jnp.where(feasible, raw, 0)), axis_name)
     if reverse:
         return jnp.where(
             max_c > 0, _idiv(MAX_PRIORITY * (max_c - raw), jnp.maximum(max_c, 1)), MAX_PRIORITY
@@ -370,6 +482,7 @@ def _normalized_max(raw, feasible, reverse: bool):
 def make_step(
     dev: StaticArrays, num_zones: int, w: dict, use_terms: bool = True,
     use_vols: bool = True, use_ports: bool = True, use_frontier: bool = False,
+    axis_name: "str | None" = None,
 ):
     """Builds the scan step: (state, xs) -> (state', chosen_node).
 
@@ -383,7 +496,25 @@ def make_step(
     (see ScanState): the current signature's row is ANDed with the
     monotone filter components each step, so a chunked caller can read
     the G-union between chunks and compact the node axis (frontier
-    scan).  Off, the plane stays None and the step is unchanged."""
+    scan).  Off, the plane stays None and the step is unchanged.
+
+    ``axis_name`` names the node-axis mesh dimension when the step runs
+    under ``shard_map``: ``dev``/``state`` node planes are then per-shard
+    slices and every whole-axis reduce below goes through the ``_ax_*``
+    collectives so scores, tie sets, and the chosen GLOBAL node index are
+    identical to the single-device trace.  None (the default) keeps every
+    reduce local and the step byte-for-byte equivalent to the unsharded
+    kernel."""
+
+    n_local = dev.node_exists.shape[0]  # per-shard width under shard_map
+    if axis_name is None:
+        offset = jnp.int32(0)
+    else:
+        # global index of this shard's first column: shards are laid out
+        # in node order along the 1-D mesh, so offset + local index IS
+        # the original node-axis position
+        offset = jax.lax.axis_index(axis_name).astype(jnp.int32) * n_local
+    col_ids = offset + jnp.arange(n_local, dtype=jnp.int32)  # [N] global ids
 
     # Zone membership as a [Z, N] one-hot contraction matrix, hoisted out
     # of the step (scan treats closed-over values as loop constants): the
@@ -460,7 +591,7 @@ def make_step(
             over = has_kind[:, None] & (state.nk + count_new > dev.vol_limits[:, None])
             vol_bad = disk_bad | jnp.any(over, axis=0)
             feasible = feasible & ~vol_bad
-        n_feasible = jnp.sum(feasible.astype(jnp.int32))
+        n_feasible = _ax_sum(jnp.sum(feasible.astype(jnp.int32)), axis_name)
 
         if use_frontier:
             # monotone components ONLY: fit/pods/ports can only get worse
@@ -498,7 +629,7 @@ def make_step(
             total = total + w["balanced"] * _balanced_score(cpu_req, cpu_cap, mem_req, mem_cap)
         if w["spread"]:
             cnt = state.spread_counts[gid]  # [N]
-            max_n = jnp.max(jnp.where(feasible, cnt, 0))
+            max_n = _ax_max(jnp.max(jnp.where(feasible, cnt, 0)), axis_name)
             node_fp = jnp.where(
                 max_n > 0,
                 _idiv((max_n - cnt) * (MAX_PRIORITY * FIXED_POINT_ONE), jnp.maximum(max_n, 1)),
@@ -506,7 +637,9 @@ def make_step(
             )
             # zone blend: counts aggregated over feasible nodes per zone
             # (one-hot matvec, not scatter/gather — see zone_onehot above)
-            zsum = zone_onehot @ jnp.where(feasible & has_zone, cnt, 0)  # [Z]
+            zsum = _ax_sum(
+                zone_onehot @ jnp.where(feasible & has_zone, cnt, 0),
+                axis_name)  # [Z], replicated across shards
             max_z = jnp.max(zsum)
             zcnt = zsum @ zone_onehot  # [N]: zsum[zone_idx] without the gather
             zone_fp = jnp.where(
@@ -514,16 +647,19 @@ def make_step(
                 _idiv((max_z - zcnt) * (MAX_PRIORITY * FIXED_POINT_ONE), jnp.maximum(max_z, 1)),
                 MAX_PRIORITY * FIXED_POINT_ONE,
             )
-            have_zones = dev.g_has_spread[gid] & jnp.any(feasible & has_zone)
+            have_zones = dev.g_has_spread[gid] & _ax_any(
+                feasible & has_zone, axis_name)
             total_fp = jnp.where(have_zones & has_zone, (node_fp + 2 * zone_fp) // 3, node_fp)
             total = total + w["spread"] * (total_fp // FIXED_POINT_ONE)
         if w["node_affinity"]:
             total = total + w["node_affinity"] * _normalized_max(
-                dev.node_aff_raw[gid], feasible, reverse=False
+                dev.node_aff_raw[gid], feasible, reverse=False,
+                axis_name=axis_name
             )
         if w["taint"]:
             total = total + w["taint"] * _normalized_max(
-                dev.taint_intol_raw[gid], feasible, reverse=True
+                dev.taint_intol_raw[gid], feasible, reverse=True,
+                axis_name=axis_name
             )
         if w["interpod"]:
             # static (existing pods' symmetric terms) + dynamic: the pod's
@@ -533,21 +669,35 @@ def make_step(
             raw = dev.interpod_raw[gid]
             if use_terms:
                 raw = raw + dev.own_w[gid] @ dm + (m_g.astype(jnp.int32) * dev.sym_w) @ downer
-            max_c = jnp.maximum(0, jnp.max(jnp.where(feasible, raw, INT32_MIN)))
-            min_c = jnp.minimum(0, jnp.min(jnp.where(feasible, raw, INT32_MAX)))
+            max_c = jnp.maximum(0, _ax_max(
+                jnp.max(jnp.where(feasible, raw, INT32_MIN)), axis_name))
+            min_c = jnp.minimum(0, _ax_min(
+                jnp.min(jnp.where(feasible, raw, INT32_MAX)), axis_name))
             rng = max_c - min_c
             s = jnp.where(rng > 0, _idiv(MAX_PRIORITY * (raw - min_c), jnp.maximum(rng, 1)), 0)
             total = total + w["interpod"] * s
 
         # -- selection (selectHost) -----------------------------------
         masked = jnp.where(feasible, total, INT32_MIN)
-        max_score = jnp.max(masked)
+        max_score = _ax_max(jnp.max(masked), axis_name)
         ties = feasible & (total == max_score)
-        t_count = jnp.sum(ties.astype(jnp.int32))
+        t_count = _ax_sum(jnp.sum(ties.astype(jnp.int32)), axis_name)
         idx = state.round_robin % jnp.maximum(t_count, 1)
         cum = jnp.cumsum(ties.astype(jnp.int32))
-        pick_among_ties = jnp.argmax(ties & (cum == idx + 1))
-        only = jnp.argmax(feasible)
+        if axis_name is not None:
+            # cross-shard exclusive prefix of tie counts: shifting shard
+            # s's local cumsum by the ties on shards < s makes ``cum``
+            # the GLOBAL running tie count in node-axis order, so the
+            # round-robin pick rotates over the global tie set exactly
+            # as the single-device kernel (and the oracle) rotate
+            t_local = jnp.sum(ties.astype(jnp.int32))
+            all_t = jax.lax.all_gather(t_local, axis_name)  # [S]
+            me = jax.lax.axis_index(axis_name)
+            shard_ids = jnp.arange(all_t.shape[0], dtype=jnp.int32)
+            cum = cum + jnp.sum(jnp.where(shard_ids < me, all_t, 0))
+        pick_among_ties = _ax_first_true(
+            ties & (cum == idx + 1), offset, axis_name)
+        only = _ax_first_true(feasible, offset, axis_name)
         chosen = jnp.where(
             (n_feasible == 0) | ~pvalid,
             jnp.int32(-1),
@@ -559,23 +709,29 @@ def make_step(
         # -- commit (assume) ------------------------------------------
         landed = chosen >= 0
         safe = jnp.maximum(chosen, 0)
-        onehot = (jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe) & landed
+        # ``chosen``/``safe`` are GLOBAL node indices (replicated across
+        # shards); comparing against ``col_ids`` lands the onehot on the
+        # owning shard's local column and zeros everywhere else
+        onehot = (col_ids == safe) & landed
         oh_i = onehot.astype(jnp.int32)
         # the chosen node's column, extracted by onehot CONTRACTION, never
         # by dynamic slice: a traced index into the SHARDED node axis makes
         # GSPMD all-gather the whole [T, N]/[W, N] plane every step (the
         # exact regression assert_collective_structure guards against); the
         # contraction is elementwise on the shard + an O(T) all-reduce
-        safe_onehot = jnp.arange(dev.node_exists.shape[0], dtype=jnp.int32) == safe
+        safe_onehot = col_ids == safe
         if use_terms:
             # affinity domain counters, expanded over nodes: the landed pod
             # counts toward every node sharing the chosen node's topology
             # domain for each term it matches/owns — a scatter-free
             # elementwise same-domain mask (no-op when the chosen node lacks
             # the key, mirroring the old trash-slot semantics)
-            d_at_safe = (dev.node_domain
-                         * safe_onehot[None, :].astype(jnp.int32)).sum(axis=1)  # [T]
-            valid_at_safe = (dev.dom_valid & safe_onehot[None, :]).any(axis=1)  # [T]
+            d_at_safe = _ax_sum(
+                (dev.node_domain
+                 * safe_onehot[None, :].astype(jnp.int32)).sum(axis=1),
+                axis_name)  # [T]
+            valid_at_safe = _ax_any(
+                dev.dom_valid & safe_onehot[None, :], axis_name)  # [T]
             same_dom = (
                 (dev.node_domain == d_at_safe[:, None])
                 & dev.dom_valid
@@ -594,7 +750,7 @@ def make_step(
             # sentinel row, which must stay empty — mask them to write False,
             # a no-op under max)
             vol_upd = (vol_valid & ~vol_count_only & landed)[:, None] & onehot[None, :]  # [W, N]
-            newv_at_safe = (new_v & safe_onehot[None, :]).any(axis=1)  # [W]
+            newv_at_safe = _ax_any(new_v & safe_onehot[None, :], axis_name)  # [W]
             newv_chosen = (vol_valid & newv_at_safe & landed).astype(jnp.int32)  # [W]
             vol_any = state.vol_any.at[vol_ids].max(vol_upd)
             vol_ns = state.vol_ns.at[vol_ids].max(vol_upd & ~vol_ro_ok[:, None])
@@ -670,38 +826,26 @@ def _runner(num_zones: int, weights: tuple, use_terms: bool = True,
     return run
 
 
-@lru_cache(maxsize=64)
-def _loop_runner(num_zones: int, weights: tuple, use_terms: bool,
-                 use_vols: bool, use_ports: bool, chunk_len: int):
-    """The device-resident wave loop: a ``lax.while_loop`` that advances
-    the frontier scan chunk by chunk entirely on device and exits only
-    when the segment is done OR a compaction is worth taking — the host
-    is re-entered O(compactions + 1) times per segment, independent of
-    chunk count.
-
-    Carry = (ScanState, chosen buffer [P_pad], chunk cursor, stop flag).
-    ``state`` and ``chosen_buf`` are DONATED (the XLA executable reuses
-    their buffers in place across iterations); callers must treat the
-    passed-in arrays as consumed and must never fall back onto them —
-    the backend's retry ladder re-derives everything from host arrays.
-    The compaction decision is computed ON DEVICE: after each chunk the
-    all-G ``still_ok`` refresh runs (see ``monotone_plane_device``) and
-    the alive-union count is compared against ``compact_thresh`` (a
-    host-precomputed int equivalent to the ``_pow2_width``/
-    ``compact_frac`` rule; -1 = never fires).  ``n_chunks`` is a device
-    operand, not a Python constant, so the pow-2 pod-axis bucket padding
-    never adds loop trips."""
-    w = dict(zip(WEIGHT_KEYS, weights))
+def _make_loop_run(num_zones: int, w: dict, use_terms: bool, use_vols: bool,
+                   use_ports: bool, chunk_len: int,
+                   axis_name: "str | None" = None):
+    """The (unjitted) wave-loop body shared by the single-device and the
+    shard_map runners.  ``axis_name`` threads through to ``make_step``:
+    sharded, the in-loop still_ok/alive reduce and every score/tie reduce
+    are per-shard collectives INSIDE the ``lax.while_loop`` — the shards
+    advance in lockstep (cond consumes replicated scalars) with no host
+    hop per chunk, and the per-shard ``alive`` slices concatenate back to
+    the global mask at the loop exit."""
 
     def run(dev: StaticArrays, xs_full, state: ScanState, chosen_buf,
             start_chunk, n_chunks, compact_thresh):
         step = make_step(dev, num_zones, w, use_terms=use_terms,
                          use_vols=use_vols, use_ports=use_ports,
-                         use_frontier=True)
+                         use_frontier=True, axis_name=axis_name)
 
         def alive_of(st):
             alive = jnp.any(st.still_ok, axis=0) & dev.node_exists
-            return alive, jnp.sum(alive.astype(jnp.int32))
+            return alive, _ax_sum(jnp.sum(alive.astype(jnp.int32)), axis_name)
 
         def cond(carry):
             _, _, c, want = carry
@@ -727,7 +871,78 @@ def _loop_runner(num_zones: int, weights: tuple, use_terms: bool,
         alive, n_alive = alive_of(state)
         return state, chosen_buf, c, want, alive, n_alive
 
+    return run
+
+
+@lru_cache(maxsize=64)
+def _loop_runner(num_zones: int, weights: tuple, use_terms: bool,
+                 use_vols: bool, use_ports: bool, chunk_len: int):
+    """The device-resident wave loop: a ``lax.while_loop`` that advances
+    the frontier scan chunk by chunk entirely on device and exits only
+    when the segment is done OR a compaction is worth taking — the host
+    is re-entered O(compactions + 1) times per segment, independent of
+    chunk count.
+
+    Carry = (ScanState, chosen buffer [P_pad], chunk cursor, stop flag).
+    ``state`` and ``chosen_buf`` are DONATED (the XLA executable reuses
+    their buffers in place across iterations); callers must treat the
+    passed-in arrays as consumed and must never fall back onto them —
+    the backend's retry ladder re-derives everything from host arrays.
+    The compaction decision is computed ON DEVICE: after each chunk the
+    all-G ``still_ok`` refresh runs (see ``monotone_plane_device``) and
+    the alive-union count is compared against ``compact_thresh`` (a
+    host-precomputed int equivalent to the ``_pow2_width``/
+    ``compact_frac`` rule; -1 = never fires).  ``n_chunks`` is a device
+    operand, not a Python constant, so the pow-2 pod-axis bucket padding
+    never adds loop trips."""
+    w = dict(zip(WEIGHT_KEYS, weights))
+    run = _make_loop_run(num_zones, w, use_terms, use_vols, use_ports,
+                         chunk_len)
     return jax.jit(run, donate_argnums=(2, 3))
+
+
+@lru_cache(maxsize=16)
+def _sharded_loop_runner(num_zones: int, weights: tuple, use_terms: bool,
+                         use_vols: bool, use_ports: bool, chunk_len: int,
+                         mesh):
+    """``_loop_runner``'s wave loop wrapped in ``shard_map`` over a 1-D
+    node-axis mesh: every node-axis plane of StaticArrays/ScanState is
+    partitioned (``parallel.mesh.loop_in_specs``), the pod-axis xs and
+    the chosen buffer are replicated, and every whole-axis reduce inside
+    the loop is a psum/pmax/pmin collective (see ``make_step``'s
+    ``axis_name``) — the cross-host sync budget stays O(compactions + 1)
+    per wave because the loop never leaves the device between chunks.
+
+    Donation carries through shard_map unchanged (state and chosen
+    buffer are reused in place across loop runs), which is what lets
+    DC601's use-after-donate tracking extend through the sharded
+    dispatch chain.  ``check_rep=False``: the replicated scalar outputs
+    (cursor, stop flag, alive count) are provably identical on every
+    shard — they are pure functions of psum/pmax results — but shard_map
+    cannot prove it through ``lax.while_loop``."""
+    from jax.experimental.shard_map import shard_map
+
+    from ..parallel.mesh import NODE_AXIS, loop_in_specs, loop_out_specs
+
+    w = dict(zip(WEIGHT_KEYS, weights))
+    run = _make_loop_run(num_zones, w, use_terms, use_vols, use_ports,
+                         chunk_len, axis_name=NODE_AXIS)
+    sharded = shard_map(run, mesh=mesh, in_specs=loop_in_specs(),
+                        out_specs=loop_out_specs(), check_rep=False)
+    return jax.jit(sharded, donate_argnums=(2, 3))
+
+
+def _sharded_loop_runner_for(static: BatchStatic, chunk_len: int, mesh):
+    weights = tuple(int(static.weights.get(k, 0)) for k in WEIGHT_KEYS)
+    return _sharded_loop_runner(  # device: static — mesh identity is a hashable per-device-set constant; one compile per (mesh, key)
+        int(static.num_zones),
+        weights,
+        bool(static.terms),
+        bool(static.use_vols),
+        bool(getattr(static, "use_ports", True)),
+        int(chunk_len),
+        mesh,
+    )
 
 
 def _loop_runner_for(static: BatchStatic, chunk_len: int):
@@ -928,7 +1143,7 @@ class FrontierRun:
                  node_cache: "DeviceNodeCache | None" = None,
                  chunk_len: int = 512, compact_frac: float = 0.5,
                  min_width: int = 128, on_compact=None,
-                 device_loop: bool = False, on_loop=None):
+                 device_loop: bool = False, on_loop=None, mesh=None):
         self.static = static
         self.chunk_len = chunk_len
         self.compact_frac = compact_frac
@@ -936,6 +1151,7 @@ class FrontierRun:
         self.on_compact = on_compact
         self.on_loop = on_loop
         self.device_loop = bool(device_loop)
+        self.mesh = mesh if device_loop else None
         self._p_real = len(static.group_of_pod)
         self._dev = to_device(static, node_cache=node_cache)
         self._state = state_to_device(
@@ -961,7 +1177,27 @@ class FrontierRun:
             self._xs_full = batch_xs(static)
             p_pad = int(self._xs_full[0].shape[0])  # pow2, >= chunk bucket
             self._chunk_eff = min(chunk_len, p_pad)
-            self._loop = _loop_runner_for(static, self._chunk_eff)
+            if self.mesh is not None:
+                ns = int(self.mesh.size)
+                if ns < 2 or ns & (ns - 1):
+                    raise ValueError(
+                        "mesh mode requires a power-of-two shard count >= 2")
+                if self._width % ns:
+                    raise ValueError(
+                        f"segment width {self._width} not divisible by {ns} "
+                        "shards (pad via snapshot.pad_segment_to_multiple)")
+                from ..parallel import mesh as pmesh
+                # compaction widths must stay shard-divisible: every
+                # pow-2 width >= the pow-2 shard count divides evenly
+                self.min_width = max(self.min_width, ns)
+                self._dev = pmesh.place_static(self._dev, self.mesh)
+                self._state = pmesh.place_state(self._state, self.mesh)
+                self._loop = _sharded_loop_runner_for(
+                    static, self._chunk_eff, self.mesh)
+                self.stats["n_shards"] = ns
+                self.stats["shard_alive_frac"] = []
+            else:
+                self._loop = _loop_runner_for(static, self._chunk_eff)
             self._n_chunks = -(-self._p_real // self._chunk_eff)
             self._buf = jnp.full((p_pad,), -1, dtype=jnp.int32)
             self._c = 0  # chunks completed (host mirror, updated at syncs)
@@ -1026,12 +1262,25 @@ class FrontierRun:
         self._c = c_exit
         frac = round(n_alive / max(self._width, 1), 4)
         self.stats["alive_frac"].append(frac)
+        shard_fracs = None
+        ns = self.stats.get("n_shards", 0)
+        if ns and self._width % ns == 0:
+            # per-shard alive split: the mask is shard-concatenated in
+            # node order, so an even reshape recovers each shard's slice
+            alive_h = np.asarray(alive)  # device: sync — rides the loop-exit transfer the cursor read above already stalled on
+            n_loc = self._width // ns
+            per = alive_h.reshape(ns, n_loc).sum(axis=1)
+            shard_fracs = [round(int(c) / max(n_loc, 1), 4) for c in per]
+            self.stats["shard_alive_frac"].append(shard_fracs)
         tr = tracing.current()
         if tr is not None:
             # one instant per loop EXIT (not per chunk): the pruning
-            # trajectory at every host re-entry
-            tr.instant("frontier.alive", frac=frac, width=self._width,
-                       chunk=self._c)
+            # trajectory at every host re-entry.  Per-shard fractions ride
+            # the SAME instant as extra attrs — no second trace format.
+            attrs = dict(frac=frac, width=self._width, chunk=self._c)
+            if shard_fracs is not None:
+                attrs["shards"] = shard_fracs
+            tr.instant("frontier.alive", **attrs)
         return want, alive, n_alive
 
     def _finalize_loop(self) -> tuple[np.ndarray, int]:
@@ -1048,6 +1297,15 @@ class FrontierRun:
                     js = np.nonzero(np.asarray(alive))[0]
                     self._dev, self._state = gather_node_axis(
                         self._dev, self._state, js, width_new)
+                    if self.mesh is not None:
+                        # re-commit the compacted planes to the mesh: the
+                        # gather ran under GSPMD and its output placement
+                        # is whatever XLA chose, but the next loop run's
+                        # in_specs demand clean node-axis partitions
+                        from ..parallel import mesh as pmesh
+                        self._dev = pmesh.place_static(self._dev, self.mesh)
+                        self._state = pmesh.place_state(
+                            self._state, self.mesh)
                     self._map = self._map[js]
                     self._width = width_new
                     self.stats["compactions"] += 1

@@ -3,10 +3,18 @@
 from .mesh import (
     NODE_AXIS,
     assert_collective_structure,
+    loop_in_specs,
+    loop_out_specs,
     make_mesh,
+    match_partition_rules,
+    mesh_dispatch_span,
+    place_state,
+    place_static,
     schedule_batch_sharded,
     schedule_batch_sharded_verified,
     shard_state,
     shard_static,
     sharded_hlo,
+    state_specs,
+    static_specs,
 )

@@ -17,6 +17,8 @@ of SURVEY.md §5.8.
 
 from __future__ import annotations
 
+import re
+from functools import lru_cache
 from typing import Optional
 
 import jax
@@ -27,11 +29,14 @@ from ..models.snapshot import BatchStatic, InitialState
 from ..ops.batch_kernel import (
     StaticArrays,
     ScanState,
+    _STATIC_NODE_AXES,
+    _STATE_NODE_AXES,
     _runner_for,
     batch_xs,
     state_to_device,
     to_device,
 )
+from ..utils import tracing
 
 NODE_AXIS = "nodes"
 
@@ -42,69 +47,116 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
         devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
-    return Mesh(np.array(devices), (NODE_AXIS,))
+    return Mesh(np.array(devices), (NODE_AXIS,))  # device: sync — host-side Device handles (no array data); built once per device set, off the per-wave path
+
+
+# -- partition rules over pytrees -------------------------------------------
+
+
+def match_partition_rules(rules, names):
+    """First-match-wins regex rules → PartitionSpec per leaf name (the
+    classic partition-rule-over-pytree idiom): every ``name`` is matched
+    against the rule patterns in order; unmatched names replicate
+    (``P()``).  Keeping the mapping RULE-driven — instead of a hand-kept
+    spec per field — means a new node-axis plane added to
+    ``StaticArrays``/``ScanState`` only needs its entry in the kernel's
+    ``_*_NODE_AXES`` tables, and the loop specs below pick it up."""
+    out = {}
+    for name in names:
+        spec = P()
+        for pat, s in rules:
+            if re.fullmatch(pat, name):
+                spec = s
+                break
+        out[name] = spec
+    return out
+
+
+def _node_axis_spec(ax: int) -> P:
+    """P with ``nodes`` on dimension ``ax`` (leading dims replicated)."""
+    return P(*([None] * ax + [NODE_AXIS]))
+
+
+@lru_cache(maxsize=1)
+def static_specs() -> StaticArrays:
+    """PartitionSpec per ``StaticArrays`` field, derived from the
+    kernel's node-axis table: node planes shard, signature/term tables
+    replicate."""
+    rules = tuple((re.escape(f), _node_axis_spec(ax))
+                  for f, ax in _STATIC_NODE_AXES.items())
+    return StaticArrays(**match_partition_rules(rules, StaticArrays._fields))
+
+
+@lru_cache(maxsize=1)
+def state_specs() -> ScanState:
+    """PartitionSpec per ``ScanState`` field (``still_ok`` — handled
+    explicitly by the kernel's compaction, not in the axis table — shards
+    its trailing node axis like every other [.., N] plane; ``round_robin``
+    and ``total_match`` replicate)."""
+    rules = tuple((re.escape(f), _node_axis_spec(ax))
+                  for f, ax in _STATE_NODE_AXES.items())
+    rules += ((re.escape("still_ok"), _node_axis_spec(1)),)
+    return ScanState(**match_partition_rules(rules, ScanState._fields))
+
+
+def loop_in_specs():
+    """shard_map in_specs for the wave loop ``run(dev, xs_full, state,
+    chosen_buf, start_chunk, n_chunks, compact_thresh)``: node planes
+    partitioned, the pod-axis xs (7-tuple) and every scalar replicated."""
+    return (static_specs(), (P(),) * 7, state_specs(), P(), P(), P(), P())
+
+
+def loop_out_specs():
+    """shard_map out_specs for the loop's ``(state, chosen_buf, cursor,
+    want_compact, alive, n_alive)``: the carry planes stay partitioned,
+    the chosen buffer / control scalars are replicated (identical on
+    every shard — products of psum'd values), and the per-shard alive
+    slices concatenate back to the global [N] mask."""
+    return (state_specs(), P(), P(), P(), P(NODE_AXIS), P())
+
+
+def place_static(dev: StaticArrays, mesh: Mesh) -> StaticArrays:
+    """Commit every ``StaticArrays`` leaf to ``mesh`` per its rule-derived
+    spec (node axis partitioned, the rest replicated)."""
+    return StaticArrays(*(
+        jax.device_put(arr, NamedSharding(mesh, spec))
+        for arr, spec in zip(dev, static_specs())))
+
+
+def place_state(state: ScanState, mesh: Mesh) -> ScanState:
+    """Commit every ``ScanState`` leaf to ``mesh`` (a ``still_ok`` of
+    None — non-frontier callers — passes through untouched)."""
+    return ScanState(*(
+        arr if arr is None else jax.device_put(arr, NamedSharding(mesh, spec))
+        for arr, spec in zip(state, state_specs())))
+
+
+def mesh_dispatch_span(mesh: Mesh, width: int):
+    """The ``mesh.dispatch`` trace span wrapping every sharded loop
+    dispatch: shard count + mesh shape + current node width ride the
+    span attrs, so the wave trace shows WHERE the node axis was split
+    without a second trace format (TC503/TC504 gate this hot path)."""
+    tr = tracing.current()
+    if tr is None:
+        return tracing.NULL_SPAN
+    return tr.span("mesh.dispatch", cat="mesh", shards=int(mesh.size),
+                   mesh_shape=str(tuple(int(s) for s in mesh.shape.values())),
+                   width=int(width))
 
 
 def shard_static(dev: StaticArrays, mesh: Mesh) -> StaticArrays:
-    """Place static arrays: node-axis sharded, signature axis replicated."""
-    n = NamedSharding(mesh, P(NODE_AXIS))
-    n_r = NamedSharding(mesh, P(NODE_AXIS, None))
-    g_n = NamedSharding(mesh, P(None, NODE_AXIS))
-    repl = NamedSharding(mesh, P())
-    return StaticArrays(
-        node_exists=jax.device_put(dev.node_exists, n),
-        node_alloc=jax.device_put(dev.node_alloc, n_r),
-        node_alloc_pods=jax.device_put(dev.node_alloc_pods, n),
-        node_zone=jax.device_put(dev.node_zone, n),
-        static_ok=jax.device_put(dev.static_ok, g_n),
-        node_aff_raw=jax.device_put(dev.node_aff_raw, g_n),
-        taint_intol_raw=jax.device_put(dev.taint_intol_raw, g_n),
-        static_score=jax.device_put(dev.static_score, g_n),
-        interpod_raw=jax.device_put(dev.interpod_raw, g_n),
-        g_request=jax.device_put(dev.g_request, repl),
-        g_nonzero=jax.device_put(dev.g_nonzero, repl),
-        g_ports=jax.device_put(dev.g_ports, repl),
-        g_has_spread=jax.device_put(dev.g_has_spread, repl),
-        spread_inc=jax.device_put(dev.spread_inc, repl),
-        # phase B: the [.., N] maps shard with the node axis; the per-term /
-        # per-signature tables replicate (small)
-        term_matches_sig=jax.device_put(dev.term_matches_sig, repl),
-        sym_w=jax.device_put(dev.sym_w, repl),
-        own_w=jax.device_put(dev.own_w, repl),
-        own_ra=jax.device_put(dev.own_ra, repl),
-        own_raa=jax.device_put(dev.own_raa, repl),
-        own_all=jax.device_put(dev.own_all, repl),
-        is_raa=jax.device_put(dev.is_raa, repl),
-        self_match=jax.device_put(dev.self_match, repl),
-        node_domain=jax.device_put(dev.node_domain, g_n),
-        dom_valid=jax.device_put(dev.dom_valid, g_n),
-        vol_limits=jax.device_put(dev.vol_limits, repl),
-    )
+    """Place static arrays: node-axis sharded, signature axis replicated
+    (the per-term / per-signature tables are small).  Placement is
+    rule-driven — see ``static_specs``."""
+    return place_static(dev, mesh)
 
 
 def shard_state(state: ScanState, mesh: Mesh) -> ScanState:
-    n = NamedSharding(mesh, P(NODE_AXIS))
-    n_r = NamedSharding(mesh, P(NODE_AXIS, None))
-    g_n = NamedSharding(mesh, P(None, NODE_AXIS))
-    repl = NamedSharding(mesh, P())
-    return ScanState(
-        requested=jax.device_put(state.requested, n_r),
-        nonzero_requested=jax.device_put(state.nonzero_requested, n_r),
-        pod_count=jax.device_put(state.pod_count, n),
-        ports_used=jax.device_put(state.ports_used, n_r),
-        spread_counts=jax.device_put(state.spread_counts, g_n),
-        round_robin=jax.device_put(state.round_robin, repl),
-        # phase B: the [T, N] expanded domain counters shard on the node
-        # axis like every other per-node map (updates are elementwise
-        # same-domain masks — no cross-shard scatter); total_match is the
-        # only replicated affinity state
-        dm=jax.device_put(state.dm, g_n),
-        downer=jax.device_put(state.downer, g_n),
-        total_match=jax.device_put(state.total_match, repl),
-        vol_any=jax.device_put(state.vol_any, g_n),
-        vol_ns=jax.device_put(state.vol_ns, g_n),
-        nk=jax.device_put(state.nk, g_n),
-    )
+    """Place the carry: the [T, N] expanded domain counters shard on the
+    node axis like every other per-node map (updates are elementwise
+    same-domain masks — no cross-shard scatter); ``round_robin`` and
+    ``total_match`` are the only replicated dynamic state."""
+    return place_state(state, mesh)
 
 
 def _prepare(static: BatchStatic, init: InitialState, mesh: Mesh):

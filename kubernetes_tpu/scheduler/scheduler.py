@@ -310,6 +310,47 @@ class Scheduler:
             frame_events += st.get("frame_events", 0)
         return apply_s, frames, frame_events
 
+    def _observe_mesh_wave(self, lf, pre_shard, ncache, wave_span) -> None:
+        """Per-shard SLO attribution of the sharded wave loop (the PR-12
+        caveat lands here: an AGGREGATE upload fraction hides one cold
+        shard behind N-1 warm ones).  The worst shard's upload fraction
+        and the alive-fraction skew land on literal-named gauges —
+        ``utils.slo.mesh_slos`` windows them — and the per-shard lists
+        ride the existing wave span as one ``mesh`` attr, not a second
+        trace format."""
+        mesh_segs = [s for s in (lf or []) if s.get("mode") == "mesh"]
+        if not mesh_segs:
+            return
+        n_shards = max(int(s.get("n_shards", 0)) for s in mesh_segs)
+        self.metrics.mesh_shards.set(n_shards)
+        attrs: dict = {"n_shards": n_shards}
+        skews = [max(fr) - min(fr) for s in mesh_segs
+                 for fr in (s.get("shard_alive_frac") or []) if fr]
+        if skews:
+            skew = round(max(skews), 4)
+            self.metrics.mesh_shard_alive_skew.set(skew)
+            attrs["shard_alive_skew"] = skew
+        if pre_shard is not None and ncache is not None:
+            dirty = ncache.stats.get("shard_dirty_cols", ())
+            cols = ncache.stats.get("shard_cols_total", ())
+            # first mesh wave: set_mesh() sized the per-shard counters
+            # AFTER the pre-wave capture — an empty pre-list means zero
+            pre_d = pre_shard[0] or (0,) * len(dirty)
+            pre_c = pre_shard[1] or (0,) * len(cols)
+            fracs = []
+            if len(dirty) == len(pre_d) and len(cols) == len(pre_c):
+                for d0, d1, c0, c1 in zip(pre_d, dirty, pre_c, cols):
+                    if c1 - c0 > 0:
+                        fracs.append((d1 - d0) / (c1 - c0))
+            if fracs:
+                worst = round(max(fracs), 4)
+                self.metrics.mesh_worst_shard_upload_fraction.set(worst)
+                attrs["shard_upload_fractions"] = [round(f, 4) for f in fracs]
+                attrs["worst_shard_upload_fraction"] = worst
+        self.last_batch_phases["mesh"] = attrs
+        if wave_span is not None:
+            wave_span.set(mesh=attrs)
+
     # -- snapshot ----------------------------------------------------------
     def snapshot(self) -> dict[str, NodeInfo]:
         """Generation-checked CoW refresh (cache.go:79)."""
@@ -926,6 +967,12 @@ class Scheduler:
         pre_cols = ((ncache.stats["dirty_cols"], ncache.stats["cols_total"],
                      ncache.stats["reuses"])
                     if ncache is not None else None)
+        # per-shard upload accounting (mesh mode): snapshot the per-shard
+        # cumulative counters so the wave delta attributes dirty columns
+        # to the shard that received them
+        pre_shard = ((tuple(ncache.stats.get("shard_dirty_cols", ())),
+                      tuple(ncache.stats.get("shard_cols_total", ())))
+                     if ncache is not None else None)
         pre_decode = self._ingest_decode_stats()
         pre_apply = self._pump_apply_stats()
         pre_fallbacks = self.metrics.confirm_fallbacks.value
@@ -1041,6 +1088,7 @@ class Scheduler:
                     fr = seg.get("alive_frac") or []
                     if fr:
                         self.metrics.frontier_alive_fraction.observe(min(fr))
+            self._observe_mesh_wave(lf, pre_shard, ncache, wave_span)
         finally:
             if wave_cm is not None:
                 wave_span.set(bound=totals["bound"], failed=totals["failed"],

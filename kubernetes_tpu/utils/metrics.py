@@ -432,3 +432,21 @@ class SchedulerMetrics:
             "scheduler_preemption_sheds_total",
             "preemption-eligible pods denied the PostFilter pass because "
             "their tier is below the ladder's floor (rung >= 2)"))
+        # sharded wave loop (ISSUE 18): per-shard SLO attribution of the
+        # node-axis mesh — aggregate fractions hid one cold shard behind
+        # the warm ones (the PR-12 caveat), so the WORST shard is what
+        # gets a first-class signal.  Gauges, not histograms: the SLO
+        # layer consumes them as windowed means (GaugeSLI).
+        self.mesh_shards = r.register(Gauge(
+            "scheduler_mesh_shards",
+            "shard count of the node-axis mesh the last sharded wave "
+            "loop ran on (0 = single-device path)"))
+        self.mesh_worst_shard_upload_fraction = r.register(Gauge(
+            "scheduler_mesh_worst_shard_upload_fraction",
+            "highest per-shard dirty-column upload fraction of the last "
+            "wave (1 = some shard re-uploaded its whole node slice)"))
+        self.mesh_shard_alive_skew = r.register(Gauge(
+            "scheduler_mesh_shard_alive_skew",
+            "max spread between per-shard alive fractions at the last "
+            "sharded loop exit (large = the frontier died unevenly and "
+            "some shards carry dead columns)"))

@@ -337,18 +337,56 @@ class AdmissionThrottle:
     the max lets mixed batches ride with their most important pod
     rather than punishing it for its cohort.  Counters are guarded by a
     lock (apiserver handler threads race).
+
+    The ``Retry-After`` hint is **load-adaptive**: a fixed hint invites
+    every shed client back on the same schedule regardless of how deep
+    the backlog actually is, so a 10x backlog gets the same retry storm
+    as a 1.1x one.  Instead the hint scales with the live windowed mean
+    of the queue-depth gauge (the same track the ladder's breach SLO
+    watches, read from the evaluator's time-series store) relative to
+    that SLO's threshold, clamped to [``retry_after_s``,
+    ``retry_after_max_s``] — the configured value is preserved as the
+    floor, and a dead store (no scraper, no samples) degrades to
+    exactly the old fixed-hint behavior.
     """
 
     def __init__(self, ladder: DegradationLadder,
                  retry_after_s: float = 1.0,
-                 resources: tuple = ("pods",)):
+                 resources: tuple = ("pods",),
+                 retry_after_max_s: float = 30.0):
         self.ladder = ladder
         self.retry_after_s = retry_after_s
+        self.retry_after_max_s = max(retry_after_max_s, retry_after_s)
         self.resources = frozenset(resources)
         self._mu = threading.Lock()
         self.admitted = 0
         self.throttled = 0
         self.throttled_by_tier: dict[int, int] = {}
+
+    def _depth_slo(self) -> Optional[SLO]:
+        """The ladder's queue-depth SLO (a GaugeSLI), if it has one —
+        its metric name and threshold define 'how deep is deep'."""
+        for slo in self.ladder.evaluator.slos:
+            if isinstance(slo.sli, GaugeSLI):
+                return slo
+        return None
+
+    def retry_after_hint(self) -> float:
+        """Live Retry-After: base x (windowed mean queue depth /
+        breach threshold), clamped to [base, max].  Reads the same ring
+        the ladder breached on, so the hint and the rung agree about
+        the backlog; any missing piece (no store, no samples, no gauge
+        SLO) falls back to the configured base."""
+        slo = self._depth_slo()
+        store = self.ladder.evaluator.store
+        if slo is None or store is None or slo.sli.threshold <= 0:
+            return self.retry_after_s
+        samples = store.query(slo.sli.metric, slo.fast_window_s)
+        if not samples:
+            return self.retry_after_s
+        depth = sum(v for _, v in samples) / len(samples)
+        scaled = self.retry_after_s * (depth / slo.sli.threshold)
+        return min(max(scaled, self.retry_after_s), self.retry_after_max_s)
 
     def admit(self, resource: str, bodies: list) -> Optional[float]:
         if resource not in self.resources:
@@ -366,7 +404,7 @@ class AdmissionThrottle:
         with self._mu:
             self.throttled += 1
             self.throttled_by_tier[tier] = self.throttled_by_tier.get(tier, 0) + 1
-        return self.retry_after_s
+        return self.retry_after_hint()
 
     def stats(self) -> dict:
         with self._mu:

@@ -150,6 +150,26 @@ DEFAULT_SLOS = [
 ]
 
 
+def mesh_slos() -> list[SLO]:
+    """SLOs over the per-shard attribution gauges the sharded wave loop
+    exports — this lands the per-shard SLO caveat left open when the
+    telemetry pipeline first shipped: a single hot shard (skewed upload
+    traffic or a lopsided alive distribution after compaction) now burns
+    its own budget instead of hiding in the cluster-wide mean.  Gauge
+    SLIs so both keep producing data (and can recover) while the mesh
+    idles between waves."""
+    return [
+        SLO(name="mesh_shard_upload_skew",
+            sli=GaugeSLI(
+                metric="scheduler_mesh_worst_shard_upload_fraction",
+                threshold=0.5)),
+        SLO(name="mesh_shard_alive_skew",
+            sli=GaugeSLI(
+                metric="scheduler_mesh_shard_alive_skew",
+                threshold=0.25)),
+    ]
+
+
 class BurnRateEvaluator:
     """Evaluates a set of SLOs against a time-series store.
 

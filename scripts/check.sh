@@ -10,6 +10,11 @@
 #      tree happens to be clean.
 #   4. a fast smoke of the overload degradation-ladder unit tests (the
 #      fake-clock ladder semantics — seconds, not the full suite).
+#   5. a forced-8-device mesh smoke: the shard_map wave-loop parity
+#      tests under XLA_FLAGS=--xla_force_host_platform_device_count=8
+#      (virtual CPU devices — catches sharding regressions without
+#      hardware; the forced-tie backend parity test plus the uneven-N
+#      padding gate).
 #
 # Usage: scripts/check.sh [ktpu-analyze args...]
 # Extra args are forwarded to ktpu-analyze — e.g. `scripts/check.sh
@@ -31,3 +36,8 @@ python -m pytest tests/test_static_analysis.py -q -p no:cacheprovider
 
 echo "== overload ladder smoke =="
 python -m pytest tests/test_overload.py -q -p no:cacheprovider -k "ladder"
+
+echo "== forced-8-device mesh smoke =="
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_mesh.py -q -p no:cacheprovider \
+    -k "sharded_backend or uneven_width"

@@ -1,6 +1,6 @@
 #!/usr/bin/env python3
-"""Evidence-integrity gate: every ``BENCH_AB_*.json`` the record cites
-must exist in the tree.
+"""Evidence-integrity gate: every ``BENCH_AB_*.json`` /
+``MULTICHIP_*.json`` ledger the record cites must exist in the tree.
 
 The ROADMAP carried the failure mode for four PRs: README/CHANGES/
 COVERAGE cited worktree ledgers (``BENCH_AB_device_loop.json``,
@@ -36,7 +36,7 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-LEDGER_RE = re.compile(r"BENCH_AB_\w+\.json")
+LEDGER_RE = re.compile(r"(?:BENCH_AB|MULTICHIP)_\w+\.json")
 DEMOTION_RE = re.compile(r"never committed|missing", re.I)
 
 PROSE_FILES = ["README.md", "CHANGES.md", "COVERAGE.md", "ROADMAP.md"]
@@ -111,7 +111,7 @@ def main() -> int:
         print(f"check_ledgers: {len(problems)} phantom ledger citation(s) "
               f"— evidence-integrity gate FAILED", file=sys.stderr)
         return 1
-    print("check_ledgers: every cited BENCH_AB_*.json exists")
+    print("check_ledgers: every cited BENCH_AB_*/MULTICHIP_*.json exists")
     return 0
 
 

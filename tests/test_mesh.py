@@ -140,3 +140,148 @@ def test_collective_structure_gate_rejects_state_allgather():
         "}\n")
     with pytest.raises(AssertionError, match="all-gathers node-axis state"):
         assert_collective_structure(bad_hlo, static)
+
+
+# -- shard_map wave loop (ISSUE 18) ------------------------------------------
+# The device-resident wave loop runs under shard_map with the node axis
+# partitioned: in-loop psum/pmax/pmin reductions replace the per-chunk host
+# hop, and the cross-shard argmax tie-breaks on (score, GLOBAL node index) so
+# the round-robin rotation stays bit-exact vs the sequential CPU oracle.
+
+import numpy as np
+
+from kubernetes_tpu.models.snapshot import (
+    frontier_seed,
+    pad_segment_to_multiple,
+)
+from kubernetes_tpu.ops import TPUBatchBackend
+from kubernetes_tpu.ops.batch_kernel import FrontierRun
+from kubernetes_tpu.testutil import make_pod
+
+from tests.test_frontier import assert_frontier_parity, tie_cluster
+
+
+def _seeded(pods, nim):
+    pctx = PriorityContext(nim)
+    tz = Tensorizer()
+    static = tz.build_static(pods, nim, pctx)
+    init = tz.initial_state(static, nim, pctx, pods)
+    frontier_seed(static, init)
+    return static, init
+
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_sharded_loop_forced_ties_and_compaction_parity(n_dev):
+    """The capstone fixture under sharding: identical nodes tie on every
+    score while staggered caps force mid-segment compactions — the
+    sharded wave loop, the single-device loop, and the plain full-width
+    scan must agree on bindings AND the tie counter at every mesh
+    size."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    static, init = _seeded(pods, nim)
+    pstatic, pinit = pad_segment_to_multiple(static, init, n_dev)
+    run = FrontierRun(pstatic, pinit, device_loop=True, chunk_len=16,
+                      min_width=8, mesh=make_mesh(n_dev))
+    m_chosen, m_rr = run.finalize()
+    single = FrontierRun(static, init, device_loop=True, chunk_len=16,
+                         min_width=8)
+    s_chosen, s_rr = single.finalize()
+    p_chosen, p_rr = schedule_batch_arrays(static, init)
+    # identity padding keeps real-node indices stable, so the sharded
+    # chosen vector compares directly against the unpadded runs
+    np.testing.assert_array_equal(m_chosen, s_chosen)
+    np.testing.assert_array_equal(m_chosen, p_chosen)
+    assert m_rr == s_rr == p_rr
+    assert run.stats["compactions"] >= 1, "compaction never fired sharded"
+    # per-shard compaction stats rode the existing spans
+    assert run.stats.get("n_shards") == n_dev
+    # the O(compactions + 1) sync budget survives sharding: reductions
+    # happen IN the loop, never as a host hop per chunk
+    assert run.stats["host_syncs"] <= run.stats["loop_runs"] + 1
+    assert run.stats["loop_runs"] >= run.stats["compactions"] + 1
+
+
+def test_sharded_loop_uneven_width_pads_no_phantom_columns():
+    """An N that does not divide the shard count: padding must force the
+    extra columns infeasible for every signature (no phantom feasible
+    column can win any reduce) and the sharded run stays exact vs the
+    unpadded plain scan."""
+    import random as _random
+
+    from tests.test_parity import build_cluster
+
+    rng = _random.Random(91)
+    nim = build_cluster(rng, 20, zones=3)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(90)]
+    pctx = PriorityContext(nim)
+    tz = Tensorizer(pad_multiple=2)  # n_pad=20: not divisible by 8
+    static = tz.build_static(pods, nim, pctx)
+    init = tz.initial_state(static, nim, pctx, pods)
+    frontier_seed(static, init)
+    assert int(static.n_pad) % 8 != 0
+    pstatic, pinit = pad_segment_to_multiple(static, init, 8)
+    assert int(pstatic.n_pad) % 8 == 0 and pstatic.n_pad > static.n_pad
+    n = int(static.n_pad)
+    # the padded tail is dead on arrival: no existence, no feasibility
+    assert not pstatic.node_exists[n:].any()
+    assert not np.asarray(pinit.still_ok)[:, n:].any()
+    run = FrontierRun(pstatic, pinit, device_loop=True, chunk_len=16,
+                      min_width=8, mesh=make_mesh(8))
+    m_chosen, m_rr = run.finalize()
+    p_chosen, p_rr = schedule_batch_arrays(static, init)
+    np.testing.assert_array_equal(m_chosen, p_chosen)
+    assert m_rr == p_rr
+    assert not (m_chosen >= n).any(), "a phantom pad column was chosen"
+
+
+def test_sharded_backend_oracle_parity_end_to_end():
+    """Through the backend with ``frontier_mesh=True``: bindings and the
+    round-robin counter match the per-pod CPU oracle, the segment is
+    served in mesh mode with zero fallbacks, and the per-segment
+    host_syncs stay O(compactions + 1)."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    backend = assert_frontier_parity(
+        pods, nim,
+        backend_kwargs=dict(frontier_chunk=16, frontier_min_width=8,
+                            frontier_mesh=True))
+    assert backend.stats["frontier_fallback_modes"].get("mesh", 0) == 0
+    seg = backend.last_frontier[0]
+    assert seg["mode"] == "mesh"
+    assert seg["n_shards"] == 8  # conftest forces 8 virtual devices
+    assert seg["compactions"] >= 1
+    assert seg["host_syncs"] <= seg["compactions"] + 2
+    # per-shard alive fractions rode the span attrs: one snapshot per
+    # loop exit (>= one per compaction), each over all 8 shards
+    assert len(seg["shard_alive_frac"]) > seg["compactions"] >= 1
+    assert all(len(s) == 8 for s in seg["shard_alive_frac"])
+
+
+def test_sharded_backend_mesh_failure_degrades_to_single_device():
+    """Breaker-style fallback: a poisoned mesh build disables the mesh
+    path for the backend's lifetime — segments serve through the
+    single-device loop with parity intact, and the fallback is counted
+    under its own mode."""
+    nim = tie_cluster(16)
+    pods = [make_pod(f"p-{i:03d}", cpu="100m", memory="128Mi",
+                     labels={"app": "web"}) for i in range(110)]
+    from kubernetes_tpu.scheduler import GenericScheduler
+
+    from tests.test_frontier import oracle_batch
+
+    pctx = PriorityContext(nim)
+    a, b = GenericScheduler(), GenericScheduler()
+    want = oracle_batch(pods, nim, pctx, a)
+    backend = TPUBatchBackend(algorithm=b, frontier_chunk=16,
+                              frontier_min_width=8, frontier_mesh=True,
+                              mesh_devices=1)  # < 2: mesh build must fail
+    got = backend.schedule_batch(pods, nim, pctx)
+    assert [g for g in got] == want
+    assert a._round_robin == b._round_robin
+    assert backend._mesh_failed
+    assert backend.stats["frontier_fallback_modes"].get("mesh", 0) >= 1
+    assert backend.last_frontier[0]["mode"] == "loop"

@@ -327,6 +327,46 @@ def test_throttle_orders_tiers_batch_first():
     assert stats["throttled_by_tier"] == {PriorityTierClassifier.BATCH: 1}
 
 
+def test_throttle_retry_after_scales_with_live_queue_depth():
+    """ISSUE 18 satellite: the rung-3 ``Retry-After`` hint scales with
+    the live windowed backlog instead of a fixed config — a 4x backlog
+    tells shed clients to stay away 4x longer, the configured value is
+    preserved as the floor, a hostile backlog clamps at the max, and a
+    dead store degrades to exactly the old fixed hint."""
+    clock = FakeClock()
+    reg = Registry()
+    pending = reg.register(Gauge("scheduler_pending_pods"))
+    store = TimeSeriesStore(reg, interval_s=0.5, clock=clock)
+    lad = DegradationLadder(
+        slos=overload_slos(pending_threshold=100.0, fast_window_s=2.0),
+        store=store, clock=clock)
+    lad.rung = MAX_RUNG
+    th = AdmissionThrottle(lad, retry_after_s=2.0, retry_after_max_s=12.0)
+    # no samples yet: degrade to the configured fixed hint
+    assert th.admit("pods", [_body(0)]) == 2.0
+    # backlog at 4x the breach threshold -> the hint scales 4x
+    pending.set(400.0)
+    for _ in range(4):
+        store.sample_once()
+        clock.advance(0.5)
+    assert th.admit("pods", [_body(0)]) == pytest.approx(8.0)
+    # a drained backlog never undercuts the configured base (clamp floor)
+    pending.set(10.0)
+    for _ in range(6):
+        store.sample_once()
+        clock.advance(0.5)
+    assert th.admit("pods", [_body(0)]) == pytest.approx(2.0)
+    # a runaway backlog clamps at the ceiling (clamp preserved)
+    pending.set(1e6)
+    for _ in range(6):
+        store.sample_once()
+        clock.advance(0.5)
+    assert th.admit("pods", [_body(0)]) == 12.0
+    # the ceiling can never be configured below the floor
+    assert AdmissionThrottle(lad, retry_after_s=5.0,
+                             retry_after_max_s=1.0).retry_after_max_s == 5.0
+
+
 def test_preempt_floor_restricts_to_critical_at_rung_two():
     lad = _ladder()
     assert lad.preempt_tier_floor == 0

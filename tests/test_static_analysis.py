@@ -778,7 +778,9 @@ def test_sanctioned_sync_sites_counts():
 
     sites = sanctioned_sync_sites(ROOT)
     bk = sites["kubernetes_tpu/ops/batch_kernel.py"]
-    assert bk["FrontierRun._sync_loop"] == 3
+    # 4th site: the per-shard alive snapshot rides the loop-exit
+    # transfer (ISSUE 18 — sharded wave loop attribution)
+    assert bk["FrontierRun._sync_loop"] == 4
     assert bk["FrontierRun._finalize_loop"] == 2
     assert bk["FrontierRun._maybe_compact"] == 2
     assert bk["FrontierRun.finalize"] == 2
