@@ -396,7 +396,8 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
               frontier: bool = True, watch_frames: bool = True,
               device_loop: bool = True, frontier_chunk: int = 512,
               verify_oracle: bool = False, trace=None,
-              telemetry=None, mesh: bool = False) -> dict:
+              telemetry=None, mesh: bool = False,
+              coalesce: float = 0.0) -> dict:
     """Steady-state arrival load (``test/e2e/scalability/density.go:
     316-318,474-475``): pods arrive from an ARRIVAL THREAD — wave w+1 is
     created the moment wave w leaves the queue, the density.go shape
@@ -482,7 +483,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
         r = _run_churn_timed(n_nodes, total_pods, waves, workload, seed,
                              pipeline, lazy_ingest, frontier,
                              watch_frames, device_loop, frontier_chunk,
-                             verify_oracle, telemetry, mesh)
+                             verify_oracle, telemetry, mesh, coalesce)
     finally:
         lazy_mod.ENABLED = lazy_was
         frames_mod.ENABLED = frames_was
@@ -518,7 +519,7 @@ def run_churn(n_nodes: int = 5_000, total_pods: int = 20_000, waves: int = 10,
 def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
                      lazy_ingest, frontier, watch_frames, device_loop,
                      frontier_chunk, verify_oracle, telemetry=None,
-                     mesh=False) -> dict:
+                     mesh=False, coalesce=0.0) -> dict:
     import threading
 
     from kubernetes_tpu.api import lazy as lazy_mod
@@ -529,7 +530,8 @@ def _run_churn_timed(n_nodes, total_pods, waves, workload, seed, pipeline,
     from kubernetes_tpu.store import Store
 
     rng = random.Random(seed)
-    cs = Clientset(Store(event_log_window=max(200_000, 2 * (n_nodes + total_pods))))
+    cs = Clientset(Store(event_log_window=max(200_000, 2 * (n_nodes + total_pods)),
+                         coalesce_window_s=coalesce))
     for node in make_nodes(n_nodes, rng, workload):
         cs.nodes.create(node)
     if workload == "mixed":
@@ -1109,6 +1111,431 @@ def run_watch_ab(n_nodes: int = 5_000, total_pods: int = 20_000,
         "bound_counts": sorted(bounds),
         "apply_s_per_run": {"A_old": a_apply, "B_new": b_apply},
         "oracle_parity": parity,
+    }
+
+
+def _rss_mb() -> float:
+    """Current resident set (VmRSS) in MiB — current, not peak, so the
+    second arm of an A/B is not poisoned by the first arm's high-water
+    mark the way ``ru_maxrss`` would be."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except OSError:
+        pass
+    return 0.0
+
+
+def _fleet_arm(arm_b: bool, n_watchers: int, seed_pods: int, churn_ops: int,
+               http_watchers: int, selector_watchers: int, n_informers: int,
+               pump_threads: int, coalesce_window_s: float, seed: int,
+               slo_probe: bool, drain_timeout_s: float = 120.0) -> dict:
+    """One arm of the hollow-watcher fleet bench: B = coalescing window +
+    framed delivery + shared encode, A = per-event delivery (the
+    pre-serving-tier broadcaster), same harness, same seeded churn.
+
+    The fleet is kubemark applied to the WATCH axis: ``n_watchers``
+    in-process hollow watchers (no thread each — a pump pool drives
+    slices), a small HTTP cohort on real apiserver streams (selector
+    watchers among them exercising column-level sub-frame packing), and
+    a few real ``SharedInformer``s with ``compact_on_resync`` for the
+    RSS point.  Throughput is LOGICAL fan-out: every churn event must
+    reach every full watcher (a coalesced fold counts — the client holds
+    the newest state that event produced), so events/s =
+    churn_ops x full_watchers / drain wall."""
+    import dataclasses
+    import threading
+
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Clientset
+    from kubernetes_tpu.client.informer import SharedInformer
+    from kubernetes_tpu.client.remote import RemoteStore
+    from kubernetes_tpu.kubelet.hollow import HollowWatcher, HollowWatcherFleet
+    from kubernetes_tpu.store import Store
+    from kubernetes_tpu.store import frames as frames_mod
+    from kubernetes_tpu.utils import tracing
+    from kubernetes_tpu.utils.fanout import WatchFanoutTracker
+    from kubernetes_tpu.utils.metrics import (DEFAULT_STORE_METRICS,
+                                              ClientMetrics, Registry)
+    from kubernetes_tpu.utils.slo import BurnRateEvaluator, serving_slos
+    from kubernetes_tpu.utils.timeseries import TimeSeriesStore
+
+    frames_was, shenc_was = frames_mod.ENABLED, frames_mod.SHARED_ENCODE
+    frames_mod.ENABLED = arm_b
+    frames_mod.SHARED_ENCODE = arm_b
+    sm = DEFAULT_STORE_METRICS
+    sm0 = (sm.coalesce_flushes.value, sm.coalesced_events.value,
+           sm.coalesce_fallbacks.value)
+    store = Store(event_log_window=max(200_000, 8 * (seed_pods + churn_ops)),
+                  coalesce_window_s=(coalesce_window_s if arm_b else 0.0))
+    server = None
+    stop = threading.Event()
+    stall = threading.Event()
+    threads: list[threading.Thread] = []
+    tracer = tracing.enable(ring_waves=4) if slo_probe else None
+    try:
+        rng = random.Random(seed)
+        cs = Clientset(store)
+
+        def pod(i):
+            return {"metadata": {"name": f"fp-{i:05d}", "namespace": "default",
+                                 "labels": {"tier": "hot" if i % 2 == 0
+                                            else "cold"}},
+                    "spec": {}, "status": {"phase": "Pending"}}
+
+        for i in range(seed_pods):
+            store.create("Pod", pod(i))
+        seed_head = store.revision
+
+        metrics = ClientMetrics(Registry())
+        tracker = WatchFanoutTracker(metrics)
+        fleet = HollowWatcherFleet(store, n_watchers, kind="Pod",
+                                   frames=arm_b, tracker=tracker,
+                                   from_revision=seed_head)
+        server = APIServer(store)
+        server.start()
+        remote = RemoteStore(server.url)
+        http_fleet = HollowWatcherFleet(remote, http_watchers, kind="Pod",
+                                        frames=arm_b, tracker=tracker,
+                                        prefix="http",
+                                        from_revision=seed_head)
+        sel_watchers = [
+            HollowWatcher(
+                f"sel-{i:03d}",
+                remote.watch("Pod", from_revision=seed_head, frames=arm_b,
+                             label_selector="tier=hot"))
+            for i in range(selector_watchers)
+        ]
+        informers = [SharedInformer(cs.pods, compact_on_resync=True)
+                     for _ in range(n_informers)]
+        for inf in informers:
+            inf.start_manual()
+
+        # -- pump pool: slices of the hollow fleet + one aux driver --------
+        def pump_slice(ws):
+            while not stop.is_set():
+                if stall.is_set():
+                    time.sleep(0.002)
+                    continue
+                n = 0
+                for w in ws:
+                    n += w.pump()
+                if n == 0:
+                    time.sleep(0.001)
+
+        def pump_aux():
+            while not stop.is_set():
+                if stall.is_set():
+                    time.sleep(0.002)
+                    continue
+                n = http_fleet.pump_all()
+                for w in sel_watchers:
+                    n += w.pump()
+                for inf in informers:
+                    n += inf.pump()
+                if n == 0:
+                    time.sleep(0.001)
+
+        step = max(1, n_watchers // pump_threads)
+        for j in range(0, n_watchers, step):
+            t = threading.Thread(target=pump_slice,
+                                 args=(fleet.watchers[j:j + step],),
+                                 daemon=True, name=f"fleet-pump-{j}")
+            threads.append(t)
+        threads.append(threading.Thread(target=pump_aux, daemon=True,
+                                        name="fleet-pump-aux"))
+
+        # staleness sampler: per-tick p50/p99 revision lag across the
+        # hollow fleet (plain int reads — watcher applied_rev is a word)
+        lag_p50: list[int] = []
+        lag_p99: list[int] = []
+
+        def sampler():
+            while not stop.is_set():
+                head = store.revision
+                tracker.observe_head(head)
+                lags = sorted(head - w.applied_rev for w in fleet.watchers)
+                lag_p50.append(lags[len(lags) // 2])
+                lag_p99.append(lags[(len(lags) * 99) // 100])
+                tracker.sample()
+                time.sleep(0.02)
+
+        threads.append(threading.Thread(target=sampler, daemon=True,
+                                        name="fleet-sampler"))
+        for t in threads:
+            t.start()
+
+        # -- the measured churn: singles (the coalescer's diet) ------------
+        alive = set(range(seed_pods))
+        hot = list(range(0, seed_pods, 2))
+        touched: set = set()
+        t0 = time.perf_counter()
+        for op in range(churn_ops):
+            i = rng.choice(hot)
+            touched.add(i)
+            r = rng.random()
+            if i in alive and r < 0.12:
+                store.delete("Pod", "default", f"fp-{i:05d}")
+                alive.discard(i)
+            elif i not in alive:
+                store.create("Pod", pod(i))
+                alive.add(i)
+            else:
+                obj = store.get("Pod", "default", f"fp-{i:05d}")
+                obj["status"] = {"phase": f"Running-{op}"}
+                store.update("Pod", obj)
+        head = store.revision
+        deadline = time.perf_counter() + drain_timeout_s
+        while (fleet.converged(head) < n_watchers
+               or http_fleet.converged(head) < http_watchers):
+            if time.perf_counter() > deadline:
+                break
+            time.sleep(0.005)
+        wall = time.perf_counter() - t0
+        # grace for the selector cohort (its applied_rev tops out at the
+        # last MATCHING revision, not head) and the informers
+        time.sleep(0.25)
+
+        full_clients = n_watchers + http_watchers
+        logical = churn_ops * full_clients
+        delivered = sum(w.event_units for w in fleet.watchers + http_fleet.watchers)
+        deliveries = sum(w.deliveries for w in fleet.watchers + http_fleet.watchers)
+
+        # -- state-equivalence gate (over the keys the watchers SAW:
+        # the fleet watches from the seed head, so only churned keys
+        # have deliveries to agree on) --------------------------------------
+        expected = {}
+        for i in touched:
+            key = f"default/fp-{i:05d}"
+            if i in alive:
+                expected[key] = int(
+                    store.get("Pod", "default", f"fp-{i:05d}")
+                    ["metadata"]["resourceVersion"])
+            else:
+                expected[key] = None
+        mismatches = gapped = 0
+        for w in fleet.watchers + http_fleet.watchers:
+            if w.gaps:
+                gapped += 1
+                continue
+            for key, rev in expected.items():
+                if w.cache.get(key) != rev and not (rev is None
+                                                    and key not in w.cache):
+                    mismatches += 1
+                    break
+        sel_bad = sel_mismatch = 0
+        for w in sel_watchers:
+            if any(not k.split("/", 1)[1].startswith("fp-") or
+                   int(k.split("fp-")[1]) % 2 != 0 for k in w.cache):
+                sel_bad += 1
+            for key, rev in expected.items():
+                if rev is not None and w.cache.get(key) != rev:
+                    sel_mismatch += 1
+                    break
+        for inf in informers:
+            inf.relist()  # resync -> compact_on_resync sweep (the RSS point)
+        inf_lag = [head - inf.last_revision for inf in informers]
+        rss = _rss_mb()
+
+        # -- SLO probe: stall the pumps, burn, drain, recover --------------
+        slo_block = None
+        if slo_probe:
+            tracker.attach_breach_context()
+            clk = [0.0]
+            ts = TimeSeriesStore(metrics.registry, interval_s=0.5,
+                                 capacity=600, clock=lambda: clk[0])
+            slos = [dataclasses.replace(s, fast_window_s=1.0,
+                                        slow_window_s=3.0, recovery_evals=2)
+                    for s in serving_slos(worst_lag_revisions=40.0)]
+            ev = BurnRateEvaluator(slos=slos, store=ts)
+            events: list[dict] = []
+
+            def tick():
+                clk[0] += 0.5
+                tracker.observe_head(store.revision)
+                tracker.sample()
+                ts.sample_once()
+                events.extend(ev.evaluate())
+
+            stall.set()
+            for op in range(120):  # lag builds while nobody pumps
+                i = rng.choice(hot)
+                if i in alive:
+                    obj = store.get("Pod", "default", f"fp-{i:05d}")
+                    obj["status"] = {"phase": f"stall-{op}"}
+                    store.update("Pod", obj)
+            store.flush_coalesced()
+            for _ in range(30):
+                tick()
+                if any(e["type"] == "breach" for e in events):
+                    break
+                time.sleep(0.02)
+            stall.clear()
+            shead = store.revision
+            sdl = time.perf_counter() + 30.0
+            while (fleet.converged(shead) < n_watchers
+                   and time.perf_counter() < sdl):
+                time.sleep(0.005)
+            for _ in range(40):
+                tick()
+                if any(e["type"] == "recovered" for e in events):
+                    break
+                time.sleep(0.02)
+            dump_ctx = None
+            for d in (tracer.dumps if tracer is not None else []):
+                if d["reason"].startswith("slo:watch_fanout_worst_client"):
+                    dump_ctx = d["attrs"].get("context")
+            slo_block = {
+                "slo": "watch_fanout_worst_client_staleness",
+                "breached": any(e["type"] == "breach" for e in events),
+                "recovered": any(e["type"] == "recovered" for e in events),
+                "breach_dump_top_laggards": (
+                    len(dump_ctx["top_laggards"]) if dump_ctx else 0),
+                "events": events,
+            }
+
+        return {
+            "arm": "B_coalesced_shared" if arm_b else "A_per_event",
+            "wall_s": round(wall, 3),
+            "fanout_events_per_s": int(logical / wall) if wall else None,
+            "logical_events": logical,
+            "delivered_units": delivered,
+            "deliveries": deliveries,
+            "staleness_p50_revisions": (sorted(lag_p50)[len(lag_p50) // 2]
+                                        if lag_p50 else 0),
+            "staleness_p99_revisions": (sorted(lag_p99)[len(lag_p99) // 2]
+                                        if lag_p99 else 0),
+            "rss_mb": rss,
+            "coalesce": {
+                "flushes": int(sm.coalesce_flushes.value - sm0[0]),
+                "folded": int(sm.coalesced_events.value - sm0[1]),
+                "fallbacks": int(sm.coalesce_fallbacks.value - sm0[2]),
+            },
+            "equiv": {"clients": full_clients, "mismatches": mismatches,
+                      "gapped": gapped},
+            "selector": {"clients": selector_watchers,
+                         "non_matching_keys": sel_bad,
+                         "mismatches": sel_mismatch},
+            "informers": {"count": n_informers,
+                          "compact_on_resync": True,
+                          "lag_after_relist": inf_lag,
+                          "compactions": sum(i.stats["compactions"]
+                                             for i in informers)},
+            "slo": slo_block,
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        try:
+            fleet.stop_all()
+            http_fleet.stop_all()
+            for w in sel_watchers:
+                w.stop()
+            for inf in informers:
+                inf.stop()
+        except Exception:
+            pass
+        if server is not None:
+            server.stop()
+        store.close()
+        if tracer is not None:
+            tracing.disable()
+        frames_mod.ENABLED = frames_was
+        frames_mod.SHARED_ENCODE = shenc_was
+
+
+def run_watch_fleet(n_watchers: int = 10_000, seed_pods: int = 400,
+                    churn_ops: int = 600, http_watchers: int = 24,
+                    selector_watchers: int = 8, n_informers: int = 4,
+                    pump_threads: int = 8, coalesce_window_s: float = 0.005,
+                    seed: int = 0, parity: bool = True) -> dict:
+    """The hollow-watcher fleet bench (ISSUE 19): ``n_watchers``
+    concurrent watch clients against ONE broadcaster under single-event
+    churn, A/B-ing the serving tier (B = time-window coalescing + framed
+    delivery + single-encode fan-out; A = per-event delivery).
+
+    Ships the BENCH_watch_fleet.json evidence: logical fan-out events/s
+    per arm (every churn event reaching every client), per-client
+    staleness p50/p99 in revisions, RSS with ``compact_on_resync``
+    informers riding along, a zero-mismatch state-equivalence gate over
+    every client's final cache, the per-CLIENT staleness SLO burning and
+    recovering mid-run (with the top-K laggard breach dump), and — with
+    ``parity`` — the north-preset churn replayed through the per-pod CPU
+    oracle with the coalescing window ON."""
+    a = _fleet_arm(False, n_watchers, seed_pods, churn_ops, http_watchers,
+                   selector_watchers, n_informers, pump_threads,
+                   coalesce_window_s, seed, slo_probe=False)
+    print(f"# watch-fleet A: {a['fanout_events_per_s']} ev/s "
+          f"wall={a['wall_s']}s equiv={a['equiv']}", file=sys.stderr)
+    b = _fleet_arm(True, n_watchers, seed_pods, churn_ops, http_watchers,
+                   selector_watchers, n_informers, pump_threads,
+                   coalesce_window_s, seed, slo_probe=True)
+    print(f"# watch-fleet B: {b['fanout_events_per_s']} ev/s "
+          f"wall={b['wall_s']}s equiv={b['equiv']} slo={b['slo']}",
+          file=sys.stderr)
+    ratio = (round(b["fanout_events_per_s"] / a["fanout_events_per_s"], 2)
+             if a["fanout_events_per_s"] else None)
+
+    parity_block = None
+    if parity:
+        print("# watch-fleet: north-preset oracle parity with coalescing on",
+              file=sys.stderr)
+        r = run_churn(5_000, 20_000, 10, seed=seed, verify_oracle=True,
+                      coalesce=coalesce_window_s)
+        parity_block = dict(r["oracle_parity"],
+                            coalesce_window_s=coalesce_window_s,
+                            pods_per_sec=r["pods_per_sec"])
+
+    mism = (a["equiv"]["mismatches"] + b["equiv"]["mismatches"]
+            + a["selector"]["mismatches"] + b["selector"]["mismatches"]
+            + a["selector"]["non_matching_keys"]
+            + b["selector"]["non_matching_keys"])
+    gapped = a["equiv"]["gapped"] + b["equiv"]["gapped"]
+    slo_ok = bool(b["slo"] and b["slo"]["breached"] and b["slo"]["recovered"]
+                  and b["slo"]["breach_dump_top_laggards"] > 0)
+    verdict = {
+        "pass": bool(ratio is not None and ratio >= 3.0 and mism == 0
+                     and gapped == 0 and slo_ok
+                     and (parity_block is None
+                          or parity_block["mismatches"] == 0)),
+        "fanout_ratio_B_over_A": ratio,
+        "min_ratio": 3.0,
+        "state_mismatches": mism,
+        "dropped_state_clients": gapped,
+        "slo_burned_and_recovered": slo_ok,
+        "oracle_parity_mismatches": (parity_block["mismatches"]
+                                     if parity_block else None),
+    }
+    return {
+        "claim": ("Heavy-traffic serving tier: a bounded time-window "
+                  "coalescing seam at the broadcaster (per-key latest-wins "
+                  "folds into synthetic watch frames), column-level "
+                  "selector sub-frames, and single-encode fan-out — "
+                  "measured as logical fan-out throughput against a "
+                  "kubemark-style hollow-watcher fleet"),
+        "method": (f"{n_watchers} hollow in-process watchers + "
+                   f"{http_watchers} HTTP stream clients "
+                   f"(+{selector_watchers} selector watchers, "
+                   f"{n_informers} compact_on_resync informers) on one "
+                   f"store; {churn_ops} single-object churn ops over "
+                   f"{seed_pods} seeded pods; both arms same seeds, same "
+                   "pump pool; throughput is logical fan-out (churn_ops x "
+                   "full clients / drain wall); equivalence gates every "
+                   "client's final cache against the store; the B arm "
+                   "additionally stalls the pumps to burn and recover the "
+                   "per-CLIENT staleness SLO"),
+        "watchers": {"hollow": n_watchers, "http": http_watchers,
+                     "selector": selector_watchers,
+                     "informers": n_informers},
+        "churn": {"seed_pods": seed_pods, "ops": churn_ops,
+                  "coalesce_window_s": coalesce_window_s},
+        "A": a,
+        "B": b,
+        "oracle_parity_coalesced": parity_block,
+        "verdict": verdict,
     }
 
 
@@ -2229,6 +2656,30 @@ def main() -> None:
         "artifact behind them; --nodes overrides scale",
     )
     parser.add_argument(
+        "--watch-fleet", nargs="?", const="BENCH_watch_fleet.json",
+        default=None, metavar="PATH",
+        help="run the hollow-watcher fleet bench (ISSUE 19): 10k+ "
+        "concurrent watch clients against one broadcaster under churn, "
+        "A/B-ing the serving tier (coalescing window + framed delivery "
+        "+ single-encode fan-out vs per-event), with a zero-mismatch "
+        "state-equivalence gate, the per-CLIENT staleness SLO burning "
+        "and recovering mid-run, and a north-preset oracle-parity leg "
+        "with coalescing on; writes the ledger JSON to PATH (default "
+        "BENCH_watch_fleet.json) — verdicts only print with the "
+        "artifact behind them",
+    )
+    parser.add_argument(
+        "--fleet-watchers", type=int, default=10_000, metavar="N",
+        help="hollow-watcher count for --watch-fleet (default 10000; "
+        "the committed ledger requires >= 10000)",
+    )
+    parser.add_argument(
+        "--fleet-no-parity", dest="fleet_parity", action="store_false",
+        default=True,
+        help="skip --watch-fleet's north-preset oracle-parity leg "
+        "(minutes of churn) — fleet-only iteration",
+    )
+    parser.add_argument(
         "--multichip", nargs="?", const="MULTICHIP_churn.json",
         default=None, metavar="PATH",
         help="run the sharded-wave-loop churn ledger (ISSUE 18): the "
@@ -2289,6 +2740,35 @@ def main() -> None:
             "device_counts": v["device_counts"],
             "verdict": v,
             "artifact": args.multichip,
+        }))
+        sys.exit(0 if v["pass"] else 1)
+
+    if args.watch_fleet is not None:
+        import datetime
+
+        ledger = run_watch_fleet(n_watchers=args.fleet_watchers,
+                                 parity=args.fleet_parity)
+        ledger["date"] = datetime.date.today().isoformat()
+        # the no-artifact-no-verdict guard (same contract as --overload
+        # and the A/B ledgers): if the JSON cannot be written, refuse to
+        # print the verdict block and exit non-zero
+        try:
+            with open(args.watch_fleet, "w") as f:
+                json.dump(ledger, f, indent=1)
+                f.write("\n")
+        except OSError as e:
+            print(f"# REFUSING to print watch-fleet verdicts: artifact "
+                  f"write to {args.watch_fleet!r} failed ({e})",
+                  file=sys.stderr)
+            sys.exit(1)
+        v = ledger["verdict"]
+        print(json.dumps({
+            "metric": "watch-fleet-fanout-ratio",
+            "value": v["fanout_ratio_B_over_A"],
+            "unit": "x (B logical fan-out events/s vs A)",
+            "vs_baseline": v["min_ratio"],
+            "verdict": v,
+            "artifact": args.watch_fleet,
         }))
         sys.exit(0 if v["pass"] else 1)
 

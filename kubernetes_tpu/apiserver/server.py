@@ -626,6 +626,53 @@ def _make_handler(server: APIServer):
                         items = [i for i in items if get(i) == value]
             return items
 
+        def _compile_selectors(self, q):
+            """Parse label/field selectors ONCE into a per-object
+            predicate for the watch stream (the LIST path keeps
+            :meth:`_apply_list_selectors`, which filters a materialized
+            list).  Returns (pred-or-None, error-or-None): pred=None
+            with no error means no selectors; an error string means a
+            malformed selector the caller must 400."""
+            label_sel = q.get("labelSelector", [None])[0]
+            field_sel = q.get("fieldSelector", [None])[0]
+            tests = []
+            if label_sel:
+                from ..api.selectors import parse_selector_string
+
+                try:
+                    sel = parse_selector_string(label_sel)
+                except ValueError as e:
+                    return None, f"bad labelSelector: {e}"
+                tests.append(lambda i, _s=sel: _s.matches(
+                    (i.get("metadata") or {}).get("labels") or {}))
+            if field_sel:
+                import re as _re
+
+                getters = {
+                    "spec.nodeName": lambda i: (i.get("spec") or {}).get("nodeName") or "",
+                    "metadata.name": lambda i: (i.get("metadata") or {}).get("name"),
+                    "metadata.namespace": lambda i: (i.get("metadata") or {}).get("namespace"),
+                    "status.phase": lambda i: (i.get("status") or {}).get("phase") or "",
+                }
+                for clause in field_sel.split(","):
+                    m = _re.fullmatch(r"([^=!]+?)\s*(==|!=|=)\s*(.*)",
+                                      clause.strip())
+                    if m is None:
+                        return None, f"bad fieldSelector clause {clause!r}"
+                    key, op, value = m.group(1), m.group(2), m.group(3)
+                    get = getters.get(key)
+                    if get is None:
+                        return None, f"unsupported fieldSelector {key!r}"
+                    if op == "!=":
+                        tests.append(lambda i, _g=get, _v=value: _g(i) != _v)
+                    else:  # '=' and '==' are the same operator
+                        tests.append(lambda i, _g=get, _v=value: _g(i) == _v)
+            if not tests:
+                return None, None
+            if len(tests) == 1:
+                return tests[0], None
+            return (lambda i, _t=tuple(tests): all(t(i) for t in _t)), None
+
         def _serve_patch(self, kind: str, ns: str, name: str) -> None:
             """The PATCH verb (reference ``handlers/rest.go`` PatchResource):
             patch type negotiated via Content-Type, applied server-side
@@ -1293,21 +1340,25 @@ def _make_handler(server: APIServer):
 
         # -- watch streaming (handlers/rest.go:276 watch upgrade) ----------
         def _serve_watch(self, kind: str, q) -> None:
-            from ..store.frames import FRAME
+            from ..store.frames import FRAME, event_wire_bytes
 
             from_rev = None
             if "resourceVersion" in q:
                 from_rev = int(q["resourceVersion"][0])
             timeout = float(q.get("timeoutSeconds", ["30"])[0])
-            has_selectors = bool(q.get("labelSelector") or q.get("fieldSelector"))
-            if has_selectors and self._apply_list_selectors([], q) is None:
-                return  # bad selector: 400 written BEFORE the stream starts
-            # column-packed frame delivery (?frames=1): one JSON line per
-            # correlated batch txn instead of N.  Selector watches stay
-            # per-event — the stream filter below is per-object, and a
-            # partially-matching frame would have to be re-packed anyway
-            want_frames = (q.get("frames", ["0"])[0] in ("1", "true")
-                           and not has_selectors)
+            # selectors compile ONCE per stream into a predicate (the
+            # old shape reparsed them per event per client); a malformed
+            # selector 400s BEFORE the stream starts
+            pred, sel_err = self._compile_selectors(q)
+            if sel_err is not None:
+                return self._error(400, "BadRequest", sel_err)
+            # column-packed frame delivery (?frames=1): one JSON line
+            # per correlated batch txn instead of N.  Selector watches
+            # get frames too (ISSUE 19): the predicate filters at the
+            # COLUMN level and a matching sub-frame is re-packed before
+            # encoding — per-event JSON lines only for clients that
+            # never opted into frames
+            want_frames = q.get("frames", ["0"])[0] in ("1", "true")
             watch = server.store.watch(kind, from_revision=from_rev,
                                        frames=want_frames)
             try:
@@ -1324,32 +1375,29 @@ def _make_handler(server: APIServer):
                     if ev is None:
                         continue
                     if ev.type == FRAME:
-                        # one chunked line carries the whole frame (only
-                        # possible when this watcher opted in above)
-                        self._write_chunk(
-                            json.dumps(ev.to_wire()).encode() + b"\n")
+                        frame = ev
+                        if pred is not None:
+                            # the LIST-then-WATCH contract at the column
+                            # level: keep matching entries, re-pack, and
+                            # stream the sub-frame (None = no entry
+                            # matched; the client's fence advances on
+                            # its next matching delivery)
+                            frame = ev.select([
+                                i for i, o in enumerate(ev.objects)
+                                if o is not None and pred(o)])
+                            if frame is None:
+                                continue
+                        # encoded ONCE per frame per revision and shared
+                        # across every streaming client (frames are
+                        # shared-immutable across watcher queues)
+                        self._write_chunk(frame.wire_bytes())
                         continue
-                    if has_selectors:
-                        # the LIST-then-WATCH contract: the same selectors
-                        # filter the event stream (a selector silently
-                        # ignored on watch would re-create the full-cluster
-                        # fan-out the selector exists to avoid)
-                        kept = self._apply_list_selectors([ev.object], q)
-                        if not kept:  # no match, or a bad selector (None)
-                            continue
-                    line = (
-                        json.dumps(
-                            {
-                                "type": ev.type,
-                                "kind": ev.kind,
-                                "key": ev.key,
-                                "revision": ev.revision,
-                                "object": ev.object,
-                            }
-                        ).encode()
-                        + b"\n"
-                    )
-                    self._write_chunk(line)
+                    if pred is not None and not pred(ev.object):
+                        # a selector silently ignored on watch would
+                        # re-create the full-cluster fan-out the
+                        # selector exists to avoid
+                        continue
+                    self._write_chunk(event_wire_bytes(ev))
                 self._end_chunks()
             except (BrokenPipeError, ConnectionResetError):
                 pass

@@ -135,7 +135,9 @@ class RemoteWatch:
                  opener, resource: str, metrics: Optional[ClientMetrics] = None,
                  min_backoff: float = 0.05, max_backoff: float = 2.0,
                  sleep: Callable[[float], None] = time.sleep,
-                 frames: bool = False):
+                 frames: bool = False,
+                 label_selector: Optional[str] = None,
+                 field_selector: Optional[str] = None):
         self._base = base_url
         self._resource = resource
         self._opener = opener
@@ -143,6 +145,12 @@ class RemoteWatch:
         # server ignores the parameter and streams per-event lines — the
         # read loop handles both shapes, so this is a pure opt-in.
         self._frames = frames
+        # server-side stream filtering (the LIST-then-WATCH selector
+        # contract); with frames=True the server re-packs matching
+        # sub-frames at the column level (ISSUE 19) instead of falling
+        # back to per-event lines
+        self._label_selector = label_selector
+        self._field_selector = field_selector
         self.metrics = metrics or ClientMetrics()
         self._min_backoff = min_backoff
         self._max_backoff = max_backoff
@@ -161,6 +169,14 @@ class RemoteWatch:
         url = f"{self._base}/api/v1/{self._resource}?watch=true&timeoutSeconds=5"
         if self._frames:
             url += "&frames=1"
+        if self._label_selector:
+            from urllib.parse import quote
+
+            url += f"&labelSelector={quote(self._label_selector)}"
+        if self._field_selector:
+            from urllib.parse import quote
+
+            url += f"&fieldSelector={quote(self._field_selector)}"
         if self._last_rev is not None:
             url += f"&resourceVersion={self._last_rev}"
         tr = tracing.current()
@@ -704,9 +720,13 @@ class RemoteStore:
         return out["errors"]
 
     def watch(self, kind: Optional[str] = None, from_revision: Optional[int] = None,
-              frames: bool = False) -> RemoteWatch:
+              frames: bool = False,
+              label_selector: Optional[str] = None,
+              field_selector: Optional[str] = None) -> RemoteWatch:
         if kind is None:
             raise RemoteError("remote watch requires a kind")
         return RemoteWatch(self.base_url, kind, from_revision, self._open,
                            self._resource(kind), metrics=self.metrics,
-                           sleep=self._sleep, frames=frames)
+                           sleep=self._sleep, frames=frames,
+                           label_selector=label_selector,
+                           field_selector=field_selector)

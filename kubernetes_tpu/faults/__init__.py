@@ -66,6 +66,11 @@ register("store.wal.append",
 register("store.commit",
          "store write commit (create/update/delete/bind_many) — error: "
          "the write fails before any state mutates (apiserver overload)")
+register("store.coalesce",
+         "coalescing-window flush at the broadcaster seam — error: the "
+         "framed flush path fails and THAT window degrades to per-event "
+         "delivery of the same folded events (state preserved, packing "
+         "lost, store_coalesce_fallbacks_total increments)")
 register("remote.request",
          "one HTTP request attempt in RemoteStore — error: transport "
          "failure; delay: slow apiserver")

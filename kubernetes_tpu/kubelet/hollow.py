@@ -955,3 +955,124 @@ class HollowFleet:
             for key in total:
                 total[key] += r[key]
         return total
+
+
+class HollowWatcher:
+    """Kubemark-shaped hollow WATCHER (the serving-tier analogue of
+    :class:`HollowKubelet`): a real watch stream feeding a minimal
+    informer cache (key → resourceVersion) with no controller
+    underneath.  Thread-cheap by construction — no thread, no typed
+    decode, no handler fan-out; the fleet driver pumps it cooperatively
+    — so 10k+ of them fit in one process, which is how many-client
+    fan-out behavior is tested on one machine (the kubemark trick,
+    applied to watch traffic instead of nodes).
+
+    Works over any watch with ``get(timeout)``/``stop()`` and the
+    event/frame duck types: the in-process ``Store.watch`` queue or a
+    ``RemoteWatch`` HTTP stream.  Applies the same revision fence as
+    ``SharedInformer`` (stale deliveries skipped), so its final cache is
+    exactly the state-equivalence surface the fleet bench gates on."""
+
+    __slots__ = ("id", "watch", "cache", "applied_rev", "deliveries",
+                 "event_units", "gaps", "tracker")
+
+    def __init__(self, client_id: str, watch, tracker=None):
+        from ..utils.fanout import WatchFanoutTracker  # noqa: F401 (typing aid)
+
+        self.id = client_id
+        self.watch = watch
+        # bounded: one int per live object key (the hollow informer cache)
+        self.cache: dict = {}
+        self.applied_rev = 0
+        self.deliveries = 0   # queue items consumed (a frame counts 1)
+        self.event_units = 0  # events represented (a frame counts len())
+        self.gaps = 0
+        self.tracker = tracker
+        if tracker is not None:
+            tracker.register(client_id)
+
+    def pump(self, budget: Optional[int] = None) -> int:
+        """Drain up to ``budget`` queued deliveries (None = everything
+        waiting) and report the applied revision to the tracker once per
+        pump, not per item — the fan-out hot path stays two dict ops."""
+        from ..store.frames import FRAME
+        from ..store.store import DELETED, WATCH_GAP
+
+        n = 0
+        while budget is None or n < budget:
+            item = self.watch.get(timeout=0)
+            if item is None:
+                break
+            t = item.type
+            if t == FRAME:
+                fence = self.applied_rev
+                for i in range(len(item.keys)):
+                    rev = item.revisions[i]
+                    if rev <= fence:
+                        continue  # straggler inside a superseded frame
+                    if item.types[i] == DELETED:
+                        self.cache.pop(item.keys[i], None)
+                    else:
+                        self.cache[item.keys[i]] = rev
+                if item.revision > self.applied_rev:
+                    self.applied_rev = item.revision
+                self.event_units += len(item.keys)
+            elif t == WATCH_GAP:
+                # continuity lost (410 analogue): a hollow watcher has no
+                # lister to rebuild from — count it; the fleet bench
+                # treats any gapped client as dropped-state
+                self.gaps += 1
+            else:
+                if item.revision <= self.applied_rev:
+                    n += 1
+                    continue  # revision fence, as SharedInformer applies it
+                if t == DELETED:
+                    self.cache.pop(item.key, None)
+                else:
+                    self.cache[item.key] = item.revision
+                self.applied_rev = item.revision
+                self.event_units += 1
+            self.deliveries += 1
+            n += 1
+        if n and self.tracker is not None:
+            self.tracker.report(self.id, self.applied_rev)
+        return n
+
+    def stop(self) -> None:
+        self.watch.stop()
+        if self.tracker is not None:
+            self.tracker.unregister(self.id)
+
+
+class HollowWatcherFleet:
+    """N hollow watchers on one watch source — the many-client axis of
+    the serving-tier bench.  ``source`` is anything with
+    ``watch(kind, frames=...)`` (a ``Store`` or a ``RemoteStore``); the
+    caller drives ``pump_all`` from however many threads it wants (the
+    watchers are partitionable by slice — no shared mutable state
+    between them beyond the tracker's locked dict)."""
+
+    def __init__(self, source, n: int, kind: str = "Pod",
+                 frames: bool = True, tracker=None, prefix: str = "hw",
+                 from_revision: Optional[int] = None):
+        self.tracker = tracker
+        self.watchers = [
+            HollowWatcher(
+                f"{prefix}-{i:05d}",
+                source.watch(kind, from_revision=from_revision,
+                             frames=frames),
+                tracker,
+            )
+            for i in range(n)
+        ]
+
+    def pump_all(self, budget: Optional[int] = None) -> int:
+        return sum(w.pump(budget) for w in self.watchers)
+
+    def converged(self, head: int) -> int:
+        """How many watchers have applied everything up to ``head``."""
+        return sum(1 for w in self.watchers if w.applied_rev >= head)
+
+    def stop_all(self) -> None:
+        for w in self.watchers:
+            w.stop()

@@ -44,6 +44,13 @@ from typing import Iterator, Optional
 # dormant — they only ever see plain WatchEvents)
 ENABLED = True
 
+# module seam for the single-encode fan-out A/B (bench.py --watch-fleet):
+# True (default) serializes each frame/event wire payload ONCE and shares
+# the encoded bytes across every HTTP watcher streaming it; False
+# restores the pre-serving-tier shape where every client pays its own
+# json.dumps per delivery.
+SHARED_ENCODE = True
+
 # WatchFrame.type value: a transport framing marker, not a state
 # transition (like WATCH_GAP).  Consumers that dispatch on event type
 # must expand the frame (``events()``) or apply it as a batch.
@@ -65,7 +72,7 @@ class WatchFrame:
     """
 
     __slots__ = ("kind", "types", "keys", "revisions", "prev_revisions",
-                 "objects", "txn", "_node_names")
+                 "objects", "txn", "_node_names", "_wire_b")
 
     # duck-typed dispatch marker (``ev.type == FRAME``) for consumers
     # that pull mixed WatchEvent/WatchFrame items off one watch queue
@@ -88,6 +95,7 @@ class WatchFrame:
         # so one trace shows the store→informer→confirm propagation
         self.txn = txn
         self._node_names: Optional[list] = None
+        self._wire_b: Optional[bytes] = None
 
     def __len__(self) -> int:
         return len(self.keys)
@@ -108,6 +116,45 @@ class WatchFrame:
             got = self._node_names = [
                 (o.get("spec") or {}).get("nodeName", "") if o else ""
                 for o in self.objects]
+        return got
+
+    def select(self, indices: list) -> Optional["WatchFrame"]:
+        """Column-level sub-frame: keep only the entries at ``indices``
+        (ascending, as produced by a selector filter walk), sharing the
+        payload dicts with this frame (shared-immutable, like every
+        other consumer).  Revision order — and therefore the per-frame
+        resourceVersion fence — is preserved by construction.  Returns
+        None for an empty selection: an all-filtered frame must not
+        reach the wire (``from_wire`` rejects empty frames; the client's
+        fence advances on its next matching delivery instead)."""
+        if not indices:
+            return None
+        if len(indices) == len(self.keys):
+            return self  # every entry matched: share the packed frame
+        prev = self.prev_revisions
+        return WatchFrame(
+            self.kind,
+            [self.types[i] for i in indices],
+            [self.keys[i] for i in indices],
+            [self.revisions[i] for i in indices],
+            [self.objects[i] for i in indices],
+            prev_revisions=None if prev is None else [prev[i] for i in indices],
+            txn=self.txn,
+        )
+
+    def wire_bytes(self) -> bytes:
+        """The frame's encoded watch line (wire form + newline), computed
+        once and shared across every streaming client (the single-encode
+        fan-out leg) while :data:`SHARED_ENCODE` is on.  Benign race by
+        design: two handler threads may both encode the same frame; the
+        bytes are identical and the last assignment wins."""
+        import json
+
+        if not SHARED_ENCODE:
+            return json.dumps(self.to_wire()).encode() + b"\n"
+        got = self._wire_b
+        if got is None:
+            got = self._wire_b = json.dumps(self.to_wire()).encode() + b"\n"
         return got
 
     def events(self) -> Iterator:
@@ -170,3 +217,29 @@ class WatchFrame:
             raise FrameDecodeError("frame txn id must be a string")
         return cls(kind, list(types), list(keys), revisions, list(objects),
                    prev_revisions=prev, txn=txn)
+
+
+def event_wire_bytes(ev) -> bytes:
+    """Encoded watch line for one plain :class:`~.store.WatchEvent`
+    (wire form + newline), computed once per event and shared across
+    every streaming client while :data:`SHARED_ENCODE` is on.  The cache
+    rides the event object itself (``object.__setattr__`` through the
+    frozen dataclass): events are shared-immutable across all watcher
+    queues, so the first client to encode pays and the rest reuse.
+    Benign race: concurrent encoders produce identical bytes."""
+    import json
+
+    if SHARED_ENCODE:
+        got = getattr(ev, "_wire_b", None)
+        if got is not None:
+            return got
+    line = json.dumps({
+        "type": ev.type,
+        "kind": ev.kind,
+        "key": ev.key,
+        "revision": ev.revision,
+        "object": ev.object,
+    }).encode() + b"\n"
+    if SHARED_ENCODE:
+        object.__setattr__(ev, "_wire_b", line)
+    return line

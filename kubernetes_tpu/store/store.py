@@ -29,12 +29,14 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
 from .. import faults
 from ..api.meta import new_uid
 from ..utils import tracing
+from ..utils.metrics import DEFAULT_STORE_METRICS
 
 
 def _py_fast_deepcopy(obj):
@@ -136,12 +138,34 @@ class Watch:
             return None
 
 
+class _PendingBatch:
+    """One open coalescing window at the broadcaster seam: per-key
+    latest-wins fold of single-event churn awaiting one framed flush.
+
+    ``latest`` maps (kind, key) → the newest buffered event for that
+    object; a fold deletes-and-reinserts so dict order tracks each
+    key's LATEST commit — the flush frame's revision column is strictly
+    increasing by construction (the ``from_wire`` invariant).  WAL, the
+    event log, and replication all stay per-event at commit time; ONLY
+    live watcher delivery waits for the window."""
+
+    __slots__ = ("latest", "deadline", "txn", "folded")
+
+    def __init__(self, deadline: float, txn: str):
+        self.latest: "collections.OrderedDict[tuple, WatchEvent]" = (
+            collections.OrderedDict())
+        self.deadline = deadline
+        self.txn = txn
+        self.folded = 0  # deliveries superseded inside this window
+
+
 class Store:
     """In-process strongly-ordered object store (etcd3 + watch-cache analogue)."""
 
     def __init__(self, event_log_window: int = 100_000,
                  data_dir: Optional[str] = None, fsync: bool = False,
-                 compact_every: int = 100_000, transformer=None):
+                 compact_every: int = 100_000, transformer=None,
+                 coalesce_window_s: float = 0.0):
         self._mu = threading.RLock()
         self._rev = 0
         # kind -> {key -> _Item}
@@ -156,6 +180,25 @@ class Store:
         # in via watch(frames=True) receive one WatchFrame per correlated
         # batch txn; everyone else gets the per-event expansion
         self._watchers: list[tuple[Optional[str], "queue.Queue[Optional[WatchEvent]]", bool]] = []
+        # time-window update coalescing (the serving-tier broadcaster
+        # seam): 0.0 (default) = off, every event fans out at commit;
+        # > 0 = single-event update/delete churn is folded per key
+        # (latest wins) and flushed as ONE synthetic WatchFrame per kind
+        # when the window closes.  Batch txns (_emit_many), new watcher
+        # registration, and snapshot installs are ordering barriers that
+        # flush the open window first.
+        self._coalesce_window = float(coalesce_window_s or 0.0)
+        self._coalesce_max_keys = 10_000
+        self._pending: Optional[_PendingBatch] = None
+        self._coalesce_closed = False
+        self._coalesce_wake: Optional[threading.Event] = None
+        self._coalesce_thread: Optional[threading.Thread] = None
+        if self._coalesce_window > 0.0:
+            self._coalesce_wake = threading.Event()
+            self._coalesce_thread = threading.Thread(
+                target=self._coalesce_loop, name="store-coalesce",
+                daemon=True)
+            self._coalesce_thread.start()
         # durability (the etcd WAL+snapshot analogue, store/wal.py):
         # with a data_dir every committed event is logged before the call
         # returns, and a fresh Store over the same dir recovers the state
@@ -189,6 +232,11 @@ class Store:
             self._wal.write_snapshot(self._rev, objects)
 
     def close(self) -> None:
+        if self._coalesce_thread is not None:
+            self._coalesce_closed = True
+            self._coalesce_wake.set()
+            self._coalesce_thread.join(timeout=5.0)
+            self.flush_coalesced()  # nothing buffered outlives the store
         if self._wal is not None:
             self._wal.close()
 
@@ -477,6 +525,9 @@ class Store:
         """Replace state wholesale (raft InstallSnapshot analogue): used
         when a rejoining replica is older than the leader's log window."""
         with self._mu:
+            # pending events precede the snapshot: deliver them before
+            # the state jump (watchers older than the snapshot relist)
+            self._flush_pending_locked()
             self._objects = {
                 kind: {key: _Item(data=_fast_deepcopy(data),
                                   revision=data["metadata"].get("resourceVersion", rev))
@@ -542,6 +593,11 @@ class Store:
         ONE :class:`~.frames.WatchFrame` instead of N events (the log
         replay below stays per-event — only live batches frame)."""
         with self._mu:
+            # ordering barrier: flush the open coalescing window before
+            # the log replay below — otherwise the replay (which reads
+            # the per-event log, where buffered events already live)
+            # would be followed by a flush frame re-delivering them
+            self._flush_pending_locked()
             q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
             if from_revision is not None and from_revision < self._rev:
                 oldest = self._log[0].revision if self._log else self._rev + 1
@@ -582,9 +638,124 @@ class Store:
         # the mutation detector catches violations in tests).
         self._append_log(ev)
         self._replicate(ev)
+        if self._coalesce_window > 0.0:
+            # durability and the replay window are already per-event
+            # (above); only LIVE delivery waits for the window.  Without
+            # coalescing an event committed before watch() registration
+            # is not delivered live either, so skipping the buffer when
+            # nobody watches changes nothing (watch() replays the log).
+            if self._watchers:
+                self._buffer_event(ev)
+            return
         for kind, q, _frames in self._watchers:
             if kind is None or kind == ev.kind:
                 q.put(ev)
+
+    # -- time-window coalescing (the serving-tier broadcaster seam) --------
+    def _buffer_event(self, ev: WatchEvent) -> None:
+        """Fold one committed event into the open window (opening one if
+        needed).  Caller holds the store lock."""
+        p = self._pending
+        if p is None:
+            p = self._pending = _PendingBatch(
+                time.monotonic() + self._coalesce_window,
+                tracing.next_txn("coalesce"))
+            self._coalesce_wake.set()
+        k = (ev.kind, ev.key)
+        if k in p.latest:
+            # latest wins: the superseded delivery is dropped, and the
+            # key moves to the tail so the flush frame's revision column
+            # stays strictly increasing (each key sorted by its LATEST
+            # commit, which is also this window's arrival order)
+            del p.latest[k]
+            p.folded += 1
+        p.latest[k] = ev
+        # bounded: hard per-window key cap — the window flushes inline
+        # before the pending dict can outgrow it
+        if len(p.latest) >= self._coalesce_max_keys:
+            self._flush_pending_locked()
+
+    def flush_coalesced(self) -> None:
+        """Deliver the open coalescing window NOW — the flusher thread's
+        deadline path, an ordering barrier, or an explicit test/shutdown
+        flush."""
+        with self._mu:
+            self._flush_pending_locked()
+
+    def _flush_pending_locked(self) -> None:
+        p = self._pending
+        if p is None:
+            return
+        self._pending = None
+        events = list(p.latest.values())
+        if not events:
+            return
+        from . import frames as frames_mod
+
+        m = DEFAULT_STORE_METRICS
+        m.coalesce_flushes.inc()
+        if p.folded:
+            m.coalesced_events.inc(p.folded)
+        by_kind: dict[str, list[WatchEvent]] = {}
+        for ev in events:
+            by_kind.setdefault(ev.kind, []).append(ev)
+        # synthetic frames carry NO prev_revisions (fold hides the
+        # intermediate transitions, so the pre-transition revision is
+        # honestly unknown — consumers take the per-object fallback
+        # compare, exactly the plain-update CAS semantics); the fence
+        # (frame.revision = last entry) is exact as ever
+        frames_by_kind: dict[str, object] = {}
+        try:
+            faults.hit("store.coalesce", n=len(events), folded=p.folded)
+            if frames_mod.ENABLED:
+                for kind, evs in by_kind.items():
+                    if len(evs) > 1:
+                        frames_by_kind[kind] = frames_mod.WatchFrame(
+                            kind,
+                            [e.type for e in evs],
+                            [e.key for e in evs],
+                            [e.revision for e in evs],
+                            [e.object for e in evs],
+                            prev_revisions=None,
+                            txn=p.txn,
+                        )
+        except Exception:  # noqa: BLE001 - degrade, never drop state
+            # flush-path failure (injected or real): this window falls
+            # back to per-event delivery of the SAME folded events — the
+            # state every consumer converges to is identical, only the
+            # packing is lost
+            frames_by_kind = {}
+            m.coalesce_fallbacks.inc()
+        for wkind, q, wants_frames in self._watchers:
+            for kind, evs in by_kind.items():
+                if wkind is not None and wkind != kind:
+                    continue
+                frame = frames_by_kind.get(kind) if wants_frames else None
+                if frame is not None:
+                    q.put(frame)
+                else:
+                    for ev in evs:
+                        q.put(ev)
+
+    def _coalesce_loop(self) -> None:
+        """Daemon flusher: parked until a window opens, then sleeps out
+        the deadline and flushes.  Never holds the store lock while
+        sleeping."""
+        while True:
+            self._coalesce_wake.wait()  # blocking-ok — daemon flusher parked until a window opens
+            self._coalesce_wake.clear()
+            if self._coalesce_closed:
+                return
+            while not self._coalesce_closed:
+                with self._mu:
+                    p = self._pending
+                    delay = 0.0 if p is None else p.deadline - time.monotonic()
+                if p is None:
+                    break
+                if delay > 0:
+                    time.sleep(delay)  # blocking-ok — outside the lock, bounded by coalesce_window_s
+                    continue
+                self.flush_coalesced()
 
     def _emit_many(self, events: list[WatchEvent],
                    prev_revisions: Optional[list[int]] = None,
@@ -598,6 +769,10 @@ class Store:
         event sequence they always did."""
         if not events:
             return
+        # ordering barrier: a batch txn fans out at commit, so anything
+        # buffered in an open coalescing window must reach the queues
+        # first — watchers see revisions in order, no fence violations
+        self._flush_pending_locked()
         for ev in events:
             self._append_log(ev)
             self._replicate(ev)

@@ -265,11 +265,50 @@ class ClientMetrics:
             "client_retry_after_honored_total",
             "retry sleeps that honored a server Retry-After header "
             "(clamped to the client's max backoff, jitter preserved)"))
+        # serving tier (ISSUE 19): per-CLIENT staleness attribution of
+        # the watch-fanout SLO — the WORST client's revision lag behind
+        # the store head, sampled every scrape by WatchFanoutTracker
+        # (gauge, not counter: it keeps producing data — and can
+        # recover — while the fleet idles, the GaugeSLI property)
+        self.watch_worst_staleness = r.register(Gauge(
+            "client_watch_worst_staleness_revisions",
+            "largest per-client revision lag behind the store head at "
+            "the last fan-out staleness sample (0 = every watcher "
+            "caught up)"))
 
 
 # informers without an explicit metrics object aggregate here: one place
 # to ask "did anything relist / drop / leak handler errors this process"
 DEFAULT_CLIENT_METRICS = ClientMetrics()
+
+
+class StoreMetrics:
+    """Broadcaster-side observability (the serving tier): the
+    time-window coalescer's flushes, folds, and flush-path fallbacks.
+    The fault matrix asserts recovery through
+    ``store_coalesce_fallbacks_total`` — a degraded window that is
+    invisible here fails the test."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.coalesce_flushes = r.register(Counter(
+            "store_coalesce_flushes_total",
+            "coalescing windows flushed to the watcher queues (deadline, "
+            "ordering barrier, key cap, or shutdown)"))
+        self.coalesced_events = r.register(Counter(
+            "store_coalesced_events_total",
+            "per-key deliveries superseded inside a coalescing window "
+            "(latest-wins folds — fan-out work that never happened)"))
+        self.coalesce_fallbacks = r.register(Counter(
+            "store_coalesce_fallbacks_total",
+            "coalescing windows degraded to per-event delivery after a "
+            "flush-path failure (state preserved, packing lost)"))
+
+
+# stores aggregate here (one broadcaster seam per process in practice);
+# the fleet bench scrapes this registry alongside the client one
+DEFAULT_STORE_METRICS = StoreMetrics()
 
 
 class SchedulerMetrics:

@@ -150,6 +150,39 @@ DEFAULT_SLOS = [
 ]
 
 
+def serving_slos(worst_lag_revisions: float = 500.0) -> list[SLO]:
+    """SLOs over the serving tier's per-CLIENT attribution gauge — the
+    caveat the mesh PR left open ("per-CLIENT attribution still waits
+    for the serving-tier tentpole") closes here: the fleet's WORST
+    watcher gets a first-class signal instead of hiding in the
+    cluster-wide gap ratio.  A GaugeSLI for the same reason as
+    ``mesh_slos()``: it keeps producing samples (and can recover) while
+    churn idles; ``worst_lag_revisions`` is the lag the budget is graded
+    against (the bench compresses it along with the windows)."""
+    return [
+        SLO(name="watch_fanout_worst_client_staleness",
+            sli=GaugeSLI(
+                metric="client_watch_worst_staleness_revisions",
+                threshold=worst_lag_revisions)),
+    ]
+
+
+#: breach-context providers by SLO name (``register_breach_context``):
+#: a provider's dict rides the flight-recorder dump when that SLO
+#: breaches — the serving tier attaches its top-K laggard attribution
+#: here.  Module-level and lock-free by the evaluator's single-threaded
+#: contract (providers are registered at wiring time, read on the
+#: scraper thread).
+_BREACH_CONTEXT: dict = {}
+
+
+def register_breach_context(slo_name: str, provider) -> None:
+    """Attach ``provider`` (a zero-arg callable returning a JSON-shaped
+    dict) to ``slo_name``: its output is included in the flight-recorder
+    dump fired when that SLO breaches.  Last registration wins."""
+    _BREACH_CONTEXT[slo_name] = provider
+
+
 def mesh_slos() -> list[SLO]:
     """SLOs over the per-shard attribution gauges the sharded wave loop
     exports — this lands the per-shard SLO caveat left open when the
@@ -241,9 +274,23 @@ class BurnRateEvaluator:
         try:
             window = {track: self.store.query(track, slo.slow_window_s)
                       for track in slo.sli.tracks()}
+            extra = {}
+            provider = _BREACH_CONTEXT.get(slo.name)
+            if provider is not None:
+                # per-SLO attribution (the serving tier's top-K laggard
+                # dump): a provider failure must not lose the dump — the
+                # outer except already guards, but keep the window even
+                # when only the context breaks
+                try:
+                    extra["context"] = provider()
+                except Exception:  # noqa: BLE001
+                    import logging
+
+                    logging.getLogger("kubernetes_tpu.slo").exception(
+                        "SLO breach context provider failed (dump kept)")
             tr.dump(f"slo:{slo.name}", fast_burn=ev["fast_burn"],
                     slow_burn=ev["slow_burn"], objective=slo.objective,
-                    window=window)
+                    window=window, **extra)
         except Exception:  # noqa: BLE001
             import logging
 

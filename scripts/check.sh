@@ -15,6 +15,11 @@
 #      (virtual CPU devices — catches sharding regressions without
 #      hardware; the forced-tie backend parity test plus the uneven-N
 #      padding gate).
+#   6. a hollow-watcher fleet smoke: ~200 watchers for a couple of
+#      seconds through the serving tier (coalescing window + framed
+#      delivery + shared encode vs per-event), gating fan-out liveness,
+#      zero dropped-state clients, and the per-CLIENT staleness SLO
+#      evaluator sampling (burn + recover + laggard dump).
 #
 # Usage: scripts/check.sh [ktpu-analyze args...]
 # Extra args are forwarded to ktpu-analyze — e.g. `scripts/check.sh
@@ -41,3 +46,6 @@ echo "== forced-8-device mesh smoke =="
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m pytest tests/test_mesh.py -q -p no:cacheprovider \
     -k "sharded_backend or uneven_width"
+
+echo "== watch-fleet smoke =="
+python -m pytest tests/test_watch_fleet.py -q -p no:cacheprovider

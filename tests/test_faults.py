@@ -685,7 +685,7 @@ def _build_fleet(cs, clock):
 
 
 class World:
-    def __init__(self, data_dir=None, server=None):
+    def __init__(self, data_dir=None, server=None, store=None):
         self.clock = FakeClock()
         self.server = server
         if server is not None:
@@ -694,7 +694,7 @@ class World:
                 server, sleep=lambda s: _time.sleep(min(s, 0.02)))
             sched_store = self.remote
         else:
-            self.store = Store(data_dir=data_dir)
+            self.store = store if store is not None else Store(data_dir=data_dir)
             sched_store = self.store
         self.cs = Clientset(self.store)  # direct handle (fleet + workload)
         self.fleet = _build_fleet(self.cs, self.clock)
@@ -866,6 +866,12 @@ MATRIX = {
                   error_factory=lambda: ConnectionResetError("cut")),
         world="remote", exact=True,
         check=lambda w, plan: w.remote.metrics.watch_reconnects.value > 0),
+    # special-cased coalescing-window run (ISSUE 19): the broadcaster
+    # flushes through the coalescing seam for the WHOLE run; one flush
+    # faults and degrades to per-event delivery of the same folded
+    # events — nothing is requeued and no decision re-made (delivery
+    # only unpacks), so the pod→node map matches the oracle exactly
+    "store.coalesce": dict(world="coalesce"),
     # special-cased throttle-surge run (ISSUE 17): the apiserver's
     # overload admission gate answers 429 + Retry-After on create paths;
     # the client retries honoring the hint, the delayed pods re-decide,
@@ -1037,12 +1043,47 @@ def _run_admit_matrix(oracle_bindings):
         server.stop()
 
 
+def _run_coalesce_matrix(oracle_bindings):
+    """Scheduling over a COALESCING store (live delivery buffered into
+    bounded windows, flushed framed) with one flush failure injected:
+    that window degrades to per-event delivery of the same folded
+    events, the fallback counter records it, and the cluster converges
+    to the fault-free oracle's bindings exactly — the degradation
+    changes packing, never state or order."""
+    from kubernetes_tpu.utils.metrics import DEFAULT_STORE_METRICS
+
+    sm = DEFAULT_STORE_METRICS
+    fb0 = sm.coalesce_fallbacks.value
+    w = World(store=Store(coalesce_window_s=0.02))
+    plan = FaultPlan(seed=5).on("store.coalesce", mode="error", nth=1)
+    with plan.armed():
+        w.create_workload()
+        # realtime so the window deadline (wall clock, not the fake
+        # scheduler clock) actually closes between rounds
+        w.drive(realtime=True)
+    if not w.converged():
+        w.store.flush_coalesced()
+        w.drive(rounds=5, realtime=True)
+    assert w.converged(), "cluster never converged on a coalescing store"
+    assert plan.fired["store.coalesce"] == 1, "flush fault never fired"
+    assert sm.coalesce_fallbacks.value == fb0 + 1, (
+        "degradation not visible in store_coalesce_fallbacks_total")
+    # (the fallback-is-per-window, next-window-frames-again property is
+    # pinned at the store level in tests/test_coalesce.py)
+    assert w.bindings() == oracle_bindings, (
+        "coalesced delivery (with one degraded window) changed bindings")
+    w.store.close()
+
+
 @pytest.mark.parametrize("point", sorted(MATRIX))
 def test_fault_matrix_converges_to_oracle_bindings(point, oracle_bindings,
                                                   tmp_path):
     scenario = MATRIX[point]
     if scenario["world"] == "wal":
         _run_wal_matrix(tmp_path, oracle_bindings)
+        return
+    if scenario["world"] == "coalesce":
+        _run_coalesce_matrix(oracle_bindings)
         return
     if scenario["world"] == "telemetry":
         _run_telemetry_matrix(oracle_bindings)
